@@ -1,0 +1,38 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+A brand-new framework with the capabilities of Deeplearning4j (reference:
+codeinvento/deeplearning4j), designed TPU-first on JAX/XLA/Pallas:
+
+- configuration-driven sequential (``MultiLayerNetwork``) and DAG
+  (``ComputationGraph``) models compiled to single XLA executables,
+- pure-functional layers differentiated with ``jax.grad`` (no hand-written
+  backward passes — the reference pairs ``activate``/``backpropGradient`` by
+  hand, e.g. deeplearning4j-nn/.../nn/api/Layer.java:88),
+- optimizers as pure update transforms over parameter pytrees,
+- SPMD parallelism over ``jax.sharding.Mesh`` axes (data/model/pipeline)
+  instead of the reference's threaded ParallelWrapper + Spark/Aeron stack.
+
+Public API intentionally mirrors DL4J naming so a DL4J user can find their
+way around: ``NeuralNetConfiguration``, ``MultiLayerConfiguration``,
+``ComputationGraphConfiguration``, ``MultiLayerNetwork``, ``ComputationGraph``,
+``ParallelWrapper``, ``Evaluation``, ``EarlyStoppingConfiguration``, etc.
+"""
+
+from deeplearning4j_tpu.nn.config import (
+    NeuralNetConfiguration,
+    MultiLayerConfiguration,
+    ComputationGraphConfiguration,
+)
+from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
+from deeplearning4j_tpu.models.computation_graph import ComputationGraph
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "NeuralNetConfiguration",
+    "MultiLayerConfiguration",
+    "ComputationGraphConfiguration",
+    "MultiLayerNetwork",
+    "ComputationGraph",
+    "__version__",
+]

@@ -1,0 +1,205 @@
+"""Dataset fetchers + canonical iterators.
+
+Analogs of deeplearning4j-data/deeplearning4j-datasets fetchers
+(MnistDataFetcher, EmnistDataFetcher, IrisDataFetcher,
+TinyImageNetFetcher — SURVEY §2.3) and the iterator impls
+(MnistDataSetIterator, IrisDataSetIterator, ...).
+
+Network policy: this environment has zero egress, so fetchers look for
+locally cached raw files under ``DL4J_TPU_DATA_DIR`` (default
+``~/.deeplearning4j_tpu/data``) and otherwise generate a deterministic
+procedural stand-in with the same shapes/dtypes/class structure. The
+stand-in makes smoke tests and benchmarks runnable anywhere; real-data
+parity only needs the cache directory populated (same contract as the
+reference's ``CacheableExtractableDataSetFetcher``).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import (
+    ArrayDataSetIterator,
+    DataSet,
+    DataSetIterator,
+)
+
+DATA_DIR = os.environ.get("DL4J_TPU_DATA_DIR",
+                          os.path.expanduser("~/.deeplearning4j_tpu/data"))
+
+
+def _one_hot(idx: np.ndarray, n: int) -> np.ndarray:
+    out = np.zeros((idx.shape[0], n), np.float32)
+    out[np.arange(idx.shape[0]), idx] = 1.0
+    return out
+
+
+def _synthetic_image_classes(num: int, h: int, w: int, c: int, classes: int,
+                             seed: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic class-structured images: each class is a distinct
+    frequency/orientation pattern + noise, so models actually learn."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, classes, size=num)
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    images = np.empty((num, h, w, c), np.float32)
+    for k in range(classes):
+        mask = labels == k
+        n_k = int(mask.sum())
+        if n_k == 0:
+            continue
+        fx = 1.0 + (k % 5)
+        fy = 1.0 + (k // 5) % 5
+        base = np.sin(2 * np.pi * fx * xx / w + k) * \
+            np.cos(2 * np.pi * fy * yy / h)
+        pattern = np.repeat(base[:, :, None], c, axis=2)
+        noise = rng.normal(0, 0.3, size=(n_k, h, w, c)).astype(np.float32)
+        images[mask] = pattern[None] + noise
+    images = (images - images.min()) / (images.max() - images.min() + 1e-8)
+    return images.astype(np.float32), labels
+
+
+class MnistDataFetcher:
+    """Reads the canonical IDX-format files if cached locally, else builds
+    a synthetic 10-class 28x28 set (reference: MnistDataFetcher)."""
+
+    NUM_TRAIN = 60000
+    NUM_TEST = 10000
+
+    def __init__(self, train: bool = True, subset: Optional[int] = None,
+                 seed: int = 123):
+        self.train = train
+        self.subset = subset
+        self.seed = seed
+
+    def fetch(self) -> Tuple[np.ndarray, np.ndarray]:
+        base = os.path.join(DATA_DIR, "mnist")
+        prefix = "train" if self.train else "t10k"
+        img_path = os.path.join(base, f"{prefix}-images-idx3-ubyte.gz")
+        lbl_path = os.path.join(base, f"{prefix}-labels-idx1-ubyte.gz")
+        if os.path.exists(img_path) and os.path.exists(lbl_path):
+            images = self._read_idx_images(img_path)
+            labels = self._read_idx_labels(lbl_path)
+        else:
+            n = self.NUM_TRAIN if self.train else self.NUM_TEST
+            n = min(n, self.subset or n)
+            images4d, labels = _synthetic_image_classes(
+                n, 28, 28, 1, 10, self.seed + (0 if self.train else 1))
+            images = images4d.reshape(n, 784)
+        if self.subset:
+            images = images[:self.subset]
+            labels = labels[:self.subset]
+        return images.astype(np.float32), labels
+
+    @staticmethod
+    def _read_idx_images(path: str) -> np.ndarray:
+        with gzip.open(path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = np.frombuffer(f.read(), np.uint8)
+        return data.reshape(n, rows * cols).astype(np.float32) / 255.0
+
+    @staticmethod
+    def _read_idx_labels(path: str) -> np.ndarray:
+        with gzip.open(path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            return np.frombuffer(f.read(), np.uint8).astype(np.int64)
+
+
+class MnistDataSetIterator(DataSetIterator):
+    """(reference: MnistDataSetIterator) — yields flattened 784-float
+    features + one-hot 10 labels."""
+
+    def __init__(self, batch_size: int, train: bool = True,
+                 subset: Optional[int] = None, seed: int = 123,
+                 shuffle: bool = True):
+        images, labels = MnistDataFetcher(train, subset, seed).fetch()
+        ds = DataSet(images, _one_hot(labels, 10))
+        self._it = ArrayDataSetIterator(ds, batch_size, shuffle=shuffle,
+                                        seed=seed, drop_last=True)
+
+    def __iter__(self):
+        return iter(self._it)
+
+    def reset(self):
+        self._it.reset()
+
+    @property
+    def batch_size(self):
+        return self._it.batch_size
+
+
+class IrisDataSetIterator(DataSetIterator):
+    """(reference: IrisDataSetIterator) — the classic 150x4 set, generated
+    deterministically from the published means/stds when no cache exists."""
+
+    def __init__(self, batch_size: int = 150, seed: int = 6):
+        rng = np.random.default_rng(seed)
+        means = np.array([[5.0, 3.4, 1.5, 0.2],
+                          [5.9, 2.8, 4.3, 1.3],
+                          [6.6, 3.0, 5.6, 2.0]], np.float32)
+        stds = np.array([[0.35, 0.38, 0.17, 0.10],
+                         [0.52, 0.31, 0.47, 0.20],
+                         [0.64, 0.32, 0.55, 0.27]], np.float32)
+        feats, labels = [], []
+        for k in range(3):
+            feats.append(rng.normal(means[k], stds[k], size=(50, 4)))
+            labels.append(np.full(50, k))
+        x = np.concatenate(feats).astype(np.float32)
+        y = np.concatenate(labels)
+        perm = rng.permutation(150)
+        ds = DataSet(x[perm], _one_hot(y[perm], 3))
+        self._it = ArrayDataSetIterator(ds, batch_size)
+
+    def __iter__(self):
+        return iter(self._it)
+
+    def reset(self):
+        self._it.reset()
+
+    @property
+    def batch_size(self):
+        return self._it.batch_size
+
+
+class TinyImageNetFetcher:
+    """64x64x3, 200 classes (reference: TinyImageNetFetcher). Synthetic
+    fallback mirrors shapes/classes for benchmarks."""
+
+    H, W, C, CLASSES = 64, 64, 3, 200
+
+    def __init__(self, subset: int = 10000, seed: int = 7):
+        self.subset = subset
+        self.seed = seed
+
+    def fetch(self) -> Tuple[np.ndarray, np.ndarray]:
+        cache = os.path.join(DATA_DIR, "tinyimagenet", "train.npz")
+        if os.path.exists(cache):
+            z = np.load(cache)
+            return z["images"][:self.subset], z["labels"][:self.subset]
+        return _synthetic_image_classes(self.subset, self.H, self.W, self.C,
+                                        self.CLASSES, self.seed)
+
+
+class TinyImageNetDataSetIterator(DataSetIterator):
+    def __init__(self, batch_size: int, subset: int = 10000, seed: int = 7,
+                 num_classes: Optional[int] = None):
+        images, labels = TinyImageNetFetcher(subset, seed).fetch()
+        n_cls = num_classes or TinyImageNetFetcher.CLASSES
+        labels = labels % n_cls
+        ds = DataSet(images, _one_hot(labels, n_cls))
+        self._it = ArrayDataSetIterator(ds, batch_size, shuffle=True,
+                                        seed=seed, drop_last=True)
+
+    def __iter__(self):
+        return iter(self._it)
+
+    def reset(self):
+        self._it.reset()
+
+    @property
+    def batch_size(self):
+        return self._it.batch_size

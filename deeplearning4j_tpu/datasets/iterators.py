@@ -1,0 +1,168 @@
+"""Utility dataset iterators.
+
+Analogs of deeplearning4j-data/deeplearning4j-utility-iterators
+(SURVEY §2.3): AsyncDataSetIterator (background prefetch),
+MultipleEpochsIterator, EarlyTerminationDataSetIterator,
+DataSetIteratorSplitter, AsyncShieldDataSetIterator.
+
+The async prefetcher is the ETL/compute overlap mechanism: a host thread
+prepares the next minibatches while the TPU executes the current step
+(reference: AsyncDataSetIterator wraps fit's iterator at
+MultiLayerNetwork.java:1273). Combined with the jitted step's async
+dispatch, this keeps the device fed without an explicit infeed queue.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, DataSetIterator
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch with a bounded queue (reference:
+    AsyncDataSetIterator, default queue size 8)."""
+
+    _SENTINEL = object()
+
+    def __init__(self, base: DataSetIterator, queue_size: int = 8):
+        self.base = base
+        self.queue_size = queue_size
+
+    def __iter__(self) -> Iterator[DataSet]:
+        q: queue.Queue = queue.Queue(maxsize=self.queue_size)
+        error = []
+
+        def worker():
+            try:
+                for batch in self.base:
+                    q.put(batch)
+            except BaseException as e:  # propagate to consumer
+                error.append(e)
+            finally:
+                q.put(self._SENTINEL)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is self._SENTINEL:
+                break
+            yield item
+        t.join()
+        if error:
+            raise error[0]
+
+    def reset(self):
+        self.base.reset()
+
+    @property
+    def batch_size(self):
+        return self.base.batch_size
+
+
+class AsyncShieldDataSetIterator(DataSetIterator):
+    """Marks an iterator as not-async-safe (reference:
+    AsyncShieldDataSetIterator) — fit() will not wrap it."""
+
+    def __init__(self, base: DataSetIterator):
+        self.base = base
+
+    def __iter__(self):
+        return iter(self.base)
+
+    def reset(self):
+        self.base.reset()
+
+    @property
+    def async_supported(self):
+        return False
+
+    @property
+    def batch_size(self):
+        return self.base.batch_size
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Replays the base iterator N times as one pass (reference:
+    MultipleEpochsIterator)."""
+
+    def __init__(self, base: DataSetIterator, epochs: int):
+        self.base = base
+        self.epochs = epochs
+
+    def __iter__(self):
+        for e in range(self.epochs):
+            for batch in self.base:
+                yield batch
+            self.base.reset()
+
+    def reset(self):
+        self.base.reset()
+
+    @property
+    def batch_size(self):
+        return self.base.batch_size
+
+
+class EarlyTerminationDataSetIterator(DataSetIterator):
+    """Caps the number of minibatches per pass (reference:
+    EarlyTerminationDataSetIterator)."""
+
+    def __init__(self, base: DataSetIterator, max_batches: int):
+        self.base = base
+        self.max_batches = max_batches
+
+    def __iter__(self):
+        for i, batch in enumerate(self.base):
+            if i >= self.max_batches:
+                break
+            yield batch
+
+    def reset(self):
+        self.base.reset()
+
+    @property
+    def batch_size(self):
+        return self.base.batch_size
+
+
+class DataSetIteratorSplitter:
+    """Splits one iterator into train/test partitions by batch count
+    (reference: DataSetIteratorSplitter)."""
+
+    def __init__(self, base: DataSetIterator, total_batches: int,
+                 ratio: float):
+        self.base = base
+        self.n_train = int(total_batches * ratio)
+        self.total = total_batches
+
+    @property
+    def train_iterator(self) -> DataSetIterator:
+        return _SplitView(self.base, 0, self.n_train)
+
+    @property
+    def test_iterator(self) -> DataSetIterator:
+        return _SplitView(self.base, self.n_train, self.total)
+
+
+class _SplitView(DataSetIterator):
+    def __init__(self, base, lo, hi):
+        self.base, self.lo, self.hi = base, lo, hi
+
+    def __iter__(self):
+        for i, batch in enumerate(self.base):
+            if i >= self.hi:
+                break
+            if i >= self.lo:
+                yield batch
+        self.base.reset()
+
+    def reset(self):
+        self.base.reset()
+
+    @property
+    def batch_size(self):
+        return self.base.batch_size

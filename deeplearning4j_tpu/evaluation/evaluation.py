@@ -1,0 +1,286 @@
+"""Evaluation metrics.
+
+Analogs of the reference's eval package (deeplearning4j-nn/.../eval/):
+``Evaluation`` (accuracy/precision/recall/F1 + confusion matrix,
+Evaluation.java:88), ``RegressionEvaluation``, ``ROC``/``ROCBinary``
+(AUC via exact thresholding), ``EvaluationBinary``,
+``EvaluationCalibration``.
+
+Accumulation happens on host in numpy (cheap relative to inference);
+the model's forward pass that produces predictions is the jitted XLA path.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class Evaluation:
+    """Multi-class classification metrics over one-hot or index labels."""
+
+    def __init__(self, num_classes: Optional[int] = None,
+                 label_names: Optional[List[str]] = None):
+        self.num_classes = num_classes
+        self.label_names = label_names
+        self._confusion: Optional[np.ndarray] = None
+
+    def _ensure(self, n: int):
+        if self._confusion is None:
+            self.num_classes = self.num_classes or n
+            self._confusion = np.zeros((self.num_classes, self.num_classes),
+                                       dtype=np.int64)
+
+    def eval(self, labels, predictions, mask=None):
+        """labels: one-hot (N, C) or int (N,); predictions: prob (N, C).
+        Time-series (N, T, C) flattens with optional (N, T) mask — same
+        as the reference's evalTimeSeries."""
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if predictions.ndim == 3:
+            if mask is not None:
+                m = np.asarray(mask).reshape(-1).astype(bool)
+            labels = labels.reshape(-1, labels.shape[-1]) if labels.ndim == 3 \
+                else labels.reshape(-1)
+            predictions = predictions.reshape(-1, predictions.shape[-1])
+            if mask is not None:
+                labels = labels[m]
+                predictions = predictions[m]
+        pred_idx = np.argmax(predictions, axis=-1)
+        if labels.ndim == 2:
+            true_idx = np.argmax(labels, axis=-1)
+        else:
+            true_idx = labels.astype(np.int64)
+        self._ensure(predictions.shape[-1])
+        np.add.at(self._confusion, (true_idx, pred_idx), 1)
+
+    # ---- metrics --------------------------------------------------------
+    def accuracy(self) -> float:
+        c = self._confusion
+        return float(np.trace(c) / max(c.sum(), 1))
+
+    def _tp(self):
+        return np.diag(self._confusion).astype(np.float64)
+
+    def precision(self, cls: Optional[int] = None) -> float:
+        c = self._confusion
+        denom = c.sum(axis=0).astype(np.float64)
+        prec = np.divide(self._tp(), denom, out=np.zeros_like(denom),
+                         where=denom > 0)
+        if cls is not None:
+            return float(prec[cls])
+        present = c.sum(axis=1) > 0
+        return float(prec[present].mean()) if present.any() else 0.0
+
+    def recall(self, cls: Optional[int] = None) -> float:
+        c = self._confusion
+        denom = c.sum(axis=1).astype(np.float64)
+        rec = np.divide(self._tp(), denom, out=np.zeros_like(denom),
+                        where=denom > 0)
+        if cls is not None:
+            return float(rec[cls])
+        present = denom > 0
+        return float(rec[present].mean()) if present.any() else 0.0
+
+    def f1(self, cls: Optional[int] = None) -> float:
+        p = self.precision(cls)
+        r = self.recall(cls)
+        return 2 * p * r / (p + r) if (p + r) > 0 else 0.0
+
+    def confusion_matrix(self) -> np.ndarray:
+        return self._confusion
+
+    def stats(self) -> str:
+        lines = [
+            "========================Evaluation Metrics========================",
+            f" # of classes:    {self.num_classes}",
+            f" Accuracy:        {self.accuracy():.4f}",
+            f" Precision:       {self.precision():.4f}",
+            f" Recall:          {self.recall():.4f}",
+            f" F1 Score:        {self.f1():.4f}",
+            "==================================================================",
+        ]
+        return "\n".join(lines)
+
+
+class RegressionEvaluation:
+    """Column-wise MSE/MAE/RMSE/R²/correlation (reference:
+    RegressionEvaluation.java)."""
+
+    def __init__(self, num_columns: Optional[int] = None):
+        self.n = 0
+        self._sum_sq = None
+        self._sum_abs = None
+        self._sum_label = None
+        self._sum_label_sq = None
+        self._sum_pred = None
+        self._sum_pred_sq = None
+        self._sum_lp = None
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels, np.float64)
+        predictions = np.asarray(predictions, np.float64)
+        if labels.ndim == 3:
+            labels = labels.reshape(-1, labels.shape[-1])
+            predictions = predictions.reshape(-1, predictions.shape[-1])
+            if mask is not None:
+                m = np.asarray(mask).reshape(-1).astype(bool)
+                labels, predictions = labels[m], predictions[m]
+        if self._sum_sq is None:
+            c = labels.shape[-1]
+            self._sum_sq = np.zeros(c)
+            self._sum_abs = np.zeros(c)
+            self._sum_label = np.zeros(c)
+            self._sum_label_sq = np.zeros(c)
+            self._sum_pred = np.zeros(c)
+            self._sum_pred_sq = np.zeros(c)
+            self._sum_lp = np.zeros(c)
+        err = predictions - labels
+        self.n += labels.shape[0]
+        self._sum_sq += (err ** 2).sum(axis=0)
+        self._sum_abs += np.abs(err).sum(axis=0)
+        self._sum_label += labels.sum(axis=0)
+        self._sum_label_sq += (labels ** 2).sum(axis=0)
+        self._sum_pred += predictions.sum(axis=0)
+        self._sum_pred_sq += (predictions ** 2).sum(axis=0)
+        self._sum_lp += (labels * predictions).sum(axis=0)
+
+    def mean_squared_error(self, col: int = 0) -> float:
+        return float(self._sum_sq[col] / max(self.n, 1))
+
+    def mean_absolute_error(self, col: int = 0) -> float:
+        return float(self._sum_abs[col] / max(self.n, 1))
+
+    def root_mean_squared_error(self, col: int = 0) -> float:
+        return float(np.sqrt(self.mean_squared_error(col)))
+
+    def r_squared(self, col: int = 0) -> float:
+        ss_tot = self._sum_label_sq[col] - self._sum_label[col] ** 2 / self.n
+        ss_res = self._sum_sq[col]
+        return float(1.0 - ss_res / max(ss_tot, 1e-12))
+
+    def pearson_correlation(self, col: int = 0) -> float:
+        n = self.n
+        cov = self._sum_lp[col] - self._sum_label[col] * self._sum_pred[col] / n
+        vl = self._sum_label_sq[col] - self._sum_label[col] ** 2 / n
+        vp = self._sum_pred_sq[col] - self._sum_pred[col] ** 2 / n
+        return float(cov / max(np.sqrt(vl * vp), 1e-12))
+
+    def average_mean_squared_error(self) -> float:
+        return float(self._sum_sq.mean() / max(self.n, 1))
+
+
+class ROC:
+    """Binary ROC/AUC + precision-recall (exact, threshold-free — the
+    reference's ROC.java with thresholdSteps=0 'exact' mode)."""
+
+    def __init__(self):
+        self._scores: List[np.ndarray] = []
+        self._labels: List[np.ndarray] = []
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 2 and labels.shape[-1] == 2:
+            labels = labels[:, 1]
+            predictions = predictions[:, 1]
+        labels = labels.reshape(-1)
+        predictions = predictions.reshape(-1)
+        if mask is not None:
+            m = np.asarray(mask).reshape(-1).astype(bool)
+            labels, predictions = labels[m], predictions[m]
+        self._labels.append(labels)
+        self._scores.append(predictions)
+
+    def calculate_auc(self) -> float:
+        y = np.concatenate(self._labels)
+        s = np.concatenate(self._scores)
+        order = np.argsort(-s, kind="mergesort")
+        y = y[order]
+        tps = np.cumsum(y)
+        fps = np.cumsum(1 - y)
+        tpr = tps / max(tps[-1], 1)
+        fpr = fps / max(fps[-1], 1)
+        return float(np.trapezoid(tpr, fpr))
+
+    def calculate_auprc(self) -> float:
+        y = np.concatenate(self._labels)
+        s = np.concatenate(self._scores)
+        order = np.argsort(-s, kind="mergesort")
+        y = y[order]
+        tps = np.cumsum(y)
+        precision = tps / np.arange(1, len(y) + 1)
+        recall = tps / max(tps[-1], 1)
+        return float(np.trapezoid(precision, recall))
+
+
+class ROCMultiClass:
+    """One-vs-all ROC per class (reference: ROCMultiClass.java)."""
+
+    def __init__(self):
+        self._rocs: Dict[int, ROC] = {}
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        for c in range(predictions.shape[-1]):
+            self._rocs.setdefault(c, ROC()).eval(
+                labels[..., c], predictions[..., c], mask)
+
+    def calculate_auc(self, cls: int) -> float:
+        return self._rocs[cls].calculate_auc()
+
+    def calculate_average_auc(self) -> float:
+        return float(np.mean([r.calculate_auc() for r in self._rocs.values()]))
+
+
+class EvaluationBinary:
+    """Per-output binary metrics for multi-label sigmoid outputs
+    (reference: EvaluationBinary.java)."""
+
+    def __init__(self, threshold: float = 0.5):
+        self.threshold = threshold
+        self._tp = None
+        self._fp = None
+        self._tn = None
+        self._fn = None
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels) > 0.5
+        preds = np.asarray(predictions) > self.threshold
+        if labels.ndim == 3:
+            labels = labels.reshape(-1, labels.shape[-1])
+            preds = preds.reshape(-1, preds.shape[-1])
+            if mask is not None:
+                m = np.asarray(mask).reshape(-1).astype(bool)
+                labels, preds = labels[m], preds[m]
+        elif mask is not None:
+            m = np.asarray(mask).reshape(-1).astype(bool)
+            labels, preds = labels[m], preds[m]
+        if self._tp is None:
+            c = labels.shape[-1]
+            self._tp = np.zeros(c, np.int64)
+            self._fp = np.zeros(c, np.int64)
+            self._tn = np.zeros(c, np.int64)
+            self._fn = np.zeros(c, np.int64)
+        self._tp += (labels & preds).sum(axis=0)
+        self._fp += (~labels & preds).sum(axis=0)
+        self._tn += (~labels & ~preds).sum(axis=0)
+        self._fn += (labels & ~preds).sum(axis=0)
+
+    def accuracy(self, col: int = 0) -> float:
+        total = self._tp[col] + self._fp[col] + self._tn[col] + self._fn[col]
+        return float((self._tp[col] + self._tn[col]) / max(total, 1))
+
+    def precision(self, col: int = 0) -> float:
+        d = self._tp[col] + self._fp[col]
+        return float(self._tp[col] / d) if d else 0.0
+
+    def recall(self, col: int = 0) -> float:
+        d = self._tp[col] + self._fn[col]
+        return float(self._tp[col] / d) if d else 0.0
+
+    def f1(self, col: int = 0) -> float:
+        p, r = self.precision(col), self.recall(col)
+        return 2 * p * r / (p + r) if (p + r) > 0 else 0.0

@@ -1,0 +1,163 @@
+"""Base model: shared fit/evaluate machinery for MultiLayerNetwork and
+ComputationGraph.
+
+Analog of the reference's ``Model``/``NeuralNetwork`` contracts
+(deeplearning4j-nn/.../nn/api/Model.java) and the shared parts of the fit
+loop (MultiLayerNetwork.fit at nn/multilayer/MultiLayerNetwork.java:1268):
+iterate minibatches, record ETL time, run the optimizer step, fire
+listeners. Here the optimizer step is one donated jitted function
+(optimize/solver.py) and 'workspaces' are XLA's memory plan.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, DataSetIterator
+from deeplearning4j_tpu.evaluation.evaluation import Evaluation, RegressionEvaluation
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+from deeplearning4j_tpu.optimize.solver import TrainState
+
+
+class BaseModel:
+    def __init__(self):
+        self.train_state: Optional[TrainState] = None
+        self.listeners: List[TrainingListener] = []
+        self._train_step = None
+        self._rng = None
+        self.epoch_count = 0
+        self._last_loss = None
+
+    # ---- to be provided by subclasses -----------------------------------
+    def init(self, seed: Optional[int] = None):
+        raise NotImplementedError
+
+    def _build_train_step(self):
+        raise NotImplementedError
+
+    def output(self, features, train: bool = False):
+        raise NotImplementedError
+
+    @property
+    def conf_global(self):
+        raise NotImplementedError
+
+    # ---- params ---------------------------------------------------------
+    @property
+    def params(self):
+        return self.train_state.params
+
+    @property
+    def model_state(self):
+        return self.train_state.model_state
+
+    def num_params(self) -> int:
+        leaves = jax.tree_util.tree_leaves(self.train_state.params)
+        return int(sum(np.prod(l.shape) for l in leaves))
+
+    def set_params(self, params):
+        self.train_state = self.train_state._replace(params=params)
+
+    def set_listeners(self, *listeners: TrainingListener):
+        self.listeners = list(listeners)
+        return self
+
+    def add_listeners(self, *listeners: TrainingListener):
+        self.listeners.extend(listeners)
+        return self
+
+    @property
+    def iteration_count(self) -> int:
+        return int(self.train_state.iteration)
+
+    # ---- fit loop -------------------------------------------------------
+    def fit(self, data, epochs: int = 1):
+        """fit(DataSet) / fit(DataSetIterator[, epochs]) — the reference's
+        MultiLayerNetwork.fit(DataSetIterator) hot loop."""
+        if self.train_state is None:
+            self.init()
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        if isinstance(data, DataSet):
+            self._fit_batch(data)
+            return self
+        iterator = data
+        for epoch in range(epochs):
+            for lst in self.listeners:
+                lst.on_epoch_start(self, self.epoch_count)
+            it_start = time.perf_counter()
+            for batch in iterator:
+                etl_ms = (time.perf_counter() - it_start) * 1000.0
+                self._fit_batch(batch, etl_ms=etl_ms)
+                it_start = time.perf_counter()
+            if isinstance(iterator, DataSetIterator):
+                iterator.reset()
+            for lst in self.listeners:
+                lst.on_epoch_end(self, self.epoch_count)
+            self.epoch_count += 1
+        return self
+
+    def _fit_batch(self, batch: DataSet, etl_ms: float = 0.0):
+        self._rng, step_key = jax.random.split(self._rng)
+        features = jnp.asarray(batch.features)
+        labels = jnp.asarray(batch.labels)
+        fmask = None if batch.features_mask is None else jnp.asarray(
+            batch.features_mask)
+        lmask = None if batch.labels_mask is None else jnp.asarray(
+            batch.labels_mask)
+        self.train_state, loss = self._train_step(
+            self.train_state, features, labels, fmask, lmask, step_key)
+        it = int(self.train_state.iteration)
+        for lst in self.listeners:
+            lst.iteration_done(self, it, self.epoch_count, loss, etl_ms,
+                               batch.num_examples())
+        self._last_loss = loss
+
+    def score(self, dataset: Optional[DataSet] = None) -> float:
+        """Loss on a dataset (reference: MultiLayerNetwork.score(DataSet)),
+        or the last training loss when called without arguments."""
+        if dataset is None:
+            if self._last_loss is None:
+                raise RuntimeError("no score yet: call fit() first or pass a"
+                                   " DataSet to score(dataset)")
+            return float(self._last_loss)
+        return float(self.compute_loss(dataset))
+
+    def compute_loss(self, dataset: DataSet):
+        raise NotImplementedError
+
+    def _output_for_eval(self, batch: DataSet):
+        """Inference with the batch's features mask threaded through (both
+        model classes accept mask=; CG uses it as the default input mask)."""
+        return self.output(batch.features, mask=batch.features_mask)
+
+    # ---- evaluation -----------------------------------------------------
+    def evaluate(self, iterator, evaluation: Optional[Evaluation] = None
+                 ) -> Evaluation:
+        e = evaluation or Evaluation()
+        single = isinstance(iterator, DataSet)
+        batches = [iterator] if single else iterator
+        for batch in batches:
+            preds = self._output_for_eval(batch)
+            e.eval(batch.labels, np.asarray(preds),
+                   mask=batch.labels_mask if batch.labels_mask is not None
+                   else batch.features_mask)
+        if not single and isinstance(iterator, DataSetIterator):
+            iterator.reset()
+        return e
+
+    def evaluate_regression(self, iterator) -> RegressionEvaluation:
+        e = RegressionEvaluation()
+        single = isinstance(iterator, DataSet)
+        batches = [iterator] if single else iterator
+        for batch in batches:
+            preds = self._output_for_eval(batch)
+            e.eval(batch.labels, np.asarray(preds), mask=batch.labels_mask)
+        if not single and isinstance(iterator, DataSetIterator):
+            iterator.reset()
+        return e

@@ -1,0 +1,252 @@
+"""ComputationGraph — arbitrary-DAG model (multi-input / multi-output).
+
+Analog of the reference's ``ComputationGraph``
+(deeplearning4j-nn/.../nn/graph/ComputationGraph.java:93 — init():377,
+topologicalSortOrder():1216, calcBackpropGradients:1947). Execution walks
+the topological order computed at config time; the whole DAG — every
+branch, merge, and loss — compiles to one XLA executable. Backprop in
+reverse topo order is replaced by ``jax.grad`` through the forward walk.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.models.base import BaseModel
+from deeplearning4j_tpu.nn.graph.config import ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.inputs import RecurrentType
+from deeplearning4j_tpu.nn.layers.base import LayerContext
+from deeplearning4j_tpu.optimize.solver import (
+    TrainState,
+    build_optimizer,
+    make_train_step,
+)
+
+
+class ComputationGraph(BaseModel):
+    def __init__(self, conf: ComputationGraphConfiguration):
+        super().__init__()
+        self.conf = conf
+        conf.resolve()
+        self._topo = conf.topological_order()
+        self._nodes = {n.name: n for n in conf.nodes}
+        self._layer_nodes = [n for n in conf.nodes if n.layer is not None]
+        self.layer_names = tuple(n.name for n in self._layer_nodes)
+        self._output_fn = None
+        self._loss_eval_fn = None
+
+    @property
+    def conf_global(self):
+        return self.conf.global_config
+
+    # ---- init -----------------------------------------------------------
+    def init(self, seed: Optional[int] = None):
+        g = self.conf.global_config
+        root = jax.random.PRNGKey(g.seed if seed is None else seed)
+        self._rng = jax.random.fold_in(root, 0x5eed)
+        params: Dict[str, Any] = {}
+        state: Dict[str, Any] = {}
+        for i, node in enumerate(self._layer_nodes):
+            it = self.conf.layer_input_type(node.name)
+            k = jax.random.fold_in(root, i)
+            layer = node.layer
+            params[node.name] = (layer.initialize(k, it)
+                                 if layer.has_params else {})
+            state[node.name] = layer.init_state(it)
+        tx = build_optimizer(
+            self.layer_names,
+            {n.name: n.layer.updater for n in self._layer_nodes},
+            {n.name: n.layer.frozen for n in self._layer_nodes},
+            g.updater,
+            g.gradient_normalization,
+        )
+        opt_state = tx.init(params)
+        self.train_state = TrainState(params, state, opt_state,
+                                      jnp.zeros((), jnp.int32))
+        self._tx = tx
+        return self
+
+    # ---- functional forward --------------------------------------------
+    def _walk(self, params, model_state, inputs: Dict[str, jnp.ndarray],
+              fmasks: Dict[str, Optional[jnp.ndarray]], train: bool, rng,
+              stop_before_loss: bool):
+        """Execute the DAG. Returns (activations dict, new_state).
+        When ``stop_before_loss`` the output layers' pre-activations are
+        stored for the fused-loss path."""
+        g = self.conf.global_config
+        acts: Dict[str, jnp.ndarray] = {}
+        for k, v in inputs.items():
+            v = jnp.asarray(v)
+            if g.compute_dtype == "bfloat16" and jnp.issubdtype(
+                    v.dtype, jnp.floating):
+                v = v.astype(jnp.bfloat16)
+            acts[k] = v
+        new_state = dict(model_state)
+        for li, name in enumerate(self._topo):
+            node = self._nodes[name]
+            xs = [acts[s] for s in node.inputs]
+            if node.layer is not None:
+                x = xs[0]
+                if node.preprocessor is not None:
+                    x = node.preprocessor.apply(x)
+                key = None if rng is None else jax.random.fold_in(rng, li)
+                it = self.conf.layer_input_type(name)
+                mask = None
+                if isinstance(it, RecurrentType):
+                    mask = fmasks.get(node.inputs[0])
+                    if mask is None:
+                        mask = fmasks.get("__default__")
+                ctx = LayerContext(train=train, rng=key, mask=mask)
+                lp = params.get(name, {})
+                if g.compute_dtype == "bfloat16":
+                    lp = jax.tree_util.tree_map(
+                        lambda a: a.astype(jnp.bfloat16)
+                        if jnp.issubdtype(a.dtype, jnp.floating) else a, lp)
+                is_output = name in self.conf.network_outputs
+                if is_output and stop_before_loss and hasattr(
+                        node.layer, "compute_loss"):
+                    acts[name] = (x, lp, ctx)  # defer to loss
+                    continue
+                y, s = node.layer.apply(lp, model_state.get(name, {}), x, ctx)
+                new_state[name] = s
+                acts[name] = y
+            else:
+                from deeplearning4j_tpu.nn.graph.vertices import (
+                    LastTimeStepVertex)
+                if isinstance(node.vertex, LastTimeStepVertex):
+                    m = fmasks.get(node.inputs[0])
+                    if m is None:
+                        m = fmasks.get("__default__")
+                    acts[name] = node.vertex.apply(*xs, mask=m)
+                else:
+                    acts[name] = node.vertex.apply(*xs)
+        return acts, new_state
+
+    def _loss(self, params, model_state, features, labels, fmasks, lmasks,
+              rng, iteration):
+        inputs = dict(zip(self.conf.network_inputs, features))
+        fm = {"__default__": fmasks[0] if fmasks else None}
+        for i, k in enumerate(self.conf.network_inputs):
+            fm[k] = fmasks[i] if fmasks and i < len(fmasks) else None
+        acts, new_state = self._walk(params, model_state, inputs, fm, True,
+                                     rng, stop_before_loss=True)
+        any_leaf = jax.tree_util.tree_leaves(params)
+        acc = (jnp.promote_types(jnp.float32, any_leaf[0].dtype)
+               if any_leaf else jnp.float32)
+        total = jnp.zeros((), acc)
+        for i, out_name in enumerate(self.conf.network_outputs):
+            node = self._nodes[out_name]
+            entry = acts[out_name]
+            label = labels[i]
+            lmask = lmasks[i] if lmasks and i < len(lmasks) else None
+            if isinstance(entry, tuple) and hasattr(node.layer, "compute_loss"):
+                x, lp, ctx = entry
+                if lmask is not None:
+                    ctx = dataclasses.replace(ctx, mask=lmask)
+                loss = node.layer.compute_loss(
+                    lp, model_state.get(out_name, {}), x, label, ctx)
+            else:
+                raise TypeError(f"output node '{out_name}' is not a loss-"
+                                "bearing layer")
+            total = total + loss.astype(acc)
+        for n in self._layer_nodes:
+            total = total + n.layer.regularization_loss(params.get(n.name, {}))
+        return total, new_state
+
+    def _build_train_step(self):
+        def loss_fn(params, model_state, features, labels, fmask, lmask, rng,
+                    iteration):
+            # features/labels arrive as tuples (multi-input safe)
+            return self._loss(params, model_state, features, labels, fmask,
+                              lmask, rng, iteration)
+        return make_train_step(loss_fn, self._tx)
+
+    # ---- fit ------------------------------------------------------------
+    def _fit_batch(self, batch: Union[DataSet, MultiDataSet],
+                   etl_ms: float = 0.0):
+        self._rng, step_key = jax.random.split(self._rng)
+        if isinstance(batch, MultiDataSet):
+            feats = tuple(jnp.asarray(f) for f in batch.features)
+            labels = tuple(jnp.asarray(l) for l in batch.labels)
+            fmasks = tuple(None if m is None else jnp.asarray(m)
+                           for m in (batch.features_masks or [])) or None
+            lmasks = tuple(None if m is None else jnp.asarray(m)
+                           for m in (batch.labels_masks or [])) or None
+            n_examples = batch.num_examples()
+        else:
+            feats = (jnp.asarray(batch.features),)
+            labels = (jnp.asarray(batch.labels),)
+            fmasks = (None if batch.features_mask is None
+                      else (jnp.asarray(batch.features_mask),))
+            lmasks = (None if batch.labels_mask is None
+                      else (jnp.asarray(batch.labels_mask),))
+            n_examples = batch.num_examples()
+        self.train_state, loss = self._train_step(
+            self.train_state, feats, labels, fmasks, lmasks, step_key)
+        it = int(self.train_state.iteration)
+        for lst in self.listeners:
+            lst.iteration_done(self, it, self.epoch_count, loss, etl_ms,
+                               n_examples)
+        self._last_loss = loss
+
+    # ---- inference ------------------------------------------------------
+    def output(self, *features, train: bool = False, mask=None):
+        """Forward pass; returns a single array for single-output graphs,
+        else a list (reference: ComputationGraph.output(INDArray...)).
+        ``mask`` is the default (N, T) sequence mask for recurrent inputs."""
+        if self.train_state is None:
+            self.init()
+        if len(features) == 1 and isinstance(features[0], (list, tuple)):
+            features = tuple(features[0])
+        if self._output_fn is None:
+            def fwd(params, model_state, feats, default_mask):
+                inputs = dict(zip(self.conf.network_inputs, feats))
+                fm = {"__default__": default_mask}
+                acts, _ = self._walk(params, model_state, inputs, fm, False,
+                                     None, stop_before_loss=False)
+                return [acts[o] for o in self.conf.network_outputs]
+            self._output_fn = jax.jit(fwd)
+        outs = self._output_fn(self.train_state.params,
+                               self.train_state.model_state,
+                               tuple(jnp.asarray(f) for f in features),
+                               None if mask is None else jnp.asarray(mask))
+        return outs[0] if len(outs) == 1 else outs
+
+    def compute_loss(self, dataset: Union[DataSet, MultiDataSet]):
+        if isinstance(dataset, MultiDataSet):
+            feats = tuple(jnp.asarray(f) for f in dataset.features)
+            labels = tuple(jnp.asarray(l) for l in dataset.labels)
+        else:
+            feats = (jnp.asarray(dataset.features),)
+            labels = (jnp.asarray(dataset.labels),)
+        if self._loss_eval_fn is None:
+            def lf(params, model_state, f, l):
+                loss, _ = self._loss(params, model_state, f, l, None, None,
+                                     None, jnp.zeros((), jnp.int32))
+                return loss
+            self._loss_eval_fn = jax.jit(lf)
+        return self._loss_eval_fn(self.train_state.params,
+                                  self.train_state.model_state, feats, labels)
+
+    def summary(self) -> str:
+        lines = [f"{'name':<24}{'type':<26}{'inputs':<30}{'params':>10}"]
+        for name in self._topo:
+            node = self._nodes[name]
+            kind = (type(node.layer).__name__ if node.layer is not None
+                    else type(node.vertex).__name__)
+            nparams = 0
+            if self.train_state is not None and node.layer is not None:
+                nparams = sum(int(np.prod(a.shape)) for a in
+                              jax.tree_util.tree_leaves(
+                                  self.train_state.params.get(name, {})))
+            lines.append(f"{name:<24}{kind:<26}"
+                         f"{','.join(node.inputs):<30}{nparams:>10}")
+        if self.train_state is not None:
+            lines.append(f"total params: {self.num_params()}")
+        return "\n".join(lines)

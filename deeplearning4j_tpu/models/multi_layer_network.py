@@ -1,0 +1,267 @@
+"""MultiLayerNetwork — sequential-stack model.
+
+Analog of the reference's ``MultiLayerNetwork``
+(deeplearning4j-nn/.../nn/multilayer/MultiLayerNetwork.java:94 — init():549,
+fit(DataSetIterator):1268, backprop():1363, output:2031,
+computeGradientAndScore:2360), redesigned around a functional core:
+
+- parameters/state are pytrees keyed by layer name,
+- the full forward+loss is one pure function; ``jax.grad`` replaces
+  ``calcBackpropGradients``, and the whole train step compiles to a single
+  XLA executable with donated buffers (no workspaces needed),
+- stochastic layers get per-layer fold_in keys from one step key,
+- feature/label masks thread through like the reference's
+  ``setLayerMaskArrays`` path (SURVEY §5.7).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.models.base import BaseModel
+from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.inputs import RecurrentType
+from deeplearning4j_tpu.nn.layers.base import LayerContext
+from deeplearning4j_tpu.optimize.solver import (
+    TrainState,
+    build_optimizer,
+    make_train_step,
+)
+
+
+def _compute_cast(x, dt):
+    if dt == "bfloat16" and jnp.issubdtype(x.dtype, jnp.floating):
+        return x.astype(jnp.bfloat16)
+    return x
+
+
+class MultiLayerNetwork(BaseModel):
+    def __init__(self, conf: MultiLayerConfiguration):
+        super().__init__()
+        self.conf = conf
+        conf.resolve_shapes()
+        self.layers = conf.layers
+        self.layer_names = tuple(l.name for l in self.layers)
+        self._preprocessors = conf.preprocessors()
+        self._input_types = conf.layer_input_types()
+        self._output_fn = None
+        self._loss_eval_fn = None
+
+    @property
+    def conf_global(self):
+        return self.conf.global_config
+
+    # ---- init -----------------------------------------------------------
+    def init(self, seed: Optional[int] = None):
+        """Build params/state pytrees (reference: init():549 — flattened
+        buffer + per-layer views; here: named pytree, flattening only needed
+        for checkpoint/averaging utilities)."""
+        g = self.conf.global_config
+        root = jax.random.PRNGKey(g.seed if seed is None else seed)
+        self._rng = jax.random.fold_in(root, 0x5eed)
+        params: Dict[str, Any] = {}
+        state: Dict[str, Any] = {}
+        for i, layer in enumerate(self.layers):
+            it = self._input_types[i]
+            k = jax.random.fold_in(root, i)
+            params[layer.name] = layer.initialize(k, it) if layer.has_params else {}
+            state[layer.name] = layer.init_state(it)
+        tx = self._make_tx()
+        opt_state = tx.init(params)
+        self.train_state = TrainState(params, state, opt_state,
+                                      jnp.zeros((), jnp.int32))
+        self._tx = tx
+        return self
+
+    def _make_tx(self):
+        g = self.conf.global_config
+        return build_optimizer(
+            self.layer_names,
+            {l.name: l.updater for l in self.layers},
+            {l.name: l.frozen for l in self.layers},
+            g.updater,
+            g.gradient_normalization,
+        )
+
+    # ---- functional forward --------------------------------------------
+    def _forward(self, params, model_state, x, fmask, train: bool, rng,
+                 upto: Optional[int] = None, collect: bool = False):
+        """Pure forward through layers [0, upto). Returns (activation,
+        new_state) or (list_of_activations, new_state) when collect
+        (reference: feedForwardToLayer:955)."""
+        g = self.conf.global_config
+        x = _compute_cast(jnp.asarray(x), g.compute_dtype)
+        n = len(self.layers) if upto is None else upto
+        new_state = dict(model_state)
+        acts = []
+        for i in range(n):
+            layer = self.layers[i]
+            pp = self._preprocessors.get(i)
+            if pp is not None:
+                x = pp.apply(x)
+            key = None if rng is None else jax.random.fold_in(rng, i)
+            mask = fmask if isinstance(self._input_types[i], RecurrentType) else None
+            ctx = LayerContext(train=train, rng=key, mask=mask)
+            lp = params.get(layer.name, {})
+            if g.compute_dtype == "bfloat16":
+                lp = jax.tree_util.tree_map(
+                    lambda a: a.astype(jnp.bfloat16)
+                    if jnp.issubdtype(a.dtype, jnp.floating) else a, lp)
+            x, s = layer.apply(lp, model_state.get(layer.name, {}), x, ctx)
+            new_state[layer.name] = s
+            if collect:
+                acts.append(x)
+        return (acts if collect else x), new_state
+
+    def _loss(self, params, model_state, features, labels, fmask, lmask, rng,
+              iteration):
+        """Full training loss: forward to the last hidden layer, output
+        layer loss, plus L1/L2 (reference: computeGradientAndScore:2360 +
+        outputLayer.computeScore)."""
+        n = len(self.layers)
+        x, new_state = self._forward(params, model_state, features, fmask,
+                                     True, rng, upto=n - 1)
+        out_layer = self.layers[-1]
+        pp = self._preprocessors.get(n - 1)
+        if pp is not None:
+            x = pp.apply(x)
+        key = None if rng is None else jax.random.fold_in(rng, n - 1)
+        mask = lmask if lmask is not None else (
+            fmask if isinstance(self._input_types[n - 1], RecurrentType) else None)
+        ctx = LayerContext(train=True, rng=key, mask=mask)
+        if not hasattr(out_layer, "compute_loss"):
+            raise TypeError(f"last layer {type(out_layer).__name__} is not an"
+                            " output/loss layer")
+        loss = out_layer.compute_loss(params.get(out_layer.name, {}),
+                                      model_state.get(out_layer.name, {}),
+                                      x, labels, ctx)
+        reg = sum((l.regularization_loss(params.get(l.name, {}))
+                   for l in self.layers), jnp.zeros((), jnp.float32))
+        # promote (not truncate): float64 under gradient checks, else float32
+        acc = jnp.promote_types(jnp.float32, loss.dtype)
+        return loss.astype(acc) + reg.astype(acc), new_state
+
+    def _build_train_step(self):
+        def loss_fn(params, model_state, features, labels, fmask, lmask, rng,
+                    iteration):
+            return self._loss(params, model_state, features, labels, fmask,
+                              lmask, rng, iteration)
+        return make_train_step(loss_fn, self._tx)
+
+    # ---- inference ------------------------------------------------------
+    def output(self, features, train: bool = False, mask=None):
+        """Inference forward pass (reference: output:2031 /
+        output(INDArray, ..., featuresMask)). Jit-cached; the final output
+        layer applies its activation (e.g. softmax). ``mask`` is the
+        (N, T) features mask for padded sequence batches."""
+        if self.train_state is None:
+            self.init()
+        if self._output_fn is None:
+            def fwd(params, model_state, x, fmask):
+                n = len(self.layers)
+                h, _ = self._forward(params, model_state, x, fmask, False,
+                                     None, upto=n - 1)
+                out = self.layers[-1]
+                pp = self._preprocessors.get(n - 1)
+                if pp is not None:
+                    h = pp.apply(h)
+                ctx = LayerContext(train=False, rng=None, mask=fmask)
+                y, _ = out.apply(params.get(out.name, {}),
+                                 model_state.get(out.name, {}), h, ctx)
+                if hasattr(out, "pre_output") and hasattr(out, "activation"):
+                    # OutputLayer.apply already applies activation
+                    pass
+                return y
+            self._output_fn = jax.jit(fwd)
+        return self._output_fn(self.train_state.params,
+                               self.train_state.model_state,
+                               jnp.asarray(features),
+                               None if mask is None else jnp.asarray(mask))
+
+    def feed_forward(self, features, train: bool = False) -> List[jnp.ndarray]:
+        """All layer activations (reference: feedForward())."""
+        acts, _ = self._forward(self.train_state.params,
+                                self.train_state.model_state,
+                                jnp.asarray(features), None, train,
+                                None, collect=True)
+        return acts
+
+    def compute_loss(self, dataset: DataSet):
+        if self._loss_eval_fn is None:
+            def lf(params, model_state, f, l, fm, lm):
+                loss, _ = self._loss(params, model_state, f, l, fm, lm, None,
+                                     jnp.zeros((), jnp.int32))
+                return loss
+            self._loss_eval_fn = jax.jit(lf)
+        return self._loss_eval_fn(
+            self.train_state.params, self.train_state.model_state,
+            jnp.asarray(dataset.features), jnp.asarray(dataset.labels),
+            None if dataset.features_mask is None else jnp.asarray(dataset.features_mask),
+            None if dataset.labels_mask is None else jnp.asarray(dataset.labels_mask))
+
+    # ---- rnn streaming inference ---------------------------------------
+    def rnn_time_step(self, features, carries: Optional[dict] = None):
+        """Stateful single/multi-step inference for recurrent nets —
+        reference: rnnTimeStep (MultiLayerNetwork.java:2806). ``carries``
+        maps layer name → (h, c); returns (output, new_carries).
+        Functional: the caller threads the state."""
+        from deeplearning4j_tpu.nn.layers.recurrent import LSTM, SimpleRnn
+        if self.train_state is None:
+            self.init()
+        x = jnp.asarray(features)
+        if x.ndim == 2:
+            x = x[:, None, :]  # single timestep
+        carries = dict(carries or {})
+        params = self.train_state.params
+        n = len(self.layers)
+        for i, layer in enumerate(self.layers):
+            pp = self._preprocessors.get(i)
+            if pp is not None:
+                x = pp.apply(x)
+            ctx = LayerContext(train=False)
+            lp = params.get(layer.name, {})
+            st = self.train_state.model_state.get(layer.name, {})
+            if isinstance(layer, (LSTM, SimpleRnn)):
+                init = carries.get(layer.name)
+                x, s = layer.apply(lp, st, x, ctx, initial_state=init)
+                if isinstance(layer, LSTM):
+                    carries[layer.name] = (s["last_h"], s["last_c"])
+                else:
+                    carries[layer.name] = s["last_h"]
+            elif i == n - 1 and hasattr(layer, "pre_output"):
+                x, _ = layer.apply(lp, st, x, ctx)
+            else:
+                x, _ = layer.apply(lp, st, x, ctx)
+        return x, carries
+
+    # ---- misc -----------------------------------------------------------
+    def summary(self) -> str:
+        lines = [f"{'idx':<4}{'name':<22}{'type':<26}{'params':>10}  out"]
+        for i, l in enumerate(self.layers):
+            nparams = 0
+            if self.train_state is not None:
+                nparams = sum(int(np.prod(a.shape)) for a in
+                              jax.tree_util.tree_leaves(
+                                  self.train_state.params.get(l.name, {})))
+            out_t = l.output_type(self._input_types[i])
+            lines.append(f"{i:<4}{l.name:<22}{type(l).__name__:<26}"
+                         f"{nparams:>10}  {out_t.shape()}")
+        lines.append(f"total params: {self.num_params() if self.train_state else '?'}")
+        return "\n".join(lines)
+
+    def clone(self) -> "MultiLayerNetwork":
+        m = MultiLayerNetwork(self.conf)
+        if self.train_state is not None:
+            m.init()
+            m.train_state = TrainState(
+                jax.tree_util.tree_map(lambda a: a, self.train_state.params),
+                jax.tree_util.tree_map(lambda a: a, self.train_state.model_state),
+                m.train_state.opt_state,
+                jnp.zeros((), jnp.int32))
+        return m

@@ -1,0 +1,231 @@
+"""Network configuration: builders + JSON serde.
+
+Analog of the reference's config system (deeplearning4j-nn/.../nn/conf/
+NeuralNetConfiguration.java:82, Builder at :584; MultiLayerConfiguration
+.java:55; ComputationGraphConfiguration.java), with the same builder-pattern
+API a DL4J user expects:
+
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(123)
+            .updater(Adam(1e-3))
+            .list()
+            .layer(ConvolutionLayer(n_out=20, kernel_size=(5, 5), ...))
+            .layer(OutputLayer(n_out=10, loss=LossFunction.MCXENT))
+            .set_input_type(InputType.convolutional_flat(28, 28, 1))
+            .build())
+
+Shape inference runs at build time: each layer's ``InputType`` is computed
+and preprocessors are auto-inserted (nn/preprocessors.py), like the
+reference's ``MultiLayerConfiguration.Builder.build`` does via
+``InputType.getPreProcessorForInputType``.
+
+Configs serialize to JSON (``to_json``/``from_json``) through the explicit
+type registry in utils/serde.py — the analog of the reference's Jackson +
+classpath-scanning subtype discovery (NeuralNetConfiguration.java:434).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer
+from deeplearning4j_tpu.nn.preprocessors import Preprocessor, infer_preprocessor
+from deeplearning4j_tpu.optimize.updaters import (
+    GradientNormalizationConfig,
+    Sgd,
+    Updater,
+)
+from deeplearning4j_tpu.utils import serde
+from deeplearning4j_tpu.utils.serde import register_serializable
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class GlobalConfig:
+    """Cross-layer hyperparameters set on NeuralNetConfiguration.Builder."""
+    seed: int = 12345
+    updater: Updater = dataclasses.field(default_factory=lambda: Sgd(1e-3))
+    gradient_normalization: GradientNormalizationConfig = dataclasses.field(
+        default_factory=GradientNormalizationConfig)
+    l1: float = 0.0
+    l2: float = 0.0
+    dtype: str = "float32"          # param dtype
+    compute_dtype: str = "float32"  # activation dtype ("bfloat16" for MXU speed)
+    mini_batch: bool = True
+
+
+class NeuralNetConfiguration:
+    """Entry point; only hosts the Builder, matching reference ergonomics."""
+
+    class Builder:
+        def __init__(self):
+            self._cfg = GlobalConfig()
+
+        def _replace(self, **kw):
+            self._cfg = dataclasses.replace(self._cfg, **kw)
+            return self
+
+        def seed(self, s: int):
+            return self._replace(seed=int(s))
+
+        def updater(self, u: Updater):
+            return self._replace(updater=u)
+
+        def l1(self, v: float):
+            return self._replace(l1=v)
+
+        def l2(self, v: float):
+            return self._replace(l2=v)
+
+        def gradient_normalization(self, kind: str, threshold: float = 1.0):
+            return self._replace(gradient_normalization=
+                                 GradientNormalizationConfig(kind, threshold))
+
+        def dtype(self, dt: str):
+            return self._replace(dtype=dt)
+
+        def compute_dtype(self, dt: str):
+            return self._replace(compute_dtype=dt)
+
+        def list(self) -> "ListBuilder":
+            return ListBuilder(self._cfg)
+
+        def graph_builder(self) -> "GraphBuilder":
+            from deeplearning4j_tpu.nn.graph.config import GraphBuilder
+            return GraphBuilder(self._cfg)
+
+
+class ListBuilder:
+    """Sequential-model builder (reference: NeuralNetConfiguration.Builder
+    .list() → MultiLayerConfiguration.Builder)."""
+
+    def __init__(self, cfg: GlobalConfig):
+        self._cfg = cfg
+        self._layers: List[Layer] = []
+        self._input_type: Optional[InputType] = None
+        self._preprocessors: Dict[int, Preprocessor] = {}
+
+    def layer(self, layer: Layer) -> "ListBuilder":
+        self._layers.append(layer)
+        return self
+
+    def set_input_type(self, it: InputType) -> "ListBuilder":
+        self._input_type = it
+        return self
+
+    def input_pre_processor(self, idx: int, pp: Preprocessor) -> "ListBuilder":
+        self._preprocessors[idx] = pp
+        return self
+
+    def build(self) -> "MultiLayerConfiguration":
+        if not self._layers:
+            raise ValueError("no layers configured")
+        layers = []
+        for i, l in enumerate(self._layers):
+            updates = {}
+            if l.name is None:
+                updates["name"] = f"layer_{i}"
+            if l.l1 == 0.0 and self._cfg.l1:
+                updates["l1"] = self._cfg.l1
+            if l.l2 == 0.0 and self._cfg.l2:
+                updates["l2"] = self._cfg.l2
+            layers.append(dataclasses.replace(l, **updates) if updates else l)
+        conf = MultiLayerConfiguration(
+            global_config=self._cfg,
+            layers=tuple(layers),
+            input_type=self._input_type,
+            manual_preprocessors=dict(self._preprocessors),
+        )
+        conf.resolve_shapes()  # validate at build time, like the reference
+        return conf
+
+
+@register_serializable
+@dataclasses.dataclass
+class MultiLayerConfiguration:
+    """Sequential stack config (reference: MultiLayerConfiguration.java:55)."""
+    global_config: GlobalConfig
+    layers: Tuple[Layer, ...]
+    input_type: Optional[InputType] = None
+    manual_preprocessors: Dict[int, Preprocessor] = dataclasses.field(
+        default_factory=dict)
+
+    def resolve_shapes(self):
+        """Compute per-layer input types + auto preprocessors.
+
+        Returns (input_types, preprocessors) where input_types[i] is what
+        layer i receives (post-preprocessor).
+        """
+        if self.input_type is None:
+            raise ValueError(
+                "set_input_type(...) is required for shape inference")
+        input_types: List[InputType] = []
+        preprocessors: Dict[int, Preprocessor] = {}
+        cur = self.input_type
+        resolved_layers = list(self.layers)
+        for i, layer in enumerate(resolved_layers):
+            pp = self.manual_preprocessors.get(i)
+            if pp is None:
+                pp = infer_preprocessor(cur, layer)
+            if pp is not None:
+                preprocessors[i] = pp
+                cur = pp.output_type(cur)
+            # infer n_in where the layer supports it (reference: setNIn)
+            if hasattr(layer, "n_in") and layer.n_in is None and hasattr(
+                    layer, "resolved_n_in"):
+                try:
+                    n_in = layer.resolved_n_in(cur)
+                    layer = dataclasses.replace(layer, n_in=n_in)
+                    resolved_layers[i] = layer
+                except Exception:
+                    pass
+            input_types.append(cur)
+            cur = layer.output_type(cur)
+        self.layers = tuple(resolved_layers)
+        self._input_types = input_types
+        self._auto_preprocessors = preprocessors
+        self._output_type = cur
+        return input_types, preprocessors
+
+    @property
+    def output_type(self) -> InputType:
+        if not hasattr(self, "_output_type"):
+            self.resolve_shapes()
+        return self._output_type
+
+    def layer_input_types(self) -> List[InputType]:
+        if not hasattr(self, "_input_types"):
+            self.resolve_shapes()
+        return self._input_types
+
+    def preprocessors(self) -> Dict[int, Preprocessor]:
+        if not hasattr(self, "_auto_preprocessors"):
+            self.resolve_shapes()
+        return self._auto_preprocessors
+
+    # ---- serde ----------------------------------------------------------
+    def to_json(self) -> str:
+        return serde.to_json(self)
+
+    @staticmethod
+    def from_json(s: str) -> "MultiLayerConfiguration":
+        conf = serde.from_json(s)
+        if not isinstance(conf, MultiLayerConfiguration):
+            raise TypeError("JSON did not decode to MultiLayerConfiguration")
+        # dict keys arrive as strings from JSON
+        conf.manual_preprocessors = {int(k): v for k, v in
+                                     conf.manual_preprocessors.items()}
+        conf.layers = tuple(conf.layers)
+        conf.resolve_shapes()
+        return conf
+
+
+# Re-export for __init__ convenience; the DAG config lives in nn/graph/.
+def __getattr__(name):
+    if name == "ComputationGraphConfiguration":
+        from deeplearning4j_tpu.nn.graph.config import (
+            ComputationGraphConfiguration as CGC)
+        return CGC
+    raise AttributeError(name)

@@ -1,0 +1,444 @@
+"""Convolutional layer family (NHWC, TPU-native).
+
+Analogs of the reference's conv stack: ``ConvolutionLayer``
+(deeplearning4j-nn/.../nn/layers/convolution/ConvolutionLayer.java:57 — which
+hooks cuDNN reflectively at :75-85), ``SeparableConvolution2D``,
+``Deconvolution2D``, ``SubsamplingLayer`` (max/avg pool), ``Upsampling2D``,
+``ZeroPaddingLayer``, ``Cropping2D``, ``SpaceToDepthLayer``,
+``SpaceToBatchLayer``, ``Convolution1DLayer``.
+
+TPU-first design notes:
+- All activations are NHWC and all kernels HWIO — the layouts XLA's TPU
+  conv emitter maps directly onto the MXU without relayout copies. There is
+  no cuDNN-helper indirection: ``lax.conv_general_dilated`` IS the
+  accelerated path, and XLA fuses bias+activation into the conv epilogue.
+- ``ConvolutionMode`` mirrors the reference enum (Strict/Truncate/Same):
+  Same → XLA 'SAME' padding; Truncate/Strict → 'VALID' with Strict
+  additionally validating divisibility at config time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.inputs import (
+    ConvolutionalFlatType,
+    ConvolutionalType,
+    InputType,
+    RecurrentType,
+)
+from deeplearning4j_tpu.nn.layers.base import FeedForwardLayer, Layer, LayerContext
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.initializers import WeightInit
+from deeplearning4j_tpu.utils.serde import register_enum, register_serializable
+
+DIMENSION_NUMBERS = ("NHWC", "HWIO", "NHWC")
+
+
+@register_enum
+class ConvolutionMode(enum.Enum):
+    STRICT = "strict"
+    TRUNCATE = "truncate"
+    SAME = "same"
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+def _out_dim(size: int, k: int, s: int, d: int, mode: ConvolutionMode,
+             pad: int) -> int:
+    eff_k = (k - 1) * d + 1
+    if mode is ConvolutionMode.SAME:
+        return -(-size // s)  # ceil
+    out = (size + 2 * pad - eff_k) // s + 1
+    if mode is ConvolutionMode.STRICT and (size + 2 * pad - eff_k) % s != 0:
+        raise ValueError(
+            f"ConvolutionMode.STRICT: (size={size} + 2*pad={pad} - k_eff={eff_k})"
+            f" not divisible by stride={s}; use TRUNCATE or SAME"
+        )
+    return out
+
+
+def _padding_arg(mode: ConvolutionMode, pad: Tuple[int, int]):
+    if mode is ConvolutionMode.SAME:
+        return "SAME"
+    return [(pad[0], pad[0]), (pad[1], pad[1])]
+
+
+def _ensure_nhwc(x: jnp.ndarray, input_type: InputType) -> jnp.ndarray:
+    if isinstance(input_type, ConvolutionalFlatType):
+        n = x.shape[0]
+        return x.reshape(n, input_type.height, input_type.width, input_type.channels)
+    return x
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class ConvolutionLayer(FeedForwardLayer):
+    """2D convolution. Reference: nn/conf/layers/ConvolutionLayer +
+    nn/layers/convolution/ConvolutionLayer.java (im2col or cuDNN); here a
+    single ``lax.conv_general_dilated`` that XLA tiles onto the MXU."""
+    kernel_size: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Tuple[int, int] = (0, 0)
+    dilation: Tuple[int, int] = (1, 1)
+    convolution_mode: ConvolutionMode = ConvolutionMode.TRUNCATE
+    groups: int = 1
+
+    def _resolve_in(self, input_type: InputType) -> ConvolutionalType:
+        if isinstance(input_type, ConvolutionalFlatType):
+            input_type = input_type.unflatten()
+        if not isinstance(input_type, ConvolutionalType):
+            raise ValueError(f"{type(self).__name__} needs convolutional input,"
+                             f" got {input_type}")
+        return input_type
+
+    def output_type(self, input_type: InputType) -> InputType:
+        it = self._resolve_in(input_type)
+        k, s, d, p = map(_pair, (self.kernel_size, self.stride, self.dilation,
+                                 self.padding))
+        h = _out_dim(it.height, k[0], s[0], d[0], self.convolution_mode, p[0])
+        w = _out_dim(it.width, k[1], s[1], d[1], self.convolution_mode, p[1])
+        return ConvolutionalType(h, w, self.n_out)
+
+    def initialize(self, key, input_type):
+        it = self._resolve_in(input_type)
+        k = _pair(self.kernel_size)
+        c_in = it.channels
+        # Each output unit only sees c_in/groups input channels.
+        fan_in = (c_in // self.groups) * k[0] * k[1]
+        fan_out = (self.n_out // self.groups) * k[0] * k[1]
+        dt = self.param_dtype()
+        kw, _ = jax.random.split(key)
+        params = {"W": self.weight_init.init(
+            kw, (k[0], k[1], c_in // self.groups, self.n_out), fan_in, fan_out, dt)}
+        if self.has_bias:
+            params["b"] = jnp.zeros((self.n_out,), dt)
+        return params
+
+    def apply(self, params, state, x, ctx):
+        ctx, dk = ctx.split_rng()
+        x = self.maybe_dropout(x, ctx, dk)
+        s, d, p = map(_pair, (self.stride, self.dilation, self.padding))
+        y = lax.conv_general_dilated(
+            x, params["W"], window_strides=s,
+            padding=_padding_arg(self.convolution_mode, p),
+            rhs_dilation=d, dimension_numbers=DIMENSION_NUMBERS,
+            feature_group_count=self.groups,
+            preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
+        )
+        y = y.astype(x.dtype)
+        if self.has_bias:
+            y = y + params["b"]
+        return self.activation.apply(y), state
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class SeparableConvolution2D(ConvolutionLayer):
+    """Depthwise + pointwise conv (reference: SeparableConvolution2D)."""
+    depth_multiplier: int = 1
+
+    def initialize(self, key, input_type):
+        it = self._resolve_in(input_type)
+        k = _pair(self.kernel_size)
+        c_in = it.channels
+        dm = self.depth_multiplier
+        kd, kp = jax.random.split(key)
+        dt = self.param_dtype()
+        params = {
+            # depthwise kernel: HWIO with feature_group_count = c_in
+            "dW": self.weight_init.init(kd, (k[0], k[1], 1, c_in * dm),
+                                        k[0] * k[1], dm, dt),
+            "pW": self.weight_init.init(kp, (1, 1, c_in * dm, self.n_out),
+                                        c_in * dm, self.n_out, dt),
+        }
+        if self.has_bias:
+            params["b"] = jnp.zeros((self.n_out,), dt)
+        return params
+
+    def apply(self, params, state, x, ctx):
+        ctx, dk = ctx.split_rng()
+        x = self.maybe_dropout(x, ctx, dk)
+        s, d, p = map(_pair, (self.stride, self.dilation, self.padding))
+        c_in = x.shape[-1]
+        y = lax.conv_general_dilated(
+            x, params["dW"], window_strides=s,
+            padding=_padding_arg(self.convolution_mode, p),
+            rhs_dilation=d, dimension_numbers=DIMENSION_NUMBERS,
+            feature_group_count=c_in)
+        y = lax.conv_general_dilated(
+            y, params["pW"], window_strides=(1, 1), padding="VALID",
+            dimension_numbers=DIMENSION_NUMBERS)
+        if self.has_bias:
+            y = y + params["b"]
+        return self.activation.apply(y), state
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class Deconvolution2D(ConvolutionLayer):
+    """Transposed convolution (reference: Deconvolution2D)."""
+
+    def output_type(self, input_type: InputType) -> InputType:
+        it = self._resolve_in(input_type)
+        k, s, d, p = map(_pair, (self.kernel_size, self.stride, self.dilation,
+                                 self.padding))
+        if self.convolution_mode is ConvolutionMode.SAME:
+            h = it.height * s[0]
+            w = it.width * s[1]
+        else:
+            eff_kh = (k[0] - 1) * d[0] + 1
+            eff_kw = (k[1] - 1) * d[1] + 1
+            h = s[0] * (it.height - 1) + eff_kh - 2 * p[0]
+            w = s[1] * (it.width - 1) + eff_kw - 2 * p[1]
+        return ConvolutionalType(h, w, self.n_out)
+
+    def apply(self, params, state, x, ctx):
+        ctx, dk = ctx.split_rng()
+        x = self.maybe_dropout(x, ctx, dk)
+        s, d, p = map(_pair, (self.stride, self.dilation, self.padding))
+        k = _pair(self.kernel_size)
+        # Transposed conv as input-dilated conv: out = s*(in-1) + k_eff - 2p.
+        # (lax.conv_transpose's padding convention differs; explicit
+        # lhs_dilation keeps the arithmetic identical to the reference's
+        # Deconvolution2D output-shape formula.)
+        pads = []
+        for ax in (0, 1):
+            k_eff = (k[ax] - 1) * d[ax] + 1
+            if self.convolution_mode is ConvolutionMode.SAME:
+                total = s[ax] + k_eff - 2   # makes out = in * s
+                lo = total // 2
+                pads.append((lo, total - lo))
+            else:
+                pads.append((k_eff - 1 - p[ax], k_eff - 1 - p[ax]))
+        y = lax.conv_general_dilated(
+            x, params["W"], window_strides=(1, 1), padding=pads,
+            lhs_dilation=s, rhs_dilation=d,
+            dimension_numbers=DIMENSION_NUMBERS)
+        if self.has_bias:
+            y = y + params["b"]
+        return self.activation.apply(y), state
+
+
+@register_enum
+class PoolingType(enum.Enum):
+    MAX = "max"
+    AVG = "avg"
+    SUM = "sum"
+    PNORM = "pnorm"
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class SubsamplingLayer(Layer):
+    """Spatial pooling (reference: SubsamplingLayer; cuDNN helper at
+    deeplearning4j-cuda/.../CudnnSubsamplingHelper.java). On TPU this is a
+    ``lax.reduce_window`` which XLA fuses aggressively."""
+    kernel_size: Tuple[int, int] = (2, 2)
+    stride: Tuple[int, int] = (2, 2)
+    padding: Tuple[int, int] = (0, 0)
+    pooling_type: PoolingType = PoolingType.MAX
+    convolution_mode: ConvolutionMode = ConvolutionMode.TRUNCATE
+    pnorm: int = 2
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if isinstance(input_type, ConvolutionalFlatType):
+            input_type = input_type.unflatten()
+        it = input_type
+        k, s, p = map(_pair, (self.kernel_size, self.stride, self.padding))
+        h = _out_dim(it.height, k[0], s[0], 1, self.convolution_mode, p[0])
+        w = _out_dim(it.width, k[1], s[1], 1, self.convolution_mode, p[1])
+        return ConvolutionalType(h, w, it.channels)
+
+    def apply(self, params, state, x, ctx):
+        k, s, p = map(_pair, (self.kernel_size, self.stride, self.padding))
+        if self.convolution_mode is ConvolutionMode.SAME:
+            pad = "SAME"
+        else:
+            pad = [(0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0)]
+        window = (1, k[0], k[1], 1)
+        strides = (1, s[0], s[1], 1)
+        if self.pooling_type is PoolingType.MAX:
+            return lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pad), state
+        if self.pooling_type is PoolingType.SUM:
+            return lax.reduce_window(x, 0.0, lax.add, window, strides, pad), state
+        if self.pooling_type is PoolingType.AVG:
+            summed = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+            if pad == "SAME":
+                counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add,
+                                           window, strides, pad)
+                return summed / counts, state
+            return summed / (k[0] * k[1]), state
+        if self.pooling_type is PoolingType.PNORM:
+            pn = float(self.pnorm)
+            summed = lax.reduce_window(jnp.abs(x) ** pn, 0.0, lax.add, window,
+                                       strides, pad)
+            return summed ** (1.0 / pn), state
+        raise ValueError(self.pooling_type)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class Upsampling2D(Layer):
+    """Nearest-neighbor upsampling (reference: Upsampling2D)."""
+    size: Tuple[int, int] = (2, 2)
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_type(self, input_type: InputType) -> InputType:
+        it = input_type
+        s = _pair(self.size)
+        return ConvolutionalType(it.height * s[0], it.width * s[1], it.channels)
+
+    def apply(self, params, state, x, ctx):
+        s = _pair(self.size)
+        x = jnp.repeat(x, s[0], axis=1)
+        x = jnp.repeat(x, s[1], axis=2)
+        return x, state
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class ZeroPaddingLayer(Layer):
+    """Zero padding (reference: ZeroPaddingLayer). padding = (top, bottom,
+    left, right)."""
+    pad: Tuple[int, int, int, int] = (0, 0, 0, 0)
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_type(self, input_type: InputType) -> InputType:
+        it = input_type
+        t, b, l, r = self.pad
+        return ConvolutionalType(it.height + t + b, it.width + l + r, it.channels)
+
+    def apply(self, params, state, x, ctx):
+        t, b, l, r = self.pad
+        return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0))), state
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class Cropping2D(Layer):
+    """Spatial cropping (reference: nn/conf/layers/convolutional/Cropping2D)."""
+    crop: Tuple[int, int, int, int] = (0, 0, 0, 0)
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_type(self, input_type: InputType) -> InputType:
+        it = input_type
+        t, b, l, r = self.crop
+        return ConvolutionalType(it.height - t - b, it.width - l - r, it.channels)
+
+    def apply(self, params, state, x, ctx):
+        t, b, l, r = self.crop
+        h, w = x.shape[1], x.shape[2]
+        return x[:, t:h - b if b else h, l:w - r if r else w, :], state
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class SpaceToDepthLayer(Layer):
+    """(reference: SpaceToDepthLayer). NHWC space-to-depth, block rearrange."""
+    block_size: int = 2
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_type(self, input_type: InputType) -> InputType:
+        it = input_type
+        b = self.block_size
+        return ConvolutionalType(it.height // b, it.width // b, it.channels * b * b)
+
+    def apply(self, params, state, x, ctx):
+        n, h, w, c = x.shape
+        b = self.block_size
+        x = x.reshape(n, h // b, b, w // b, b, c)
+        x = x.transpose(0, 1, 3, 2, 4, 5)
+        return x.reshape(n, h // b, w // b, b * b * c), state
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class SpaceToBatchLayer(Layer):
+    """(reference: SpaceToBatchLayer). Moves spatial blocks into batch dim."""
+    block_size: Tuple[int, int] = (2, 2)
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_type(self, input_type: InputType) -> InputType:
+        it = input_type
+        bh, bw = _pair(self.block_size)
+        return ConvolutionalType(it.height // bh, it.width // bw, it.channels)
+
+    def apply(self, params, state, x, ctx):
+        n, h, w, c = x.shape
+        bh, bw = _pair(self.block_size)
+        x = x.reshape(n, h // bh, bh, w // bw, bw, c)
+        x = x.transpose(2, 4, 0, 1, 3, 5)
+        return x.reshape(n * bh * bw, h // bh, w // bw, c), state
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class Convolution1DLayer(FeedForwardLayer):
+    """1D (temporal) convolution over (N, T, F) sequences (reference:
+    Convolution1DLayer)."""
+    kernel_size: int = 3
+    stride: int = 1
+    padding: int = 0
+    dilation: int = 1
+    convolution_mode: ConvolutionMode = ConvolutionMode.SAME
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if not isinstance(input_type, RecurrentType):
+            raise ValueError("Convolution1DLayer needs recurrent input")
+        t = input_type.timesteps
+        if t is not None and t > 0:
+            t = _out_dim(t, self.kernel_size, self.stride, self.dilation,
+                         self.convolution_mode, self.padding)
+        return RecurrentType(self.n_out, t)
+
+    def initialize(self, key, input_type):
+        n_in = self.resolved_n_in(input_type)
+        fan_in = n_in * self.kernel_size
+        dt = self.param_dtype()
+        params = {"W": self.weight_init.init(
+            key, (self.kernel_size, n_in, self.n_out), fan_in,
+            self.n_out * self.kernel_size, dt)}
+        if self.has_bias:
+            params["b"] = jnp.zeros((self.n_out,), dt)
+        return params
+
+    def apply(self, params, state, x, ctx):
+        pad = ("SAME" if self.convolution_mode is ConvolutionMode.SAME
+               else [(self.padding, self.padding)])
+        y = lax.conv_general_dilated(
+            x, params["W"], window_strides=(self.stride,), padding=pad,
+            rhs_dilation=(self.dilation,),
+            dimension_numbers=("NHC", "HIO", "NHC"))
+        if self.has_bias:
+            y = y + params["b"]
+        return self.activation.apply(y), state

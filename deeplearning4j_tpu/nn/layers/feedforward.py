@@ -1,0 +1,176 @@
+"""Dense / embedding / elementwise feed-forward layers.
+
+Analogs of the reference's ``nn/conf/layers/DenseLayer``, ``EmbeddingLayer``,
+``EmbeddingSequenceLayer``, ``ActivationLayer``, ``DropoutLayer``,
+``AutoEncoder`` (deeplearning4j-nn/.../nn/layers/feedforward/). Forward math
+only; backward is ``jax.grad``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.inputs import (
+    FeedForwardType,
+    InputType,
+    RecurrentType,
+)
+from deeplearning4j_tpu.nn.layers.base import FeedForwardLayer, Layer, LayerContext
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.initializers import WeightInit
+from deeplearning4j_tpu.utils.serde import register_serializable
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class DenseLayer(FeedForwardLayer):
+    """y = act(x @ W + b). W: (n_in, n_out) so the matmul hits the MXU with
+    the feature axis on lanes; works on (N, F) and (N, T, F) inputs alike."""
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if isinstance(input_type, RecurrentType):
+            return RecurrentType(self.n_out, input_type.timesteps)
+        return FeedForwardType(self.n_out)
+
+    def initialize(self, key, input_type):
+        n_in = self.resolved_n_in(input_type)
+        kw, _ = jax.random.split(key)
+        dt = self.param_dtype()
+        params = {"W": self.weight_init.init(kw, (n_in, self.n_out), n_in,
+                                             self.n_out, dt)}
+        if self.has_bias:
+            params["b"] = jnp.zeros((self.n_out,), dt)
+        return params
+
+    def apply(self, params, state, x, ctx):
+        ctx, dk = ctx.split_rng()
+        x = self.maybe_dropout(x, ctx, dk)
+        y = jnp.einsum("...i,io->...o", x, params["W"])
+        if self.has_bias:
+            y = y + params["b"]
+        return self.activation.apply(y), state
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class EmbeddingLayer(FeedForwardLayer):
+    """Integer-index lookup (reference: EmbeddingLayer — a Dense layer whose
+    input is an index; forward is a gather, backward a scatter-add, both of
+    which XLA lowers to efficient dynamic-slice/segment ops on TPU)."""
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return FeedForwardType(self.n_out)
+
+    def initialize(self, key, input_type):
+        n_in = self.n_in
+        if n_in is None:
+            raise ValueError("EmbeddingLayer requires explicit n_in (vocab size)")
+        dt = self.param_dtype()
+        params = {"W": self.weight_init.init(key, (n_in, self.n_out), n_in,
+                                             self.n_out, dt)}
+        if self.has_bias:
+            params["b"] = jnp.zeros((self.n_out,), dt)
+        return params
+
+    def apply(self, params, state, x, ctx):
+        idx = x.astype(jnp.int32)
+        if idx.ndim > 1 and idx.shape[-1] == 1:
+            idx = idx[..., 0]
+        y = jnp.take(params["W"], idx, axis=0)
+        if self.has_bias:
+            y = y + params["b"]
+        return self.activation.apply(y), state
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class EmbeddingSequenceLayer(FeedForwardLayer):
+    """Sequence of indices (N, T) → (N, T, n_out) (reference:
+    EmbeddingSequenceLayer)."""
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t = input_type.timesteps if isinstance(input_type, RecurrentType) else None
+        return RecurrentType(self.n_out, t)
+
+    def initialize(self, key, input_type):
+        if self.n_in is None:
+            raise ValueError("EmbeddingSequenceLayer requires explicit n_in")
+        dt = self.param_dtype()
+        return {"W": self.weight_init.init(key, (self.n_in, self.n_out),
+                                           self.n_in, self.n_out, dt)}
+
+    def apply(self, params, state, x, ctx):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 3 and idx.shape[-1] == 1:
+            idx = idx[..., 0]
+        return jnp.take(params["W"], idx, axis=0), state
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class ActivationLayer(Layer):
+    """Standalone activation (reference: nn/conf/layers/ActivationLayer)."""
+    activation: Activation = Activation.RELU
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_type(self, input_type):
+        return input_type
+
+    def apply(self, params, state, x, ctx):
+        return self.activation.apply(x), state
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class DropoutLayer(Layer):
+    """Standalone dropout layer (reference: nn/conf/layers/DropoutLayer).
+    ``dropout`` field from the base config is the drop probability."""
+    dropout: float = 0.5
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_type(self, input_type):
+        return input_type
+
+    def apply(self, params, state, x, ctx):
+        ctx, dk = ctx.split_rng()
+        return self.maybe_dropout(x, ctx, dk), state
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class AutoEncoder(FeedForwardLayer):
+    """Denoising autoencoder layer (reference: nn/layers/feedforward/
+    autoencoder/AutoEncoder.java). In a feed-forward stack it behaves as a
+    dense encoder; ``reconstruct``/pretraining uses the tied decoder params.
+    """
+    corruption_level: float = 0.3
+
+    def output_type(self, input_type):
+        return FeedForwardType(self.n_out)
+
+    def initialize(self, key, input_type):
+        n_in = self.resolved_n_in(input_type)
+        kw, kv = jax.random.split(key)
+        dt = self.param_dtype()
+        return {
+            "W": self.weight_init.init(kw, (n_in, self.n_out), n_in, self.n_out, dt),
+            "b": jnp.zeros((self.n_out,), dt),
+            "vb": jnp.zeros((n_in,), dt),   # visible bias for reconstruction
+        }
+
+    def apply(self, params, state, x, ctx):
+        y = jnp.einsum("...i,io->...o", x, params["W"]) + params["b"]
+        return self.activation.apply(y), state
+
+    def reconstruct(self, params, h):
+        v = jnp.einsum("...o,io->...i", h, params["W"]) + params["vb"]
+        return self.activation.apply(v)

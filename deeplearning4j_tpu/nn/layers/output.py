@@ -1,0 +1,176 @@
+"""Output / loss layers and global pooling.
+
+Analogs of the reference's ``OutputLayer``, ``RnnOutputLayer``, ``LossLayer``,
+``CnnLossLayer``, ``GlobalPoolingLayer`` (deeplearning4j-nn/.../nn/conf/
+layers/). An output layer is a dense projection plus a loss; models call
+``compute_loss`` for training and ``apply`` for inference.
+
+Numerics: when (SOFTMAX, MCXENT/NLL) or (SIGMOID, XENT) pair up, the loss is
+computed on logits via fused log-sum-exp paths (ops/losses.py) — same math,
+TPU-stable, and XLA folds it into the final matmul.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.inputs import (
+    ConvolutionalType,
+    FeedForwardType,
+    InputType,
+    RecurrentType,
+)
+from deeplearning4j_tpu.nn.layers.base import Layer, LayerContext
+from deeplearning4j_tpu.nn.layers.convolution import PoolingType
+from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops import losses as L
+from deeplearning4j_tpu.utils.serde import register_serializable
+
+
+def _fused_loss(activation, loss_fn, labels, logits, mask):
+    if activation is Activation.SOFTMAX and loss_fn in (
+            L.LossFunction.MCXENT, L.LossFunction.NEGATIVELOGLIKELIHOOD):
+        return L.stable_mcxent_from_logits(labels, logits, mask)
+    if activation is Activation.SIGMOID and loss_fn is L.LossFunction.XENT:
+        return L.stable_xent_from_logits(labels, logits, mask)
+    return None
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class OutputLayer(DenseLayer):
+    """Dense + loss (reference: nn/conf/layers/OutputLayer; score math in
+    BaseOutputLayer.computeScore)."""
+    loss: L.LossFunction = L.LossFunction.MCXENT
+    activation: Activation = Activation.SOFTMAX
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if isinstance(input_type, RecurrentType):
+            return RecurrentType(self.n_out, input_type.timesteps)
+        return FeedForwardType(self.n_out)
+
+    def pre_output(self, params, x):
+        y = jnp.einsum("...i,io->...o", x, params["W"])
+        if self.has_bias:
+            y = y + params["b"]
+        return y
+
+    def compute_loss(self, params, state, x, labels, ctx):
+        ctx, dk = ctx.split_rng()
+        x = self.maybe_dropout(x, ctx, dk)
+        logits = self.pre_output(params, x)
+        fused = _fused_loss(self.activation, self.loss, labels, logits, ctx.mask)
+        if fused is not None:
+            return fused
+        return self.loss(labels, self.activation.apply(logits), ctx.mask)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class RnnOutputLayer(OutputLayer):
+    """Per-timestep output (reference: RnnOutputLayer). Input (N, T, F),
+    labels (N, T, n_out), mask (N, T)."""
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t = input_type.timesteps if isinstance(input_type, RecurrentType) else None
+        return RecurrentType(self.n_out, t)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class LossLayer(Layer):
+    """Loss without params (reference: nn/conf/layers/LossLayer)."""
+    loss: L.LossFunction = L.LossFunction.MCXENT
+    activation: Activation = Activation.IDENTITY
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def apply(self, params, state, x, ctx):
+        return self.activation.apply(x), state
+
+    def compute_loss(self, params, state, x, labels, ctx):
+        fused = _fused_loss(self.activation, self.loss, labels, x, ctx.mask)
+        if fused is not None:
+            return fused
+        return self.loss(labels, self.activation.apply(x), ctx.mask)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class CnnLossLayer(LossLayer):
+    """Per-pixel loss over NHWC maps (reference: CnnLossLayer). Labels have
+    the same NHWC shape; mask broadcasting handles (N,H,W) masks."""
+
+    def compute_loss(self, params, state, x, labels, ctx):
+        n = x.shape[0]
+        x2 = x.reshape(n, -1, x.shape[-1])
+        l2 = labels.reshape(n, -1, labels.shape[-1])
+        mask = ctx.mask
+        if mask is not None:
+            mask = mask.reshape(n, -1)
+        ctx2 = dataclasses.replace(ctx, mask=mask)
+        fused = _fused_loss(self.activation, self.loss, l2, x2, ctx2.mask)
+        if fused is not None:
+            return fused
+        return self.loss(l2, self.activation.apply(x2), ctx2.mask)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class GlobalPoolingLayer(Layer):
+    """Global pooling over spatial or temporal dims (reference:
+    nn/layers/pooling/GlobalPoolingLayer.java). CNN (N,H,W,C)→(N,C);
+    RNN (N,T,F)→(N,F) honoring the sequence mask."""
+    pooling_type: PoolingType = PoolingType.MAX
+    pnorm: int = 2
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if isinstance(input_type, ConvolutionalType):
+            return FeedForwardType(input_type.channels)
+        if isinstance(input_type, RecurrentType):
+            return FeedForwardType(input_type.size)
+        return input_type
+
+    def apply(self, params, state, x, ctx):
+        if x.ndim == 4:
+            axes = (1, 2)
+            mask = None
+        else:
+            axes = (1,)
+            mask = ctx.mask
+        if mask is not None:
+            m = mask[:, :, None].astype(x.dtype)
+            if self.pooling_type is PoolingType.MAX:
+                x = jnp.where(m > 0, x, -jnp.inf)
+                return jnp.max(x, axis=axes), state
+            if self.pooling_type is PoolingType.SUM:
+                return jnp.sum(x * m, axis=axes), state
+            if self.pooling_type is PoolingType.AVG:
+                denom = jnp.maximum(jnp.sum(m, axis=axes), 1.0)
+                return jnp.sum(x * m, axis=axes) / denom, state
+            if self.pooling_type is PoolingType.PNORM:
+                pn = float(self.pnorm)
+                return jnp.sum((jnp.abs(x) * m) ** pn, axis=axes) ** (1.0 / pn), state
+        if self.pooling_type is PoolingType.MAX:
+            return jnp.max(x, axis=axes), state
+        if self.pooling_type is PoolingType.AVG:
+            return jnp.mean(x, axis=axes), state
+        if self.pooling_type is PoolingType.SUM:
+            return jnp.sum(x, axis=axes), state
+        if self.pooling_type is PoolingType.PNORM:
+            pn = float(self.pnorm)
+            return jnp.sum(jnp.abs(x) ** pn, axis=axes) ** (1.0 / pn), state
+        raise ValueError(self.pooling_type)

@@ -1,0 +1,176 @@
+"""Input preprocessors — shape adapters between layer families.
+
+Analog of the reference's ``nn/conf/preprocessor/`` package
+(CnnToFeedForwardPreProcessor, FeedForwardToRnnPreProcessor, etc.), with the
+same auto-insertion behavior driven by ``InputType``
+(deeplearning4j-nn/.../nn/conf/inputs/InputType.java). Pure reshapes —
+XLA turns them into free layout changes.
+
+Layouts: CNN is NHWC, RNN is (N, T, F) — see nn/inputs.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.inputs import (
+    ConvolutionalFlatType,
+    ConvolutionalType,
+    FeedForwardType,
+    InputType,
+    RecurrentType,
+)
+from deeplearning4j_tpu.utils.serde import register_serializable
+
+
+class Preprocessor:
+    def output_type(self, input_type: InputType) -> InputType:
+        raise NotImplementedError
+
+    def apply(self, x: jnp.ndarray) -> jnp.ndarray:
+        raise NotImplementedError
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class CnnToFeedForward(Preprocessor):
+    height: int
+    width: int
+    channels: int
+
+    def output_type(self, input_type):
+        return FeedForwardType(self.height * self.width * self.channels)
+
+    def apply(self, x):
+        return x.reshape(x.shape[0], -1)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class FeedForwardToCnn(Preprocessor):
+    height: int
+    width: int
+    channels: int
+
+    def output_type(self, input_type):
+        return ConvolutionalType(self.height, self.width, self.channels)
+
+    def apply(self, x):
+        return x.reshape(x.shape[0], self.height, self.width, self.channels)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class FeedForwardToRnn(Preprocessor):
+    """(N*T, F) → (N, T, F) is the reference's semantics; here the model
+    keeps the batch dim, so this adapter broadcasts (N, F) → (N, 1, F)."""
+    size: int
+
+    def output_type(self, input_type):
+        return RecurrentType(self.size, None)
+
+    def apply(self, x):
+        if x.ndim == 2:
+            return x[:, None, :]
+        return x
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class RnnToFeedForward(Preprocessor):
+    """(N, T, F) → applied per-timestep dense works natively on 3D, so this
+    adapter is only needed when a strictly-2D layer follows; it flattens
+    time into batch like the reference's RnnToFeedForwardPreProcessor."""
+    size: int
+
+    def output_type(self, input_type):
+        return FeedForwardType(self.size)
+
+    def apply(self, x):
+        return x.reshape(-1, x.shape[-1])
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class CnnToRnn(Preprocessor):
+    """NHWC (N,H,W,C) → (N, H, W*C) treating height as time (reference:
+    CnnToRnnPreProcessor flattens spatial dims per timestep)."""
+    height: int
+    width: int
+    channels: int
+
+    def output_type(self, input_type):
+        return RecurrentType(self.width * self.channels, self.height)
+
+    def apply(self, x):
+        n, h, w, c = x.shape
+        return x.reshape(n, h, w * c)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class RnnToCnn(Preprocessor):
+    height: int
+    width: int
+    channels: int
+
+    def output_type(self, input_type):
+        return ConvolutionalType(self.height, self.width, self.channels)
+
+    def apply(self, x):
+        n = x.shape[0]
+        return x.reshape(n, self.height, self.width, self.channels)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class UnflattenToCnn(Preprocessor):
+    """ConvolutionalFlat input (N, H*W*C) → NHWC. The analog of the
+    reference's FeedForwardToCnnPreProcessor inserted for
+    ``InputType.convolutionalFlat`` (MNIST-style vectors)."""
+    height: int
+    width: int
+    channels: int
+
+    def output_type(self, input_type):
+        return ConvolutionalType(self.height, self.width, self.channels)
+
+    def apply(self, x):
+        return x.reshape(x.shape[0], self.height, self.width, self.channels)
+
+
+def infer_preprocessor(prev: InputType, layer) -> Preprocessor | None:
+    """Auto-insert an adapter when the previous output family doesn't match
+    what the next layer expects — mirrors
+    ``InputType.getPreProcessorForInputType`` dispatch in the reference."""
+    from deeplearning4j_tpu.nn.layers.convolution import (
+        Convolution1DLayer, ConvolutionLayer, SubsamplingLayer, Upsampling2D,
+        ZeroPaddingLayer, Cropping2D, SpaceToDepthLayer, SpaceToBatchLayer,
+    )
+    from deeplearning4j_tpu.nn.layers.feedforward import (
+        DenseLayer, EmbeddingLayer, EmbeddingSequenceLayer,
+    )
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer, RnnOutputLayer
+    from deeplearning4j_tpu.nn.layers.recurrent import (
+        LSTM, SimpleRnn, Bidirectional, LastTimeStep,
+    )
+
+    conv_like = (ConvolutionLayer, SubsamplingLayer, Upsampling2D,
+                 ZeroPaddingLayer, Cropping2D, SpaceToDepthLayer,
+                 SpaceToBatchLayer)
+    rnn_like = (LSTM, SimpleRnn, Bidirectional, LastTimeStep,
+                Convolution1DLayer)
+
+    if isinstance(prev, ConvolutionalFlatType) and isinstance(layer, conv_like):
+        return UnflattenToCnn(prev.height, prev.width, prev.channels)
+    if isinstance(prev, ConvolutionalType):
+        if isinstance(layer, rnn_like):
+            return CnnToRnn(prev.height, prev.width, prev.channels)
+        if isinstance(layer, (DenseLayer, OutputLayer)) and not isinstance(
+                layer, RnnOutputLayer):
+            return CnnToFeedForward(prev.height, prev.width, prev.channels)
+    if isinstance(prev, FeedForwardType) and isinstance(layer, rnn_like):
+        return FeedForwardToRnn(prev.size)
+    return None

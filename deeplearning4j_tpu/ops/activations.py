@@ -1,0 +1,80 @@
+"""Activation functions.
+
+TPU-native analog of the ND4J activation registry the reference consumes
+(``org.nd4j.linalg.activations.Activation``; used throughout
+deeplearning4j-nn layer configs). Each activation is a pure jnp function —
+derivatives come from ``jax.grad``, so there is no per-activation backprop
+method. XLA fuses these into the adjacent matmul/conv, which is exactly the
+elementwise-fusion the TPU HBM-bandwidth budget wants.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.utils.serde import register_enum
+
+
+@register_enum
+class Activation(enum.Enum):
+    IDENTITY = "identity"
+    RELU = "relu"
+    RELU6 = "relu6"
+    LEAKYRELU = "leakyrelu"
+    ELU = "elu"
+    SELU = "selu"
+    GELU = "gelu"
+    SIGMOID = "sigmoid"
+    HARDSIGMOID = "hardsigmoid"
+    TANH = "tanh"
+    HARDTANH = "hardtanh"
+    RATIONALTANH = "rationaltanh"
+    RECTIFIEDTANH = "rectifiedtanh"
+    SOFTMAX = "softmax"
+    LOGSOFTMAX = "logsoftmax"
+    SOFTPLUS = "softplus"
+    SOFTSIGN = "softsign"
+    SWISH = "swish"
+    MISH = "mish"
+    CUBE = "cube"
+    THRESHOLDEDRELU = "thresholdedrelu"
+
+    def apply(self, x: jnp.ndarray) -> jnp.ndarray:
+        return _FNS[self](x)
+
+
+def _rational_tanh(x):
+    # Rational approximation of tanh (reference ships RationalTanh as a
+    # cheap tanh; on TPU the VPU makes real tanh cheap, but we keep the
+    # function for numerical parity): 1.7159 * tanh(2x/3) approximated.
+    a = jnp.clip(x * (2.0 / 3.0), -3.0, 3.0)
+    p = a * (27.0 + a * a) / (27.0 + 9.0 * a * a)
+    return 1.7159 * p
+
+
+_FNS = {
+    Activation.IDENTITY: lambda x: x,
+    Activation.RELU: jax.nn.relu,
+    Activation.RELU6: jax.nn.relu6,
+    Activation.LEAKYRELU: lambda x: jax.nn.leaky_relu(x, 0.01),
+    Activation.ELU: jax.nn.elu,
+    Activation.SELU: jax.nn.selu,
+    Activation.GELU: jax.nn.gelu,
+    Activation.SIGMOID: jax.nn.sigmoid,
+    Activation.HARDSIGMOID: jax.nn.hard_sigmoid,
+    Activation.TANH: jnp.tanh,
+    Activation.HARDTANH: lambda x: jnp.clip(x, -1.0, 1.0),
+    Activation.RATIONALTANH: _rational_tanh,
+    Activation.RECTIFIEDTANH: lambda x: jnp.maximum(jnp.tanh(x), 0.0),
+    Activation.SOFTMAX: lambda x: jax.nn.softmax(x, axis=-1),
+    Activation.LOGSOFTMAX: lambda x: jax.nn.log_softmax(x, axis=-1),
+    Activation.SOFTPLUS: jax.nn.softplus,
+    Activation.SOFTSIGN: jax.nn.soft_sign,
+    Activation.SWISH: jax.nn.swish,
+    Activation.MISH: jax.nn.mish,
+    Activation.CUBE: lambda x: x ** 3,
+    Activation.THRESHOLDEDRELU: lambda x: jnp.where(x > 1.0, x, 0.0),
+}

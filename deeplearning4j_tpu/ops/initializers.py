@@ -1,0 +1,88 @@
+"""Weight initialization schemes.
+
+TPU-native analog of the reference's ``WeightInit`` enum + ``WeightInitUtil``
+(deeplearning4j-nn/.../nn/weights/WeightInit.java, WeightInitUtil.java).
+Pure functions of a jax PRNG key — deterministic and reproducible across
+hosts, which matters for SPMD: every host initializes identical replicated
+params from the same key instead of broadcasting from a chief.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.utils.serde import register_enum
+
+
+@register_enum
+class WeightInit(enum.Enum):
+    ZERO = "zero"
+    ONES = "ones"
+    CONSTANT = "constant"
+    NORMAL = "normal"
+    UNIFORM = "uniform"
+    XAVIER = "xavier"
+    XAVIER_UNIFORM = "xavier_uniform"
+    XAVIER_FAN_IN = "xavier_fan_in"
+    LECUN_NORMAL = "lecun_normal"
+    LECUN_UNIFORM = "lecun_uniform"
+    RELU = "relu"            # He normal
+    RELU_UNIFORM = "relu_uniform"
+    HE_NORMAL = "he_normal"
+    HE_UNIFORM = "he_uniform"
+    SIGMOID_UNIFORM = "sigmoid_uniform"
+    VAR_SCALING_NORMAL_FAN_AVG = "vs_normal_fan_avg"
+    IDENTITY = "identity"
+
+    def init(self, key, shape: Sequence[int], fan_in: int, fan_out: int,
+             dtype=jnp.float32, gain: float = 1.0) -> jnp.ndarray:
+        return _init(self, key, tuple(shape), fan_in, fan_out, dtype, gain)
+
+
+def _init(scheme, key, shape, fan_in, fan_out, dtype, gain):
+    fi = max(int(fan_in), 1)
+    fo = max(int(fan_out), 1)
+    if scheme is WeightInit.ZERO:
+        return jnp.zeros(shape, dtype)
+    if scheme is WeightInit.ONES:
+        return jnp.ones(shape, dtype)
+    if scheme is WeightInit.CONSTANT:
+        return jnp.full(shape, gain, dtype)
+    if scheme is WeightInit.NORMAL:
+        return gain * jax.random.normal(key, shape, dtype) / jnp.sqrt(fi)
+    if scheme is WeightInit.UNIFORM:
+        a = gain / jnp.sqrt(fi)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme is WeightInit.XAVIER:
+        std = gain * jnp.sqrt(2.0 / (fi + fo))
+        return std * jax.random.normal(key, shape, dtype)
+    if scheme is WeightInit.XAVIER_UNIFORM:
+        a = gain * jnp.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme is WeightInit.XAVIER_FAN_IN:
+        return gain * jax.random.normal(key, shape, dtype) / jnp.sqrt(fi)
+    if scheme is WeightInit.LECUN_NORMAL:
+        return gain * jax.random.normal(key, shape, dtype) * jnp.sqrt(1.0 / fi)
+    if scheme is WeightInit.LECUN_UNIFORM:
+        a = gain * jnp.sqrt(3.0 / fi)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme in (WeightInit.RELU, WeightInit.HE_NORMAL):
+        return gain * jax.random.normal(key, shape, dtype) * jnp.sqrt(2.0 / fi)
+    if scheme in (WeightInit.RELU_UNIFORM, WeightInit.HE_UNIFORM):
+        a = gain * jnp.sqrt(6.0 / fi)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme is WeightInit.SIGMOID_UNIFORM:
+        a = gain * 4.0 * jnp.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme is WeightInit.VAR_SCALING_NORMAL_FAN_AVG:
+        std = gain * jnp.sqrt(2.0 / (fi + fo))
+        return std * jax.random.normal(key, shape, dtype)
+    if scheme is WeightInit.IDENTITY:
+        if len(shape) != 2 or shape[0] != shape[1]:
+            raise ValueError("IDENTITY init requires a square 2D shape")
+        return gain * jnp.eye(shape[0], dtype=dtype)
+    raise ValueError(f"unknown WeightInit: {scheme}")

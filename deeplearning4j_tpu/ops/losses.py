@@ -1,0 +1,175 @@
+"""Loss functions.
+
+TPU-native analog of ``org.nd4j.linalg.lossfunctions.LossFunctions`` that the
+reference's output layers consume (deeplearning4j-nn/.../nn/conf/layers/
+OutputLayer etc.). Every loss is a pure function
+``loss(labels, preactivation_or_activation, mask) -> scalar`` — the gradient
+w.r.t. the network comes from ``jax.grad`` through the whole model, so there
+are no hand-written ``computeGradient`` twins.
+
+All losses support optional per-example or per-timestep masks (the reference
+threads masks through ``ILossFunction.computeScoreArray``; see SURVEY §5.7).
+Score convention matches the reference: mean over (unmasked) examples.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.utils.serde import register_enum
+
+_EPS = 1e-7
+
+
+def _masked_mean(per_example: jnp.ndarray, mask) -> jnp.ndarray:
+    """Mean over examples, honoring an optional {0,1} mask.
+
+    ``per_example`` has shape (N,) or (N, T): loss already reduced over
+    feature dims. Mask broadcasts against it.
+    """
+    if mask is None:
+        return jnp.mean(per_example)
+    mask = jnp.asarray(mask, per_example.dtype)
+    mask = jnp.reshape(mask, per_example.shape)
+    total = jnp.sum(per_example * mask)
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+    return total / denom
+
+
+def _reduce_features(x: jnp.ndarray) -> jnp.ndarray:
+    """Sum over the trailing feature axis, keeping (N,) or (N, T)."""
+    return jnp.sum(x, axis=-1)
+
+
+@register_enum
+class LossFunction(enum.Enum):
+    MSE = "mse"
+    L1 = "l1"
+    L2 = "l2"
+    MAE = "mae"
+    XENT = "xent"                      # binary cross-entropy (sigmoid out)
+    MCXENT = "mcxent"                  # multi-class cross-entropy (softmax out)
+    SPARSE_MCXENT = "sparse_mcxent"    # integer labels
+    NEGATIVELOGLIKELIHOOD = "nll"
+    KL_DIVERGENCE = "kld"
+    COSINE_PROXIMITY = "cosine"
+    HINGE = "hinge"
+    SQUARED_HINGE = "squared_hinge"
+    POISSON = "poisson"
+    MEAN_SQUARED_LOGARITHMIC_ERROR = "msle"
+    MEAN_ABSOLUTE_PERCENTAGE_ERROR = "mape"
+
+    def __call__(self, labels, output, mask=None):
+        return _FNS[self](labels, output, mask)
+
+
+def mse(labels, output, mask=None):
+    # Mean over features (reference: LossMSE = LossL2 / nOut).
+    return _masked_mean(jnp.mean(jnp.square(output - labels), axis=-1), mask)
+
+
+def l1(labels, output, mask=None):
+    return _masked_mean(_reduce_features(jnp.abs(output - labels)), mask)
+
+
+def l2(labels, output, mask=None):
+    # L2 in the reference is the un-averaged-over-features squared error sum.
+    return _masked_mean(_reduce_features(jnp.square(output - labels)), mask)
+
+
+def mae(labels, output, mask=None):
+    return _masked_mean(jnp.mean(jnp.abs(output - labels), axis=-1), mask)
+
+
+def xent(labels, output, mask=None):
+    p = jnp.clip(output, _EPS, 1.0 - _EPS)
+    per = -(labels * jnp.log(p) + (1.0 - labels) * jnp.log1p(-p))
+    return _masked_mean(_reduce_features(per), mask)
+
+
+def mcxent(labels, output, mask=None):
+    p = jnp.clip(output, _EPS, 1.0)
+    return _masked_mean(-_reduce_features(labels * jnp.log(p)), mask)
+
+
+def sparse_mcxent(labels, output, mask=None):
+    labels = labels.astype(jnp.int32)
+    p = jnp.clip(output, _EPS, 1.0)
+    logp = jnp.log(p)
+    per = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return _masked_mean(per, mask)
+
+
+def kl_divergence(labels, output, mask=None):
+    p = jnp.clip(output, _EPS, 1.0)
+    t = jnp.clip(labels, _EPS, 1.0)
+    return _masked_mean(_reduce_features(labels * (jnp.log(t) - jnp.log(p))), mask)
+
+
+def cosine_proximity(labels, output, mask=None):
+    ln = labels / (jnp.linalg.norm(labels, axis=-1, keepdims=True) + _EPS)
+    on = output / (jnp.linalg.norm(output, axis=-1, keepdims=True) + _EPS)
+    return _masked_mean(-_reduce_features(ln * on), mask)
+
+
+def hinge(labels, output, mask=None):
+    # labels in {-1, +1}
+    return _masked_mean(_reduce_features(jnp.maximum(0.0, 1.0 - labels * output)), mask)
+
+
+def squared_hinge(labels, output, mask=None):
+    return _masked_mean(
+        _reduce_features(jnp.square(jnp.maximum(0.0, 1.0 - labels * output))), mask
+    )
+
+
+def poisson(labels, output, mask=None):
+    p = jnp.clip(output, _EPS, None)
+    return _masked_mean(_reduce_features(p - labels * jnp.log(p)), mask)
+
+
+def msle(labels, output, mask=None):
+    per = jnp.square(jnp.log1p(jnp.maximum(output, 0)) - jnp.log1p(jnp.maximum(labels, 0)))
+    return _masked_mean(_reduce_features(per), mask)
+
+
+def mape(labels, output, mask=None):
+    per = 100.0 * jnp.abs((labels - output) / jnp.clip(jnp.abs(labels), _EPS, None))
+    return _masked_mean(jnp.mean(per, axis=-1), mask)
+
+
+_FNS = {
+    LossFunction.MSE: mse,
+    LossFunction.L1: l1,
+    LossFunction.L2: l2,
+    LossFunction.MAE: mae,
+    LossFunction.XENT: xent,
+    LossFunction.MCXENT: mcxent,
+    LossFunction.SPARSE_MCXENT: sparse_mcxent,
+    LossFunction.NEGATIVELOGLIKELIHOOD: mcxent,  # same math as reference
+    LossFunction.KL_DIVERGENCE: kl_divergence,
+    LossFunction.COSINE_PROXIMITY: cosine_proximity,
+    LossFunction.HINGE: hinge,
+    LossFunction.SQUARED_HINGE: squared_hinge,
+    LossFunction.POISSON: poisson,
+    LossFunction.MEAN_SQUARED_LOGARITHMIC_ERROR: msle,
+    LossFunction.MEAN_ABSOLUTE_PERCENTAGE_ERROR: mape,
+}
+
+
+def stable_mcxent_from_logits(labels, logits, mask=None):
+    """Fused softmax+CE on logits — numerically stable path used by output
+    layers when activation is SOFTMAX (avoids materializing the softmax;
+    XLA fuses the log-sum-exp into the preceding matmul's epilogue)."""
+    logz = jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+    per = _reduce_features(labels * (logz - logits))
+    return _masked_mean(per, mask)
+
+
+def stable_xent_from_logits(labels, logits, mask=None):
+    """Fused sigmoid+BCE on logits."""
+    per = jnp.maximum(logits, 0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    return _masked_mean(_reduce_features(per), mask)

@@ -1,0 +1,170 @@
+"""Training listeners.
+
+Analogs of the reference's listener SPI (deeplearning4j-nn/.../optimize/api/
+TrainingListener.java) and stock impls (optimize/listeners/):
+ScoreIterationListener, PerformanceListener (samples/sec, batches/sec, ETL ms
+— PerformanceListener.java:99-112), CollectScoresIterationListener,
+TimeIterationListener, EvaluativeListener, CheckpointListener
+(listeners/checkpoint/CheckpointListener.java:72).
+
+Listeners run on host, outside the jitted step; reading the loss forces a
+device sync, so score-reporting listeners honor a ``frequency`` to avoid
+stalling the TPU pipeline every iteration.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import List, Optional
+
+log = logging.getLogger(__name__)
+
+
+class TrainingListener:
+    def on_epoch_start(self, model, epoch: int):
+        pass
+
+    def on_epoch_end(self, model, epoch: int):
+        pass
+
+    def iteration_done(self, model, iteration: int, epoch: int,
+                       loss, etl_ms: float, batch_size: int):
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    """Logs the loss every N iterations (reference: ScoreIterationListener)."""
+
+    def __init__(self, frequency: int = 10):
+        self.frequency = max(1, frequency)
+        self.scores: List[float] = []
+
+    def iteration_done(self, model, iteration, epoch, loss, etl_ms, batch_size):
+        if iteration % self.frequency == 0:
+            score = float(loss)  # device sync
+            self.scores.append(score)
+            log.info("Score at iteration %d is %.6f", iteration, score)
+
+
+class PerformanceListener(TrainingListener):
+    """Throughput reporting: samples/sec, batches/sec, ETL ms — the metric
+    definitions come from the reference (PerformanceListener.java:99-112)
+    and feed BENCH results."""
+
+    def __init__(self, frequency: int = 1, report_score: bool = False):
+        self.frequency = max(1, frequency)
+        self.report_score = report_score
+        self._last_time: Optional[float] = None
+        self._last_iter = 0
+        self._samples = 0
+        self.history: List[dict] = []
+
+    def iteration_done(self, model, iteration, epoch, loss, etl_ms, batch_size):
+        self._samples += batch_size
+        now = time.perf_counter()
+        if self._last_time is None:
+            self._last_time = now
+            self._last_iter = iteration
+            self._samples = 0
+            return
+        if iteration % self.frequency == 0 and iteration > self._last_iter:
+            dt = now - self._last_time
+            batches = iteration - self._last_iter
+            rec = {
+                "iteration": iteration,
+                "samples_per_sec": self._samples / dt,
+                "batches_per_sec": batches / dt,
+                "etl_ms": etl_ms,
+            }
+            if self.report_score:
+                rec["score"] = float(loss)
+            self.history.append(rec)
+            log.info("iter %d: %.1f samples/sec, %.2f batches/sec, ETL %.2f ms",
+                     iteration, rec["samples_per_sec"], rec["batches_per_sec"],
+                     etl_ms)
+            self._last_time = now
+            self._last_iter = iteration
+            self._samples = 0
+
+
+class CollectScoresIterationListener(TrainingListener):
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.scores: List[tuple] = []
+
+    def iteration_done(self, model, iteration, epoch, loss, etl_ms, batch_size):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, float(loss)))
+
+
+class TimeIterationListener(TrainingListener):
+    """ETA logging (reference: TimeIterationListener)."""
+
+    def __init__(self, total_iterations: int, frequency: int = 10):
+        self.total = total_iterations
+        self.frequency = max(1, frequency)
+        self._start = None
+
+    def iteration_done(self, model, iteration, epoch, loss, etl_ms, batch_size):
+        if self._start is None:
+            self._start = time.perf_counter()
+            return
+        if iteration % self.frequency == 0 and iteration > 0:
+            elapsed = time.perf_counter() - self._start
+            rate = iteration / elapsed
+            remaining = (self.total - iteration) / max(rate, 1e-9)
+            log.info("iteration %d/%d, ETA %.1fs", iteration, self.total,
+                     remaining)
+
+
+class EvaluativeListener(TrainingListener):
+    """Periodic evaluation on a held-out iterator (reference:
+    EvaluativeListener)."""
+
+    def __init__(self, iterator, frequency_epochs: int = 1):
+        self.iterator = iterator
+        self.frequency = max(1, frequency_epochs)
+        self.evaluations: List = []
+
+    def on_epoch_end(self, model, epoch):
+        if epoch % self.frequency == 0:
+            e = model.evaluate(self.iterator)
+            self.evaluations.append((epoch, e))
+            log.info("epoch %d eval: accuracy=%.4f", epoch, e.accuracy())
+
+
+class CheckpointListener(TrainingListener):
+    """Periodic checkpoints with retention (reference: CheckpointListener
+    — every N epochs/iterations, keepLast semantics)."""
+
+    def __init__(self, directory: str, every_n_epochs: Optional[int] = None,
+                 every_n_iterations: Optional[int] = None, keep_last: int = 3):
+        self.dir = directory
+        self.every_n_epochs = every_n_epochs
+        self.every_n_iterations = every_n_iterations
+        self.keep_last = keep_last
+        self._saved: List[str] = []
+        os.makedirs(directory, exist_ok=True)
+
+    def _save(self, model, tag: str):
+        from deeplearning4j_tpu.models.serialization import save_model
+        path = os.path.join(self.dir, f"checkpoint_{tag}.zip")
+        save_model(model, path, save_updater=True)
+        self._saved.append(path)
+        while len(self._saved) > self.keep_last:
+            old = self._saved.pop(0)
+            try:
+                os.remove(old)
+            except OSError:
+                pass
+
+    def iteration_done(self, model, iteration, epoch, loss, etl_ms, batch_size):
+        if (self.every_n_iterations and iteration > 0
+                and iteration % self.every_n_iterations == 0):
+            self._save(model, f"iter_{iteration}")
+
+    def on_epoch_end(self, model, epoch):
+        if self.every_n_epochs and (epoch + 1) % self.every_n_epochs == 0:
+            self._save(model, f"epoch_{epoch}")

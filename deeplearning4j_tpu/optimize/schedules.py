@@ -1,0 +1,112 @@
+"""Learning-rate (and generally hyperparameter) schedules.
+
+TPU-native analog of ``org.nd4j.linalg.schedule.ISchedule`` and its
+implementations, consumed by layer/updater configs in the reference
+(deeplearning4j-nn configs take ``IUpdater`` with an optional schedule).
+Each schedule is a serializable dataclass with ``value_at(iteration, epoch)``
+returning a jnp scalar — pure, so it can live inside a jitted train step
+(iteration is a traced int32, not Python state).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.utils.serde import register_serializable
+
+
+class Schedule:
+    def value_at(self, iteration, epoch=0):
+        raise NotImplementedError
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class FixedSchedule(Schedule):
+    value: float
+
+    def value_at(self, iteration, epoch=0):
+        return jnp.asarray(self.value, jnp.float32)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class ExponentialSchedule(Schedule):
+    initial_value: float
+    gamma: float
+
+    def value_at(self, iteration, epoch=0):
+        return self.initial_value * jnp.power(self.gamma, iteration.astype(jnp.float32)
+                                              if hasattr(iteration, "astype") else float(iteration))
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class InverseSchedule(Schedule):
+    initial_value: float
+    gamma: float
+    power: float
+
+    def value_at(self, iteration, epoch=0):
+        it = jnp.asarray(iteration, jnp.float32)
+        return self.initial_value / jnp.power(1.0 + self.gamma * it, self.power)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class PolySchedule(Schedule):
+    initial_value: float
+    power: float
+    max_iter: int
+
+    def value_at(self, iteration, epoch=0):
+        it = jnp.asarray(iteration, jnp.float32)
+        frac = jnp.clip(it / float(self.max_iter), 0.0, 1.0)
+        return self.initial_value * jnp.power(1.0 - frac, self.power)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class SigmoidSchedule(Schedule):
+    initial_value: float
+    gamma: float
+    step_size: int
+
+    def value_at(self, iteration, epoch=0):
+        it = jnp.asarray(iteration, jnp.float32)
+        return self.initial_value / (1.0 + jnp.exp(self.gamma * (it - self.step_size)))
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class StepSchedule(Schedule):
+    initial_value: float
+    decay_rate: float
+    step_size: int
+
+    def value_at(self, iteration, epoch=0):
+        it = jnp.asarray(iteration, jnp.float32)
+        return self.initial_value * jnp.power(self.decay_rate,
+                                              jnp.floor(it / float(self.step_size)))
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class WarmupCosineSchedule(Schedule):
+    """Linear warmup then cosine decay — the modern default for large-batch
+    TPU training (no direct reference analog; added for pod-scale runs)."""
+    peak_value: float
+    warmup_iters: int
+    total_iters: int
+    end_value: float = 0.0
+
+    def value_at(self, iteration, epoch=0):
+        it = jnp.asarray(iteration, jnp.float32)
+        warm = self.peak_value * it / jnp.maximum(float(self.warmup_iters), 1.0)
+        denom = jnp.maximum(float(self.total_iters - self.warmup_iters), 1.0)
+        frac = jnp.clip((it - self.warmup_iters) / denom, 0.0, 1.0)
+        cos = self.end_value + 0.5 * (self.peak_value - self.end_value) * (
+            1.0 + jnp.cos(jnp.pi * frac))
+        return jnp.where(it < self.warmup_iters, warm, cos)

@@ -1,0 +1,220 @@
+"""Updaters (optimizers).
+
+TPU-native analog of the ND4J updater family consumed by the reference's
+layer configs (``org.nd4j.linalg.learning.config.IUpdater``: Sgd, Adam,
+Nesterovs, RMSProp, AdaGrad, ...) and the updater engine that maps gradient
+views to them (deeplearning4j-nn/.../nn/updater/BaseMultiLayerUpdater.java:38,
+UpdaterBlock.java:25).
+
+Design: the reference flattens all params into one buffer and runs updaters
+over contiguous views so multi-layer updates are single native calls. On TPU
+the equivalent is a pure optax ``GradientTransformation`` over the parameter
+pytree inside one jitted train step — XLA fuses the whole update into a few
+kernels, which is the same batching win without the view bookkeeping.
+
+Per-layer updater overrides (DL4J allows a different updater per layer) are
+supported via ``optax.multi_transform`` in the model builder.
+
+Schedules: each updater takes either a float learning rate or a
+:class:`~deeplearning4j_tpu.optimize.schedules.Schedule`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Union
+
+import optax
+
+from deeplearning4j_tpu.optimize.schedules import Schedule
+from deeplearning4j_tpu.utils.serde import register_serializable
+
+LR = Union[float, Schedule]
+
+
+def _lr_fn(lr: LR):
+    if isinstance(lr, Schedule):
+        return lambda count: lr.value_at(count)
+    return lr
+
+
+class Updater:
+    """Base class for serializable updater configs."""
+
+    def to_optax(self) -> optax.GradientTransformation:
+        raise NotImplementedError
+
+    @property
+    def has_state(self) -> bool:
+        return True
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class Sgd(Updater):
+    learning_rate: LR = 1e-3
+
+    def to_optax(self):
+        return optax.sgd(_lr_fn(self.learning_rate))
+
+    @property
+    def has_state(self) -> bool:
+        return False
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class Nesterovs(Updater):
+    learning_rate: LR = 0.1
+    momentum: float = 0.9
+
+    def to_optax(self):
+        return optax.sgd(_lr_fn(self.learning_rate), momentum=self.momentum,
+                         nesterov=True)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class Adam(Updater):
+    learning_rate: LR = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def to_optax(self):
+        return optax.adam(_lr_fn(self.learning_rate), b1=self.beta1,
+                          b2=self.beta2, eps=self.epsilon)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class AdamW(Updater):
+    learning_rate: LR = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+    weight_decay: float = 1e-2
+
+    def to_optax(self):
+        return optax.adamw(_lr_fn(self.learning_rate), b1=self.beta1,
+                           b2=self.beta2, eps=self.epsilon,
+                           weight_decay=self.weight_decay)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class AdaMax(Updater):
+    learning_rate: LR = 2e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def to_optax(self):
+        return optax.adamax(_lr_fn(self.learning_rate), b1=self.beta1,
+                            b2=self.beta2, eps=self.epsilon)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class Nadam(Updater):
+    learning_rate: LR = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def to_optax(self):
+        return optax.nadam(_lr_fn(self.learning_rate), b1=self.beta1,
+                           b2=self.beta2, eps=self.epsilon)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class AMSGrad(Updater):
+    learning_rate: LR = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def to_optax(self):
+        return optax.amsgrad(_lr_fn(self.learning_rate), b1=self.beta1,
+                             b2=self.beta2, eps=self.epsilon)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class RmsProp(Updater):
+    learning_rate: LR = 1e-1
+    rms_decay: float = 0.95
+    epsilon: float = 1e-8
+
+    def to_optax(self):
+        return optax.rmsprop(_lr_fn(self.learning_rate), decay=self.rms_decay,
+                             eps=self.epsilon)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class AdaGrad(Updater):
+    learning_rate: LR = 1e-1
+    epsilon: float = 1e-6
+
+    def to_optax(self):
+        return optax.adagrad(_lr_fn(self.learning_rate), eps=self.epsilon)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class AdaDelta(Updater):
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def to_optax(self):
+        return optax.adadelta(rho=self.rho, eps=self.epsilon)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class NoOp(Updater):
+    """Frozen parameters — the reference uses NoOp for FrozenLayer."""
+
+    def to_optax(self):
+        return optax.set_to_zero()
+
+    @property
+    def has_state(self) -> bool:
+        return False
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class GradientNormalizationConfig:
+    """Gradient normalization/clipping, analog of the reference's
+    ``GradientNormalization`` enum (deeplearning4j-nn/.../nn/conf/
+    GradientNormalization.java): renormalize by layer-wise L2, clip
+    elementwise, clip by global L2 norm."""
+    kind: str = "none"  # none|renormalize_l2|clip_value|clip_l2_per_layer|clip_l2_global
+    threshold: float = 1.0
+
+    def to_optax(self) -> Optional[optax.GradientTransformation]:
+        if self.kind == "none":
+            return None
+        if self.kind == "clip_value":
+            return optax.clip(self.threshold)
+        if self.kind == "clip_l2_global":
+            return optax.clip_by_global_norm(self.threshold)
+        if self.kind in ("renormalize_l2", "clip_l2_per_layer"):
+            import jax
+            import jax.numpy as jnp
+
+            def update_fn(updates, state, params=None):
+                def per_leaf(g):
+                    n = jnp.linalg.norm(g.reshape(-1))
+                    if self.kind == "renormalize_l2":
+                        return g / jnp.maximum(n, 1e-8)
+                    scale = jnp.minimum(1.0, self.threshold / jnp.maximum(n, 1e-8))
+                    return g * scale
+                return jax.tree_util.tree_map(per_leaf, updates), state
+
+            return optax.GradientTransformation(lambda params: optax.EmptyState(),
+                                                update_fn)
+        raise ValueError(f"unknown gradient normalization kind: {self.kind}")

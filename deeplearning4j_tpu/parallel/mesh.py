@@ -1,0 +1,87 @@
+"""Device mesh construction.
+
+The TPU-native replacement for the reference's device-affinity machinery
+(JITA ``AffinityManager`` thread↔GPU pinning used by ParallelWrapper at
+deeplearning4j-scaleout-parallelwrapper/.../ParallelWrapper.java:195 and the
+Aeron ``VoidParameterServer`` mesh discovery — SURVEY §2.14): one
+``jax.sharding.Mesh`` over all addressable devices, with named axes for
+each parallelism strategy:
+
+- ``data``  — data parallelism (ParallelWrapper / Spark masters analog)
+- ``model`` — tensor parallelism (no reference analog; SURVEY §2.11 row 7)
+- ``seq``   — sequence/context parallelism (ring attention)
+- ``pipe``  — pipeline stages
+
+Multi-host: ``jax.distributed.initialize`` + the same Mesh spanning all
+processes; XLA routes collectives over ICI within a slice and DCN across
+slices. No parameter server, no gradient compression — the interconnect is
+the parameter server.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
+
+
+def create_mesh(axes: Optional[Dict[str, int]] = None,
+                devices: Optional[Sequence] = None) -> Mesh:
+    """Build a mesh. Default: all devices on the data axis.
+
+    ``axes`` values may include one -1 entry meaning "everything left",
+    e.g. {"data": -1, "model": 4}.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if not axes:
+        axes = {DATA_AXIS: n}
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis may be -1")
+    fixed = math.prod(s for s in sizes if s != -1)
+    if -1 in sizes:
+        if n % fixed != 0:
+            raise ValueError(f"{n} devices not divisible by fixed axes {fixed}")
+        sizes[sizes.index(-1)] = n // fixed
+    total = math.prod(sizes)
+    if total != n:
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total}"
+                         f" devices, have {n}")
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, tuple(names))
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None):
+    """Multi-host bring-up (replaces VoidParameterServer.init + Aeron mesh
+    discovery, SharedTrainingWrapper.java:206-244). On TPU pods with the
+    standard runtime, argumentless initialize() autodetects everything."""
+    if coordinator_address is None:
+        jax.distributed.initialize()
+    else:
+        jax.distributed.initialize(coordinator_address, num_processes,
+                                   process_id)
+
+
+def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Leading-dim (batch) sharding for input batches."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

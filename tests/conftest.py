@@ -1,0 +1,37 @@
+"""Test fixture — the analog of the reference's BaseDL4JTest
+(deeplearning4j-core/src/test/java/org/deeplearning4j/BaseDL4JTest.java).
+
+All tests run on a virtual 8-device CPU mesh so multi-chip sharding logic is
+exercised without TPU hardware (the reference's analog: Spark local[N] +
+ParallelWrapper CPU workers, SURVEY §4).
+
+Note: this image ships a TPU PJRT shim that force-selects the 'axon'
+platform at interpreter start (its backend dial blocks for minutes when no
+chip is attached). ``jax.config.update("jax_platforms", "cpu")`` below runs
+before any backend is initialized and wins over the shim, pinning the whole
+test session to the virtual CPU mesh.
+"""
+
+import os
+
+# Must be set before jax initializes backends: 8 virtual CPU devices.
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(12345)

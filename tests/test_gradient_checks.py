@@ -1,0 +1,228 @@
+"""Finite-difference gradient checks.
+
+Analog of the reference's 16 gradient-check suites
+(deeplearning4j-core/src/test/java/org/deeplearning4j/gradientcheck/ —
+GradientChecksTests, CNNGradientCheckTest, LSTMGradientCheckTests,
+GradientCheckTestsMasking, NoBiasGradientCheckTests, ...). One shared
+checker (gradientcheck/gradient_check_util.py), many architectures.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.gradientcheck.gradient_check_util import (
+    check_model_gradients,
+)
+from deeplearning4j_tpu.models.computation_graph import ComputationGraph
+from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.graph.vertices import ElementWiseVertex, MergeVertex
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers.convolution import (
+    ConvolutionLayer,
+    ConvolutionMode,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+from deeplearning4j_tpu.nn.layers.normalization import (
+    BatchNormalization,
+    LayerNormalization,
+)
+from deeplearning4j_tpu.nn.layers.output import (
+    GlobalPoolingLayer,
+    OutputLayer,
+    RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.layers.recurrent import (
+    LSTM,
+    Bidirectional,
+    GravesLSTM,
+    LastTimeStep,
+    SimpleRnn,
+)
+from deeplearning4j_tpu.nn.layers.convolution import PoolingType
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.losses import LossFunction
+from deeplearning4j_tpu.optimize.updaters import Sgd
+
+RNG = np.random.default_rng(42)
+
+
+def onehot(idx, n):
+    out = np.zeros((len(idx), n), np.float64)
+    out[np.arange(len(idx)), idx] = 1.0
+    return out
+
+
+def small_ds(n=8, f=4, classes=3):
+    x = RNG.normal(size=(n, f))
+    y = onehot(RNG.integers(0, classes, n), classes)
+    return DataSet(x, y)
+
+
+def build(layers, input_type, seed=12345):
+    b = NeuralNetConfiguration.Builder().seed(seed).updater(Sgd(0.1)).list()
+    for l in layers:
+        b = b.layer(l)
+    return MultiLayerNetwork(b.set_input_type(input_type).build()).init()
+
+
+def test_mlp_mcxent():
+    m = build([DenseLayer(n_out=6, activation=Activation.TANH),
+               OutputLayer(n_out=3, loss=LossFunction.MCXENT,
+                           activation=Activation.SOFTMAX)],
+              InputType.feed_forward(4))
+    assert check_model_gradients(m, small_ds())
+
+
+def test_mlp_activations():
+    for act in [Activation.RELU, Activation.ELU, Activation.SOFTPLUS,
+                Activation.SIGMOID, Activation.SWISH]:
+        m = build([DenseLayer(n_out=5, activation=act),
+                   OutputLayer(n_out=3)],
+                  InputType.feed_forward(4), seed=hash(act.name) % 100000)
+        assert check_model_gradients(m, small_ds(), max_params_per_leaf=8), act
+
+
+def test_losses():
+    for loss, out_act, labels_kind in [
+        (LossFunction.MSE, Activation.IDENTITY, "real"),
+        (LossFunction.L1, Activation.IDENTITY, "real"),
+        (LossFunction.XENT, Activation.SIGMOID, "binary"),
+        (LossFunction.MCXENT, Activation.SOFTMAX, "onehot"),
+        (LossFunction.POISSON, Activation.SOFTPLUS, "count"),
+    ]:
+        n, f, c = 8, 4, 3
+        x = RNG.normal(size=(n, f))
+        if labels_kind == "real":
+            y = RNG.normal(size=(n, c))
+        elif labels_kind == "binary":
+            y = RNG.integers(0, 2, size=(n, c)).astype(np.float64)
+        elif labels_kind == "count":
+            y = RNG.integers(0, 5, size=(n, c)).astype(np.float64)
+        else:
+            y = onehot(RNG.integers(0, c, n), c)
+        m = build([DenseLayer(n_out=6, activation=Activation.TANH),
+                   OutputLayer(n_out=c, loss=loss, activation=out_act)],
+                  InputType.feed_forward(f))
+        assert check_model_gradients(m, DataSet(x, y),
+                                     max_params_per_leaf=8), loss
+
+
+def test_l1_l2_regularization():
+    m = build([DenseLayer(n_out=6, activation=Activation.TANH, l1=0.01,
+                          l2=0.02),
+               OutputLayer(n_out=3, l2=0.01)],
+              InputType.feed_forward(4))
+    assert check_model_gradients(m, small_ds())
+
+
+def test_no_bias():
+    m = build([DenseLayer(n_out=6, activation=Activation.TANH, has_bias=False),
+               OutputLayer(n_out=3, has_bias=False)],
+              InputType.feed_forward(4))
+    assert check_model_gradients(m, small_ds())
+
+
+def test_cnn():
+    n = 4
+    x = RNG.normal(size=(n, 6, 6, 2))
+    y = onehot(RNG.integers(0, 3, n), 3)
+    m = build([ConvolutionLayer(n_out=3, kernel_size=(3, 3),
+                                activation=Activation.TANH,
+                                convolution_mode=ConvolutionMode.SAME),
+               SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)),
+               OutputLayer(n_out=3)],
+              InputType.convolutional(6, 6, 2))
+    assert check_model_gradients(m, DataSet(x, y), max_params_per_leaf=8)
+
+
+def test_cnn_avg_pool_batchnorm():
+    n = 4
+    x = RNG.normal(size=(n, 6, 6, 2))
+    y = onehot(RNG.integers(0, 3, n), 3)
+    m = build([ConvolutionLayer(n_out=3, kernel_size=(3, 3),
+                                activation=Activation.ELU,
+                                convolution_mode=ConvolutionMode.SAME),
+               BatchNormalization(),
+               SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2),
+                                pooling_type=PoolingType.AVG),
+               OutputLayer(n_out=3)],
+              InputType.convolutional(6, 6, 2))
+    assert check_model_gradients(m, DataSet(x, y), max_params_per_leaf=6)
+
+
+def test_lstm():
+    n, t, f = 4, 5, 3
+    x = RNG.normal(size=(n, t, f))
+    y = onehot(RNG.integers(0, 3, n), 3)
+    m = build([LastTimeStep(inner=LSTM(n_in=f, n_out=4)),
+               OutputLayer(n_out=3)],
+              InputType.recurrent(f, t))
+    assert check_model_gradients(m, DataSet(x, y), max_params_per_leaf=8)
+
+
+def test_graves_lstm_and_simple_rnn():
+    n, t, f = 4, 5, 3
+    x = RNG.normal(size=(n, t, f))
+    y = np.stack([onehot(RNG.integers(0, 3, n), 3)] * t, axis=1)
+    for cell in [GravesLSTM(n_in=f, n_out=4), SimpleRnn(n_in=f, n_out=4)]:
+        m = build([cell, RnnOutputLayer(n_out=3)],
+                  InputType.recurrent(f, t))
+        assert check_model_gradients(m, DataSet(x, y),
+                                     max_params_per_leaf=6), type(cell)
+
+
+def test_bidirectional():
+    n, t, f = 4, 5, 3
+    x = RNG.normal(size=(n, t, f))
+    y = onehot(RNG.integers(0, 3, n), 3)
+    m = build([Bidirectional(fwd=LSTM(n_in=f, n_out=4), mode="concat"),
+               GlobalPoolingLayer(pooling_type=PoolingType.AVG),
+               OutputLayer(n_out=3)],
+              InputType.recurrent(f, t))
+    assert check_model_gradients(m, DataSet(x, y), max_params_per_leaf=6)
+
+
+def test_masking():
+    """Gradient check with sequence masks (reference:
+    GradientCheckTestsMasking)."""
+    n, t, f = 4, 6, 3
+    x = RNG.normal(size=(n, t, f))
+    y = np.stack([onehot(RNG.integers(0, 3, n), 3)] * t, axis=1)
+    lengths = RNG.integers(2, t + 1, n)
+    mask = (np.arange(t)[None, :] < lengths[:, None]).astype(np.float64)
+    m = build([LSTM(n_in=f, n_out=4), RnnOutputLayer(n_out=3)],
+              InputType.recurrent(f, t))
+    assert check_model_gradients(
+        m, DataSet(x, y, features_mask=mask, labels_mask=mask),
+        max_params_per_leaf=6)
+
+
+def test_computation_graph_gradients():
+    n, f = 6, 4
+    x = RNG.normal(size=(n, f))
+    y = onehot(RNG.integers(0, 3, n), 3)
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(12345).updater(Sgd(0.1)).graph_builder()
+            .add_inputs("in")
+            .add_layer("a", DenseLayer(n_out=5, activation=Activation.TANH), "in")
+            .add_layer("b", DenseLayer(n_out=5, activation=Activation.SIGMOID), "in")
+            .add_vertex("m", MergeVertex(), "a", "b")
+            .add_layer("c", DenseLayer(n_out=4, activation=Activation.TANH), "m")
+            .add_vertex("ew", ElementWiseVertex(op="add"), "c", "c")
+            .add_layer("out", OutputLayer(n_out=3), "ew")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(f))
+            .build())
+    model = ComputationGraph(conf).init()
+    assert check_model_gradients(model, DataSet(x, y), max_params_per_leaf=8)
+
+
+def test_layernorm_gradients():
+    m = build([DenseLayer(n_out=6, activation=Activation.TANH),
+               LayerNormalization(),
+               OutputLayer(n_out=3)],
+              InputType.feed_forward(4))
+    assert check_model_gradients(m, small_ds())

@@ -1,0 +1,312 @@
+"""Per-layer forward-shape and known-value tests.
+
+Analog of the reference's layer unit tests
+(deeplearning4j-core/src/test/java/org/deeplearning4j/nn/layers/**).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import LayerContext
+from deeplearning4j_tpu.nn.layers.convolution import (
+    Convolution1DLayer,
+    ConvolutionLayer,
+    ConvolutionMode,
+    Cropping2D,
+    Deconvolution2D,
+    PoolingType,
+    SeparableConvolution2D,
+    SpaceToDepthLayer,
+    SubsamplingLayer,
+    Upsampling2D,
+    ZeroPaddingLayer,
+)
+from deeplearning4j_tpu.nn.layers.feedforward import (
+    ActivationLayer,
+    DenseLayer,
+    DropoutLayer,
+    EmbeddingLayer,
+    EmbeddingSequenceLayer,
+)
+from deeplearning4j_tpu.nn.layers.normalization import (
+    BatchNormalization,
+    LayerNormalization,
+    LocalResponseNormalization,
+)
+from deeplearning4j_tpu.nn.layers.output import GlobalPoolingLayer
+from deeplearning4j_tpu.nn.layers.recurrent import (
+    LSTM,
+    Bidirectional,
+    GravesLSTM,
+    LastTimeStep,
+    SimpleRnn,
+)
+from deeplearning4j_tpu.ops.activations import Activation
+
+KEY = jax.random.PRNGKey(0)
+CTX = LayerContext(train=False)
+TRAIN_CTX = LayerContext(train=True, rng=jax.random.PRNGKey(1))
+
+
+def run(layer, input_type, x, ctx=CTX):
+    params = layer.initialize(KEY, input_type) if layer.has_params else {}
+    state = layer.init_state(input_type)
+    y, new_state = layer.apply(params, state, jnp.asarray(x), ctx)
+    expected = layer.output_type(input_type)
+    assert y.shape[1:] == tuple(
+        s for s in expected.shape() if s != -1) or -1 in expected.shape()
+    return y, params, new_state
+
+
+def test_dense_shape_and_value():
+    layer = DenseLayer(n_in=4, n_out=3, activation=Activation.IDENTITY)
+    params = layer.initialize(KEY, InputType.feed_forward(4))
+    x = jnp.ones((2, 4))
+    y, _ = layer.apply(params, {}, x, CTX)
+    assert y.shape == (2, 3)
+    expect = x @ params["W"] + params["b"]
+    np.testing.assert_allclose(np.asarray(y), np.asarray(expect), rtol=1e-6)
+
+
+def test_dense_on_sequence():
+    layer = DenseLayer(n_in=4, n_out=3)
+    params = layer.initialize(KEY, InputType.recurrent(4))
+    y, _ = layer.apply(params, {}, jnp.ones((2, 5, 4)), CTX)
+    assert y.shape == (2, 5, 3)
+
+
+def test_conv2d_shapes():
+    it = InputType.convolutional(28, 28, 1)
+    layer = ConvolutionLayer(n_out=8, kernel_size=(5, 5), stride=(1, 1))
+    y, _, _ = run(layer, it, np.random.randn(2, 28, 28, 1).astype(np.float32))
+    assert y.shape == (2, 24, 24, 8)
+    same = ConvolutionLayer(n_out=8, kernel_size=(3, 3), stride=(2, 2),
+                            convolution_mode=ConvolutionMode.SAME)
+    y2, _, _ = run(same, it, np.random.randn(2, 28, 28, 1).astype(np.float32))
+    assert y2.shape == (2, 14, 14, 8)
+
+
+def test_conv2d_known_value():
+    """3x3 all-ones kernel over constant input = 9*c."""
+    it = InputType.convolutional(5, 5, 1)
+    layer = ConvolutionLayer(n_in=1, n_out=1, kernel_size=(3, 3),
+                             has_bias=False)
+    params = {"W": jnp.ones((3, 3, 1, 1))}
+    x = jnp.full((1, 5, 5, 1), 2.0)
+    y, _ = layer.apply(params, {}, x, CTX)
+    np.testing.assert_allclose(np.asarray(y), 18.0, rtol=1e-6)
+
+
+def test_separable_and_deconv_shapes():
+    it = InputType.convolutional(16, 16, 4)
+    x = np.random.randn(2, 16, 16, 4).astype(np.float32)
+    sep = SeparableConvolution2D(n_out=8, kernel_size=(3, 3),
+                                 convolution_mode=ConvolutionMode.SAME)
+    y, _, _ = run(sep, it, x)
+    assert y.shape == (2, 16, 16, 8)
+    dec = Deconvolution2D(n_out=8, kernel_size=(2, 2), stride=(2, 2))
+    y2, _, _ = run(dec, it, x)
+    assert y2.shape == (2, 32, 32, 8)
+
+
+def test_subsampling_max_avg():
+    it = InputType.convolutional(4, 4, 2)
+    x = np.arange(32, dtype=np.float32).reshape(1, 4, 4, 2)
+    mx = SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2),
+                          pooling_type=PoolingType.MAX)
+    y, _, _ = run(mx, it, x)
+    assert y.shape == (1, 2, 2, 2)
+    assert float(y[0, 0, 0, 0]) == 10.0  # max of {0,2,8,10}
+    av = SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2),
+                          pooling_type=PoolingType.AVG)
+    y2, _, _ = run(av, it, x)
+    assert float(y2[0, 0, 0, 0]) == 5.0
+
+
+def test_upsample_pad_crop_s2d():
+    it = InputType.convolutional(4, 4, 3)
+    x = np.random.randn(2, 4, 4, 3).astype(np.float32)
+    y, _, _ = run(Upsampling2D(size=(2, 2)), it, x)
+    assert y.shape == (2, 8, 8, 3)
+    y, _, _ = run(ZeroPaddingLayer(pad=(1, 1, 2, 2)), it, x)
+    assert y.shape == (2, 6, 8, 3)
+    y, _, _ = run(Cropping2D(crop=(1, 1, 1, 1)), it, x)
+    assert y.shape == (2, 2, 2, 3)
+    y, _, _ = run(SpaceToDepthLayer(block_size=2), it, x)
+    assert y.shape == (2, 2, 2, 12)
+
+
+def test_conv1d():
+    it = InputType.recurrent(8, 10)
+    layer = Convolution1DLayer(n_out=16, kernel_size=3,
+                               convolution_mode=ConvolutionMode.SAME)
+    y, _, _ = run(layer, it, np.random.randn(2, 10, 8).astype(np.float32))
+    assert y.shape == (2, 10, 16)
+
+
+def test_batchnorm_train_and_eval():
+    it = InputType.feed_forward(6)
+    layer = BatchNormalization()
+    params = layer.initialize(KEY, it)
+    state = layer.init_state(it)
+    x = jnp.asarray(np.random.randn(64, 6).astype(np.float32) * 3 + 1)
+    y, new_state = layer.apply(params, state, x, TRAIN_CTX)
+    # normalized output ~ zero mean unit var
+    assert abs(float(jnp.mean(y))) < 0.1
+    assert abs(float(jnp.std(y)) - 1.0) < 0.1
+    # running stats moved toward batch stats
+    assert float(jnp.max(jnp.abs(new_state["mean"]))) > 0.0
+    # eval mode uses running stats
+    y2, s2 = layer.apply(params, new_state, x, CTX)
+    assert s2 == new_state or jnp.allclose(s2["mean"], new_state["mean"])
+
+
+def test_batchnorm_nhwc():
+    it = InputType.convolutional(8, 8, 4)
+    layer = BatchNormalization()
+    params = layer.initialize(KEY, it)
+    state = layer.init_state(it)
+    x = jnp.asarray(np.random.randn(4, 8, 8, 4).astype(np.float32))
+    y, _ = layer.apply(params, state, x, TRAIN_CTX)
+    assert y.shape == x.shape
+
+
+def test_layernorm_and_lrn():
+    it = InputType.feed_forward(16)
+    ln = LayerNormalization()
+    params = ln.initialize(KEY, it)
+    x = jnp.asarray(np.random.randn(4, 16).astype(np.float32))
+    y, _ = ln.apply(params, {}, x, CTX)
+    np.testing.assert_allclose(np.asarray(jnp.mean(y, -1)), 0.0, atol=1e-5)
+
+    itc = InputType.convolutional(4, 4, 8)
+    lrn = LocalResponseNormalization()
+    xc = jnp.asarray(np.random.randn(2, 4, 4, 8).astype(np.float32))
+    y, _ = lrn.apply({}, {}, xc, CTX)
+    assert y.shape == xc.shape
+
+
+def test_embedding():
+    layer = EmbeddingLayer(n_in=10, n_out=4)
+    params = layer.initialize(KEY, InputType.feed_forward(1))
+    idx = jnp.asarray([[1], [3]])
+    y, _ = layer.apply(params, {}, idx, CTX)
+    assert y.shape == (2, 4)
+    seq = EmbeddingSequenceLayer(n_in=10, n_out=4)
+    sp = seq.initialize(KEY, InputType.recurrent(1, 5))
+    y2, _ = seq.apply(sp, {}, jnp.zeros((2, 5), jnp.int32), CTX)
+    assert y2.shape == (2, 5, 4)
+
+
+def test_dropout_train_vs_eval():
+    layer = DropoutLayer(dropout=0.5)
+    x = jnp.ones((10, 20))
+    y_eval, _ = layer.apply({}, {}, x, CTX)
+    np.testing.assert_allclose(np.asarray(y_eval), 1.0)
+    y_train, _ = layer.apply({}, {}, x, TRAIN_CTX)
+    vals = np.unique(np.asarray(y_train))
+    assert set(np.round(vals, 4)).issubset({0.0, 2.0})
+
+
+def test_lstm_shapes_and_state():
+    it = InputType.recurrent(8, 6)
+    layer = LSTM(n_in=8, n_out=12)
+    params = layer.initialize(KEY, it)
+    x = jnp.asarray(np.random.randn(3, 6, 8).astype(np.float32))
+    y, state = layer.apply(params, {}, x, CTX)
+    assert y.shape == (3, 6, 12)
+    assert state["last_h"].shape == (3, 12)
+    assert state["last_c"].shape == (3, 12)
+    # last output equals last hidden state
+    np.testing.assert_allclose(np.asarray(y[:, -1]),
+                               np.asarray(state["last_h"]), rtol=1e-5)
+
+
+def test_lstm_masking_freezes_state():
+    it = InputType.recurrent(4, 5)
+    layer = LSTM(n_in=4, n_out=3)
+    params = layer.initialize(KEY, it)
+    x = jnp.asarray(np.random.randn(2, 5, 4).astype(np.float32))
+    mask = jnp.asarray([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], jnp.float32)
+    ctx = LayerContext(train=False, mask=mask)
+    y, state = layer.apply(params, {}, x, ctx)
+    # masked timesteps emit zeros
+    np.testing.assert_allclose(np.asarray(y[0, 3:]), 0.0, atol=1e-7)
+    # final state of example 0 equals state at t=2
+    y_full, state3 = layer.apply(params, {}, x[:, :3], CTX)
+    np.testing.assert_allclose(np.asarray(state["last_h"][0]),
+                               np.asarray(state3["last_h"][0]), rtol=1e-5)
+
+
+def test_lstm_step_one_matches_scan():
+    it = InputType.recurrent(4, 3)
+    layer = LSTM(n_in=4, n_out=5)
+    params = layer.initialize(KEY, it)
+    x = jnp.asarray(np.random.randn(2, 3, 4).astype(np.float32))
+    y, _ = layer.apply(params, {}, x, CTX)
+    h = jnp.zeros((2, 5))
+    c = jnp.zeros((2, 5))
+    for t in range(3):
+        h, c = layer.step_one(params, x[:, t], (h, c))
+    np.testing.assert_allclose(np.asarray(y[:, -1]), np.asarray(h), rtol=1e-5)
+
+
+def test_graves_lstm_and_simple_rnn():
+    it = InputType.recurrent(4, 6)
+    x = np.random.randn(2, 6, 4).astype(np.float32)
+    y, p, _ = run(GravesLSTM(n_in=4, n_out=7), it, x)
+    assert y.shape == (2, 6, 7)
+    assert "pI" in p
+    y2, _, _ = run(SimpleRnn(n_in=4, n_out=7), it, x)
+    assert y2.shape == (2, 6, 7)
+
+
+def test_bidirectional_modes():
+    it = InputType.recurrent(4, 6)
+    x = np.random.randn(2, 6, 4).astype(np.float32)
+    for mode, width in [("concat", 10), ("add", 5), ("average", 5)]:
+        layer = Bidirectional(fwd=LSTM(n_in=4, n_out=5), mode=mode)
+        y, _, _ = run(layer, it, x)
+        assert y.shape == (2, 6, width)
+
+
+def test_last_time_step():
+    it = InputType.recurrent(4, 6)
+    layer = LastTimeStep(inner=LSTM(n_in=4, n_out=5))
+    params = layer.initialize(KEY, it)
+    x = jnp.asarray(np.random.randn(2, 6, 4).astype(np.float32))
+    y, _ = layer.apply(params, {}, x, CTX)
+    assert y.shape == (2, 5)
+    # with mask: pick last unmasked step
+    mask = jnp.asarray([[1, 1, 0, 0, 0, 0], [1, 1, 1, 1, 1, 1]], jnp.float32)
+    y2, _ = layer.apply(params, {}, x, LayerContext(train=False, mask=mask))
+    inner_y, _ = layer.inner.apply(params, {}, x,
+                                   LayerContext(train=False, mask=mask))
+    np.testing.assert_allclose(np.asarray(y2[0]), np.asarray(inner_y[0, 1]),
+                               rtol=1e-5)
+
+
+def test_global_pooling():
+    itc = InputType.convolutional(4, 4, 3)
+    x = np.random.randn(2, 4, 4, 3).astype(np.float32)
+    y, _, _ = run(GlobalPoolingLayer(pooling_type=PoolingType.AVG), itc, x)
+    assert y.shape == (2, 3)
+    np.testing.assert_allclose(np.asarray(y), x.mean(axis=(1, 2)), rtol=1e-5)
+    itr = InputType.recurrent(3, 5)
+    xs = np.random.randn(2, 5, 3).astype(np.float32)
+    mask = jnp.asarray([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], jnp.float32)
+    layer = GlobalPoolingLayer(pooling_type=PoolingType.AVG)
+    y2, _ = layer.apply({}, {}, jnp.asarray(xs),
+                        LayerContext(train=False, mask=mask))
+    np.testing.assert_allclose(np.asarray(y2[0]), xs[0, :3].mean(axis=0),
+                               rtol=1e-5)
+
+
+def test_activation_layer():
+    y, _, _ = run(ActivationLayer(activation=Activation.RELU),
+                  InputType.feed_forward(4),
+                  np.array([[-1.0, 2.0, -3.0, 4.0]], np.float32))
+    np.testing.assert_allclose(np.asarray(y), [[0, 2, 0, 4]])

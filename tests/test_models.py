@@ -1,0 +1,273 @@
+"""MultiLayerNetwork / ComputationGraph end-to-end tests.
+
+Analog of the reference's core suites in deeplearning4j-core/src/test
+(MultiLayerTest, ComputationGraphTestRNN, TestSetGetParameters, conf serde
+round-trips).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import ArrayDataSetIterator, DataSet
+from deeplearning4j_tpu.datasets.fetchers import (
+    IrisDataSetIterator,
+    MnistDataSetIterator,
+)
+from deeplearning4j_tpu.models.computation_graph import ComputationGraph
+from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
+from deeplearning4j_tpu.models.serialization import (
+    restore_computation_graph,
+    restore_multi_layer_network,
+    save_model,
+)
+from deeplearning4j_tpu.nn.config import (
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.graph.vertices import (
+    ElementWiseVertex,
+    MergeVertex,
+)
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers.convolution import (
+    ConvolutionLayer,
+    ConvolutionMode,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+from deeplearning4j_tpu.nn.layers.normalization import BatchNormalization
+from deeplearning4j_tpu.nn.layers.output import OutputLayer, RnnOutputLayer
+from deeplearning4j_tpu.nn.layers.recurrent import LSTM
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.losses import LossFunction
+from deeplearning4j_tpu.optimize.updaters import Adam, Sgd
+
+
+def iris_mlp_conf(seed=123):
+    return (NeuralNetConfiguration.Builder()
+            .seed(seed)
+            .updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=16, activation=Activation.RELU))
+            .layer(DenseLayer(n_out=16, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+
+
+def test_builder_shape_inference():
+    conf = iris_mlp_conf()
+    assert conf.layers[0].n_in == 4
+    assert conf.layers[1].n_in == 16
+    assert conf.layers[2].n_in == 16
+
+
+def test_mlp_learns_iris():
+    model = MultiLayerNetwork(iris_mlp_conf()).init()
+    it = IrisDataSetIterator(batch_size=50)
+    before = model.evaluate(it).accuracy()
+    model.fit(it, epochs=60)
+    e = model.evaluate(it)
+    assert e.accuracy() > 0.9, e.stats()
+    assert e.accuracy() > before
+
+
+def test_score_decreases():
+    model = MultiLayerNetwork(iris_mlp_conf()).init()
+    it = IrisDataSetIterator(batch_size=150)
+    batch = next(iter(it))
+    s0 = model.score(batch)
+    model.fit(it, epochs=20)
+    assert model.score(batch) < s0
+
+
+def test_conf_json_roundtrip():
+    conf = iris_mlp_conf()
+    js = conf.to_json()
+    conf2 = MultiLayerConfiguration.from_json(js)
+    assert conf2.to_json() == js
+    assert conf2.layers[1].n_out == 16
+    assert conf2.global_config.updater == Adam(1e-2)
+    m = MultiLayerNetwork(conf2).init()
+    assert m.output(np.zeros((2, 4), np.float32)).shape == (2, 3)
+
+
+def test_model_serialization_roundtrip(tmp_path):
+    model = MultiLayerNetwork(iris_mlp_conf()).init()
+    it = IrisDataSetIterator(batch_size=150)
+    model.fit(it, epochs=3)
+    x = np.random.default_rng(0).normal(size=(5, 4)).astype(np.float32)
+    y1 = np.asarray(model.output(x))
+    path = str(tmp_path / "model.zip")
+    save_model(model, path, save_updater=True)
+    model2 = restore_multi_layer_network(path, load_updater=True)
+    y2 = np.asarray(model2.output(x))
+    np.testing.assert_allclose(y1, y2, rtol=1e-6)
+    # exact training resume: one more batch on each gives identical params
+    batch = next(iter(it))
+    model.fit(batch)
+    model2.fit(batch)
+    for a, b in zip(jax.tree_util.tree_leaves(model.params),
+                    jax.tree_util.tree_leaves(model2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_small_cnn_trains():
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(7)
+            .updater(Adam(1e-2))
+            .list()
+            .layer(ConvolutionLayer(n_out=8, kernel_size=(3, 3),
+                                    activation=Activation.RELU,
+                                    convolution_mode=ConvolutionMode.SAME))
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(BatchNormalization())
+            .layer(DenseLayer(n_out=32, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=10))
+            .set_input_type(InputType.convolutional_flat(28, 28, 1))
+            .build())
+    model = MultiLayerNetwork(conf).init()
+    it = MnistDataSetIterator(batch_size=64, subset=512, train=True)
+    model.fit(it, epochs=3)
+    acc = model.evaluate(it).accuracy()
+    assert acc > 0.5, f"CNN failed to learn synthetic mnist: {acc}"
+
+
+def test_lstm_sequence_classification():
+    # classify whether the mean of a noisy sequence is positive
+    rng = np.random.default_rng(3)
+    n, t, f = 256, 10, 4
+    x = rng.normal(size=(n, t, f)).astype(np.float32)
+    shift = rng.choice([-0.8, 0.8], size=(n, 1, 1)).astype(np.float32)
+    x = x + shift
+    y = (shift[:, 0, 0] > 0).astype(np.int64)
+    labels = np.zeros((n, 2), np.float32)
+    labels[np.arange(n), y] = 1.0
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(5)
+            .updater(Adam(5e-3))
+            .list()
+            .layer(LSTM(n_out=16))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.recurrent(f, t))
+            .build())
+    from deeplearning4j_tpu.nn.layers.recurrent import LastTimeStep
+    # LSTM output is a sequence; use global pooling via LastTimeStep wrap
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(5)
+            .updater(Adam(5e-3))
+            .list()
+            .layer(LastTimeStep(inner=LSTM(n_in=f, n_out=16)))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.recurrent(f, t))
+            .build())
+    model = MultiLayerNetwork(conf).init()
+    it = ArrayDataSetIterator(DataSet(x, labels), 64, shuffle=True, seed=0)
+    model.fit(it, epochs=8)
+    assert model.evaluate(it).accuracy() > 0.85
+
+
+def test_rnn_output_layer_per_timestep():
+    rng = np.random.default_rng(4)
+    n, t, f = 128, 6, 3
+    x = rng.normal(size=(n, t, f)).astype(np.float32)
+    y = (x.sum(axis=2) > 0)
+    labels = np.stack([1 - y, y], axis=-1).astype(np.float32)
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(5).updater(Adam(1e-2)).list()
+            .layer(LSTM(n_out=16))
+            .layer(RnnOutputLayer(n_out=2))
+            .set_input_type(InputType.recurrent(f, t))
+            .build())
+    model = MultiLayerNetwork(conf).init()
+    it = ArrayDataSetIterator(DataSet(x, labels), 32)
+    model.fit(it, epochs=10)
+    preds = np.asarray(model.output(x))
+    assert preds.shape == (n, t, 2)
+    acc = ((preds.argmax(-1) == y).mean())
+    assert acc > 0.8
+
+
+def test_computation_graph_branches():
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(9)
+            .updater(Adam(1e-2))
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("a", DenseLayer(n_out=8, activation=Activation.RELU), "in")
+            .add_layer("b", DenseLayer(n_out=8, activation=Activation.TANH), "in")
+            .add_vertex("merge", MergeVertex(), "a", "b")
+            .add_layer("out", OutputLayer(n_out=3), "merge")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+    model = ComputationGraph(conf).init()
+    assert conf.node("out").layer.n_in == 16
+    it = IrisDataSetIterator(batch_size=50)
+    model.fit(it, epochs=40)
+    acc = model.evaluate(it).accuracy()
+    assert acc > 0.9
+
+
+def test_computation_graph_residual():
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(9).updater(Adam(1e-2)).graph_builder()
+            .add_inputs("in")
+            .add_layer("fc1", DenseLayer(n_out=4, activation=Activation.RELU), "in")
+            .add_vertex("res", ElementWiseVertex(op="add"), "fc1", "in")
+            .add_layer("out", OutputLayer(n_out=3), "res")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+    model = ComputationGraph(conf).init()
+    y = model.output(np.zeros((2, 4), np.float32))
+    assert y.shape == (2, 3)
+
+
+def test_cg_serialization_roundtrip(tmp_path):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(9).updater(Sgd(1e-2)).graph_builder()
+            .add_inputs("in")
+            .add_layer("fc", DenseLayer(n_out=8, activation=Activation.RELU), "in")
+            .add_layer("out", OutputLayer(n_out=3), "fc")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+    model = ComputationGraph(conf).init()
+    model.fit(IrisDataSetIterator(batch_size=150), epochs=2)
+    x = np.zeros((2, 4), np.float32)
+    y1 = np.asarray(model.output(x))
+    path = str(tmp_path / "cg.zip")
+    save_model(model, path)
+    model2 = restore_computation_graph(path)
+    np.testing.assert_allclose(y1, np.asarray(model2.output(x)), rtol=1e-6)
+
+
+def test_frozen_layer_not_updated():
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(1).updater(Adam(1e-2)).list()
+            .layer(DenseLayer(n_out=8, activation=Activation.RELU, frozen=True))
+            .layer(OutputLayer(n_out=3))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    model = MultiLayerNetwork(conf).init()
+    w0 = np.asarray(model.params["layer_0"]["W"]).copy()
+    model.fit(IrisDataSetIterator(batch_size=150), epochs=3)
+    np.testing.assert_allclose(w0, np.asarray(model.params["layer_0"]["W"]))
+    # but the output layer DID move
+    assert not np.allclose(0, np.asarray(model.params["layer_1"]["W"]) -
+                           np.asarray(MultiLayerNetwork(conf).init()
+                                      .params["layer_1"]["W"]))
+
+
+def test_summary_and_num_params():
+    model = MultiLayerNetwork(iris_mlp_conf()).init()
+    s = model.summary()
+    assert "DenseLayer" in s and "OutputLayer" in s
+    # 4*16+16 + 16*16+16 + 16*3+3 = 80+272+51
+    assert model.num_params() == (4 * 16 + 16) + (16 * 16 + 16) + (16 * 3 + 3)
