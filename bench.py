@@ -1,0 +1,80 @@
+"""Benchmark: ResNet-50 training throughput (images/sec/chip).
+
+The BASELINE.json headline metric (ResNet50 on TinyImageNet-shaped data,
+64x64x3, 200 classes). Runs on whatever accelerator jax exposes (the driver
+provides one real TPU chip; falls back to CPU with a smaller config so the
+line is always produced).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+``vs_baseline`` is vs the reference's published number for this config —
+the reference publishes none (SURVEY §6, BASELINE.md), so 1.0 is reported
+and the absolute number is the record.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+
+    from deeplearning4j_tpu.zoo.models import ResNet50
+    from deeplearning4j_tpu.optimize.updaters import Nesterovs
+
+    if on_accel:
+        batch, steps, warmup = 256, 20, 5
+        compute_dtype = "bfloat16"
+    else:
+        batch, steps, warmup = 16, 4, 2
+        compute_dtype = "float32"
+
+    model = ResNet50(num_classes=200, height=64, width=64, channels=3,
+                     compute_dtype=compute_dtype,
+                     updater=Nesterovs(1e-2, 0.9)).init()
+    model._train_step = model._build_train_step()
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, 64, 64, 3)).astype(np.float32))
+    idx = rng.integers(0, 200, batch)
+    y = np.zeros((batch, 200), np.float32)
+    y[np.arange(batch), idx] = 1.0
+    y = jnp.asarray(y)
+
+    import jax.random as jrandom
+    key = jrandom.PRNGKey(0)
+
+    ts = model.train_state
+    # warmup (includes compile)
+    for i in range(warmup):
+        ts, loss = model._train_step(ts, (x,), (y,), None, None,
+                                     jrandom.fold_in(key, i))
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        ts, loss = model._train_step(ts, (x,), (y,), None, None,
+                                     jrandom.fold_in(key, warmup + i))
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    images_per_sec = steps * batch / dt
+    print(json.dumps({
+        "metric": f"resnet50_64x64_{compute_dtype}_train_images_per_sec_per_chip"
+                  f"_{platform}",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from deeplearning4j_tpu.datasets.dataset import DataSet, DataSetIterator
 from deeplearning4j_tpu.optimize.solver import TrainState
@@ -209,7 +209,7 @@ class ParallelWrapper:
             in_specs=(P(), pspec_batch, pspec_batch, pspec_batch,
                       pspec_batch, P()),
             out_specs=(P(), P()),
-            check_rep=False)
+            check_vma=False)
         return jax.jit(wrapped, donate_argnums=(0,)), None
 
     # ---- fit ------------------------------------------------------------
@@ -219,6 +219,50 @@ class ParallelWrapper:
         if self.mode is TrainingMode.AVERAGING:
             return self._fit_averaging(iterator, epochs)
         raise ValueError(f"unsupported mode: {self.mode}")
+
+    def _pad_batch(self, batch: DataSet, target: int | None = None) -> DataSet:
+        """Pad to a multiple of num_workers (and optionally to ``target``
+        examples) with zero-weight rows: padded examples carry
+        labels_mask == 0, so the masked loss mean ignores them. Loss and
+        gradients then match the unpadded single-device step; the one
+        exception is BatchNormalization batch statistics, which see the
+        duplicated rows (mask-free batch moments) — a bounded, usually
+        negligible perturbation. (The reference rebalances queues across
+        trainer threads instead — ParallelWrapper.java:225; static shapes
+        make padding the XLA way.)"""
+        n = batch.num_examples()
+        w = self.num_workers
+        pad = ((target - n) if target else 0) + ((-(target or n)) % w)
+        if pad == 0:
+            return batch
+
+        def rep(a):
+            if a is None:
+                return None
+            a = np.asarray(a)
+            return np.concatenate([a, np.repeat(a[-1:], pad, axis=0)], axis=0)
+
+        lmask = batch.labels_mask
+        if lmask is None:
+            lab = np.asarray(batch.labels)
+            if lab.ndim <= 2:
+                # (N,) sparse or (N, C) dense labels → per-example weights
+                mask_shape = (n,)
+            elif lab.ndim == 3 and batch.features_mask is not None:
+                # variable-length sequences: keep the features-mask
+                # semantics the unpadded loss path would have used
+                lmask = np.asarray(batch.features_mask, np.float32)
+                mask_shape = None
+            else:
+                # (N, T, C) → (N, T); (N, H, W, C) → (N, H, W)
+                mask_shape = lab.shape[:-1]
+            if lmask is None:
+                lmask = np.ones(mask_shape, np.float32)
+        lmask = np.asarray(lmask)
+        zeros = np.zeros((pad,) + lmask.shape[1:], lmask.dtype)
+        return DataSet(rep(batch.features), rep(batch.labels),
+                       rep(batch.features_mask),
+                       np.concatenate([lmask, zeros], axis=0))
 
     def _fit_sync(self, iterator, epochs):
         if self._step is None:
@@ -230,6 +274,8 @@ class ParallelWrapper:
             t0 = time.perf_counter()
             for batch in iterator:
                 etl_ms = (time.perf_counter() - t0) * 1000
+                n_real = batch.num_examples()
+                batch = self._pad_batch(batch)
                 m._rng, key = jax.random.split(m._rng)
                 put = lambda a: (None if a is None else jax.device_put(
                     jnp.asarray(a), self._batch_sh))
@@ -242,7 +288,7 @@ class ParallelWrapper:
                 it = int(m.train_state.iteration)
                 for lst in m.listeners:
                     lst.iteration_done(m, it, m.epoch_count, loss, etl_ms,
-                                       batch.num_examples())
+                                       n_real)
                 m._last_loss = loss
                 t0 = time.perf_counter()
             iterator.reset()
@@ -279,6 +325,10 @@ class ParallelWrapper:
     def _run_averaging_round(self, batches):
         m = self.model
         m._rng, key = jax.random.split(m._rng)
+        n_real = sum(b.num_examples() for b in batches)
+        # equalize batch sizes (stacking needs it), padding w/ masked rows
+        target = max(b.num_examples() for b in batches)
+        batches = [self._pad_batch(b, target=target) for b in batches]
         def stack(get):
             vals = [get(b) for b in batches]
             if any(v is None for v in vals):
@@ -291,7 +341,6 @@ class ParallelWrapper:
         m.train_state, loss = self._step(m.train_state, feats, labels,
                                          fmask, lmask, key)
         it = int(m.train_state.iteration)
-        n = sum(b.num_examples() for b in batches)
         for lst in m.listeners:
-            lst.iteration_done(m, it, m.epoch_count, loss, 0.0, n)
+            lst.iteration_done(m, it, m.epoch_count, loss, 0.0, n_real)
         m._last_loss = loss

@@ -1,0 +1,331 @@
+"""Model zoo.
+
+Analog of deeplearning4j-zoo (SURVEY §2.6: ZooModel.java:23 + model/
+AlexNet, Darknet19, LeNet, ResNet50, SimpleCNN, VGG16, VGG19,
+TextGenerationLSTM, TinyYOLO...). Each zoo entry builds a ready
+configuration/model for a given input shape + class count.
+
+TPU-first notes: all convs NHWC; ResNet50 uses the standard bottleneck-v1
+topology as a ComputationGraph (merge/elementwise vertices), compiled to a
+single XLA program. bfloat16 compute is a flag away
+(``compute_dtype="bfloat16"``) and is the benchmark configuration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from deeplearning4j_tpu.models.computation_graph import ComputationGraph
+from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.graph.vertices import ElementWiseVertex
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers.convolution import (
+    ConvolutionLayer,
+    ConvolutionMode,
+    PoolingType,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.layers.feedforward import (
+    ActivationLayer,
+    DenseLayer,
+    DropoutLayer,
+)
+from deeplearning4j_tpu.nn.layers.normalization import BatchNormalization
+from deeplearning4j_tpu.nn.layers.output import (
+    GlobalPoolingLayer,
+    OutputLayer,
+    RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.layers.recurrent import LSTM
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.initializers import WeightInit
+from deeplearning4j_tpu.ops.losses import LossFunction
+from deeplearning4j_tpu.optimize.updaters import Adam, Nesterovs, Updater
+
+
+class ZooModel:
+    """Base zoo entry (reference: ZooModel.java:23). ``init()`` returns a
+    built, initialized model. Pretrained-weight loading hooks into the
+    checkpoint loader when a weights file is present locally (zero-egress
+    environment: no downloads; same cache contract as the fetchers)."""
+
+    def conf(self):
+        raise NotImplementedError
+
+    def init(self):
+        raise NotImplementedError
+
+    def init_pretrained(self, path: Optional[str] = None):
+        from deeplearning4j_tpu.models.serialization import (
+            restore_computation_graph, restore_multi_layer_network)
+        if path is None:
+            raise FileNotFoundError(
+                "no local pretrained weights; this environment has no "
+                "network egress — place a checkpoint zip and pass its path")
+        model = self.init()
+        if isinstance(model, MultiLayerNetwork):
+            return restore_multi_layer_network(path)
+        return restore_computation_graph(path)
+
+
+@dataclasses.dataclass
+class LeNet(ZooModel):
+    """reference: deeplearning4j-zoo/.../model/LeNet.java (BASELINE cfg 0)."""
+    num_classes: int = 10
+    height: int = 28
+    width: int = 28
+    channels: int = 1
+    updater: Updater = dataclasses.field(default_factory=lambda: Adam(1e-3))
+    seed: int = 123
+    compute_dtype: str = "float32"
+
+    def conf(self):
+        return (NeuralNetConfiguration.Builder()
+                .seed(self.seed)
+                .updater(self.updater)
+                .compute_dtype(self.compute_dtype)
+                .list()
+                .layer(ConvolutionLayer(n_out=20, kernel_size=(5, 5),
+                                        activation=Activation.RELU,
+                                        weight_init=WeightInit.HE_NORMAL))
+                .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=50, kernel_size=(5, 5),
+                                        activation=Activation.RELU,
+                                        weight_init=WeightInit.HE_NORMAL))
+                .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+                .layer(DenseLayer(n_out=500, activation=Activation.RELU))
+                .layer(OutputLayer(n_out=self.num_classes,
+                                   loss=LossFunction.MCXENT,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.convolutional_flat(
+                    self.height, self.width, self.channels))
+                .build())
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init()
+
+
+@dataclasses.dataclass
+class SimpleCNN(ZooModel):
+    """reference: model/SimpleCNN.java — 4 conv blocks + dense."""
+    num_classes: int = 10
+    height: int = 48
+    width: int = 48
+    channels: int = 3
+    seed: int = 123
+
+    def conf(self):
+        b = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(Adam(1e-3))
+             .list())
+        for n_out in (16, 32, 64, 128):
+            b = (b.layer(ConvolutionLayer(
+                    n_out=n_out, kernel_size=(3, 3),
+                    convolution_mode=ConvolutionMode.SAME,
+                    activation=Activation.IDENTITY))
+                 .layer(BatchNormalization())
+                 .layer(ConvolutionLayer(
+                     n_out=n_out, kernel_size=(3, 3),
+                     convolution_mode=ConvolutionMode.SAME,
+                     activation=Activation.RELU))
+                 .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2))))
+        return (b.layer(DenseLayer(n_out=256, activation=Activation.RELU,
+                                   dropout=0.5))
+                .layer(OutputLayer(n_out=self.num_classes))
+                .set_input_type(InputType.convolutional(
+                    self.height, self.width, self.channels))
+                .build())
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init()
+
+
+@dataclasses.dataclass
+class VGG16(ZooModel):
+    """reference: model/VGG16.java (BASELINE cfg 1)."""
+    num_classes: int = 200
+    height: int = 224
+    width: int = 224
+    channels: int = 3
+    seed: int = 123
+    compute_dtype: str = "float32"
+
+    def conf(self):
+        b = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(Nesterovs(1e-2, 0.9))
+             .compute_dtype(self.compute_dtype)
+             .list())
+        plan = [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]
+        for n_out, reps in plan:
+            for _ in range(reps):
+                b = b.layer(ConvolutionLayer(
+                    n_out=n_out, kernel_size=(3, 3),
+                    convolution_mode=ConvolutionMode.SAME,
+                    activation=Activation.RELU))
+            b = b.layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        return (b.layer(DenseLayer(n_out=4096, activation=Activation.RELU,
+                                   dropout=0.5))
+                .layer(DenseLayer(n_out=4096, activation=Activation.RELU,
+                                  dropout=0.5))
+                .layer(OutputLayer(n_out=self.num_classes))
+                .set_input_type(InputType.convolutional(
+                    self.height, self.width, self.channels))
+                .build())
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init()
+
+
+@dataclasses.dataclass
+class ResNet50(ZooModel):
+    """reference: model/ResNet50.java (BASELINE cfgs 1 & 4) — bottleneck-v1
+    ComputationGraph: conv1 7x7/2 → maxpool/2 → stages [3,4,6,3] →
+    global avg pool → softmax."""
+    num_classes: int = 200
+    height: int = 224
+    width: int = 224
+    channels: int = 3
+    seed: int = 123
+    compute_dtype: str = "float32"
+    updater: Updater = dataclasses.field(
+        default_factory=lambda: Nesterovs(1e-2, 0.9))
+
+    def conf(self):
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(self.updater)
+             .compute_dtype(self.compute_dtype)
+             .graph_builder()
+             .add_inputs("in")
+             .set_input_types(InputType.convolutional(
+                 self.height, self.width, self.channels)))
+
+        def conv_bn(name, src, n_out, k, s, act=Activation.RELU):
+            g.add_layer(f"{name}_conv", ConvolutionLayer(
+                n_out=n_out, kernel_size=k, stride=s,
+                convolution_mode=ConvolutionMode.SAME, has_bias=False,
+                weight_init=WeightInit.HE_NORMAL,
+                activation=Activation.IDENTITY), src)
+            g.add_layer(f"{name}_bn", BatchNormalization(), f"{name}_conv")
+            if act is None:
+                return f"{name}_bn"
+            g.add_layer(f"{name}_act", ActivationLayer(activation=act),
+                        f"{name}_bn")
+            return f"{name}_act"
+
+        def bottleneck(name, src, filters, stride, downsample):
+            f1, f2, f3 = filters, filters, filters * 4
+            x = conv_bn(f"{name}_a", src, f1, (1, 1), (stride, stride))
+            x = conv_bn(f"{name}_b", x, f2, (3, 3), (1, 1))
+            x = conv_bn(f"{name}_c", x, f3, (1, 1), (1, 1), act=None)
+            if downsample:
+                shortcut = conv_bn(f"{name}_ds", src, f3, (1, 1),
+                                   (stride, stride), act=None)
+            else:
+                shortcut = src
+            g.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), x,
+                         shortcut)
+            g.add_layer(f"{name}_out",
+                        ActivationLayer(activation=Activation.RELU),
+                        f"{name}_add")
+            return f"{name}_out"
+
+        x = conv_bn("conv1", "in", 64, (7, 7), (2, 2))
+        g.add_layer("pool1", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2),
+            convolution_mode=ConvolutionMode.SAME), x)
+        x = "pool1"
+        stages = [(64, 3, 1), (128, 4, 2), (256, 6, 2), (512, 3, 2)]
+        for si, (filters, blocks, first_stride) in enumerate(stages):
+            for bi in range(blocks):
+                stride = first_stride if bi == 0 else 1
+                x = bottleneck(f"s{si}b{bi}", x, filters, stride, bi == 0)
+        g.add_layer("avgpool", GlobalPoolingLayer(
+            pooling_type=PoolingType.AVG), x)
+        g.add_layer("out", OutputLayer(n_out=self.num_classes,
+                                       loss=LossFunction.MCXENT,
+                                       activation=Activation.SOFTMAX),
+                    "avgpool")
+        g.set_outputs("out")
+        return g.build()
+
+    def init(self) -> ComputationGraph:
+        return ComputationGraph(self.conf()).init()
+
+
+@dataclasses.dataclass
+class TextGenerationLSTM(ZooModel):
+    """reference: model/TextGenerationLSTM.java — char-level 2xLSTM(256)."""
+    vocab_size: int = 77
+    timesteps: int = 60
+    lstm_units: int = 256
+    seed: int = 123
+
+    def conf(self):
+        return (NeuralNetConfiguration.Builder()
+                .seed(self.seed)
+                .updater(Adam(2e-3))
+                .gradient_normalization("clip_value", 5.0)
+                .list()
+                .layer(LSTM(n_out=self.lstm_units,
+                            activation=Activation.TANH))
+                .layer(LSTM(n_out=self.lstm_units,
+                            activation=Activation.TANH))
+                .layer(RnnOutputLayer(n_out=self.vocab_size,
+                                      loss=LossFunction.MCXENT,
+                                      activation=Activation.SOFTMAX))
+                .set_input_type(InputType.recurrent(self.vocab_size,
+                                                    self.timesteps))
+                .build())
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init()
+
+
+@dataclasses.dataclass
+class AlexNet(ZooModel):
+    """reference: model/AlexNet.java (single-stream variant)."""
+    num_classes: int = 1000
+    height: int = 224
+    width: int = 224
+    channels: int = 3
+    seed: int = 123
+
+    def conf(self):
+        return (NeuralNetConfiguration.Builder()
+                .seed(self.seed)
+                .updater(Nesterovs(1e-2, 0.9))
+                .list()
+                .layer(ConvolutionLayer(n_out=96, kernel_size=(11, 11),
+                                        stride=(4, 4),
+                                        activation=Activation.RELU))
+                .layer(SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=256, kernel_size=(5, 5),
+                                        convolution_mode=ConvolutionMode.SAME,
+                                        activation=Activation.RELU))
+                .layer(SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=384, kernel_size=(3, 3),
+                                        convolution_mode=ConvolutionMode.SAME,
+                                        activation=Activation.RELU))
+                .layer(ConvolutionLayer(n_out=384, kernel_size=(3, 3),
+                                        convolution_mode=ConvolutionMode.SAME,
+                                        activation=Activation.RELU))
+                .layer(ConvolutionLayer(n_out=256, kernel_size=(3, 3),
+                                        convolution_mode=ConvolutionMode.SAME,
+                                        activation=Activation.RELU))
+                .layer(SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2)))
+                .layer(DenseLayer(n_out=4096, activation=Activation.RELU,
+                                  dropout=0.5))
+                .layer(DenseLayer(n_out=4096, activation=Activation.RELU,
+                                  dropout=0.5))
+                .layer(OutputLayer(n_out=self.num_classes))
+                .set_input_type(InputType.convolutional(
+                    self.height, self.width, self.channels))
+                .build())
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init()
