@@ -1,0 +1,49 @@
+"""Early stopping (SURVEY §2.1: earlystopping/)."""
+
+from deeplearning4j_tpu.earlystopping.config import (
+    EarlyStoppingConfiguration,
+    EarlyStoppingResult,
+    TerminationReason,
+)
+from deeplearning4j_tpu.earlystopping.savers import (
+    InMemoryModelSaver,
+    LocalFileGraphSaver,
+    LocalFileModelSaver,
+    ModelSaver,
+)
+from deeplearning4j_tpu.earlystopping.scorecalc import (
+    ClassificationScoreCalculator,
+    CustomScoreCalculator,
+    DataSetLossCalculator,
+    RegressionScoreCalculator,
+    ScoreCalculator,
+)
+from deeplearning4j_tpu.earlystopping.termination import (
+    BestScoreEpochTerminationCondition,
+    EpochTerminationCondition,
+    InvalidScoreIterationTerminationCondition,
+    IterationTerminationCondition,
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochsTerminationCondition,
+)
+from deeplearning4j_tpu.earlystopping.trainer import (
+    EarlyStoppingGraphTrainer,
+    EarlyStoppingTrainer,
+)
+
+__all__ = [
+    "EarlyStoppingConfiguration", "EarlyStoppingResult", "TerminationReason",
+    "ModelSaver", "InMemoryModelSaver", "LocalFileModelSaver",
+    "LocalFileGraphSaver", "ScoreCalculator", "DataSetLossCalculator",
+    "ClassificationScoreCalculator", "RegressionScoreCalculator",
+    "CustomScoreCalculator", "EpochTerminationCondition",
+    "MaxEpochsTerminationCondition",
+    "ScoreImprovementEpochsTerminationCondition",
+    "BestScoreEpochTerminationCondition", "IterationTerminationCondition",
+    "MaxTimeIterationTerminationCondition",
+    "MaxScoreIterationTerminationCondition",
+    "InvalidScoreIterationTerminationCondition", "EarlyStoppingTrainer",
+    "EarlyStoppingGraphTrainer",
+]

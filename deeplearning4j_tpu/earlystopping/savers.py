@@ -1,0 +1,84 @@
+"""Model savers for early stopping.
+
+Analog of deeplearning4j-nn/.../earlystopping/saver/
+(InMemoryModelSaver.java, LocalFileModelSaver.java, LocalFileGraphSaver
+.java). One LocalFileModelSaver serves both model classes here — the
+checkpoint format (models/serialization.py) is class-tagged.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from deeplearning4j_tpu.models import serialization
+
+
+class ModelSaver:
+    def save_best_model(self, model, score: float) -> None:
+        raise NotImplementedError
+
+    def save_latest_model(self, model, score: float) -> None:
+        raise NotImplementedError
+
+    def get_best_model(self):
+        raise NotImplementedError
+
+    def get_latest_model(self):
+        raise NotImplementedError
+
+
+class InMemoryModelSaver(ModelSaver):
+    """Keeps clones in memory (saver/InMemoryModelSaver.java)."""
+
+    def __init__(self):
+        self._best = None
+        self._latest = None
+
+    def save_best_model(self, model, score: float) -> None:
+        self._best = model.clone()
+
+    def save_latest_model(self, model, score: float) -> None:
+        self._latest = model.clone()
+
+    def get_best_model(self):
+        return self._best
+
+    def get_latest_model(self):
+        return self._latest
+
+
+class LocalFileModelSaver(ModelSaver):
+    """Writes bestModel.bin / latestModel.bin under a directory
+    (saver/LocalFileModelSaver.java — same file names)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.directory, name)
+
+    def save_best_model(self, model, score: float) -> None:
+        serialization.save_model(model, self._path("bestModel.bin"),
+                                 save_updater=True)
+
+    def save_latest_model(self, model, score: float) -> None:
+        serialization.save_model(model, self._path("latestModel.bin"),
+                                 save_updater=True)
+
+    def _restore(self, name: str):
+        path = self._path(name)
+        if not os.path.exists(path):
+            return None
+        return serialization.restore_model(path, load_updater=True)
+
+    def get_best_model(self):
+        return self._restore("bestModel.bin")
+
+    def get_latest_model(self):
+        return self._restore("latestModel.bin")
+
+
+# Alias for API parity with the reference's graph-specific saver.
+LocalFileGraphSaver = LocalFileModelSaver

@@ -1,0 +1,128 @@
+"""Early-stopping trainer.
+
+Analog of deeplearning4j-nn/.../earlystopping/trainer/
+(BaseEarlyStoppingTrainer.java, EarlyStoppingTrainer.java,
+EarlyStoppingGraphTrainer.java): drives its own epoch loop so
+iteration-level conditions can break mid-epoch, evaluates the held-out
+score every N epochs, keeps the best model via the saver, and restores it
+into the result (SURVEY §5.3 — EarlyStopping restores best checkpoint).
+
+One trainer serves both model classes (the functional core is shared).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, DataSetIterator
+from deeplearning4j_tpu.earlystopping.config import (
+    EarlyStoppingConfiguration,
+    EarlyStoppingResult,
+    TerminationReason,
+)
+
+
+class EarlyStoppingTrainer:
+    def __init__(self, config: EarlyStoppingConfiguration, model,
+                 train_data: DataSetIterator):
+        self.config = config
+        self.model = model
+        self.train_data = train_data
+        self.listener = None  # optional EarlyStoppingListener-style hook
+
+    def set_listener(self, listener) -> None:
+        self.listener = listener
+
+    def _score_direction_minimize(self) -> bool:
+        if self.config.score_calculator is not None:
+            return self.config.score_calculator.minimize_score
+        return self.config.minimize
+
+    def fit(self) -> EarlyStoppingResult:
+        cfg = self.config
+        minimize = self._score_direction_minimize()
+        for c in cfg.epoch_terminations:
+            c.initialize()
+        for c in cfg.iteration_terminations:
+            c.initialize()
+
+        if self.model.train_state is None:
+            self.model.init()
+
+        best_score: Optional[float] = None
+        best_epoch = -1
+        score_vs_epoch = {}
+        epoch = 0
+        reason = None
+        details = ""
+
+        while True:
+            # ---- one epoch, iteration conditions checked per minibatch --
+            self.train_data.reset()
+            terminated_iter = False
+            for ds in self.train_data:
+                self.model.fit(ds)
+                last = self.model.score()
+                for cond in cfg.iteration_terminations:
+                    if cond.terminate(last):
+                        terminated_iter = True
+                        reason = TerminationReason.ITERATION_TERMINATION_CONDITION
+                        details = str(cond)
+                        break
+                if terminated_iter:
+                    break
+            if terminated_iter:
+                break
+
+            # ---- held-out score + best-model tracking -------------------
+            if (cfg.score_calculator is not None
+                    and epoch % cfg.evaluate_every_n_epochs == 0):
+                score = cfg.score_calculator.calculate_score(self.model)
+                score_vs_epoch[epoch] = score
+                improved = (best_score is None
+                            or (minimize and score < best_score)
+                            or (not minimize and score > best_score))
+                if improved:
+                    best_score = score
+                    best_epoch = epoch
+                    cfg.saver.save_best_model(self.model, score)
+                if self.listener is not None:
+                    self.listener(epoch, score, self.model)
+            else:
+                score = self.model.score()
+
+            if cfg.save_last_model:
+                cfg.saver.save_latest_model(self.model, score)
+
+            # ---- epoch conditions ---------------------------------------
+            stop = False
+            for cond in cfg.epoch_terminations:
+                if cond.terminate(epoch, score, minimize):
+                    stop = True
+                    reason = TerminationReason.EPOCH_TERMINATION_CONDITION
+                    details = str(cond)
+                    break
+            if stop:
+                break
+            epoch += 1
+
+        best_model = cfg.saver.get_best_model()
+        if best_model is None:
+            best_model = self.model
+            if best_score is None:
+                best_score = float("nan")
+                best_epoch = epoch
+        return EarlyStoppingResult(
+            termination_reason=reason or TerminationReason.ERROR,
+            termination_details=details,
+            score_vs_epoch=score_vs_epoch,
+            best_model_epoch=best_epoch,
+            best_model_score=best_score if best_score is not None
+            else float("nan"),
+            total_epochs=epoch + 1,
+            best_model=best_model,
+        )
+
+
+# Reference has a distinct class for ComputationGraph; same impl here.
+EarlyStoppingGraphTrainer = EarlyStoppingTrainer

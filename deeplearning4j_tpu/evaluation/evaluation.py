@@ -284,3 +284,135 @@ class EvaluationBinary:
     def f1(self, col: int = 0) -> float:
         p, r = self.precision(col), self.recall(col)
         return 2 * p * r / (p + r) if (p + r) > 0 else 0.0
+
+
+class ROCBinary:
+    """Per-output ROC for multi-label binary outputs
+    (reference: ROCBinary.java) — one ROC per output column."""
+
+    def __init__(self):
+        self._rocs: Dict[int, ROC] = {}
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 1:
+            labels = labels[:, None]
+            predictions = predictions[:, None]
+        for c in range(labels.shape[-1]):
+            self._rocs.setdefault(c, ROC()).eval(
+                labels[..., c], predictions[..., c], mask)
+
+    def calculate_auc(self, col: int = 0) -> float:
+        return self._rocs[col].calculate_auc()
+
+    def calculate_average_auc(self) -> float:
+        return float(np.mean([r.calculate_auc()
+                              for r in self._rocs.values()]))
+
+
+class EvaluationCalibration:
+    """Reliability diagram + histograms of residuals/probabilities
+    (reference: EvaluationCalibration.java)."""
+
+    def __init__(self, reliability_bins: int = 10,
+                 histogram_bins: int = 50):
+        self.reliability_bins = reliability_bins
+        self.histogram_bins = histogram_bins
+        self._probs: List[np.ndarray] = []
+        self._labels: List[np.ndarray] = []
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels, np.float64)
+        preds = np.asarray(predictions, np.float64)
+        labels = labels.reshape(-1, labels.shape[-1])
+        preds = preds.reshape(-1, preds.shape[-1])
+        if mask is not None:
+            m = np.asarray(mask).reshape(-1).astype(bool)
+            labels, preds = labels[m], preds[m]
+        self._labels.append(labels)
+        self._probs.append(preds)
+
+    def _flat(self):
+        y = np.concatenate(self._labels).reshape(-1)
+        p = np.concatenate(self._probs).reshape(-1)
+        return y, p
+
+    def reliability_diagram(self):
+        """Returns (bin_centers, mean_predicted, fraction_positive,
+        counts) over equal-width probability bins."""
+        y, p = self._flat()
+        edges = np.linspace(0.0, 1.0, self.reliability_bins + 1)
+        idx = np.clip(np.digitize(p, edges) - 1, 0,
+                      self.reliability_bins - 1)
+        centers = (edges[:-1] + edges[1:]) / 2
+        mean_p = np.zeros(self.reliability_bins)
+        frac_pos = np.zeros(self.reliability_bins)
+        counts = np.zeros(self.reliability_bins, np.int64)
+        for b in range(self.reliability_bins):
+            sel = idx == b
+            counts[b] = sel.sum()
+            if counts[b]:
+                mean_p[b] = p[sel].mean()
+                frac_pos[b] = y[sel].mean()
+        return centers, mean_p, frac_pos, counts
+
+    def expected_calibration_error(self) -> float:
+        _, mean_p, frac_pos, counts = self.reliability_diagram()
+        total = counts.sum()
+        if total == 0:
+            return 0.0
+        return float(np.sum(counts / total * np.abs(mean_p - frac_pos)))
+
+    def residual_histogram(self):
+        y, p = self._flat()
+        return np.histogram(np.abs(y - p), bins=self.histogram_bins,
+                            range=(0.0, 1.0))
+
+    def probability_histogram(self):
+        _, p = self._flat()
+        return np.histogram(p, bins=self.histogram_bins, range=(0.0, 1.0))
+
+
+class ConfusionMatrix:
+    """Standalone confusion-matrix accumulator
+    (reference: ConfusionMatrix.java). ``Evaluation`` embeds the same
+    counts; this is the independently-usable variant."""
+
+    def __init__(self, classes: Optional[List] = None):
+        self.classes = list(classes) if classes is not None else None
+        self._counts: Dict[tuple, int] = {}
+
+    def add(self, actual, predicted, count: int = 1):
+        self._counts[(actual, predicted)] = \
+            self._counts.get((actual, predicted), 0) + count
+
+    def add_all(self, other: "ConfusionMatrix"):
+        for k, v in other._counts.items():
+            self._counts[k] = self._counts.get(k, 0) + v
+
+    def get_count(self, actual, predicted) -> int:
+        return self._counts.get((actual, predicted), 0)
+
+    def actual_total(self, actual) -> int:
+        return sum(v for (a, _), v in self._counts.items() if a == actual)
+
+    def predicted_total(self, predicted) -> int:
+        return sum(v for (_, p), v in self._counts.items()
+                   if p == predicted)
+
+    def to_array(self) -> np.ndarray:
+        cls = self.classes
+        seen = sorted({c for k in self._counts for c in k})
+        if cls is None:
+            cls = seen
+        else:
+            # labels recorded outside the declared class list still get a
+            # row/column instead of a KeyError
+            cls = cls + [c for c in seen if c not in cls]
+        n = len(cls)
+        arr = np.zeros((n, n), np.int64)
+        index = {c: i for i, c in enumerate(cls)}
+        for (a, p), v in self._counts.items():
+            arr[index[a], index[p]] = v
+        return arr

@@ -24,6 +24,7 @@ from deeplearning4j_tpu.nn.inputs import RecurrentType
 from deeplearning4j_tpu.nn.layers.base import LayerContext
 from deeplearning4j_tpu.optimize.solver import (
     TrainState,
+    make_constrain_fn,
     build_optimizer,
     make_train_step,
 )
@@ -59,18 +60,22 @@ class ComputationGraph(BaseModel):
             params[node.name] = (layer.initialize(k, it)
                                  if layer.has_params else {})
             state[node.name] = layer.init_state(it)
-        tx = build_optimizer(
+        tx = self._make_tx()
+        opt_state = tx.init(params)
+        self.train_state = TrainState(params, state, opt_state,
+                                      jnp.zeros((), jnp.int32))
+        self._tx = tx
+        return self
+
+    def _make_tx(self):
+        g = self.conf.global_config
+        return build_optimizer(
             self.layer_names,
             {n.name: n.layer.updater for n in self._layer_nodes},
             {n.name: n.layer.frozen for n in self._layer_nodes},
             g.updater,
             g.gradient_normalization,
         )
-        opt_state = tx.init(params)
-        self.train_state = TrainState(params, state, opt_state,
-                                      jnp.zeros((), jnp.int32))
-        self._tx = tx
-        return self
 
     # ---- functional forward --------------------------------------------
     def _walk(self, params, model_state, inputs: Dict[str, jnp.ndarray],
@@ -108,6 +113,7 @@ class ComputationGraph(BaseModel):
                     lp = jax.tree_util.tree_map(
                         lambda a: a.astype(jnp.bfloat16)
                         if jnp.issubdtype(a.dtype, jnp.floating) else a, lp)
+                lp = node.layer.apply_weight_noise(lp, ctx, key)
                 is_output = name in self.conf.network_outputs
                 if is_output and stop_before_loss and hasattr(
                         node.layer, "compute_loss"):
@@ -159,13 +165,19 @@ class ComputationGraph(BaseModel):
             total = total + n.layer.regularization_loss(params.get(n.name, {}))
         return total, new_state
 
+    def _constraint_layers(self):
+        return [n.layer for n in self._layer_nodes]
+
     def _build_train_step(self):
         def loss_fn(params, model_state, features, labels, fmask, lmask, rng,
                     iteration):
             # features/labels arrive as tuples (multi-input safe)
             return self._loss(params, model_state, features, labels, fmask,
                               lmask, rng, iteration)
-        return make_train_step(loss_fn, self._tx)
+        return make_train_step(
+            loss_fn, self._tx,
+            constrain_fn=make_constrain_fn(
+                [l for l in self._constraint_layers()]))
 
     # ---- fit ------------------------------------------------------------
     def _fit_batch(self, batch: Union[DataSet, MultiDataSet],
@@ -250,3 +262,19 @@ class ComputationGraph(BaseModel):
         if self.train_state is not None:
             lines.append(f"total params: {self.num_params()}")
         return "\n".join(lines)
+
+    def clone(self) -> "ComputationGraph":
+        m = ComputationGraph(self.conf)
+        if self.train_state is not None:
+            # see MultiLayerNetwork.clone: no wasted init, real copies
+            # (donation safety)
+            m._tx = m._make_tx()
+            m._rng = self._rng
+            copy = lambda t: jax.tree_util.tree_map(jnp.array, t)
+            m.train_state = TrainState(
+                copy(self.train_state.params),
+                copy(self.train_state.model_state),
+                copy(self.train_state.opt_state),
+                self.train_state.iteration)
+            m.epoch_count = self.epoch_count
+        return m

@@ -30,6 +30,7 @@ from deeplearning4j_tpu.nn.inputs import RecurrentType
 from deeplearning4j_tpu.nn.layers.base import LayerContext
 from deeplearning4j_tpu.optimize.solver import (
     TrainState,
+    make_constrain_fn,
     build_optimizer,
     make_train_step,
 )
@@ -113,6 +114,7 @@ class MultiLayerNetwork(BaseModel):
                 lp = jax.tree_util.tree_map(
                     lambda a: a.astype(jnp.bfloat16)
                     if jnp.issubdtype(a.dtype, jnp.floating) else a, lp)
+            lp = layer.apply_weight_noise(lp, ctx, key)
             x, s = layer.apply(lp, model_state.get(layer.name, {}), x, ctx)
             new_state[layer.name] = s
             if collect:
@@ -147,12 +149,18 @@ class MultiLayerNetwork(BaseModel):
         acc = jnp.promote_types(jnp.float32, loss.dtype)
         return loss.astype(acc) + reg.astype(acc), new_state
 
+    def _constraint_layers(self):
+        return self.layers
+
     def _build_train_step(self):
         def loss_fn(params, model_state, features, labels, fmask, lmask, rng,
                     iteration):
             return self._loss(params, model_state, features, labels, fmask,
                               lmask, rng, iteration)
-        return make_train_step(loss_fn, self._tx)
+        return make_train_step(
+            loss_fn, self._tx,
+            constrain_fn=make_constrain_fn(
+                [l for l in self._constraint_layers()]))
 
     # ---- inference ------------------------------------------------------
     def output(self, features, train: bool = False, mask=None):
@@ -258,10 +266,71 @@ class MultiLayerNetwork(BaseModel):
     def clone(self) -> "MultiLayerNetwork":
         m = MultiLayerNetwork(self.conf)
         if self.train_state is not None:
-            m.init()
+            # no init(): build just the optimizer transform and DEEP-copy
+            # the state (the train step donates its input buffers, so
+            # sharing references would let future fit() calls invalidate
+            # the clone's arrays on TPU)
+            m._tx = m._make_tx()
+            m._rng = self._rng
+            copy = lambda t: jax.tree_util.tree_map(jnp.array, t)
             m.train_state = TrainState(
-                jax.tree_util.tree_map(lambda a: a, self.train_state.params),
-                jax.tree_util.tree_map(lambda a: a, self.train_state.model_state),
-                m.train_state.opt_state,
-                jnp.zeros((), jnp.int32))
+                copy(self.train_state.params),
+                copy(self.train_state.model_state),
+                copy(self.train_state.opt_state),
+                self.train_state.iteration)
+            m.epoch_count = self.epoch_count
         return m
+
+    # ---- layerwise pretraining ------------------------------------------
+    def pretrain(self, iterator, epochs: int = 1):
+        """Greedy layerwise unsupervised pretraining of every layer that
+        defines ``pretrain_loss`` (AutoEncoder, VariationalAutoencoder) —
+        the reference's MultiLayerNetwork.pretrain(DataSetIterator)."""
+        for i, layer in enumerate(self.layers):
+            if getattr(layer, "supports_pretrain", False):
+                self.pretrain_layer(i, iterator, epochs)
+        return self
+
+    def pretrain_layer(self, idx: int, iterator, epochs: int = 1):
+        """Pretrain one layer on activations from the (frozen) layers below
+        it (reference: pretrainLayer(int, DataSetIterator))."""
+        import optax
+        if self.train_state is None:
+            self.init()
+        layer = self.layers[idx]
+        if not getattr(layer, "supports_pretrain", False):
+            return self
+        g = self.conf.global_config
+        updater = layer.updater or g.updater
+        tx = updater.to_optax()
+        lp = self.train_state.params[layer.name]
+        opt_state = tx.init(lp)
+        all_params = self.train_state.params
+        model_state = self.train_state.model_state
+        pp = self._preprocessors.get(idx)
+
+        def step(lp, opt_state, x, key):
+            def lf(lp):
+                h, _ = self._forward(all_params, model_state, x, None,
+                                     False, None, upto=idx)
+                if pp is not None:
+                    h = pp.apply(h)
+                return layer.pretrain_loss(lp, h, key)
+
+            loss, grads = jax.value_and_grad(lf)(lp)
+            updates, opt_state2 = tx.update(grads, opt_state, lp)
+            return optax.apply_updates(lp, updates), opt_state2, loss
+
+        jstep = jax.jit(step, donate_argnums=(0, 1))
+        for _ in range(epochs):
+            for ds in iterator:
+                self._rng, k = jax.random.split(self._rng)
+                lp, opt_state, loss = jstep(
+                    lp, opt_state, jnp.asarray(ds.features), k)
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+        new_params = dict(self.train_state.params)
+        new_params[layer.name] = lp
+        self.train_state = self.train_state._replace(params=new_params)
+        self._last_loss = float(loss)
+        return self

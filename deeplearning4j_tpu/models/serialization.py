@@ -126,6 +126,16 @@ def _restore(path: str, expected_class: str, loader, load_updater: bool):
         return model
 
 
+def restore_model(path: str, load_updater: bool = False):
+    """Class-agnostic restore: reads the checkpoint's own class tag
+    (reference analog: ModelGuesser.loadModelGuess for DL4J zips)."""
+    with zipfile.ZipFile(path, "r") as zf:
+        cls_name = json.loads(zf.read("meta.json"))["model_class"]
+    if cls_name == "MultiLayerNetwork":
+        return restore_multi_layer_network(path, load_updater)
+    return restore_computation_graph(path, load_updater)
+
+
 def restore_multi_layer_network(path: str, load_updater: bool = False):
     """reference: ModelSerializer.restoreMultiLayerNetwork."""
     from deeplearning4j_tpu.nn.config import MultiLayerConfiguration

@@ -1,0 +1,88 @@
+"""Parameter constraints, applied after each optimizer update.
+
+Analog of deeplearning4j-nn/.../nn/conf/constraint/ (MaxNormConstraint
+.java, MinMaxNormConstraint.java, UnitNormConstraint.java, NonNegative
+Constraint.java). The projection runs INSIDE the jitted train step (see
+optimize/solver.make_train_step's ``constrain_fn``), so it fuses with the
+update — no extra device round trip.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.param_keys import is_bias_path
+from deeplearning4j_tpu.utils.serde import register_serializable
+
+
+def _weight_axes(w: jnp.ndarray) -> Tuple[int, ...]:
+    """Norm is taken over all axes except the last (output dim) —
+    matching the reference's default dimensions for dense/conv weights."""
+    return tuple(range(max(w.ndim - 1, 1)))
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerConstraint:
+    """SPI: conf/constraint/ BaseConstraint. ``apply_to_bias`` default off,
+    like the reference (constraints apply to weights only by default)."""
+    apply_to_bias: bool = False
+
+    def project(self, w: jnp.ndarray) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def apply(self, params):
+        def go(path, p):
+            if not self.apply_to_bias and is_bias_path(path):
+                return p
+            return self.project(p)
+
+        leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+        return jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(params),
+            [go(path, leaf) for path, leaf in leaves])
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class MaxNormConstraint(LayerConstraint):
+    max_norm: float = 2.0
+
+    def project(self, w):
+        norm = jnp.sqrt(jnp.sum(jnp.square(w), axis=_weight_axes(w),
+                                keepdims=True) + 1e-12)
+        return w * jnp.minimum(1.0, self.max_norm / norm)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class MinMaxNormConstraint(LayerConstraint):
+    min_norm: float = 0.0
+    max_norm: float = 2.0
+    rate: float = 1.0  # interpolation rate toward the clipped norm
+
+    def project(self, w):
+        norm = jnp.sqrt(jnp.sum(jnp.square(w), axis=_weight_axes(w),
+                                keepdims=True) + 1e-12)
+        clipped = jnp.clip(norm, self.min_norm, self.max_norm)
+        target = self.rate * clipped + (1.0 - self.rate) * norm
+        return w * (target / norm)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class UnitNormConstraint(LayerConstraint):
+    def project(self, w):
+        norm = jnp.sqrt(jnp.sum(jnp.square(w), axis=_weight_axes(w),
+                                keepdims=True) + 1e-12)
+        return w / norm
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class NonNegativeConstraint(LayerConstraint):
+    def project(self, w):
+        return jnp.maximum(w, 0.0)

@@ -1,0 +1,112 @@
+"""Weight distributions.
+
+Analog of deeplearning4j-nn/.../nn/conf/distribution/ (NormalDistribution
+.java, UniformDistribution.java, TruncatedNormalDistribution.java,
+LogNormalDistribution.java, BinomialDistribution.java, ConstantDistribution
+.java, OrthogonalDistribution.java). Each is both a sampler (weight noise)
+and a weight initializer: ``init(key, shape, fan_in, fan_out, dtype)``
+matches ops/initializers.WeightInit.init so a Distribution can be passed
+anywhere a WeightInit is accepted (the reference's
+``WeightInit.DISTRIBUTION`` + ``dist(...)`` builder pattern).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.utils.serde import register_serializable
+
+
+@dataclasses.dataclass(frozen=True)
+class Distribution:
+    def sample(self, key, shape, dtype=jnp.float32) -> jnp.ndarray:
+        raise NotImplementedError
+
+    # WeightInit-compatible signature
+    def init(self, key, shape, fan_in: int, fan_out: int,
+             dtype=jnp.float32, gain: float = 1.0) -> jnp.ndarray:
+        return gain * self.sample(key, tuple(shape), dtype)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class NormalDistribution(Distribution):
+    mean: float = 0.0
+    std: float = 1.0
+
+    def sample(self, key, shape, dtype=jnp.float32):
+        return self.mean + self.std * jax.random.normal(key, shape, dtype)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class UniformDistribution(Distribution):
+    lower: float = -1.0
+    upper: float = 1.0
+
+    def sample(self, key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, self.lower, self.upper)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class TruncatedNormalDistribution(Distribution):
+    mean: float = 0.0
+    std: float = 1.0
+
+    def sample(self, key, shape, dtype=jnp.float32):
+        return self.mean + self.std * jax.random.truncated_normal(
+            key, -2.0, 2.0, shape, dtype)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class LogNormalDistribution(Distribution):
+    mean: float = 0.0
+    std: float = 1.0
+
+    def sample(self, key, shape, dtype=jnp.float32):
+        return jnp.exp(self.mean + self.std *
+                       jax.random.normal(key, shape, dtype))
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class BinomialDistribution(Distribution):
+    trials: int = 1
+    probability: float = 0.5
+
+    def sample(self, key, shape, dtype=jnp.float32):
+        draws = jax.random.bernoulli(
+            key, self.probability, (self.trials,) + tuple(shape))
+        return jnp.sum(draws, axis=0).astype(dtype)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class ConstantDistribution(Distribution):
+    value: float = 0.0
+
+    def sample(self, key, shape, dtype=jnp.float32):
+        return jnp.full(shape, self.value, dtype)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class OrthogonalDistribution(Distribution):
+    gain: float = 1.0
+
+    def sample(self, key, shape, dtype=jnp.float32):
+        if len(shape) < 2:
+            raise ValueError("orthogonal init needs >= 2 dims")
+        rows = shape[0]
+        cols = int(jnp.prod(jnp.asarray(shape[1:])))
+        a = jax.random.normal(key, (max(rows, cols), min(rows, cols)),
+                              jnp.float32)
+        q, r = jnp.linalg.qr(a)
+        q = q * jnp.sign(jnp.diagonal(r))
+        q = q.T if rows < cols else q
+        return (self.gain * q[:rows, :cols]).reshape(shape).astype(dtype)

@@ -1,0 +1,82 @@
+"""Dropout family.
+
+Analog of deeplearning4j-nn/.../nn/conf/dropout/ (IDropout.java,
+Dropout.java, AlphaDropout.java, GaussianDropout.java, GaussianNoise.java).
+All are pure functions of (x, key); layers call them on their INPUT during
+training, matching the reference's input-dropout semantics.
+
+NOTE on probability convention: the reference's ``Dropout(p)`` takes the
+RETAIN probability; here ``p`` is the DROP probability (the modern
+convention) — documented on each class.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.utils.serde import register_serializable
+
+
+@dataclasses.dataclass(frozen=True)
+class IDropout:
+    """SPI: conf/dropout/IDropout.java."""
+
+    def apply_dropout(self, x: jnp.ndarray, key) -> jnp.ndarray:
+        raise NotImplementedError
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class Dropout(IDropout):
+    """Inverted dropout; ``p`` = drop probability."""
+    p: float = 0.5
+
+    def apply_dropout(self, x, key):
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(key, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class AlphaDropout(IDropout):
+    """Self-normalizing dropout for SELU nets (conf/dropout/AlphaDropout
+    .java): keeps mean/variance by dropping to alpha' and applying an
+    affine correction."""
+    p: float = 0.05
+
+    def apply_dropout(self, x, key):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = 1.0 - self.p
+        a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+        b = -a * alpha_p * (1 - keep)
+        mask = jax.random.bernoulli(key, keep, x.shape)
+        return (a * jnp.where(mask, x, alpha_p) + b).astype(x.dtype)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class GaussianDropout(IDropout):
+    """Multiplicative gaussian noise N(1, rate/(1-rate))
+    (conf/dropout/GaussianDropout.java)."""
+    rate: float = 0.5
+
+    def apply_dropout(self, x, key):
+        std = (self.rate / (1.0 - self.rate)) ** 0.5
+        noise = 1.0 + std * jax.random.normal(key, x.shape, x.dtype)
+        return x * noise
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class GaussianNoise(IDropout):
+    """Additive gaussian noise (conf/dropout/GaussianNoise.java)."""
+    stddev: float = 0.1
+
+    def apply_dropout(self, x, key):
+        return x + self.stddev * jax.random.normal(key, x.shape, x.dtype)

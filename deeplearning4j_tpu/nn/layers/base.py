@@ -54,12 +54,16 @@ class Layer:
     init, L1/L2, dropout, per-layer updater override, frozen flag)."""
 
     name: Optional[str] = None
-    dropout: float = 0.0          # applied to the layer INPUT during training
+    # float drop-probability, or an nn.dropout.IDropout instance
+    # (Dropout/AlphaDropout/GaussianDropout/GaussianNoise)
+    dropout: Any = 0.0            # applied to the layer INPUT during training
     l1: float = 0.0
     l2: float = 0.0
     updater: Optional[Updater] = None   # per-layer override; None = global
     frozen: bool = False
     dtype: Optional[str] = None   # param dtype override ("float32"/"bfloat16")
+    weight_noise: Optional[Any] = None  # nn.weightnoise.IWeightNoise
+    constraints: Tuple = ()             # nn.constraints.LayerConstraint s
 
     # ---- contract -------------------------------------------------------
     def output_type(self, input_type: InputType) -> InputType:
@@ -87,14 +91,12 @@ class Layer:
         if (self.l1 == 0.0 and self.l2 == 0.0) or not params:
             return jnp.zeros((), jnp.float32)
         total = jnp.zeros((), jnp.float32)
-        exempt = ("b", "vb", "beta", "mean", "var", "pI", "pF", "pO")
         # Check the LEAF-level key (last path component), so nested wrapper
         # params ({"fwd": {...,"b":...}, "bwd": {...}}) are classified per
         # actual parameter, not per wrapper key.
+        from deeplearning4j_tpu.nn.param_keys import is_bias_path
         for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
-            last = path[-1]
-            key = getattr(last, "key", None)
-            if key in exempt:
+            if is_bias_path(path):
                 continue
             if self.l1:
                 total = total + self.l1 * jnp.sum(jnp.abs(leaf))
@@ -106,12 +108,27 @@ class Layer:
                       key: Optional[jax.Array]) -> jnp.ndarray:
         """Input dropout (inverted scaling, matching the reference's
         ``Dropout`` with p = retain probability semantics inverted: here
-        ``dropout`` is the DROP probability, the common modern convention)."""
-        if not ctx.train or self.dropout <= 0.0 or key is None:
+        ``dropout`` is the DROP probability, the common modern convention).
+        Also accepts any IDropout (Alpha/Gaussian...; nn/dropout.py)."""
+        if not ctx.train or key is None:
             return x
-        keep = 1.0 - self.dropout
-        mask = jax.random.bernoulli(key, keep, x.shape)
-        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+        if isinstance(self.dropout, (int, float)):
+            if self.dropout <= 0.0:
+                return x
+            keep = 1.0 - self.dropout
+            mask = jax.random.bernoulli(key, keep, x.shape)
+            return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+        return self.dropout.apply_dropout(x, key)
+
+    def apply_weight_noise(self, params, ctx: LayerContext,
+                           key: Optional[jax.Array]):
+        """Perturb params for this forward pass when a weight-noise conf is
+        set (reference: conf/weightnoise/, applied in BaseLayer
+        .getParamWithNoise)."""
+        if self.weight_noise is None or not ctx.train or key is None \
+                or not params:
+            return params
+        return self.weight_noise.apply_noise(params, key)
 
     def param_dtype(self, default=jnp.float32):
         if self.dtype == "bfloat16":

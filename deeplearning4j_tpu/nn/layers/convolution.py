@@ -442,3 +442,96 @@ class Convolution1DLayer(FeedForwardLayer):
         if self.has_bias:
             y = y + params["b"]
         return self.activation.apply(y), state
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class Subsampling1DLayer(Layer):
+    """1D pooling over (N, T, F) sequences (reference:
+    Subsampling1DLayer)."""
+    kernel_size: int = 2
+    stride: int = 2
+    pooling_type: PoolingType = PoolingType.MAX
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t = input_type.timesteps
+        if t is not None and t > 0:
+            t = (t - self.kernel_size) // self.stride + 1
+        return RecurrentType(input_type.size, t)
+
+    def apply(self, params, state, x, ctx):
+        if self.pooling_type is PoolingType.MAX:
+            init, fn = -jnp.inf, lax.max
+        else:
+            init, fn = 0.0, lax.add
+        y = lax.reduce_window(x, init, fn,
+                              (1, self.kernel_size, 1),
+                              (1, self.stride, 1), "VALID")
+        if self.pooling_type is PoolingType.AVG:
+            y = y / self.kernel_size
+        return y, state
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class Upsampling1D(Layer):
+    """Temporal repeat upsampling (reference: Upsampling1D)."""
+    size: int = 2
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t = input_type.timesteps
+        return RecurrentType(input_type.size,
+                             None if t in (None, -1) else t * self.size)
+
+    def apply(self, params, state, x, ctx):
+        return jnp.repeat(x, self.size, axis=1), state
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class ZeroPadding1DLayer(Layer):
+    """Temporal zero padding (reference: ZeroPadding1DLayer)."""
+    pad: Tuple[int, int] = (0, 0)
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t = input_type.timesteps
+        return RecurrentType(input_type.size,
+                             None if t in (None, -1)
+                             else t + self.pad[0] + self.pad[1])
+
+    def apply(self, params, state, x, ctx):
+        return jnp.pad(x, ((0, 0), self.pad, (0, 0))), state
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class Cropping1D(Layer):
+    """Temporal cropping (reference: convolutional/Cropping1D)."""
+    crop: Tuple[int, int] = (0, 0)
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t = input_type.timesteps
+        return RecurrentType(input_type.size,
+                             None if t in (None, -1)
+                             else t - self.crop[0] - self.crop[1])
+
+    def apply(self, params, state, x, ctx):
+        lo, hi = self.crop
+        end = x.shape[1] - hi
+        return x[:, lo:end, :], state

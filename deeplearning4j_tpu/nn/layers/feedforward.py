@@ -174,3 +174,23 @@ class AutoEncoder(FeedForwardLayer):
     def reconstruct(self, params, h):
         v = jnp.einsum("...o,io->...i", h, params["W"]) + params["vb"]
         return self.activation.apply(v)
+
+    @property
+    def supports_pretrain(self) -> bool:
+        return True
+
+    def pretrain_loss(self, params, x, key) -> jnp.ndarray:
+        """Denoising-reconstruction loss (reference: AutoEncoder
+        .computeGradientAndScore — corrupt, encode, decode, squared
+        error)."""
+        if self.corruption_level > 0.0 and key is not None:
+            keep = jax.random.bernoulli(key, 1.0 - self.corruption_level,
+                                        x.shape)
+            xc = jnp.where(keep, x, 0.0)
+        else:
+            xc = x
+        h = self.activation.apply(
+            jnp.einsum("...i,io->...o", xc, params["W"]) + params["b"])
+        v = self.activation.apply(
+            jnp.einsum("...o,io->...i", h, params["W"]) + params["vb"])
+        return jnp.mean(jnp.sum(jnp.square(x - v), axis=-1))

@@ -1,0 +1,126 @@
+"""Wrapper + custom-function layers.
+
+Analogs of the reference's FrozenLayer (nn/conf/layers/misc/FrozenLayer
+.java — wraps any layer, blocks updates), and the SameDiff layer family
+(nn/conf/layers/samediff/AbstractSameDiffLayer.java + nn/layers/samediff/
+SameDiffLayer.java — user-defined graph inside a DL4J layer).
+
+The SameDiff analog is the natural one for this framework: a SameDiff
+graph is "a function you define symbolically"; in JAX that is just a
+Python function of (params, x) — ``SameDiffLayer``/``LambdaLayer`` below
+run arbitrary user jax code inside a model, fully jitted and
+differentiated like everything else.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.inputs import FeedForwardType, InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, LayerContext
+from deeplearning4j_tpu.utils.serde import register_serializable
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class FrozenLayer(Layer):
+    """Wrap any layer so its parameters never update
+    (misc/FrozenLayer.java). Equivalent to ``underlying.frozen=True``;
+    exists for API parity and for wrapping at runtime."""
+    underlying: Optional[Layer] = None
+
+    def __post_init__(self):
+        object.__setattr__(self, "frozen", True)
+        if self.underlying is not None and self.name is None:
+            object.__setattr__(self, "name", self.underlying.name)
+
+    @property
+    def has_params(self) -> bool:
+        return self.underlying.has_params
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return self.underlying.output_type(input_type)
+
+    def initialize(self, key, input_type):
+        return self.underlying.initialize(key, input_type)
+
+    def init_state(self, input_type):
+        return self.underlying.init_state(input_type)
+
+    def apply(self, params, state, x, ctx: LayerContext):
+        # inference-mode ctx: frozen layers don't apply dropout (reference
+        # FrozenLayer wraps with training=false semantics)
+        frozen_ctx = dataclasses.replace(ctx, train=False)
+        return self.underlying.apply(params, state, x, frozen_ctx)
+
+    def compute_loss(self, params, state, x, labels, ctx):
+        return self.underlying.compute_loss(params, state, x, labels, ctx)
+
+    def __getattr__(self, item):
+        # delegate conf attributes (n_out etc.) to the wrapped layer
+        return getattr(object.__getattribute__(self, "underlying"), item)
+
+
+@dataclasses.dataclass(frozen=True)
+class LambdaLayer(Layer):
+    """Parameter-free custom function layer (reference:
+    nn/conf/layers/samediff/SameDiffLambdaLayer.java). ``fn(x) -> y`` must
+    be pure jax. ``output_shape_fn`` maps input feature count to output
+    feature count when it changes."""
+    fn: Optional[Callable] = None
+    output_type_fn: Optional[Callable] = None
+
+    @property
+    def has_params(self) -> bool:
+        return False
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if self.output_type_fn is not None:
+            return self.output_type_fn(input_type)
+        return input_type
+
+    def apply(self, params, state, x, ctx: LayerContext):
+        return self.fn(x), state
+
+
+@dataclasses.dataclass(frozen=True)
+class SameDiffLayer(Layer):
+    """Custom layer with trainable params (reference:
+    samediff/SameDiffLayer.java — defineLayer + defineParameters).
+
+    - ``param_shapes``: dict name → shape (defineParameters)
+    - ``fn(params, x) -> y`` pure jax (defineLayer)
+    - ``out_type(input_type) -> InputType`` (getOutputType)
+    - ``init_fn(key, name, shape) -> array`` optional custom init
+      (initializeParameters); default: scaled normal
+    """
+    param_shapes: Optional[Dict[str, Tuple[int, ...]]] = None
+    fn: Optional[Callable] = None
+    out_type: Optional[Callable] = None
+    init_fn: Optional[Callable] = None
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if self.out_type is not None:
+            return self.out_type(input_type)
+        return input_type
+
+    def initialize(self, key, input_type):
+        params = {}
+        for i, (name, shape) in enumerate(sorted(
+                (self.param_shapes or {}).items())):
+            k = jax.random.fold_in(key, i)
+            if self.init_fn is not None:
+                params[name] = self.init_fn(k, name, shape)
+            else:
+                fan_in = shape[0] if shape else 1
+                params[name] = jax.random.normal(
+                    k, shape, self.param_dtype()) / jnp.sqrt(
+                        jnp.maximum(fan_in, 1.0))
+        return params
+
+    def apply(self, params, state, x, ctx: LayerContext):
+        return self.fn(params, x), state

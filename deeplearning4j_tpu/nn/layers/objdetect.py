@@ -1,0 +1,211 @@
+"""YOLOv2 object-detection output layer + utilities.
+
+Analog of the reference's objdetect package (deeplearning4j-nn/.../nn/
+layers/objdetect/Yolo2OutputLayer.java:71, YoloUtils.java, conf in
+nn/conf/layers/objdetect/Yolo2OutputLayer.java).
+
+Layout (TPU-native NHWC): network output is (N, H, W, B*(5+C)) where B =
+number of anchor boxes and C = classes; per box [tx, ty, tw, th, to,
+class-logits...]. Labels are (N, H, W, 4+C): [cx, cy, w, h] in grid units
++ one-hot class; a cell with all-zero class vector holds no object (the
+reference uses the same minibatch,4+C,H,W tensor transposed).
+
+The whole loss — IoU-based responsibility assignment, coordinate SSE,
+confidence and class terms — is pure jnp and differentiates via jax.grad;
+the reference hand-writes ~400 lines of backward for this.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.inputs import ConvolutionalType, InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, LayerContext
+from deeplearning4j_tpu.utils.serde import register_serializable
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class Yolo2OutputLayer(Layer):
+    """Loss-only layer (no params), like the reference's
+    Yolo2OutputLayer. ``boxes`` = ((w, h), ...) anchor priors in grid
+    units."""
+    boxes: Tuple = ((1.0, 1.0),)
+    lambda_coord: float = 5.0
+    lambda_no_obj: float = 0.5
+
+    @property
+    def has_params(self) -> bool:
+        return False
+
+    @property
+    def num_boxes(self) -> int:
+        return len(self.boxes)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def _split(self, y):
+        """(N,H,W,B*(5+C)) → tx,ty,tw,th,conf-logit,class-logits."""
+        n, h, w, d = y.shape
+        b = self.num_boxes
+        y = y.reshape(n, h, w, b, d // b)
+        return y[..., 0], y[..., 1], y[..., 2], y[..., 3], y[..., 4], \
+            y[..., 5:]
+
+    def _decode(self, y):
+        """Activated predictions: center (grid units), size (grid units),
+        confidence, class probabilities."""
+        tx, ty, tw, th, to, tc = self._split(y)
+        n, h, w = tx.shape[:3]
+        gx = jnp.arange(w, dtype=y.dtype)[None, None, :, None]
+        gy = jnp.arange(h, dtype=y.dtype)[None, :, None, None]
+        anchors = jnp.asarray(self.boxes, y.dtype)  # (B, 2)
+        cx = jax.nn.sigmoid(tx) + gx
+        cy = jax.nn.sigmoid(ty) + gy
+        bw = anchors[None, None, None, :, 0] * jnp.exp(tw)
+        bh = anchors[None, None, None, :, 1] * jnp.exp(th)
+        conf = jax.nn.sigmoid(to)
+        probs = jax.nn.softmax(tc, axis=-1)
+        return cx, cy, bw, bh, conf, probs
+
+    def apply(self, params, state, x, ctx: LayerContext):
+        return x, state  # raw activations pass through (like reference)
+
+    # ---- loss ------------------------------------------------------------
+    def compute_loss(self, params, state, x, labels, ctx: LayerContext):
+        f32 = jnp.float32
+        x = x.astype(f32)
+        labels = jnp.asarray(labels, f32)
+        tx, ty, tw, th, to, tc = self._split(x)
+        n, h, w, b = tx.shape
+        # ground truth
+        g_cx, g_cy = labels[..., 0], labels[..., 1]          # (N,H,W)
+        g_w = jnp.maximum(labels[..., 2], 1e-6)
+        g_h = jnp.maximum(labels[..., 3], 1e-6)
+        g_cls = labels[..., 4:]                              # (N,H,W,C)
+        obj_mask = (jnp.sum(g_cls, axis=-1) > 0).astype(f32)  # (N,H,W)
+
+        cx, cy, bw, bh, conf, _ = self._decode(x)
+        # IoU of each predicted box vs the cell's ground-truth box
+        gx1, gx2 = g_cx - g_w / 2, g_cx + g_w / 2
+        gy1, gy2 = g_cy - g_h / 2, g_cy + g_h / 2
+        px1, px2 = cx - bw / 2, cx + bw / 2
+        py1, py2 = cy - bh / 2, cy + bh / 2
+        ix = jnp.maximum(0.0, jnp.minimum(px2, gx2[..., None])
+                         - jnp.maximum(px1, gx1[..., None]))
+        iy = jnp.maximum(0.0, jnp.minimum(py2, gy2[..., None])
+                         - jnp.maximum(py1, gy1[..., None]))
+        inter = ix * iy
+        union = bw * bh + (g_w * g_h)[..., None] - inter
+        iou = inter / jnp.maximum(union, 1e-9)               # (N,H,W,B)
+
+        # responsibility: best-IoU box per object cell (stop-grad, like
+        # the reference's argmax assignment)
+        best = jax.lax.stop_gradient(jnp.argmax(iou, axis=-1))  # (N,H,W)
+        resp = jax.nn.one_hot(best, b, dtype=f32) * obj_mask[..., None]
+
+        # coordinate loss on (sigma(t), sqrt size) vs truth
+        cell_x = g_cx - jnp.floor(g_cx)
+        cell_y = g_cy - jnp.floor(g_cy)
+        anchors = jnp.asarray(self.boxes, f32)
+        pred_sx = jax.nn.sigmoid(tx)
+        pred_sy = jax.nn.sigmoid(ty)
+        pred_sw = jnp.sqrt(jnp.maximum(
+            anchors[None, None, None, :, 0] * jnp.exp(tw), 1e-9))
+        pred_sh = jnp.sqrt(jnp.maximum(
+            anchors[None, None, None, :, 1] * jnp.exp(th), 1e-9))
+        loss_xy = jnp.square(pred_sx - cell_x[..., None]) + \
+            jnp.square(pred_sy - cell_y[..., None])
+        loss_wh = jnp.square(pred_sw - jnp.sqrt(g_w)[..., None]) + \
+            jnp.square(pred_sh - jnp.sqrt(g_h)[..., None])
+        coord = self.lambda_coord * jnp.sum(resp * (loss_xy + loss_wh))
+
+        # confidence: responsible boxes → IoU target; others → 0
+        iou_t = jax.lax.stop_gradient(iou)
+        conf_obj = jnp.sum(resp * jnp.square(conf - iou_t))
+        conf_noobj = self.lambda_no_obj * jnp.sum(
+            (1.0 - resp) * jnp.square(conf))
+
+        # classification: softmax xent through the responsible box's logits
+        logp = jax.nn.log_softmax(tc, axis=-1)           # (N,H,W,B,C)
+        resp_logp = jnp.sum(resp[..., None] * logp, axis=3)  # (N,H,W,C)
+        cls = -jnp.sum(g_cls * resp_logp)
+
+        total = coord + conf_obj + conf_noobj + cls
+        return total / jnp.maximum(jnp.asarray(n, f32), 1.0)
+
+
+@dataclasses.dataclass
+class DetectedObject:
+    """Analog of objdetect/DetectedObject.java."""
+    example: int
+    center_x: float
+    center_y: float
+    width: float
+    height: float
+    predicted_class: int
+    confidence: float
+
+    @property
+    def top_left(self):
+        return (self.center_x - self.width / 2,
+                self.center_y - self.height / 2)
+
+    @property
+    def bottom_right(self):
+        return (self.center_x + self.width / 2,
+                self.center_y + self.height / 2)
+
+
+def iou(a: DetectedObject, b: DetectedObject) -> float:
+    """YoloUtils.iou."""
+    ax1, ay1 = a.top_left
+    ax2, ay2 = a.bottom_right
+    bx1, by1 = b.top_left
+    bx2, by2 = b.bottom_right
+    iw = max(0.0, min(ax2, bx2) - max(ax1, bx1))
+    ih = max(0.0, min(ay2, by2) - max(ay1, by1))
+    inter = iw * ih
+    union = a.width * a.height + b.width * b.height - inter
+    return inter / union if union > 0 else 0.0
+
+
+def get_predicted_objects(layer: Yolo2OutputLayer, network_output,
+                          threshold: float = 0.5,
+                          nms_threshold: Optional[float] = 0.4
+                          ) -> List[DetectedObject]:
+    """Decode + confidence-threshold + non-max suppression
+    (YoloUtils.getPredictedObjects + nms). Decode runs on device; the
+    small surviving set is filtered on host."""
+    cx, cy, bw, bh, conf, probs = layer._decode(
+        jnp.asarray(network_output, jnp.float32))
+    cls = jnp.argmax(probs, axis=-1)
+    score = conf * jnp.max(probs, axis=-1)
+    cx, cy, bw, bh = (np.asarray(v) for v in (cx, cy, bw, bh))
+    score = np.asarray(score)
+    cls = np.asarray(cls)
+    out: List[DetectedObject] = []
+    idx = np.argwhere(score > threshold)
+    for nidx, hy, wx, bi in idx:
+        out.append(DetectedObject(
+            int(nidx), float(cx[nidx, hy, wx, bi]),
+            float(cy[nidx, hy, wx, bi]), float(bw[nidx, hy, wx, bi]),
+            float(bh[nidx, hy, wx, bi]), int(cls[nidx, hy, wx, bi]),
+            float(score[nidx, hy, wx, bi])))
+    if nms_threshold is None:
+        return out
+    # greedy per-class NMS
+    out.sort(key=lambda d: -d.confidence)
+    kept: List[DetectedObject] = []
+    for d in out:
+        if all(not (k.example == d.example and
+                    k.predicted_class == d.predicted_class and
+                    iou(k, d) > nms_threshold) for k in kept):
+            kept.append(d)
+    return kept

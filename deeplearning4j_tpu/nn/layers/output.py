@@ -174,3 +174,40 @@ class GlobalPoolingLayer(Layer):
             pn = float(self.pnorm)
             return jnp.sum(jnp.abs(x) ** pn, axis=axes) ** (1.0 / pn), state
         raise ValueError(self.pooling_type)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class CenterLossOutputLayer(OutputLayer):
+    """Softmax + center loss (reference: nn/conf/layers/CenterLossOutputLayer
+    + nn/layers/training/CenterLossOutputLayer.java).
+
+    Per-class feature centers live in the parameter tree and are learned by
+    gradient descent on the ``lambda/2·||f − c_y||²`` term — functionally
+    equivalent to the reference's EMA center update (its ``alpha``), which
+    is SGD on the same objective with learning rate alpha.
+    """
+    alpha: float = 0.05   # kept for API parity; folds into center lr
+    lambda_: float = 2e-4
+
+    def initialize(self, key, input_type):
+        params = super().initialize(key, input_type)
+        n_in = self.resolved_n_in(input_type)
+        params["centers"] = jnp.zeros((self.n_out, n_in),
+                                      self.param_dtype())
+        return params
+
+    def compute_loss(self, params, state, x, labels, ctx):
+        ctx, dk = ctx.split_rng()
+        x = self.maybe_dropout(x, ctx, dk)
+        logits = self.pre_output(params, x)
+        fused = _fused_loss(self.activation, self.loss, labels, logits,
+                            ctx.mask)
+        base = fused if fused is not None else self.loss(
+            labels, self.activation.apply(logits), ctx.mask)
+        # center term: pull features toward their class center
+        assigned = jnp.einsum("...c,ci->...i", labels,
+                              params["centers"].astype(x.dtype))
+        center = 0.5 * self.lambda_ * jnp.mean(
+            jnp.sum(jnp.square(x - assigned), axis=-1))
+        return base + center

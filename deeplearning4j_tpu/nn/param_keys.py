@@ -1,0 +1,18 @@
+"""Shared parameter-key classification.
+
+The framework's parameter trees use short conventional leaf names; several
+subsystems (L1/L2 regularization in nn/layers/base.py, weight noise,
+constraints) must treat bias-like parameters differently from weights —
+this is the single source of truth for that classification (the
+reference's analog: ParamInitializer.isBiasParam / isWeightParam,
+nn/api/ParamInitializer.java).
+"""
+
+BIAS_KEYS = ("b", "vb", "beta", "mean", "var", "pI", "pF", "pO",
+             "bmu", "blv", "bout")
+
+
+def is_bias_path(path) -> bool:
+    """True when a tree_flatten_with_path leaf path ends in a bias-like
+    key (bias, BN shift/statistics, peephole weights...)."""
+    return getattr(path[-1], "key", None) in BIAS_KEYS

@@ -1,0 +1,381 @@
+"""Transfer learning: fine-tune overrides, freezing, graph surgery.
+
+Analog of deeplearning4j-nn/.../nn/transferlearning/
+(TransferLearning.java:34 — Builder with fineTuneConfiguration:73,
+setFeatureExtractor:84, nOutReplace:98-160, add/remove layer ops and the
+GraphBuilder variant; FineTuneConfiguration.java; TransferLearningHelper
+.java for featurize-once training).
+
+Because params here are pytrees keyed by layer name, "surgery + copy
+weights" is: edit the layer tuple / node list, rebuild the model, then
+copy over every layer whose parameter tree shapes still match.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.config import (
+    GlobalConfig,
+    MultiLayerConfiguration,
+)
+from deeplearning4j_tpu.nn.layers.base import Layer
+from deeplearning4j_tpu.optimize.solver import TrainState
+
+
+class FineTuneConfiguration:
+    """Global-hyperparameter overrides applied to every retained layer
+    (reference: transferlearning/FineTuneConfiguration.java)."""
+
+    def __init__(self, **overrides):
+        # recognized keys: updater, seed, l1, l2, dropout
+        self.overrides = overrides
+
+    class Builder:
+        def __init__(self):
+            self._o = {}
+
+        def updater(self, u):
+            self._o["updater"] = u
+            return self
+
+        def seed(self, s: int):
+            self._o["seed"] = int(s)
+            return self
+
+        def l1(self, v: float):
+            self._o["l1"] = float(v)
+            return self
+
+        def l2(self, v: float):
+            self._o["l2"] = float(v)
+            return self
+
+        def dropout(self, v: float):
+            self._o["dropout"] = float(v)
+            return self
+
+        def build(self) -> "FineTuneConfiguration":
+            return FineTuneConfiguration(**self._o)
+
+    def apply_to_global(self, g: GlobalConfig) -> GlobalConfig:
+        kw = {k: v for k, v in self.overrides.items()
+              if k in ("updater", "seed", "l1", "l2")}
+        return dataclasses.replace(g, **kw) if kw else g
+
+    def apply_to_layer(self, layer: Layer) -> Layer:
+        kw = {}
+        if "dropout" in self.overrides:
+            kw["dropout"] = self.overrides["dropout"]
+        if "l1" in self.overrides:
+            kw["l1"] = self.overrides["l1"]
+        if "l2" in self.overrides:
+            kw["l2"] = self.overrides["l2"]
+        # per-layer updater overrides are cleared so the new global applies
+        if "updater" in self.overrides and layer.updater is not None:
+            kw["updater"] = None
+        return dataclasses.replace(layer, **kw) if kw else layer
+
+
+def _tree_shapes(t) -> List[tuple]:
+    return [tuple(np.shape(a)) for a in jax.tree_util.tree_leaves(t)]
+
+
+def _copy_matching_params(old_model, new_model,
+                          renamed: Optional[Dict[str, str]] = None) -> None:
+    """Copy params/model-state for every layer whose tree shapes match."""
+    renamed = renamed or {}
+    old_p = old_model.train_state.params
+    old_s = old_model.train_state.model_state
+    new_p = dict(new_model.train_state.params)
+    new_s = dict(new_model.train_state.model_state)
+    for name in new_p:
+        src = renamed.get(name, name)
+        if src in old_p and _tree_shapes(old_p[src]) == _tree_shapes(
+                new_p[name]):
+            new_p[name] = jax.tree_util.tree_map(lambda a: a, old_p[src])
+            if src in old_s and _tree_shapes(old_s[src]) == _tree_shapes(
+                    new_s.get(name, {})):
+                new_s[name] = jax.tree_util.tree_map(lambda a: a, old_s[src])
+    new_model.train_state = TrainState(
+        new_p, new_s, new_model.train_state.opt_state,
+        jnp.zeros((), jnp.int32))
+
+
+class TransferLearning:
+    """Namespace matching the reference API: ``TransferLearning.Builder``
+    for MultiLayerNetwork, ``TransferLearning.GraphBuilder`` for
+    ComputationGraph."""
+
+    class Builder:
+        def __init__(self, orig_model):
+            if orig_model.train_state is None:
+                orig_model.init()
+            self._orig = orig_model
+            self._layers: List[Layer] = list(orig_model.conf.layers)
+            self._fine_tune: Optional[FineTuneConfiguration] = None
+            self._freeze_until: Optional[int] = None
+            self._input_type = orig_model.conf.input_type
+
+        def _index_of(self, layer: Union[int, str]) -> int:
+            if isinstance(layer, int):
+                return layer
+            for i, l in enumerate(self._layers):
+                if l.name == layer:
+                    return i
+            raise KeyError(f"no layer named {layer!r}")
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._fine_tune = ftc
+            return self
+
+        def set_feature_extractor(self, layer: Union[int, str]):
+            """Freeze all layers up to and including ``layer``
+            (reference: setFeatureExtractor:84)."""
+            self._freeze_until = self._index_of(layer)
+            return self
+
+        def n_out_replace(self, layer: Union[int, str], n_out: int,
+                          weight_init=None):
+            """Replace a layer's n_out (re-initialized), fixing up the next
+            parametrized layer's n_in (reference: nOutReplace:98-160)."""
+            i = self._index_of(layer)
+            kw: Dict[str, Any] = {"n_out": int(n_out)}
+            if weight_init is not None:
+                kw["weight_init"] = weight_init
+            self._layers[i] = dataclasses.replace(self._layers[i], **kw)
+            for j in range(i + 1, len(self._layers)):
+                nxt = self._layers[j]
+                if hasattr(nxt, "n_in"):
+                    self._layers[j] = dataclasses.replace(nxt, n_in=None)
+                    break
+            return self
+
+        def remove_output_layer(self):
+            self._layers.pop()
+            return self
+
+        def remove_layers_from_output(self, n: int):
+            for _ in range(n):
+                self._layers.pop()
+            return self
+
+        def add_layer(self, layer: Layer):
+            if layer.name is None:
+                layer = dataclasses.replace(
+                    layer, name=f"layer_{len(self._layers)}")
+            self._layers.append(layer)
+            return self
+
+        def set_input_type(self, it):
+            self._input_type = it
+            return self
+
+        def build(self):
+            from deeplearning4j_tpu.models.multi_layer_network import (
+                MultiLayerNetwork)
+            g = self._orig.conf.global_config
+            if self._fine_tune is not None:
+                g = self._fine_tune.apply_to_global(g)
+            layers = []
+            for i, l in enumerate(self._layers):
+                if self._fine_tune is not None:
+                    l = self._fine_tune.apply_to_layer(l)
+                if self._freeze_until is not None:
+                    l = dataclasses.replace(
+                        l, frozen=i <= self._freeze_until)
+                layers.append(l)
+            conf = MultiLayerConfiguration(
+                global_config=g, layers=tuple(layers),
+                input_type=self._input_type,
+                manual_preprocessors=dict(
+                    self._orig.conf.manual_preprocessors))
+            conf.resolve_shapes()
+            model = MultiLayerNetwork(conf)
+            model.init()
+            _copy_matching_params(self._orig, model)
+            return model
+
+    class GraphBuilder:
+        def __init__(self, orig_model):
+            if orig_model.train_state is None:
+                orig_model.init()
+            self._orig = orig_model
+            self._nodes = {n.name: n for n in orig_model.conf.nodes}
+            self._order = [n.name for n in orig_model.conf.nodes]
+            self._inputs = list(orig_model.conf.network_inputs)
+            self._input_types = list(orig_model.conf.network_input_types)
+            self._outputs = list(orig_model.conf.network_outputs)
+            self._fine_tune: Optional[FineTuneConfiguration] = None
+            self._frozen: set = set()
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._fine_tune = ftc
+            return self
+
+        def set_feature_extractor(self, *names: str):
+            """Freeze the named vertices and everything upstream of them."""
+            frontier = set(names)
+            while frontier:
+                n = frontier.pop()
+                if n in self._frozen or n in self._inputs:
+                    continue
+                self._frozen.add(n)
+                frontier.update(self._nodes[n].inputs)
+            return self
+
+        def remove_vertex_and_connections(self, name: str):
+            self._nodes.pop(name)
+            self._order.remove(name)
+            removed_also = [n for n, node in self._nodes.items()
+                            if name in node.inputs]
+            for n in removed_also:
+                self.remove_vertex_and_connections(n)
+            self._outputs = [o for o in self._outputs if o in self._nodes]
+            return self
+
+        def remove_vertex(self, name: str):
+            return self.remove_vertex_and_connections(name)
+
+        def add_layer(self, name: str, layer: Layer, *inputs: str):
+            layer = dataclasses.replace(layer, name=name)
+            self._nodes[name] = self._node_cls()(
+                name=name, inputs=tuple(inputs), layer=layer)
+            self._order.append(name)
+            return self
+
+        def add_vertex(self, name: str, vertex, *inputs: str):
+            self._nodes[name] = self._node_cls()(
+                name=name, inputs=tuple(inputs), vertex=vertex)
+            self._order.append(name)
+            return self
+
+        def n_out_replace(self, name: str, n_out: int, weight_init=None):
+            node = self._nodes[name]
+            kw: Dict[str, Any] = {"n_out": int(n_out)}
+            if weight_init is not None:
+                kw["weight_init"] = weight_init
+            new_layer = dataclasses.replace(node.layer, **kw)
+            self._nodes[name] = dataclasses.replace(node, layer=new_layer)
+            # clear downstream n_in so shape inference recomputes it
+            for n, other in self._nodes.items():
+                if name in other.inputs and other.layer is not None and \
+                        hasattr(other.layer, "n_in"):
+                    self._nodes[n] = dataclasses.replace(
+                        other, layer=dataclasses.replace(
+                            other.layer, n_in=None))
+            return self
+
+        def set_outputs(self, *names: str):
+            self._outputs = list(names)
+            return self
+
+        @staticmethod
+        def _node_cls():
+            from deeplearning4j_tpu.nn.graph.config import NodeDef
+            return NodeDef
+
+        def build(self):
+            from deeplearning4j_tpu.models.computation_graph import (
+                ComputationGraph)
+            from deeplearning4j_tpu.nn.graph.config import (
+                ComputationGraphConfiguration)
+            g = self._orig.conf.global_config
+            if self._fine_tune is not None:
+                g = self._fine_tune.apply_to_global(g)
+            nodes = []
+            for name in self._order:
+                node = self._nodes[name]
+                layer = node.layer
+                if layer is not None:
+                    if self._fine_tune is not None:
+                        layer = self._fine_tune.apply_to_layer(layer)
+                    # extend, never clear: layers frozen in the original
+                    # conf stay frozen
+                    if name in self._frozen and not layer.frozen:
+                        layer = dataclasses.replace(layer, frozen=True)
+                    node = dataclasses.replace(node, layer=layer)
+                nodes.append(node)
+            conf = ComputationGraphConfiguration(
+                global_config=g, network_inputs=tuple(self._inputs),
+                network_input_types=tuple(self._input_types),
+                nodes=tuple(nodes), network_outputs=tuple(self._outputs))
+            conf.resolve()
+            model = ComputationGraph(conf)
+            model.init()
+            _copy_matching_params(self._orig, model)
+            return model
+
+
+class TransferLearningHelper:
+    """Featurize-once training (reference: TransferLearningHelper.java):
+    run inputs through the frozen front once, then train only the
+    unfrozen tail on the cached activations."""
+
+    def __init__(self, model, frozen_boundary: Union[int, str, None] = None):
+        from deeplearning4j_tpu.models.multi_layer_network import (
+            MultiLayerNetwork)
+        if not isinstance(model, MultiLayerNetwork):
+            raise TypeError("TransferLearningHelper currently supports "
+                            "MultiLayerNetwork")
+        self._orig = model
+        layers = model.conf.layers
+        if frozen_boundary is None:
+            # boundary = last frozen layer
+            idx = max((i for i, l in enumerate(layers) if l.frozen),
+                      default=-1)
+        elif isinstance(frozen_boundary, str):
+            idx = [l.name for l in layers].index(frozen_boundary)
+        else:
+            idx = frozen_boundary
+        if idx < 0:
+            raise ValueError("model has no frozen layers and no boundary "
+                             "was given")
+        self._boundary = idx
+        # unfrozen tail as its own network. Its input type is layer idx's
+        # OUTPUT type (pre-preprocessor — featurize() returns the raw
+        # activation), so the tail conf re-infers any boundary
+        # preprocessor (e.g. CnnToFeedForward flatten) itself.
+        tail_layers = [dataclasses.replace(l, frozen=False)
+                       for l in layers[idx + 1:]]
+        tail_input = layers[idx].output_type(
+            model.conf.layer_input_types()[idx])
+        conf = MultiLayerConfiguration(
+            global_config=model.conf.global_config,
+            layers=tuple(tail_layers), input_type=tail_input)
+        conf.resolve_shapes()
+        from deeplearning4j_tpu.models.multi_layer_network import (
+            MultiLayerNetwork as MLN)
+        self._tail = MLN(conf)
+        self._tail.init()
+        _copy_matching_params(model, self._tail)
+
+    def unfrozen_mln(self):
+        return self._tail
+
+    def featurize(self, dataset: DataSet) -> DataSet:
+        acts = self._orig.feed_forward(dataset.features, train=False)
+        return DataSet(np.asarray(acts[self._boundary]), dataset.labels,
+                       dataset.features_mask, dataset.labels_mask)
+
+    def fit_featurized(self, dataset: DataSet):
+        self._tail.fit(dataset)
+        # push tail params back into the original model
+        new_p = dict(self._orig.train_state.params)
+        new_s = dict(self._orig.train_state.model_state)
+        for name in self._tail.train_state.params:
+            new_p[name] = self._tail.train_state.params[name]
+            if name in self._tail.train_state.model_state:
+                new_s[name] = self._tail.train_state.model_state[name]
+        self._orig.train_state = self._orig.train_state._replace(
+            params=new_p, model_state=new_s)
+        return self
+
+    def output_from_featurized(self, featurized):
+        return self._tail.output(featurized)

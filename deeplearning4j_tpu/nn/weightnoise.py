@@ -1,0 +1,81 @@
+"""Weight noise: parameter perturbation during the training forward pass.
+
+Analog of deeplearning4j-nn/.../nn/conf/weightnoise/ (IWeightNoise.java,
+WeightNoise.java, DropConnect.java). Applied to a layer's parameter tree
+just before ``apply`` when training; the noise is NOT part of the stored
+parameters, exactly like the reference (noise is regenerated per
+iteration and gradients flow through the noisy values).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.distributions import Distribution, NormalDistribution
+from deeplearning4j_tpu.nn.param_keys import is_bias_path as _is_bias
+from deeplearning4j_tpu.utils.serde import register_serializable
+
+
+@dataclasses.dataclass(frozen=True)
+class IWeightNoise:
+    """SPI: conf/weightnoise/IWeightNoise.java."""
+
+    def apply_noise(self, params, key):
+        raise NotImplementedError
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class WeightNoise(IWeightNoise):
+    """Additive or multiplicative noise drawn from a distribution
+    (conf/weightnoise/WeightNoise.java)."""
+    distribution: Distribution = dataclasses.field(
+        default_factory=lambda: NormalDistribution(0.0, 0.01))
+    additive: bool = True
+    apply_to_bias: bool = False
+
+    def apply_noise(self, params, key):
+        leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+
+        def noisy(i, path, p):
+            if not self.apply_to_bias and _is_bias(path):
+                return p
+            k = jax.random.fold_in(key, i)
+            noise = self.distribution.sample(k, p.shape, p.dtype)
+            return p + noise if self.additive else p * noise
+
+        flat = {path: noisy(i, path, leaf)
+                for i, (path, leaf) in enumerate(leaves)}
+        return jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(params),
+            [flat[p] for p, _ in leaves])
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class DropConnect(IWeightNoise):
+    """Per-weight dropout (conf/weightnoise/DropConnect.java);
+    ``p`` = drop probability, inverted scaling."""
+    p: float = 0.5
+    apply_to_bias: bool = False
+
+    def apply_noise(self, params, key):
+        leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+        keep = 1.0 - self.p
+
+        def drop(i, path, w):
+            if not self.apply_to_bias and _is_bias(path):
+                return w
+            k = jax.random.fold_in(key, i)
+            mask = jax.random.bernoulli(k, keep, w.shape)
+            return jnp.where(mask, w / keep, 0.0).astype(w.dtype)
+
+        flat = {path: drop(i, path, leaf)
+                for i, (path, leaf) in enumerate(leaves)}
+        return jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(params),
+            [flat[p] for p, _ in leaves])

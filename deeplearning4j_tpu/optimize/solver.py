@@ -78,7 +78,7 @@ LossFn = Callable[..., Tuple[jnp.ndarray, Any]]
 
 
 def make_train_step(loss_fn: LossFn, tx: optax.GradientTransformation,
-                    donate: bool = True):
+                    donate: bool = True, constrain_fn=None):
     """Build the jitted train step.
 
     ``loss_fn(params, model_state, features, labels, fmask, lmask, rng,
@@ -98,6 +98,8 @@ def make_train_step(loss_fn: LossFn, tx: optax.GradientTransformation,
         (loss, new_ms), grads = jax.value_and_grad(lf, has_aux=True)(ts.params)
         updates, new_opt = tx.update(grads, ts.opt_state, ts.params)
         new_params = optax.apply_updates(ts.params, updates)
+        if constrain_fn is not None:
+            new_params = constrain_fn(new_params)
         return TrainState(new_params, new_ms, new_opt, ts.iteration + 1), loss
 
     return jax.jit(step, donate_argnums=(0,) if donate else ())
@@ -106,3 +108,25 @@ def make_train_step(loss_fn: LossFn, tx: optax.GradientTransformation,
 def make_eval_step(forward_fn):
     """Jitted inference step: forward_fn(params, model_state, x, mask)."""
     return jax.jit(forward_fn)
+
+
+def make_constrain_fn(layers):
+    """Post-update parameter projection from per-layer constraint configs
+    (reference: conf/constraint/ applied in BaseMultiLayerUpdater.update
+    after the updater step). Returns None when no layer has constraints."""
+    constrained = {l.name: l.constraints for l in layers if l.constraints}
+    if not constrained:
+        return None
+
+    def constrain(params):
+        out = dict(params)
+        for name, constraints in constrained.items():
+            p = out.get(name)
+            if not p:
+                continue
+            for c in constraints:
+                p = c.apply(p)
+            out[name] = p
+        return out
+
+    return constrain

@@ -329,6 +329,23 @@ class ParallelWrapper:
         # equalize batch sizes (stacking needs it), padding w/ masked rows
         target = max(b.num_examples() for b in batches)
         batches = [self._pad_batch(b, target=target) for b in batches]
+
+        def ones_lmask(b: DataSet):
+            lab = np.asarray(b.labels)
+            if lab.ndim <= 2:
+                return np.ones((b.num_examples(),), np.float32)
+            if lab.ndim == 3 and b.features_mask is not None:
+                return np.asarray(b.features_mask, np.float32)
+            return np.ones(lab.shape[:-1], np.float32)
+
+        # padding gave short batches a labels_mask; full-size batches must
+        # then get an all-ones mask, or stack() would drop every mask and
+        # train on the padded rows as real examples
+        if any(b.labels_mask is not None for b in batches):
+            batches = [b if b.labels_mask is not None else DataSet(
+                b.features, b.labels, b.features_mask, ones_lmask(b))
+                for b in batches]
+
         def stack(get):
             vals = [get(b) for b in batches]
             if any(v is None for v in vals):

@@ -1,0 +1,302 @@
+"""VAE, YOLO, CenterLoss, Frozen/Lambda/SameDiff, 1D-layer tests.
+
+Analog of reference suites: TestVAE.java, YoloGradientCheckTests /
+TestYolo2OutputLayer.java, FrozenLayerTest.java, TestSameDiff*.java,
+Convolution1DTest / TestCnn1DLayers.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import ArrayDataSetIterator, DataSet
+from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.inputs import InputType, RecurrentType
+from deeplearning4j_tpu.nn.layers.convolution import (
+    Convolution1DLayer,
+    Cropping1D,
+    Subsampling1DLayer,
+    Upsampling1D,
+    ZeroPadding1DLayer,
+)
+from deeplearning4j_tpu.nn.layers.feedforward import AutoEncoder, DenseLayer
+from deeplearning4j_tpu.nn.layers.misc import (
+    FrozenLayer,
+    LambdaLayer,
+    SameDiffLayer,
+)
+from deeplearning4j_tpu.nn.layers.objdetect import (
+    DetectedObject,
+    Yolo2OutputLayer,
+    get_predicted_objects,
+    iou,
+)
+from deeplearning4j_tpu.nn.layers.output import (
+    CenterLossOutputLayer,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.layers.variational import (
+    BernoulliReconstructionDistribution,
+    CompositeReconstructionDistribution,
+    GaussianReconstructionDistribution,
+    VariationalAutoencoder,
+)
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.losses import LossFunction
+from deeplearning4j_tpu.optimize.updaters import Adam
+
+
+def _data(n=32, nf=6, nc=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, nf)).astype(np.float32)
+    y_idx = rng.integers(0, nc, size=n)
+    x += y_idx[:, None].astype(np.float32)
+    return x, np.eye(nc, dtype=np.float32)[y_idx]
+
+
+class TestVAE:
+    def _vae_layer(self, dist):
+        return VariationalAutoencoder(
+            n_out=4, encoder_layer_sizes=(16,), decoder_layer_sizes=(16,),
+            activation=Activation.TANH, reconstruction_distribution=dist)
+
+    @pytest.mark.parametrize("dist", [
+        GaussianReconstructionDistribution(),
+        BernoulliReconstructionDistribution(),
+    ])
+    def test_pretrain_elbo_decreases(self, dist):
+        rng = np.random.default_rng(0)
+        if isinstance(dist, BernoulliReconstructionDistribution):
+            x = (rng.random((64, 6)) > 0.5).astype(np.float32)
+        else:
+            x = rng.normal(size=(64, 6)).astype(np.float32)
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(7).updater(Adam(1e-2)).list()
+                .layer(self._vae_layer(dist))
+                .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.feed_forward(6))
+                .build())
+        model = MultiLayerNetwork(conf).init()
+        layer = model.layers[0]
+        lp0 = model.train_state.params[layer.name]
+        key = jax.random.PRNGKey(0)
+        before = float(layer.pretrain_loss(lp0, jnp.asarray(x), key))
+        it = ArrayDataSetIterator(DataSet(x, x), batch_size=32)
+        model.pretrain_layer(0, it, epochs=20)
+        lp1 = model.train_state.params[layer.name]
+        after = float(layer.pretrain_loss(lp1, jnp.asarray(x), key))
+        assert after < before
+
+    def test_supervised_forward_and_fit(self):
+        x, y = _data(nf=6)
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(7).updater(Adam(1e-2)).list()
+                .layer(self._vae_layer(GaussianReconstructionDistribution()))
+                .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.feed_forward(6))
+                .build())
+        model = MultiLayerNetwork(conf).init()
+        assert model.output(x[:4]).shape == (4, 3)
+        model.fit(DataSet(x, y))
+        assert np.isfinite(model.score())
+
+    def test_reconstruct_and_logprob(self):
+        x = np.random.default_rng(0).normal(size=(8, 6)).astype(np.float32)
+        layer = self._vae_layer(GaussianReconstructionDistribution())
+        conf = (NeuralNetConfiguration.Builder().list()
+                .layer(layer)
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.feed_forward(6)).build())
+        model = MultiLayerNetwork(conf).init()
+        lp = model.train_state.params[model.layers[0].name]
+        rec = model.layers[0].reconstruct(lp, jnp.asarray(x))
+        assert rec.shape == (8, 6)
+        ll = model.layers[0].reconstruction_log_probability(
+            lp, jnp.asarray(x), jax.random.PRNGKey(0), num_samples=3)
+        assert ll.shape == (8,)
+        assert np.all(np.isfinite(np.asarray(ll)))
+
+    def test_composite_distribution(self):
+        comp = CompositeReconstructionDistribution(components=(
+            (4, GaussianReconstructionDistribution()),
+            (2, BernoulliReconstructionDistribution()),
+        ))
+        assert comp.total_features() == 6
+        assert comp.total_params() == 10
+        x = jnp.asarray(np.random.default_rng(0).random((8, 6)),
+                        jnp.float32)
+        params = jnp.asarray(
+            np.random.default_rng(1).normal(size=(8, 10)), jnp.float32)
+        ll = comp.log_prob(x, params)
+        assert ll.shape == (8,)
+        mean = comp.mean(params)
+        assert mean.shape == (8, 6)
+
+
+class TestYolo:
+    def _layer(self):
+        return Yolo2OutputLayer(boxes=((1.0, 1.0), (2.0, 2.0)),
+                                lambda_coord=5.0, lambda_no_obj=0.5)
+
+    def _labels(self, n, h, w, c):
+        lab = np.zeros((n, h, w, 4 + c), np.float32)
+        # one object in cell (1,1) of every example, class 0
+        lab[:, 1, 1, 0] = 1.5   # cx in grid units
+        lab[:, 1, 1, 1] = 1.5
+        lab[:, 1, 1, 2] = 1.0   # w
+        lab[:, 1, 1, 3] = 1.0   # h
+        lab[:, 1, 1, 4] = 1.0   # class 0
+        return lab
+
+    def test_loss_finite_and_differentiable(self):
+        n, h, w, b, c = 2, 4, 4, 2, 3
+        x = jnp.asarray(np.random.default_rng(0).normal(
+            size=(n, h, w, b * (5 + c))), jnp.float32)
+        lab = jnp.asarray(self._labels(n, h, w, c))
+        layer = self._layer()
+        from deeplearning4j_tpu.nn.layers.base import LayerContext
+        ctx = LayerContext(train=True, rng=None, mask=None)
+        loss = layer.compute_loss({}, {}, x, lab, ctx)
+        assert np.isfinite(float(loss))
+        g = jax.grad(lambda x: layer.compute_loss({}, {}, x, lab, ctx))(x)
+        assert np.all(np.isfinite(np.asarray(g)))
+
+    def test_training_decreases_loss(self):
+        from deeplearning4j_tpu.nn.layers.base import LayerContext
+        n, h, w, b, c = 4, 4, 4, 2, 3
+        lab = jnp.asarray(self._labels(n, h, w, c))
+        layer = self._layer()
+        ctx = LayerContext(train=True, rng=None, mask=None)
+        x = jnp.asarray(np.random.default_rng(0).normal(
+            size=(n, h, w, b * (5 + c))) * 0.1, jnp.float32)
+
+        lf = jax.jit(lambda x: layer.compute_loss({}, {}, x, lab, ctx))
+        gf = jax.jit(jax.grad(
+            lambda x: layer.compute_loss({}, {}, x, lab, ctx)))
+        before = float(lf(x))
+        for _ in range(50):
+            x = x - 0.01 * gf(x)
+        assert float(lf(x)) < before
+
+    def test_decode_and_nms(self):
+        n, h, w, b, c = 1, 4, 4, 2, 3
+        raw = np.zeros((n, h, w, b * (5 + c)), np.float32)
+        raw[0, 1, 1, 4] = 6.0   # box0 conf logit high
+        raw[0, 1, 1, 5] = 5.0   # class 0 logit
+        layer = self._layer()
+        objs = get_predicted_objects(layer, raw, threshold=0.5)
+        assert len(objs) >= 1
+        top = max(objs, key=lambda d: d.confidence)
+        assert top.predicted_class == 0
+        assert 1.0 < top.center_x < 2.0
+
+    def test_iou(self):
+        a = DetectedObject(0, 1.0, 1.0, 2.0, 2.0, 0, 1.0)
+        assert iou(a, a) == pytest.approx(1.0)
+        bb = DetectedObject(0, 10.0, 10.0, 2.0, 2.0, 0, 1.0)
+        assert iou(a, bb) == 0.0
+
+
+class TestMiscLayers:
+    def test_frozen_layer_wrapper(self):
+        x, y = _data(nf=6)
+        inner = DenseLayer(n_out=8, activation=Activation.RELU)
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(1).updater(Adam(1e-2)).list()
+                .layer(FrozenLayer(underlying=inner))
+                .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.feed_forward(6))
+                .build())
+        model = MultiLayerNetwork(conf).init()
+        w0 = np.asarray(model.train_state.params["layer_0"]["W"])
+        model.fit(DataSet(x, y))
+        np.testing.assert_array_equal(
+            w0, np.asarray(model.train_state.params["layer_0"]["W"]))
+
+    def test_lambda_layer(self):
+        x, y = _data(nf=6)
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(1).updater(Adam(1e-2)).list()
+                .layer(DenseLayer(n_out=8, activation=Activation.RELU))
+                .layer(LambdaLayer(fn=lambda t: t * 2.0))
+                .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.feed_forward(6))
+                .build())
+        model = MultiLayerNetwork(conf).init()
+        model.fit(DataSet(x, y))
+        assert model.output(x[:4]).shape == (4, 3)
+
+    def test_samediff_layer(self):
+        from deeplearning4j_tpu.nn.inputs import FeedForwardType
+        x, y = _data(nf=6)
+        layer = SameDiffLayer(
+            param_shapes={"W": (6, 10), "b": (10,)},
+            fn=lambda p, t: jnp.tanh(t @ p["W"] + p["b"]),
+            out_type=lambda it: FeedForwardType(10))
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(1).updater(Adam(1e-2)).list()
+                .layer(layer)
+                .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.feed_forward(6))
+                .build())
+        model = MultiLayerNetwork(conf).init()
+        w0 = np.asarray(model.train_state.params["layer_0"]["W"])
+        model.fit(DataSet(x, y))
+        # params trained
+        assert not np.array_equal(
+            w0, np.asarray(model.train_state.params["layer_0"]["W"]))
+
+    def test_center_loss(self):
+        x, y = _data(nf=6)
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(1).updater(Adam(1e-2)).list()
+                .layer(DenseLayer(n_out=8, activation=Activation.RELU))
+                .layer(CenterLossOutputLayer(n_out=3, lambda_=0.1))
+                .set_input_type(InputType.feed_forward(6))
+                .build())
+        model = MultiLayerNetwork(conf).init()
+        model.fit(ArrayDataSetIterator(DataSet(x, y), batch_size=16),
+                  epochs=3)
+        assert np.isfinite(model.score())
+        centers = np.asarray(model.train_state.params["layer_1"]["centers"])
+        assert centers.shape == (3, 8)
+        # centers moved off zero
+        assert np.abs(centers).max() > 0
+
+
+class TestConv1DFamily:
+    def test_stack_shapes(self):
+        n, t, f = 4, 16, 6
+        x = np.random.default_rng(0).normal(size=(n, t, f)).astype(
+            np.float32)
+        y = np.eye(3, dtype=np.float32)[
+            np.random.default_rng(1).integers(0, 3, n)]
+        from deeplearning4j_tpu.nn.layers.output import GlobalPoolingLayer
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(1).updater(Adam(1e-2)).list()
+                .layer(ZeroPadding1DLayer(pad=(1, 1)))
+                .layer(Convolution1DLayer(
+                    n_out=8, kernel_size=3,
+                    convolution_mode=__import__(
+                        "deeplearning4j_tpu.nn.layers.convolution",
+                        fromlist=["ConvolutionMode"]).ConvolutionMode.SAME))
+                .layer(Upsampling1D(size=2))
+                .layer(Cropping1D(crop=(2, 2)))
+                .layer(Subsampling1DLayer(kernel_size=2, stride=2))
+                .layer(GlobalPoolingLayer())
+                .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.recurrent(f, t))
+                .build())
+        model = MultiLayerNetwork(conf).init()
+        out = model.output(x)
+        assert out.shape == (n, 3)
+        model.fit(DataSet(x, y))
+        assert np.isfinite(model.score())

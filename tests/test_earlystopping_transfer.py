@@ -1,0 +1,264 @@
+"""Early stopping + transfer learning + regularization-conf tests.
+
+Analog of the reference's deeplearning4j-core/src/test suites
+TestEarlyStopping.java, TransferLearningMLNTest.java,
+TestDropout/TestConstraints/TestWeightNoise.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import ArrayDataSetIterator, DataSet
+from deeplearning4j_tpu.earlystopping import (
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    EarlyStoppingTrainer,
+    InMemoryModelSaver,
+    InvalidScoreIterationTerminationCondition,
+    LocalFileModelSaver,
+    MaxEpochsTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochsTerminationCondition,
+    TerminationReason,
+)
+from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.constraints import (
+    MaxNormConstraint,
+    NonNegativeConstraint,
+    UnitNormConstraint,
+)
+from deeplearning4j_tpu.nn.distributions import (
+    NormalDistribution,
+    OrthogonalDistribution,
+    UniformDistribution,
+)
+from deeplearning4j_tpu.nn.dropout import (
+    AlphaDropout,
+    Dropout,
+    GaussianDropout,
+    GaussianNoise,
+)
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+from deeplearning4j_tpu.nn.layers.output import OutputLayer
+from deeplearning4j_tpu.nn.transferlearning import (
+    FineTuneConfiguration,
+    TransferLearning,
+    TransferLearningHelper,
+)
+from deeplearning4j_tpu.nn.weightnoise import DropConnect, WeightNoise
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.losses import LossFunction
+from deeplearning4j_tpu.optimize.updaters import Adam, Sgd
+
+
+def _toy_data(n=64, nf=4, nc=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, nf)).astype(np.float32)
+    y_idx = rng.integers(0, nc, size=n)
+    # make it learnable: shift x by class
+    x += y_idx[:, None].astype(np.float32)
+    y = np.eye(nc, dtype=np.float32)[y_idx]
+    return x, y
+
+
+def _mlp(seed=123, **layer_kw):
+    return (NeuralNetConfiguration.Builder()
+            .seed(seed)
+            .updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=16, activation=Activation.RELU,
+                              **layer_kw))
+            .layer(DenseLayer(n_out=8, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+
+
+class TestEarlyStopping:
+    def test_max_epochs(self):
+        x, y = _toy_data()
+        train = ArrayDataSetIterator(DataSet(x, y), batch_size=16)
+        test = ArrayDataSetIterator(DataSet(x, y), batch_size=32)
+        esc = (EarlyStoppingConfiguration.Builder()
+               .epoch_termination_conditions(MaxEpochsTerminationCondition(3))
+               .score_calculator(DataSetLossCalculator(test))
+               .model_saver(InMemoryModelSaver())
+               .build())
+        model = MultiLayerNetwork(_mlp())
+        result = EarlyStoppingTrainer(esc, model, train).fit()
+        assert result.termination_reason is \
+            TerminationReason.EPOCH_TERMINATION_CONDITION
+        assert result.total_epochs == 3
+        assert result.best_model is not None
+        assert len(result.score_vs_epoch) == 3
+        # best model predicts
+        out = result.best_model.output(x[:4])
+        assert out.shape == (4, 3)
+
+    def test_score_improvement_stop(self):
+        x, y = _toy_data()
+        train = ArrayDataSetIterator(DataSet(x, y), batch_size=16)
+        test = ArrayDataSetIterator(DataSet(x, y), batch_size=32)
+        esc = (EarlyStoppingConfiguration.Builder()
+               .epoch_termination_conditions(
+                   ScoreImprovementEpochsTerminationCondition(1, 1e9),
+                   MaxEpochsTerminationCondition(50))
+               .score_calculator(DataSetLossCalculator(test))
+               .build())
+        model = MultiLayerNetwork(_mlp())
+        result = EarlyStoppingTrainer(esc, model, train).fit()
+        # improvement threshold is absurd, stops after 2 evals
+        assert result.total_epochs <= 3
+
+    def test_time_termination(self):
+        x, y = _toy_data()
+        train = ArrayDataSetIterator(DataSet(x, y), batch_size=16)
+        esc = (EarlyStoppingConfiguration.Builder()
+               .iteration_termination_conditions(
+                   MaxTimeIterationTerminationCondition(0.0))
+               .epoch_termination_conditions(
+                   MaxEpochsTerminationCondition(100))
+               .build())
+        model = MultiLayerNetwork(_mlp())
+        result = EarlyStoppingTrainer(esc, model, train).fit()
+        assert result.termination_reason is \
+            TerminationReason.ITERATION_TERMINATION_CONDITION
+
+    def test_invalid_score_guard(self):
+        assert InvalidScoreIterationTerminationCondition().terminate(
+            float("nan"))
+        assert InvalidScoreIterationTerminationCondition().terminate(
+            float("inf"))
+        assert not InvalidScoreIterationTerminationCondition().terminate(1.0)
+
+    def test_local_file_saver(self, tmp_path):
+        x, y = _toy_data()
+        train = ArrayDataSetIterator(DataSet(x, y), batch_size=16)
+        test = ArrayDataSetIterator(DataSet(x, y), batch_size=32)
+        esc = (EarlyStoppingConfiguration.Builder()
+               .epoch_termination_conditions(MaxEpochsTerminationCondition(2))
+               .score_calculator(DataSetLossCalculator(test))
+               .model_saver(LocalFileModelSaver(str(tmp_path)))
+               .build())
+        model = MultiLayerNetwork(_mlp())
+        result = EarlyStoppingTrainer(esc, model, train).fit()
+        assert (tmp_path / "bestModel.bin").exists()
+        out = result.best_model.output(x[:2])
+        assert out.shape == (2, 3)
+
+
+class TestTransferLearning:
+    def test_freeze_and_nout_replace(self):
+        x, y = _toy_data()
+        orig = MultiLayerNetwork(_mlp()).init()
+        orig.fit(DataSet(x, y))
+        new = (TransferLearning.Builder(orig)
+               .fine_tune_configuration(
+                   FineTuneConfiguration.Builder().updater(Sgd(1e-3)).build())
+               .set_feature_extractor(0)
+               .n_out_replace(2, 5)
+               .build())
+        assert new.conf.layers[0].frozen
+        assert not new.conf.layers[2].frozen
+        assert new.conf.layers[2].n_out == 5
+        # frozen layer kept original weights
+        w_old = np.asarray(orig.train_state.params["layer_0"]["W"])
+        w_new = np.asarray(new.train_state.params["layer_0"]["W"])
+        np.testing.assert_array_equal(w_old, w_new)
+        out = new.output(x[:4])
+        assert out.shape == (4, 5)
+        # frozen layer does not move during training
+        new.fit(DataSet(x, np.eye(5, dtype=np.float32)[
+            np.random.default_rng(0).integers(0, 5, len(x))]))
+        np.testing.assert_array_equal(
+            w_old, np.asarray(new.train_state.params["layer_0"]["W"]))
+
+    def test_remove_and_add_layers(self):
+        x, y = _toy_data()
+        orig = MultiLayerNetwork(_mlp()).init()
+        new = (TransferLearning.Builder(orig)
+               .remove_output_layer()
+               .add_layer(DenseLayer(n_out=12, activation=Activation.RELU))
+               .add_layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT,
+                                      activation=Activation.SOFTMAX))
+               .build())
+        assert len(new.conf.layers) == 4
+        assert new.output(x[:2]).shape == (2, 3)
+
+    def test_helper_featurize(self):
+        x, y = _toy_data()
+        orig = MultiLayerNetwork(_mlp()).init()
+        frozen = (TransferLearning.Builder(orig)
+                  .set_feature_extractor(1)
+                  .build())
+        helper = TransferLearningHelper(frozen)
+        feat = helper.featurize(DataSet(x, y))
+        assert feat.features.shape == (64, 8)
+        helper.fit_featurized(feat)
+        out = helper.unfrozen_mln().output(feat.features[:4])
+        assert out.shape == (4, 3)
+
+
+class TestRegularizationConf:
+    @pytest.mark.parametrize("do", [Dropout(0.5), AlphaDropout(0.2),
+                                    GaussianDropout(0.3), GaussianNoise(0.1)])
+    def test_dropout_family_trains(self, do):
+        x, y = _toy_data()
+        model = MultiLayerNetwork(_mlp(dropout=do)).init()
+        before = model.score(DataSet(x, y))
+        model.fit(ArrayDataSetIterator(DataSet(x, y), batch_size=32), epochs=3)
+        assert np.isfinite(model.score(DataSet(x, y)))
+        # inference must be deterministic (no dropout at eval)
+        o1 = np.asarray(model.output(x[:8]))
+        o2 = np.asarray(model.output(x[:8]))
+        np.testing.assert_array_equal(o1, o2)
+
+    @pytest.mark.parametrize("wn", [
+        WeightNoise(NormalDistribution(0.0, 0.05)),
+        WeightNoise(NormalDistribution(1.0, 0.05), additive=False),
+        DropConnect(0.3),
+    ])
+    def test_weight_noise_trains(self, wn):
+        x, y = _toy_data()
+        model = MultiLayerNetwork(_mlp(weight_noise=wn)).init()
+        model.fit(DataSet(x, y))
+        assert np.isfinite(model.score())
+        # stored params not perturbed by inference
+        o1 = np.asarray(model.output(x[:8]))
+        o2 = np.asarray(model.output(x[:8]))
+        np.testing.assert_array_equal(o1, o2)
+
+    def test_max_norm_constraint(self):
+        x, y = _toy_data()
+        model = MultiLayerNetwork(
+            _mlp(constraints=(MaxNormConstraint(max_norm=0.5),))).init()
+        model.fit(ArrayDataSetIterator(DataSet(x, y), batch_size=32), epochs=2)
+        w = np.asarray(model.train_state.params["layer_0"]["W"])
+        norms = np.sqrt((w ** 2).sum(axis=0))
+        assert np.all(norms <= 0.5 + 1e-5)
+
+    def test_unit_norm_and_nonneg(self):
+        x, y = _toy_data()
+        model = MultiLayerNetwork(
+            _mlp(constraints=(NonNegativeConstraint(),))).init()
+        model.fit(DataSet(x, y))
+        w = np.asarray(model.train_state.params["layer_0"]["W"])
+        assert np.all(w >= 0.0)
+
+        model2 = MultiLayerNetwork(
+            _mlp(constraints=(UnitNormConstraint(),))).init()
+        model2.fit(DataSet(x, y))
+        w2 = np.asarray(model2.train_state.params["layer_0"]["W"])
+        np.testing.assert_allclose(np.sqrt((w2 ** 2).sum(axis=0)), 1.0,
+                                   atol=1e-5)
+
+    def test_distribution_weight_init(self):
+        x, y = _toy_data()
+        for dist in (NormalDistribution(0.0, 0.01),
+                     UniformDistribution(-0.1, 0.1),
+                     OrthogonalDistribution()):
+            model = MultiLayerNetwork(_mlp(weight_init=dist)).init()
+            assert model.output(x[:2]).shape == (2, 3)
