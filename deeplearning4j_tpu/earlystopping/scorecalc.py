@@ -30,8 +30,9 @@ class ScoreCalculator:
 
 
 class DataSetLossCalculator(ScoreCalculator):
-    """Average loss over the iterator (scorecalc/DataSetLossCalculator.java).
-    ``average=True`` weights by example count like the reference."""
+    """Loss over the iterator (scorecalc/DataSetLossCalculator.java):
+    ``average=True`` → example-weighted mean, ``average=False`` → plain
+    sum of per-batch losses, as the reference defines."""
 
     def __init__(self, iterator: DataSetIterator, average: bool = True):
         self.iterator = iterator
@@ -43,8 +44,8 @@ class DataSetLossCalculator(ScoreCalculator):
         for ds in self.iterator:
             bs = int(np.asarray(ds.features).shape[0])
             total += float(model.score(ds)) * (bs if self.average else 1.0)
-            n += bs if self.average else 1
-        return total / max(n, 1)
+            n += bs
+        return total / max(n, 1) if self.average else total
 
 
 class ClassificationScoreCalculator(ScoreCalculator):

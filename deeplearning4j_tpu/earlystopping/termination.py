@@ -17,6 +17,10 @@ import time
 # ---- epoch-level --------------------------------------------------------
 
 class EpochTerminationCondition:
+    #: whether terminate() reads ``score``; conditions with score_based
+    #: True are skipped on epochs where no held-out score was computed
+    score_based = True
+
     def initialize(self) -> None:
         pass
 
@@ -25,6 +29,8 @@ class EpochTerminationCondition:
 
 
 class MaxEpochsTerminationCondition(EpochTerminationCondition):
+    score_based = False
+
     def __init__(self, max_epochs: int):
         self.max_epochs = int(max_epochs)
 

@@ -75,6 +75,7 @@ class EarlyStoppingTrainer:
                 break
 
             # ---- held-out score + best-model tracking -------------------
+            score = None
             if (cfg.score_calculator is not None
                     and epoch % cfg.evaluate_every_n_epochs == 0):
                 score = cfg.score_calculator.calculate_score(self.model)
@@ -88,15 +89,25 @@ class EarlyStoppingTrainer:
                     cfg.saver.save_best_model(self.model, score)
                 if self.listener is not None:
                     self.listener(epoch, score, self.model)
-            else:
+            elif cfg.score_calculator is None:
+                # no held-out calculator configured: the training loss is
+                # the score by definition (reference default)
                 score = self.model.score()
 
             if cfg.save_last_model:
-                cfg.saver.save_latest_model(self.model, score)
+                cfg.saver.save_latest_model(
+                    self.model, score if score is not None
+                    else self.model.score())
 
             # ---- epoch conditions ---------------------------------------
+            # Score-based conditions only see the calculator's metric; on
+            # non-evaluation epochs (score None) only epoch-count/time
+            # conditions can fire — never the training loss masquerading
+            # as the held-out metric.
             stop = False
             for cond in cfg.epoch_terminations:
+                if score is None and getattr(cond, "score_based", True):
+                    continue
                 if cond.terminate(epoch, score, minimize):
                     stop = True
                     reason = TerminationReason.EPOCH_TERMINATION_CONDITION

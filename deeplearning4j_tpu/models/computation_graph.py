@@ -275,6 +275,6 @@ class ComputationGraph(BaseModel):
                 copy(self.train_state.params),
                 copy(self.train_state.model_state),
                 copy(self.train_state.opt_state),
-                self.train_state.iteration)
+                jnp.array(self.train_state.iteration))
             m.epoch_count = self.epoch_count
         return m

@@ -277,7 +277,7 @@ class MultiLayerNetwork(BaseModel):
                 copy(self.train_state.params),
                 copy(self.train_state.model_state),
                 copy(self.train_state.opt_state),
-                self.train_state.iteration)
+                jnp.array(self.train_state.iteration))
             m.epoch_count = self.epoch_count
         return m
 

@@ -1,0 +1,41 @@
+"""NLP stack: embeddings (Word2Vec/ParagraphVectors/GloVe), text pipeline.
+
+TPU-native analog of deeplearning4j-nlp-parent (SURVEY §2.7,
+deeplearning4j-nlp/.../models/). The reference's hot loop delegates
+per-pair updates to native "aggregate" ops (SkipGram.java:176
+``Nd4j.getExecutioner().exec(batches)``); here pairs are batched on host
+and applied in one jitted scatter-add step on device (nlp/skipgram.py).
+"""
+
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizerFactory,
+    NGramTokenizerFactory,
+    CommonPreprocessor,
+)
+from deeplearning4j_tpu.nlp.sentence_iterators import (
+    BasicLineIterator,
+    CollectionSentenceIterator,
+    FileSentenceIterator,
+    LabelledDocument,
+    CollectionLabelledDocumentIterator,
+)
+from deeplearning4j_tpu.nlp.vocab import (
+    VocabWord,
+    VocabCache,
+    VocabConstructor,
+    Huffman,
+)
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+from deeplearning4j_tpu.nlp.glove import Glove
+from deeplearning4j_tpu.nlp import serializer as WordVectorSerializer
+
+__all__ = [
+    "DefaultTokenizerFactory", "NGramTokenizerFactory", "CommonPreprocessor",
+    "BasicLineIterator", "CollectionSentenceIterator", "FileSentenceIterator",
+    "LabelledDocument", "CollectionLabelledDocumentIterator",
+    "VocabWord", "VocabCache", "VocabConstructor", "Huffman",
+    "Word2Vec", "SequenceVectors", "ParagraphVectors", "Glove",
+    "WordVectorSerializer",
+]

@@ -1,0 +1,130 @@
+"""GloVe: global co-occurrence embeddings.
+
+Analog of the reference's models/glove/ (Glove.java + count/ co-occurrence
+pipeline, SURVEY §2.7). Co-occurrence counts are accumulated on host (the
+reference's RoundCount/CoOccurrenceWriter machinery reduced to a dict),
+then training runs as jitted AdaGrad steps over shuffled batches of
+(word_i, word_j, log X_ij) triples — the entire weighted least-squares
+update for a batch is one fused device step.
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import defaultdict
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5))
+def _glove_step(w, wc, b, bc, gw, gwc, gb, gbc, rows, cols, logx, weight, lr):
+    """AdaGrad step on J = Σ f(X_ij)(w_i·w̃_j + b_i + b̃_j − log X_ij)²."""
+    wi, wj = w[rows], wc[cols]                     # [B, D]
+    diff = (jnp.sum(wi * wj, -1) + b[rows] + bc[cols] - logx)  # [B]
+    fdiff = weight * diff
+    dwi = fdiff[:, None] * wj
+    dwj = fdiff[:, None] * wi
+    # AdaGrad accumulators (scatter-add), then scaled updates
+    gw = gw.at[rows].add(dwi * dwi)
+    gwc = gwc.at[cols].add(dwj * dwj)
+    gb = gb.at[rows].add(fdiff * fdiff)
+    gbc = gbc.at[cols].add(fdiff * fdiff)
+    w = w.at[rows].add(-lr * dwi / jnp.sqrt(gw[rows] + 1e-8))
+    wc = wc.at[cols].add(-lr * dwj / jnp.sqrt(gwc[cols] + 1e-8))
+    b = b.at[rows].add(-lr * fdiff / jnp.sqrt(gb[rows] + 1e-8))
+    bc = bc.at[cols].add(-lr * fdiff / jnp.sqrt(gbc[cols] + 1e-8))
+    loss = 0.5 * jnp.sum(weight * diff * diff)
+    return w, wc, b, bc, gw, gwc, gb, gbc, loss
+
+
+class Glove(SequenceVectors):
+    """reference: Glove.Builder — xMax/alpha weighting, symmetric window
+    co-occurrences, AdaGrad."""
+
+    def __init__(self, x_max: float = 100.0, alpha: float = 0.75,
+                 symmetric: bool = True, shuffle: bool = True, **kwargs):
+        kwargs.setdefault("learning_rate", 0.05)
+        super().__init__(**kwargs)
+        self.x_max = x_max
+        self.alpha = alpha
+        self.symmetric = symmetric
+        self.shuffle = shuffle
+        self.last_loss = None
+
+    def _cooccurrences(self, seqs: List[List[int]]
+                       ) -> Dict[Tuple[int, int], float]:
+        counts: Dict[Tuple[int, int], float] = defaultdict(float)
+        for idxs in seqs:
+            for pos, wi in enumerate(idxs):
+                lo = max(0, pos - self.window_size)
+                for cpos in range(lo, pos):
+                    wj = idxs[cpos]
+                    inc = 1.0 / (pos - cpos)   # distance weighting
+                    counts[(wi, wj)] += inc
+                    if self.symmetric:
+                        counts[(wj, wi)] += inc
+        return counts
+
+    def fit(self, sequences: Iterable[Sequence[str]]):
+        seqs = [list(s) for s in sequences]
+        if seqs and isinstance(seqs[0], str):
+            seqs = [s.split() for s in seqs]
+        if self.vocab is None:
+            self.build_vocab(seqs)
+        idx_seqs = [self._indices(s) for s in seqs]
+        co = self._cooccurrences(idx_seqs)
+        if not co:
+            raise ValueError("empty co-occurrence set")
+        rows = np.fromiter((k[0] for k in co), np.int32, len(co))
+        cols = np.fromiter((k[1] for k in co), np.int32, len(co))
+        xs = np.fromiter(co.values(), np.float32, len(co))
+        logx = np.log(xs)
+        weight = np.minimum((xs / self.x_max) ** self.alpha, 1.0)
+
+        n, d = self.vocab.num_words(), self.layer_size
+        rng = np.random.default_rng(self.seed)
+        w = jnp.asarray(((rng.random((n, d)) - 0.5) / d).astype(np.float32))
+        wc = jnp.asarray(((rng.random((n, d)) - 0.5) / d).astype(np.float32))
+        b = jnp.zeros(n, jnp.float32)
+        bc = jnp.zeros(n, jnp.float32)
+        gw = jnp.full((n, d), 1e-8, jnp.float32)
+        gwc = jnp.full((n, d), 1e-8, jnp.float32)
+        gb = jnp.full(n, 1e-8, jnp.float32)
+        gbc = jnp.full(n, 1e-8, jnp.float32)
+
+        bs = self.batch_size
+        m = len(rows)
+        order = np.arange(m)
+        for _ep in range(max(1, self.epochs) * max(1, self.iterations)):
+            if self.shuffle:
+                rng.shuffle(order)
+            total = 0.0
+            for s in range(0, m, bs):
+                sel = order[s:s + bs]
+                if len(sel) < bs:   # pad with repeats; weight-0 the pads
+                    pad = np.zeros(bs - len(sel), np.int64)
+                    wsel = np.concatenate([weight[sel],
+                                           np.zeros(bs - len(sel),
+                                                    np.float32)])
+                    lsel = np.concatenate([logx[sel], logx[pad]])
+                    rsel = np.concatenate([rows[sel], rows[pad]])
+                    csel = np.concatenate([cols[sel], cols[pad]])
+                else:
+                    wsel, lsel = weight[sel], logx[sel]
+                    rsel, csel = rows[sel], cols[sel]
+                (w, wc, b, bc, gw, gwc, gb, gbc, loss) = _glove_step(
+                    w, wc, b, bc, gw, gwc, gb, gbc,
+                    jnp.asarray(rsel), jnp.asarray(csel),
+                    jnp.asarray(lsel), jnp.asarray(wsel),
+                    jnp.float32(self.learning_rate))
+                total += float(loss)
+            self.last_loss = total / m
+        # final vectors: w + w̃ (standard GloVe export)
+        self.syn0 = w + wc
+        self.syn1 = wc
+        return self

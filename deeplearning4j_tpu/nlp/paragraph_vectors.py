@@ -1,0 +1,170 @@
+"""ParagraphVectors (doc2vec): label-aware embeddings.
+
+Analog of the reference's models/paragraphvectors/ParagraphVectors.java
+with the two sequence learning algorithms from
+models/embeddings/learning/impl/sequence/ (SURVEY §2.7):
+  - DBOW (DBOW.java): the document label is a center "word" predicting
+    every word in the document — plain SkipGram pairs with the label row.
+  - DM (DM.java): the label vector joins the context window in a CBOW
+    step predicting the center word.
+Label vectors live in the same syn0 table as word vectors (as in the
+reference, where labels are special vocab elements), so both algorithms
+reuse the jitted kernels unchanged. ``infer_vector`` trains a fresh row
+against frozen syn1 (ParagraphVectors.java inferVector).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp import skipgram as sk
+from deeplearning4j_tpu.nlp.sentence_iterators import (
+    LabelAwareIterator,
+    LabelledDocument,
+    SentenceLabelledIterator,
+)
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+
+class ParagraphVectors(Word2Vec):
+    def __init__(self, dm: bool = False, **kwargs):
+        kwargs.setdefault("use_cbow", dm)
+        super().__init__(**kwargs)
+        self.dm = dm
+        self._label_set = set()
+
+    # ---- corpus handling -------------------------------------------------
+    def _docs(self, corpus) -> List[LabelledDocument]:
+        if isinstance(corpus, LabelAwareIterator):
+            return list(corpus)
+        docs = list(corpus)
+        if docs and isinstance(docs[0], str):
+            return list(SentenceLabelledIterator(docs))
+        return docs
+
+    def fit(self, corpus: Union[LabelAwareIterator, Iterable[str],
+                                Iterable[LabelledDocument]]):
+        docs = self._docs(corpus)
+        tokenized = [(self.tokenizer_factory.create(d.content).get_tokens(),
+                      d.labels) for d in docs]
+        self._label_set = {lb for _t, lbs in tokenized for lb in lbs}
+        if self.vocab is None:
+            super(Word2Vec, self).build_vocab(
+                [t for t, _ in tokenized],
+                special_tokens=sorted(self._label_set))
+        if self.syn0 is None:
+            self._init_tables()
+        total = max(1, sum(len(t) for t, _ in tokenized) * self.epochs)
+        k = self._k()
+        batcher = sk.PairBatcher(self.batch_size, k)
+        seen = 0
+        for _ep in range(self.epochs):
+            for tokens, labels in tokenized:
+                idxs = self._indices(tokens)
+                lidxs = [self.vocab.index_of(lb) for lb in labels]
+                lidxs = [i for i in lidxs if i >= 0]
+                if self.dm:
+                    seen = self._train_dm(idxs, lidxs, seen, total)
+                else:
+                    seen = self._train_dbow(idxs, lidxs, batcher, seen, total)
+                    # words also train among themselves (reference trains
+                    # word vectors jointly unless trainWordVectors=false)
+                    seen = super(Word2Vec, self)._train_sequence(
+                        idxs, batcher, seen, total)
+        self._flush(batcher, self._lr(seen, total))
+        return self
+
+    def _train_dbow(self, idxs, lidxs, batcher, seen, total):
+        for label_row in lidxs:
+            for w in idxs:
+                self._add_pair(label_row, w, batcher, seen, total)
+                seen += 1
+        return seen
+
+    def _train_dm(self, idxs, lidxs, seen, total):
+        window = self.window_size
+        ctx_w = 2 * window + len(lidxs)
+        if getattr(self, "_cbow_buf", None) is None or \
+                self._cbow_buf.ctx_w < ctx_w:
+            from deeplearning4j_tpu.nlp.word2vec import _CbowBatcher
+            self._cbow_buf = _CbowBatcher(self.batch_size, ctx_w, self._k())
+        buf = self._cbow_buf
+        for pos, center in enumerate(idxs):
+            b = int(self._rng.integers(window)) if window > 1 else 0
+            lo = max(0, pos - (window - b))
+            hi = min(len(idxs), pos + (window - b) + 1)
+            ctx = [idxs[c] for c in range(lo, hi) if c != pos] + lidxs
+            if not ctx:
+                seen += 1
+                continue
+            if self.use_hs:
+                targets, labels = sk.hs_targets(
+                    self.vocab.element_at_index(center))
+            else:
+                targets, labels = sk.negative_sample_targets(
+                    center, self._table, self.negative, self._rng)
+            if buf.add(ctx, targets, labels):
+                self._flush_cbow(buf, self._lr(seen, total))
+            seen += 1
+        return seen
+
+    # ---- serving ---------------------------------------------------------
+    def labels(self) -> List[str]:
+        return sorted(self._label_set)
+
+    def get_label_vector(self, label: str) -> np.ndarray:
+        return self.get_word_vector(label)
+
+    def infer_vector(self, text: str, steps: int = 10,
+                     learning_rate: Optional[float] = None) -> np.ndarray:
+        """Train one fresh vector for unseen text against frozen syn1
+        (reference: ParagraphVectors.inferVector)."""
+        lr = learning_rate or self.learning_rate
+        tokens = self.tokenizer_factory.create(text).get_tokens()
+        idxs = [self.vocab.index_of(t) for t in tokens]
+        idxs = [i for i in idxs if i >= 0]
+        rng = np.random.default_rng(0)
+        vec = jnp.asarray(((rng.random(self.layer_size) - 0.5)
+                           / self.layer_size).astype(np.float32))
+        if not idxs:
+            return np.asarray(vec)
+        k = self._k()
+        targets = np.zeros((len(idxs), k), np.int32)
+        labels = np.zeros((len(idxs), k), np.float32)
+        mask = np.zeros((len(idxs), k), np.float32)
+        for _step in range(steps):
+            for p, w in enumerate(idxs):
+                if self.use_hs:
+                    t, l = sk.hs_targets(self.vocab.element_at_index(w))
+                else:
+                    t, l = sk.negative_sample_targets(
+                        w, self._table, self.negative, rng)
+                kk = min(len(t), k)
+                targets[p, :kk], labels[p, :kk] = t[:kk], l[:kk]
+                mask[p, :kk] = 1.0
+            vec = sk.infer_step(vec, self.syn1, jnp.asarray(targets),
+                                jnp.asarray(labels), jnp.asarray(mask),
+                                jnp.float32(lr))
+        return np.asarray(vec)
+
+    def similarity_to_label(self, text: str, label: str) -> float:
+        v = self.infer_vector(text)
+        lv = self.get_label_vector(label)
+        den = np.linalg.norm(v) * np.linalg.norm(lv)
+        return float(v @ lv / den) if den else 0.0
+
+    def predict(self, text: str) -> str:
+        """Nearest label for unseen text (reference:
+        ParagraphVectors.predict)."""
+        v = self.infer_vector(text)
+        best, best_sim = None, -np.inf
+        for lb in self.labels():
+            lv = self.get_label_vector(lb)
+            den = np.linalg.norm(v) * np.linalg.norm(lv)
+            s = float(v @ lv / den) if den else 0.0
+            if s > best_sim:
+                best, best_sim = lb, s
+        return best

@@ -1,0 +1,108 @@
+"""Sentence / document iterators.
+
+Analog of the reference's text/sentenceiterator/ and text/documentiterator/
+(SURVEY §2.7): streams of sentences (strings) or labelled documents feeding
+vocab construction and training. Python iterables replace the reference's
+hasNext/nextSentence protocol; ``reset()`` restarts the stream.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Iterable, Iterator, List, Optional
+
+
+class SentenceIterator:
+    """reference: sentenceiterator/SentenceIterator.java"""
+
+    def __iter__(self) -> Iterator[str]:
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+
+class CollectionSentenceIterator(SentenceIterator):
+    """In-memory list of sentences (reference: sentenceiterator/
+    CollectionSentenceIterator.java)."""
+
+    def __init__(self, sentences: Iterable[str]):
+        self._sentences = list(sentences)
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._sentences)
+
+
+class BasicLineIterator(SentenceIterator):
+    """One sentence per line from a file (reference: sentenceiterator/
+    BasicLineIterator.java)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def __iter__(self) -> Iterator[str]:
+        with open(self.path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield line
+
+
+class FileSentenceIterator(SentenceIterator):
+    """All files under a directory, line by line (reference:
+    sentenceiterator/FileSentenceIterator.java)."""
+
+    def __init__(self, root: str):
+        self.root = root
+
+    def __iter__(self) -> Iterator[str]:
+        if os.path.isfile(self.root):
+            yield from BasicLineIterator(self.root)
+            return
+        for dirpath, _dirs, files in os.walk(self.root):
+            for name in sorted(files):
+                yield from BasicLineIterator(os.path.join(dirpath, name))
+
+
+@dataclasses.dataclass
+class LabelledDocument:
+    """reference: documentiterator/LabelledDocument.java"""
+    content: str
+    labels: List[str]
+
+    @property
+    def label(self) -> Optional[str]:
+        return self.labels[0] if self.labels else None
+
+
+class LabelAwareIterator:
+    """reference: documentiterator/LabelAwareIterator.java"""
+
+    def __iter__(self) -> Iterator[LabelledDocument]:
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+
+class CollectionLabelledDocumentIterator(LabelAwareIterator):
+    def __init__(self, docs: Iterable[LabelledDocument]):
+        self._docs = list(docs)
+
+    def __iter__(self) -> Iterator[LabelledDocument]:
+        return iter(self._docs)
+
+
+class SentenceLabelledIterator(LabelAwareIterator):
+    """Wrap a SentenceIterator, auto-assigning DOC_<n> labels (reference:
+    ParagraphVectors falls back to synthetic labels via
+    documentiterator/DocumentIterator adapters)."""
+
+    def __init__(self, sentences: Iterable[str], prefix: str = "DOC_"):
+        self._sentences = list(sentences)
+        self._prefix = prefix
+
+    def __iter__(self) -> Iterator[LabelledDocument]:
+        for i, s in enumerate(self._sentences):
+            yield LabelledDocument(s, [f"{self._prefix}{i}"])

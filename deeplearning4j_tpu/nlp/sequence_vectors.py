@@ -1,0 +1,222 @@
+"""SequenceVectors: the generic embedding trainer.
+
+Analog of the reference's models/sequencevectors/SequenceVectors.java:50
+(``fit()`` at :193): build a vocab over element sequences, then train
+SkipGram/CBOW over windows. Word2Vec, ParagraphVectors and DeepWalk all
+specialise this class, exactly as in the reference.
+
+Where the reference fans sequences out to trainer threads that each feed
+native aggregate ops (§3.6), the TPU design streams pair batches into the
+jitted scatter-add kernels in nlp/skipgram.py — device-bound throughput
+with a single Python producer.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp import skipgram as sk
+from deeplearning4j_tpu.nlp.vocab import Huffman, VocabCache, VocabConstructor
+
+
+class SequenceVectors:
+    """Builder-configured embedding trainer (reference:
+    SequenceVectors.Builder)."""
+
+    def __init__(self,
+                 layer_size: int = 100,
+                 window_size: int = 5,
+                 min_word_frequency: int = 1,
+                 iterations: int = 1,
+                 epochs: int = 1,
+                 negative: int = 5,
+                 use_hierarchic_softmax: bool = False,
+                 learning_rate: float = 0.025,
+                 min_learning_rate: float = 1e-4,
+                 sampling: float = 0.0,
+                 batch_size: int = 512,
+                 seed: int = 42,
+                 stop_words: Iterable[str] = (),
+                 use_cbow: bool = False):
+        self.layer_size = layer_size
+        self.window_size = window_size
+        self.min_word_frequency = min_word_frequency
+        self.iterations = iterations
+        self.epochs = epochs
+        self.negative = negative if not use_hierarchic_softmax else 0
+        self.use_hs = use_hierarchic_softmax
+        self.learning_rate = learning_rate
+        self.min_learning_rate = min_learning_rate
+        self.sampling = sampling
+        self.batch_size = batch_size
+        self.seed = seed
+        self.stop_words = stop_words
+        self.use_cbow = use_cbow
+
+        self.vocab: Optional[VocabCache] = None
+        self.syn0: Optional[jax.Array] = None
+        self.syn1: Optional[jax.Array] = None
+        self._rng = np.random.default_rng(seed)
+        self._table: Optional[np.ndarray] = None
+        self._max_code_len = 0
+
+    # ---- vocab + tables --------------------------------------------------
+    def build_vocab(self, sequences: Iterable[Sequence[str]],
+                    special_tokens: Iterable[str] = ()):
+        ctor = VocabConstructor(self.min_word_frequency, self.stop_words)
+        self.vocab = ctor.build_vocab(
+            (list(s) for s in sequences), special_tokens=special_tokens)
+        if self.use_hs:
+            Huffman(self.vocab.vocab_words()).build()
+            self._max_code_len = max(
+                (len(w.codes) for w in self.vocab.vocab_words()), default=1)
+        return self
+
+    def _init_tables(self):
+        n, d = self.vocab.num_words(), self.layer_size
+        rng = np.random.default_rng(self.seed)
+        syn0 = ((rng.random((n, d)) - 0.5) / d).astype(np.float32)
+        rows1 = max(n - 1, 1) if self.use_hs else n
+        self.syn0 = jnp.asarray(syn0)
+        self.syn1 = jnp.zeros((rows1, d), jnp.float32)
+        if not self.use_hs:
+            self._table = self.vocab.unigram_table()
+
+    # ---- training --------------------------------------------------------
+    def fit(self, sequences: Iterable[Sequence[str]]):
+        seqs = [list(s) for s in sequences]
+        if self.vocab is None:
+            self.build_vocab(seqs)
+        if self.syn0 is None:
+            self._init_tables()
+        total_words = max(
+            1, sum(len(s) for s in seqs) * self.epochs * self.iterations)
+        k = self._k()
+        batcher = sk.PairBatcher(self.batch_size, k)
+        seen = 0
+        for _epoch in range(self.epochs):
+            for seq in seqs:
+                idxs = self._indices(seq)
+                for _it in range(self.iterations):
+                    seen = self._train_sequence(
+                        idxs, batcher, seen, total_words)
+        self._flush(batcher, self._lr(seen, total_words))
+        return self
+
+    def _k(self) -> int:
+        return (self._max_code_len if self.use_hs else 1 + self.negative)
+
+    def _lr(self, seen: int, total: int) -> float:
+        frac = min(1.0, seen / total)
+        return max(self.min_learning_rate,
+                   self.learning_rate * (1.0 - frac))
+
+    def _indices(self, seq: Sequence[str]) -> List[int]:
+        """Vocab lookup + frequent-word subsampling (word2vec.c style;
+        reference applies sampling in SequenceVectors' transformer)."""
+        out = []
+        total = max(1, self.vocab.total_word_count)
+        for tok in seq:
+            idx = self.vocab.index_of(tok)
+            if idx < 0:
+                continue
+            if self.sampling > 0:
+                f = self.vocab.element_at_index(idx).count / total
+                keep = (np.sqrt(f / self.sampling) + 1) * self.sampling / f
+                if self._rng.random() > keep:
+                    continue
+            out.append(idx)
+        return out
+
+    def _train_sequence(self, idxs: List[int], batcher: sk.PairBatcher,
+                        seen: int, total: int) -> int:
+        window = self.window_size
+        for pos, center in enumerate(idxs):
+            b = int(self._rng.integers(window)) if window > 1 else 0
+            lo = max(0, pos - (window - b))
+            hi = min(len(idxs), pos + (window - b) + 1)
+            for cpos in range(lo, hi):
+                if cpos == pos:
+                    continue
+                self._add_pair(center, idxs[cpos], batcher, seen, total)
+            seen += 1
+        return seen
+
+    def _add_pair(self, center: int, context: int, batcher: sk.PairBatcher,
+                  seen: int, total: int):
+        """SkipGram: center predicts context → (row=center, target=context).
+        word2vec.c trains syn0[context] against syn1[center-path]; either
+        orientation is symmetric over the corpus."""
+        if self.use_hs:
+            targets, labels = sk.hs_targets(
+                self.vocab.element_at_index(context))
+        else:
+            targets, labels = sk.negative_sample_targets(
+                context, self._table, self.negative, self._rng)
+        if batcher.add(center, targets, labels):
+            self._flush(batcher, self._lr(seen, total))
+
+    def _flush(self, batcher: sk.PairBatcher, lr: float):
+        if batcher.n == 0 and batcher.mask.sum() == 0:
+            return
+        centers, targets, labels, mask, _n = batcher.take()
+        self.syn0, self.syn1 = sk.skipgram_step(
+            self.syn0, self.syn1, jnp.asarray(centers), jnp.asarray(targets),
+            jnp.asarray(labels), jnp.asarray(mask),
+            jnp.float32(lr))
+
+    # ---- lookup API (reference: WordVectors interface) -------------------
+    @property
+    def word_vectors_matrix(self) -> np.ndarray:
+        return np.asarray(self.syn0)
+
+    def has_word(self, word: str) -> bool:
+        return self.vocab is not None and self.vocab.contains_word(word)
+
+    def get_word_vector(self, word: str) -> np.ndarray:
+        idx = self.vocab.index_of(word)
+        if idx < 0:
+            raise KeyError(word)
+        return np.asarray(self.syn0[idx])
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.get_word_vector(a), self.get_word_vector(b)
+        na, nb = np.linalg.norm(va), np.linalg.norm(vb)
+        if na == 0 or nb == 0:
+            return 0.0
+        return float(va @ vb / (na * nb))
+
+    def words_nearest(self, word, top_n: int = 10) -> List[str]:
+        """Cosine top-k on device (reference: wordsNearest via
+        BasicModelUtils; here one matmul on the MXU)."""
+        if isinstance(word, str):
+            v = jnp.asarray(self.get_word_vector(word))
+            exclude = {self.vocab.index_of(word)}
+        else:
+            v = jnp.asarray(np.asarray(word, np.float32))
+            exclude = set()
+        m = self.syn0 / jnp.maximum(
+            jnp.linalg.norm(self.syn0, axis=1, keepdims=True), 1e-9)
+        sims = m @ (v / jnp.maximum(jnp.linalg.norm(v), 1e-9))
+        order = np.asarray(jnp.argsort(-sims))
+        out = []
+        for idx in order:
+            if int(idx) in exclude:
+                continue
+            out.append(self.vocab.word_at_index(int(idx)))
+            if len(out) >= top_n:
+                break
+        return out
+
+    def words_nearest_sum(self, positive: List[str], negative: List[str],
+                          top_n: int = 10) -> List[str]:
+        v = sum(self.get_word_vector(w) for w in positive)
+        for w in negative:
+            v = v - self.get_word_vector(w)
+        out = self.words_nearest(v, top_n + len(positive) + len(negative))
+        skip = set(positive) | set(negative)
+        return [w for w in out if w not in skip][:top_n]

@@ -1,0 +1,155 @@
+"""Batched SkipGram / CBOW device kernels.
+
+TPU-native replacement for the reference's native aggregate ops: the
+reference batches (center, context) pairs into ``AggregateSkipGram`` /
+``AggregateCBOW`` and executes them in C++ via
+``Nd4j.getExecutioner().exec(batches)``
+(models/embeddings/learning/impl/elements/SkipGram.java:176,271; CBOW.java).
+
+Here the same batching idea becomes ONE jitted step per batch: gather the
+center rows from syn0 and the target rows (negative samples or Huffman
+inner nodes) from syn1, compute the sigmoid-gradient for every pair at
+once on the MXU, and scatter-add the updates back. Duplicate indices in a
+batch are handled correctly by XLA's scatter-add. Buffers are donated so
+the embedding tables are updated in place on device.
+
+The math (per pair, label y ∈ {0,1}, lr α):
+    g = (y − σ(syn0[c]·syn1[t])) · α
+    syn1[t] += g · syn0[c]
+    syn0[c] += g · syn1[t]        (pre-update value, as in word2vec.c)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def skipgram_step(syn0: jax.Array, syn1: jax.Array,
+                  centers: jax.Array,      # [B] int32
+                  targets: jax.Array,      # [B, K] int32
+                  labels: jax.Array,       # [B, K] float32 (1=pos, 0=neg)
+                  mask: jax.Array,         # [B, K] float32
+                  lr: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """One batched SkipGram update (negative sampling or hierarchical
+    softmax — identical math, different targets/labels)."""
+    h = syn0[centers]                                  # [B, D]
+    w = syn1[targets]                                  # [B, K, D]
+    logits = jnp.einsum("bd,bkd->bk", h, w)
+    g = (labels - jax.nn.sigmoid(logits)) * mask * lr  # [B, K]
+    dh = jnp.einsum("bk,bkd->bd", g, w)                # grad wrt syn0 rows
+    dw = g[..., None] * h[:, None, :]                  # [B, K, D]
+    d = syn0.shape[1]
+    syn1 = syn1.at[targets.reshape(-1)].add(
+        dw.reshape(-1, d).astype(syn1.dtype))
+    syn0 = syn0.at[centers].add(dh.astype(syn0.dtype))
+    return syn0, syn1
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def cbow_step(syn0: jax.Array, syn1: jax.Array,
+              context: jax.Array,       # [B, W] int32 context word rows
+              context_mask: jax.Array,  # [B, W] float32
+              targets: jax.Array,       # [B, K] int32
+              labels: jax.Array,        # [B, K] float32
+              mask: jax.Array,          # [B, K] float32
+              lr: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """One batched CBOW update: h = mean(context rows); the syn0 gradient
+    is broadcast back to every context word (reference: CBOW.java via
+    AggregateCBOW)."""
+    cvecs = syn0[context]                               # [B, W, D]
+    denom = jnp.maximum(context_mask.sum(-1, keepdims=True), 1.0)
+    h = (cvecs * context_mask[..., None]).sum(1) / denom  # [B, D]
+    w = syn1[targets]
+    logits = jnp.einsum("bd,bkd->bk", h, w)
+    g = (labels - jax.nn.sigmoid(logits)) * mask * lr
+    dh = jnp.einsum("bk,bkd->bd", g, w) / denom          # [B, D]
+    dw = g[..., None] * h[:, None, :]
+    d = syn0.shape[1]
+    syn1 = syn1.at[targets.reshape(-1)].add(
+        dw.reshape(-1, d).astype(syn1.dtype))
+    dctx = (dh[:, None, :] * context_mask[..., None]).reshape(-1, d)
+    syn0 = syn0.at[context.reshape(-1)].add(dctx.astype(syn0.dtype))
+    return syn0, syn1
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def infer_step(docvec: jax.Array,        # [D] the one trainable vector
+               syn1: jax.Array,          # frozen
+               targets: jax.Array,       # [P, K]
+               labels: jax.Array,
+               mask: jax.Array,
+               lr: jax.Array) -> jax.Array:
+    """ParagraphVectors.inferVector inner step: train a single new doc
+    vector against a frozen syn1 (reference: ParagraphVectors.java
+    inferVector)."""
+    w = syn1[targets]                                   # [P, K, D]
+    logits = jnp.einsum("d,pkd->pk", docvec, w)
+    g = (labels - jax.nn.sigmoid(logits)) * mask * lr
+    return docvec + jnp.einsum("pk,pkd->d", g, w).astype(docvec.dtype)
+
+
+class PairBatcher:
+    """Host-side accumulator of (center, targets, labels) rows, flushed to
+    the device kernel when full — the analog of the reference's batch list
+    handed to the native executioner (SkipGram.java:176-186)."""
+
+    def __init__(self, batch_size: int, k: int):
+        self.batch_size = batch_size
+        self.k = k
+        self.centers = np.zeros(batch_size, np.int32)
+        self.targets = np.zeros((batch_size, k), np.int32)
+        self.labels = np.zeros((batch_size, k), np.float32)
+        self.mask = np.zeros((batch_size, k), np.float32)
+        self.n = 0
+
+    def add(self, center: int, targets: np.ndarray, labels: np.ndarray):
+        i = self.n
+        kk = min(len(targets), self.k)
+        self.centers[i] = center
+        self.targets[i, :kk] = targets[:kk]
+        self.labels[i, :kk] = labels[:kk]
+        self.mask[i, :kk] = 1.0
+        if kk < self.k:
+            self.targets[i, kk:] = 0
+            self.labels[i, kk:] = 0.0
+            self.mask[i, kk:] = 0.0
+        self.n += 1
+        return self.n >= self.batch_size
+
+    def take(self):
+        out = (self.centers.copy(), self.targets.copy(),
+               self.labels.copy(), self.mask.copy(), self.n)
+        # zero masks beyond fill point so a partial flush is inert
+        if self.n < self.batch_size:
+            out[3][self.n:] = 0.0
+        self.n = 0
+        self.mask[:] = 0.0
+        return out
+
+
+def negative_sample_targets(pos: int, table: np.ndarray, n_neg: int,
+                            rng: np.random.Generator
+                            ) -> Tuple[np.ndarray, np.ndarray]:
+    """1 positive + n_neg negatives drawn from the unigram^0.75 table."""
+    negs = table[rng.integers(0, len(table), n_neg)]
+    targets = np.concatenate(([pos], negs)).astype(np.int32)
+    labels = np.zeros(1 + n_neg, np.float32)
+    labels[0] = 1.0
+    return targets, labels
+
+
+def hs_targets(vw, max_len: Optional[int] = None
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    """Hierarchical-softmax targets: Huffman inner nodes with label
+    1−code (word2vec convention)."""
+    points = np.asarray(vw.points, np.int32)
+    labels = 1.0 - np.asarray(vw.codes, np.float32)
+    if max_len is not None:
+        points, labels = points[:max_len], labels[:max_len]
+    return points, labels

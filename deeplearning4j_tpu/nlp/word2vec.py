@@ -1,0 +1,160 @@
+"""Word2Vec: SkipGram/CBOW over text corpora.
+
+Analog of the reference's models/word2vec/Word2Vec.java:32 (extends
+SequenceVectors) — adds the text front-end: a SentenceIterator +
+TokenizerFactory turn raw text into token sequences, then training is
+SequenceVectors' device hot loop (nlp/skipgram.py).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp import skipgram as sk
+from deeplearning4j_tpu.nlp.sentence_iterators import SentenceIterator
+from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizerFactory,
+    TokenizerFactory,
+)
+
+import jax.numpy as jnp
+
+
+class Word2Vec(SequenceVectors):
+    """reference: Word2Vec.Builder — same knob names (layerSize →
+    layer_size, windowSize → window_size, minWordFrequency, negative,
+    useHierarchicSoftmax, elementsLearningAlgorithm SkipGram/CBOW)."""
+
+    def __init__(self, tokenizer_factory: Optional[TokenizerFactory] = None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+
+    # ---- text front-end --------------------------------------------------
+    def _tokenize(self, corpus) -> List[List[str]]:
+        # materialize first so type-sniffing can't consume a generator
+        items = list(corpus)
+        if items and isinstance(items[0], str):
+            return [self.tokenizer_factory.create(s).get_tokens()
+                    for s in items]
+        return [list(s) for s in items]
+
+    def fit(self, corpus: Union[SentenceIterator, Iterable[str],
+                                Iterable[Sequence[str]]]):
+        return super().fit(self._tokenize(corpus))
+
+    def build_vocab(self, corpus, special_tokens: Iterable[str] = ()):
+        return super().build_vocab(self._tokenize(corpus),
+                                   special_tokens=special_tokens)
+
+    # ---- CBOW training path ---------------------------------------------
+    def _train_sequence(self, idxs, batcher, seen, total):
+        if not self.use_cbow:
+            return super()._train_sequence(idxs, batcher, seen, total)
+        # CBOW: context window predicts center (reference: CBOW.java via
+        # AggregateCBOW). Batched separately because the h-vector is a
+        # masked mean over context rows.
+        window = self.window_size
+        ctx_w = 2 * window
+        if not hasattr(self, "_cbow_buf") or self._cbow_buf is None:
+            self._cbow_buf = _CbowBatcher(self.batch_size, ctx_w, self._k())
+        buf = self._cbow_buf
+        for pos, center in enumerate(idxs):
+            b = int(self._rng.integers(window)) if window > 1 else 0
+            lo = max(0, pos - (window - b))
+            hi = min(len(idxs), pos + (window - b) + 1)
+            ctx = [idxs[c] for c in range(lo, hi) if c != pos]
+            if not ctx:
+                seen += 1
+                continue
+            if self.use_hs:
+                targets, labels = sk.hs_targets(
+                    self.vocab.element_at_index(center))
+            else:
+                targets, labels = sk.negative_sample_targets(
+                    center, self._table, self.negative, self._rng)
+            if buf.add(ctx, targets, labels):
+                self._flush_cbow(buf, self._lr(seen, total))
+            seen += 1
+        return seen
+
+    def fit_finalize(self):
+        pass
+
+    def _flush(self, batcher, lr):
+        super()._flush(batcher, lr)
+        if getattr(self, "_cbow_buf", None) is not None:
+            self._flush_cbow(self._cbow_buf, lr)
+
+    def _flush_cbow(self, buf: "_CbowBatcher", lr: float):
+        if buf.n == 0 and buf.mask.sum() == 0:
+            return
+        ctx, cmask, targets, labels, mask = buf.take()
+        self.syn0, self.syn1 = sk.cbow_step(
+            self.syn0, self.syn1, jnp.asarray(ctx), jnp.asarray(cmask),
+            jnp.asarray(targets), jnp.asarray(labels), jnp.asarray(mask),
+            jnp.float32(lr))
+
+
+class _CbowBatcher:
+    def __init__(self, batch_size: int, ctx_w: int, k: int):
+        self.batch_size, self.ctx_w, self.k = batch_size, ctx_w, k
+        self.ctx = np.zeros((batch_size, ctx_w), np.int32)
+        self.cmask = np.zeros((batch_size, ctx_w), np.float32)
+        self.targets = np.zeros((batch_size, k), np.int32)
+        self.labels = np.zeros((batch_size, k), np.float32)
+        self.mask = np.zeros((batch_size, k), np.float32)
+        self.n = 0
+
+    def add(self, ctx, targets, labels) -> bool:
+        i = self.n
+        w = min(len(ctx), self.ctx_w)
+        self.ctx[i, :w] = ctx[:w]
+        self.cmask[i, :w] = 1.0
+        self.cmask[i, w:] = 0.0
+        kk = min(len(targets), self.k)
+        self.targets[i, :kk] = targets[:kk]
+        self.labels[i, :kk] = labels[:kk]
+        self.mask[i, :kk] = 1.0
+        self.mask[i, kk:] = 0.0
+        self.n += 1
+        return self.n >= self.batch_size
+
+    def take(self):
+        out = (self.ctx.copy(), self.cmask.copy(), self.targets.copy(),
+               self.labels.copy(), self.mask.copy())
+        if self.n < self.batch_size:
+            out[4][self.n:] = 0.0
+            out[1][self.n:] = 0.0
+        self.n = 0
+        self.mask[:] = 0.0
+        self.cmask[:] = 0.0
+        return out
+
+
+class StaticWord2Vec:
+    """Read-only vector lookup (reference: word2vec/StaticWord2Vec.java —
+    memory-mapped serving copy without training state)."""
+
+    def __init__(self, words: List[str], vectors: np.ndarray):
+        self._index = {w: i for i, w in enumerate(words)}
+        self._words = list(words)
+        self._vectors = np.asarray(vectors, np.float32)
+
+    @classmethod
+    def from_model(cls, w2v: SequenceVectors) -> "StaticWord2Vec":
+        return cls(w2v.vocab.words(), w2v.word_vectors_matrix)
+
+    def has_word(self, w: str) -> bool:
+        return w in self._index
+
+    def get_word_vector(self, w: str) -> np.ndarray:
+        return self._vectors[self._index[w]]
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.get_word_vector(a), self.get_word_vector(b)
+        den = np.linalg.norm(va) * np.linalg.norm(vb)
+        return float(va @ vb / den) if den else 0.0

@@ -15,7 +15,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from deeplearning4j_tpu.nn.param_keys import is_bias_path
+from deeplearning4j_tpu.nn.param_keys import is_bias_path, is_weight_path
 from deeplearning4j_tpu.utils.serde import register_serializable
 
 
@@ -38,6 +38,8 @@ class LayerConstraint:
         def go(path, p):
             if not self.apply_to_bias and is_bias_path(path):
                 return p
+            if not is_weight_path(path) and not is_bias_path(path):
+                return p  # statistics-like params (class centers): never
             return self.project(p)
 
         leaves = jax.tree_util.tree_flatten_with_path(params)[0]

@@ -94,9 +94,9 @@ class Layer:
         # Check the LEAF-level key (last path component), so nested wrapper
         # params ({"fwd": {...,"b":...}, "bwd": {...}}) are classified per
         # actual parameter, not per wrapper key.
-        from deeplearning4j_tpu.nn.param_keys import is_bias_path
+        from deeplearning4j_tpu.nn.param_keys import is_weight_path
         for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
-            if is_bias_path(path):
+            if not is_weight_path(path):
                 continue
             if self.l1:
                 total = total + self.l1 * jnp.sum(jnp.abs(leaf))
@@ -115,9 +115,8 @@ class Layer:
         if isinstance(self.dropout, (int, float)):
             if self.dropout <= 0.0:
                 return x
-            keep = 1.0 - self.dropout
-            mask = jax.random.bernoulli(key, keep, x.shape)
-            return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+            from deeplearning4j_tpu.nn.dropout import Dropout
+            return Dropout(float(self.dropout)).apply_dropout(x, key)
         return self.dropout.apply_dropout(x, key)
 
     def apply_weight_noise(self, params, ctx: LayerContext,

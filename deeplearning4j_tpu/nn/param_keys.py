@@ -11,8 +11,22 @@ nn/api/ParamInitializer.java).
 BIAS_KEYS = ("b", "vb", "beta", "mean", "var", "pI", "pF", "pO",
              "bmu", "blv", "bout")
 
+# Neither weight nor bias: statistics-like parameters that must never be
+# regularized or constrained (CenterLossOutputLayer's per-class centers —
+# the reference updates them by EMA, never through weight decay).
+EXCLUDED_KEYS = ("centers",)
+
+
+def _key(path) -> str:
+    return getattr(path[-1], "key", None)
+
 
 def is_bias_path(path) -> bool:
     """True when a tree_flatten_with_path leaf path ends in a bias-like
     key (bias, BN shift/statistics, peephole weights...)."""
-    return getattr(path[-1], "key", None) in BIAS_KEYS
+    return _key(path) in BIAS_KEYS
+
+
+def is_weight_path(path) -> bool:
+    """True for parameters eligible for L1/L2 and constraints."""
+    return _key(path) not in BIAS_KEYS and _key(path) not in EXCLUDED_KEYS

@@ -99,13 +99,39 @@ def _copy_matching_params(old_model, new_model,
         src = renamed.get(name, name)
         if src in old_p and _tree_shapes(old_p[src]) == _tree_shapes(
                 new_p[name]):
-            new_p[name] = jax.tree_util.tree_map(lambda a: a, old_p[src])
+            # real copies, not references: the train step donates its
+            # input buffers, so aliasing would let either model's fit()
+            # invalidate the other's params on TPU
+            new_p[name] = jax.tree_util.tree_map(jnp.array, old_p[src])
             if src in old_s and _tree_shapes(old_s[src]) == _tree_shapes(
                     new_s.get(name, {})):
-                new_s[name] = jax.tree_util.tree_map(lambda a: a, old_s[src])
+                new_s[name] = jax.tree_util.tree_map(jnp.array, old_s[src])
     new_model.train_state = TrainState(
         new_p, new_s, new_model.train_state.opt_state,
         jnp.zeros((), jnp.int32))
+
+
+def _has_field(layer, field: str) -> bool:
+    """True when ``field`` is a real dataclass field — possibly on the
+    underlying layer of a wrapper like FrozenLayer, whose __getattr__
+    would fool a plain hasattr()."""
+    names = {f.name for f in dataclasses.fields(layer)}
+    if field in names:
+        return True
+    under = getattr(layer, "underlying", None)
+    return under is not None and _has_field(under, field)
+
+
+def _replace_fields(layer, **kw):
+    """dataclasses.replace that reaches through wrapper layers
+    (FrozenLayer.underlying) to the layer that owns the fields."""
+    names = {f.name for f in dataclasses.fields(layer)}
+    if all(k in names for k in kw):
+        return dataclasses.replace(layer, **kw)
+    under = getattr(layer, "underlying", None)
+    if under is None:
+        raise TypeError(f"{type(layer).__name__} has no fields {kw}")
+    return dataclasses.replace(layer, underlying=_replace_fields(under, **kw))
 
 
 class TransferLearning:
@@ -149,11 +175,11 @@ class TransferLearning:
             kw: Dict[str, Any] = {"n_out": int(n_out)}
             if weight_init is not None:
                 kw["weight_init"] = weight_init
-            self._layers[i] = dataclasses.replace(self._layers[i], **kw)
+            self._layers[i] = _replace_fields(self._layers[i], **kw)
             for j in range(i + 1, len(self._layers)):
                 nxt = self._layers[j]
-                if hasattr(nxt, "n_in"):
-                    self._layers[j] = dataclasses.replace(nxt, n_in=None)
+                if _has_field(nxt, "n_in"):
+                    self._layers[j] = _replace_fields(nxt, n_in=None)
                     break
             return self
 
@@ -261,15 +287,14 @@ class TransferLearning:
             kw: Dict[str, Any] = {"n_out": int(n_out)}
             if weight_init is not None:
                 kw["weight_init"] = weight_init
-            new_layer = dataclasses.replace(node.layer, **kw)
+            new_layer = _replace_fields(node.layer, **kw)
             self._nodes[name] = dataclasses.replace(node, layer=new_layer)
             # clear downstream n_in so shape inference recomputes it
             for n, other in self._nodes.items():
                 if name in other.inputs and other.layer is not None and \
-                        hasattr(other.layer, "n_in"):
+                        _has_field(other.layer, "n_in"):
                     self._nodes[n] = dataclasses.replace(
-                        other, layer=dataclasses.replace(
-                            other.layer, n_in=None))
+                        other, layer=_replace_fields(other.layer, n_in=None))
             return self
 
         def set_outputs(self, *names: str):
@@ -369,10 +394,11 @@ class TransferLearningHelper:
         # push tail params back into the original model
         new_p = dict(self._orig.train_state.params)
         new_s = dict(self._orig.train_state.model_state)
+        copy = lambda t: jax.tree_util.tree_map(jnp.array, t)
         for name in self._tail.train_state.params:
-            new_p[name] = self._tail.train_state.params[name]
+            new_p[name] = copy(self._tail.train_state.params[name])
             if name in self._tail.train_state.model_state:
-                new_s[name] = self._tail.train_state.model_state[name]
+                new_s[name] = copy(self._tail.train_state.model_state[name])
         self._orig.train_state = self._orig.train_state._replace(
             params=new_p, model_state=new_s)
         return self

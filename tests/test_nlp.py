@@ -1,0 +1,180 @@
+"""NLP stack tests: tokenization, vocab/Huffman, Word2Vec (SG/CBOW, NS/HS),
+ParagraphVectors, GloVe, serialization, vectorizers.
+
+Mirrors the reference's test strategy (SURVEY §4): Word2Vec sanity on a
+small corpus with structural similarity assertions + serde round-trips
+(deeplearning4j-nlp/src/test).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    BasicLineIterator,
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+    Glove,
+    Huffman,
+    ParagraphVectors,
+    VocabConstructor,
+    Word2Vec,
+    WordVectorSerializer,
+)
+from deeplearning4j_tpu.nlp.bagofwords import TfidfVectorizer
+from deeplearning4j_tpu.nlp.sentence_iterators import LabelledDocument
+from deeplearning4j_tpu.nlp.tokenization import NGramTokenizerFactory
+from deeplearning4j_tpu.nlp.word2vec import StaticWord2Vec
+
+
+def _toy_corpus(n=120):
+    """Two topic clusters: (cat,dog,pet) and (car,truck,road) co-occur
+    within topics, never across — similarity must reflect that."""
+    rng = np.random.default_rng(0)
+    animals = ["cat", "dog", "pet", "fur", "paw"]
+    vehicles = ["car", "truck", "road", "wheel", "engine"]
+    out = []
+    for _ in range(n):
+        pool = animals if rng.random() < 0.5 else vehicles
+        out.append(" ".join(rng.choice(pool, size=6)))
+    return out
+
+
+class TestTokenization:
+    def test_default_tokenizer(self):
+        tf = DefaultTokenizerFactory()
+        assert tf.create("hello world foo").get_tokens() == \
+            ["hello", "world", "foo"]
+
+    def test_preprocessor(self):
+        tf = DefaultTokenizerFactory()
+        tf.set_token_pre_processor(CommonPreprocessor())
+        assert tf.create("Hello, World! 123").get_tokens() == \
+            ["hello", "world"]
+
+    def test_ngrams(self):
+        tf = NGramTokenizerFactory(min_n=1, max_n=2)
+        toks = tf.create("a b c").get_tokens()
+        assert "a b" in toks and "b c" in toks and "a" in toks
+
+
+class TestVocab:
+    def test_min_frequency_cutoff(self):
+        seqs = [["a", "a", "a", "b", "b", "c"]]
+        cache = VocabConstructor(min_word_frequency=2).build_vocab(seqs)
+        assert cache.contains_word("a") and cache.contains_word("b")
+        assert not cache.contains_word("c")
+        assert cache.index_of("a") == 0  # descending frequency order
+
+    def test_huffman_codes(self):
+        seqs = [["a"] * 8 + ["b"] * 4 + ["c"] * 2 + ["d"]]
+        cache = VocabConstructor().build_vocab(seqs)
+        Huffman(cache.vocab_words()).build()
+        words = {w.word: w for w in cache.vocab_words()}
+        # most frequent word gets the shortest code
+        assert len(words["a"].codes) <= len(words["d"].codes)
+        for w in words.values():
+            assert len(w.codes) == len(w.points)
+            assert all(p < cache.num_words() - 1 for p in w.points)
+
+
+class TestWord2Vec:
+    @pytest.mark.parametrize("mode", ["ns", "hs", "cbow"])
+    def test_topic_similarity(self, mode):
+        w2v = Word2Vec(layer_size=24, window_size=3, min_word_frequency=1,
+                       epochs=12, negative=4,
+                       use_hierarchic_softmax=(mode == "hs"),
+                       use_cbow=(mode == "cbow"),
+                       learning_rate=0.05, batch_size=256, seed=7)
+        w2v.fit(_toy_corpus())
+        in_topic = w2v.similarity("cat", "dog")
+        cross = w2v.similarity("cat", "truck")
+        assert in_topic > cross, (in_topic, cross)
+
+    def test_words_nearest(self):
+        w2v = Word2Vec(layer_size=24, window_size=3, epochs=12,
+                       negative=4, learning_rate=0.05, seed=7)
+        w2v.fit(_toy_corpus())
+        near = w2v.words_nearest("car", top_n=3)
+        assert set(near) <= {"truck", "road", "wheel", "engine"}
+
+    def test_sentence_iterator_and_text_format(self, tmp_path):
+        p = tmp_path / "corpus.txt"
+        p.write_text("\n".join(_toy_corpus(40)))
+        w2v = Word2Vec(layer_size=8, epochs=2, negative=2, seed=1)
+        w2v.fit(BasicLineIterator(str(p)))
+        out = tmp_path / "vecs.txt"
+        WordVectorSerializer.write_word_vectors(w2v, str(out))
+        loaded = WordVectorSerializer.read_word_vectors(str(out))
+        assert loaded.has_word("cat")
+        np.testing.assert_allclose(loaded.get_word_vector("cat"),
+                                   w2v.get_word_vector("cat"), atol=1e-4)
+
+    def test_full_model_roundtrip(self, tmp_path):
+        w2v = Word2Vec(layer_size=8, epochs=2, negative=2, seed=1)
+        w2v.fit(_toy_corpus(30))
+        path = str(tmp_path / "model.npz")
+        WordVectorSerializer.write_full_model(w2v, path)
+        loaded = WordVectorSerializer.read_full_model(path)
+        assert loaded.vocab.num_words() == w2v.vocab.num_words()
+        np.testing.assert_allclose(loaded.get_word_vector("cat"),
+                                   w2v.get_word_vector("cat"), atol=1e-6)
+        loaded.fit(_toy_corpus(10))  # resumable
+
+    def test_static_copy(self):
+        w2v = Word2Vec(layer_size=8, epochs=1, negative=2, seed=1)
+        w2v.fit(_toy_corpus(20))
+        st = StaticWord2Vec.from_model(w2v)
+        assert st.similarity("cat", "cat") == pytest.approx(1.0, abs=1e-5)
+
+
+class TestParagraphVectors:
+    def _docs(self):
+        docs = []
+        for i in range(30):
+            docs.append(LabelledDocument(
+                "cat dog pet fur paw cat dog", ["ANIMAL"]))
+            docs.append(LabelledDocument(
+                "car truck road wheel engine car", ["VEHICLE"]))
+        return docs
+
+    @pytest.mark.parametrize("dm", [False, True])
+    def test_label_vectors_separate(self, dm):
+        pv = ParagraphVectors(dm=dm, layer_size=16, window_size=3,
+                              epochs=6, negative=4, learning_rate=0.05,
+                              seed=3, batch_size=256)
+        pv.fit(self._docs())
+        assert set(pv.labels()) == {"ANIMAL", "VEHICLE"}
+        va = pv.get_label_vector("ANIMAL")
+        cat = pv.get_word_vector("cat")
+        car = pv.get_word_vector("car")
+        cos = lambda a, b: float(a @ b / (np.linalg.norm(a) *
+                                          np.linalg.norm(b) + 1e-9))
+        assert cos(va, cat) > cos(va, car)
+
+    def test_infer_and_predict(self):
+        pv = ParagraphVectors(layer_size=16, window_size=3, epochs=6,
+                              negative=4, learning_rate=0.05, seed=3)
+        pv.fit(self._docs())
+        assert pv.predict("cat dog fur") == "ANIMAL"
+        assert pv.predict("truck road engine") == "VEHICLE"
+
+
+class TestGlove:
+    def test_glove_similarity(self):
+        g = Glove(layer_size=16, window_size=4, epochs=30,
+                  learning_rate=0.1, seed=5, batch_size=256)
+        g.fit([s.split() for s in _toy_corpus(80)])
+        assert g.similarity("cat", "dog") > g.similarity("cat", "truck")
+        assert g.last_loss is not None and np.isfinite(g.last_loss)
+
+
+class TestVectorizers:
+    def test_tfidf(self):
+        corpus = ["cat dog cat", "dog truck", "truck road truck"]
+        v = TfidfVectorizer()
+        mat = v.fit_transform(corpus)
+        assert mat.shape == (3, v.vocab.num_words())
+        cat_col = v.vocab.index_of("cat")
+        assert mat[0, cat_col] > 0 and mat[1, cat_col] == 0
