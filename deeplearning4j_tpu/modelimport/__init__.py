@@ -1,0 +1,24 @@
+"""Keras model import (HDF5).
+
+TPU-native analog of deeplearning4j-modelimport (SURVEY §2.5): read a
+Keras .h5 file (model config JSON + weights), convert each Keras layer
+through a registry of converters into this framework's layer/vertex
+configs, and copy weights into the initialized model. Where the reference
+binds libhdf5 through JavaCPP (Hdf5Archive.java), the C HDF5 library is
+reached through h5py.
+"""
+
+from deeplearning4j_tpu.modelimport.hdf5 import Hdf5Archive
+from deeplearning4j_tpu.modelimport.keras import (
+    KerasModelImport,
+    import_keras_model_and_weights,
+    import_keras_sequential_model_and_weights,
+)
+from deeplearning4j_tpu.modelimport.layers import register_custom_layer
+
+__all__ = [
+    "Hdf5Archive", "KerasModelImport",
+    "import_keras_model_and_weights",
+    "import_keras_sequential_model_and_weights",
+    "register_custom_layer",
+]

@@ -1,0 +1,128 @@
+"""HDF5 archive reader.
+
+Analog of the reference's Hdf5Archive.java (deeplearning4j-modelimport,
+which binds libhdf5 via JavaCPP — SURVEY §2.5, §3.5): attribute JSON
+reads + dataset traversal over a Keras .h5 file. h5py provides the same
+C-library binding surface.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+import numpy as np
+
+try:
+    import h5py
+    _H5PY = True
+except ImportError:          # pragma: no cover - h5py is in the image
+    _H5PY = False
+
+
+def _as_str(v) -> str:
+    if isinstance(v, bytes):
+        return v.decode("utf-8")
+    return str(v)
+
+
+class Hdf5Archive:
+    """Read-only view of a Keras HDF5 file."""
+
+    def __init__(self, path: str):
+        if not _H5PY:
+            raise RuntimeError("h5py is required for Keras import")
+        self._f = h5py.File(path, "r")
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ---- attributes ------------------------------------------------------
+    def read_attribute_as_string(self, name: str, *groups: str) -> str:
+        node = self._node(*groups)
+        return _as_str(node.attrs[name])
+
+    def read_attribute_as_json(self, name: str, *groups: str):
+        return json.loads(self.read_attribute_as_string(name, *groups))
+
+    def has_attribute(self, name: str, *groups: str) -> bool:
+        return name in self._node(*groups).attrs
+
+    def read_string_list_attribute(self, name: str, *groups: str
+                                   ) -> List[str]:
+        return [_as_str(v) for v in self._node(*groups).attrs[name]]
+
+    # ---- datasets --------------------------------------------------------
+    def read_data_set(self, name: str, *groups: str) -> np.ndarray:
+        return np.asarray(self._node(*groups)[name])
+
+    def get_groups(self, *groups: str) -> List[str]:
+        node = self._node(*groups)
+        return [k for k in node.keys()
+                if isinstance(node[k], h5py.Group)]
+
+    def get_data_sets(self, *groups: str) -> List[str]:
+        node = self._node(*groups)
+        return [k for k in node.keys()
+                if isinstance(node[k], h5py.Dataset)]
+
+    def has_group(self, *groups: str) -> bool:
+        try:
+            self._node(*groups)
+            return True
+        except KeyError:
+            return False
+
+    def _node(self, *groups: str):
+        node = self._f
+        for g in groups:
+            node = node[g]
+        return node
+
+    # ---- Keras-specific helpers -----------------------------------------
+    def model_config(self) -> dict:
+        return self.read_attribute_as_json("model_config")
+
+    def keras_version(self) -> int:
+        """Major Keras version (1 or 2) from the file's attrs."""
+        root = ("model_weights",) if self.has_group("model_weights") else ()
+        try:
+            v = self.read_attribute_as_string("keras_version", *root)
+            return int(v.split(".")[0])
+        except KeyError:
+            return 1
+
+    def layer_weights(self, layer_name: str) -> Dict[str, np.ndarray]:
+        """All weights of one layer, keyed by the LAST path component of
+        the Keras weight name ('dense_1/kernel:0' → 'kernel')."""
+        root = ("model_weights",) if self.has_group("model_weights") else ()
+        groups = root + (layer_name,)
+        if not self.has_group(*groups):
+            return {}
+        out: Dict[str, np.ndarray] = {}
+        try:
+            names = self.read_string_list_attribute("weight_names", *groups)
+        except KeyError:
+            names = []
+        node = self._node(*groups)
+        if names:
+            for wname in names:
+                arr = np.asarray(node[wname])
+                short = wname.split("/")[-1].split(":")[0]
+                out[short] = arr
+        else:
+            def visit(prefix, n):
+                for k in n.keys():
+                    item = n[k]
+                    if isinstance(item, h5py.Dataset):
+                        out[k.split(":")[0]] = np.asarray(item)
+                    else:
+                        visit(prefix + "/" + k, item)
+            visit(layer_name, node)
+        return out

@@ -1,0 +1,303 @@
+"""KerasModelImport: .h5 file → runnable model.
+
+Analog of the reference's KerasModelImport.java:41 /
+KerasModel.java:57 / KerasSequentialModel.java (SURVEY §2.5, §3.5):
+
+    Sequential model config → MultiLayerNetwork
+    Functional (Model) config → ComputationGraph
+
+Pipeline: Hdf5Archive reads ``model_config`` JSON + per-layer weight
+datasets; each layer goes through the converter registry
+(modelimport/layers.py — the KerasLayer registry analog incl. the
+custom-layer hook); weights are copied into the initialized model with
+layout transposes applied. Dim ordering: TF/NHWC maps 1:1 onto this
+framework's native NHWC layouts; Theano dim ordering (DimOrder.THEANO,
+KerasLayer.java:47) is handled by transposing conv kernels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.modelimport.hdf5 import Hdf5Archive
+from deeplearning4j_tpu.modelimport.layers import (
+    Converted,
+    convert_layer,
+)
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+from deeplearning4j_tpu.nn.layers.output import LossLayer, OutputLayer
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.losses import LossFunction
+
+
+def _input_type_from_shape(shape) -> InputType:
+    """batch_input_shape (None, ...) → InputType."""
+    dims = [d for d in shape[1:]]
+    if len(dims) == 3:
+        h, w, c = dims
+        return InputType.convolutional(int(h), int(w), int(c))
+    if len(dims) == 2:
+        t, f = dims
+        return InputType.recurrent(int(f), None if t is None else int(t))
+    if len(dims) == 1:
+        return InputType.feed_forward(int(dims[0]))
+    raise ValueError(f"unsupported Keras input shape {shape}")
+
+
+def _loss_for_activation(act: Optional[Activation],
+                         keras_loss: Optional[str]) -> LossFunction:
+    if keras_loss:
+        m = {"categorical_crossentropy": LossFunction.MCXENT,
+             "sparse_categorical_crossentropy": LossFunction.MCXENT,
+             "binary_crossentropy": LossFunction.XENT,
+             "mean_squared_error": LossFunction.MSE,
+             "mse": LossFunction.MSE,
+             "mean_absolute_error": LossFunction.L1,
+             "mae": LossFunction.L1,
+             "hinge": LossFunction.HINGE,
+             "squared_hinge": LossFunction.SQUARED_HINGE,
+             "kullback_leibler_divergence": LossFunction.KL_DIVERGENCE,
+             "poisson": LossFunction.POISSON,
+             "cosine_proximity": LossFunction.COSINE_PROXIMITY}
+        if keras_loss in m:
+            return m[keras_loss]
+    if act == Activation.SOFTMAX:
+        return LossFunction.MCXENT
+    if act == Activation.SIGMOID:
+        return LossFunction.XENT
+    return LossFunction.MSE
+
+
+def _to_output_layer(layer, act: Optional[Activation],
+                     keras_loss: Optional[str]):
+    """Final imported layer → trainable output layer (reference:
+    KerasModel wires loss layers from training_config)."""
+    loss = _loss_for_activation(act, keras_loss)
+    if isinstance(layer, DenseLayer) and not isinstance(layer, OutputLayer):
+        return OutputLayer(
+            n_in=layer.n_in, n_out=layer.n_out, activation=layer.activation,
+            has_bias=layer.has_bias, loss=loss)
+    return layer
+
+
+def _training_loss(archive: Hdf5Archive) -> Optional[str]:
+    try:
+        tc = archive.read_attribute_as_json("training_config")
+        loss = tc.get("loss")
+        if isinstance(loss, dict):
+            loss = next(iter(loss.values()), None)
+        if isinstance(loss, dict):  # serialized loss object
+            loss = loss.get("class_name")
+        return loss if isinstance(loss, str) else None
+    except KeyError:
+        return None
+
+
+def _set_imported(model, name: str, conv: Converted,
+                  weights: Dict[str, np.ndarray]):
+    """Copy one layer's mapped weights into the model's param/state trees,
+    shape-checked against the initialized values."""
+    if conv.weights is None or not weights:
+        return
+    params, state = conv.weights(weights)
+    ts = model.train_state
+    new_p = dict(ts.params)
+    new_s = dict(ts.model_state)
+    if params:
+        cur = dict(new_p.get(name, {}))
+        for k, v in params.items():
+            v = np.asarray(v)
+            if k in cur and tuple(cur[k].shape) != tuple(v.shape):
+                raise ValueError(
+                    f"imported weight {name}/{k} has shape {v.shape}, "
+                    f"model expects {tuple(cur[k].shape)}")
+            tgt_dtype = cur[k].dtype if k in cur else jnp.float32
+            cur[k] = jnp.asarray(v, tgt_dtype)
+        new_p[name] = cur
+    if state:
+        cur = dict(new_s.get(name, {}))
+        for k, v in state.items():
+            cur[k] = jnp.asarray(np.asarray(v), jnp.float32)
+        new_s[name] = cur
+    model.train_state = ts._replace(params=new_p, model_state=new_s)
+
+
+# ---- sequential ----------------------------------------------------------
+
+def import_keras_sequential_model_and_weights(
+        path: str, enforce_training_config: bool = False):
+    """Sequential .h5 → MultiLayerNetwork (reference:
+    KerasModelImport.importKerasSequentialModelAndWeights)."""
+    with Hdf5Archive(path) as archive:
+        mc = archive.model_config()
+        if mc.get("class_name") != "Sequential":
+            raise ValueError("not a Sequential model; use "
+                             "import_keras_model_and_weights")
+        version = archive.keras_version()
+        cfg = mc["config"]
+        layer_dicts = cfg if isinstance(cfg, list) else cfg["layers"]
+        keras_loss = _training_loss(archive)
+
+        input_type = None
+        converted: List[Tuple[str, Converted]] = []
+        for ld in layer_dicts:
+            lcfg = ld["config"]
+            if input_type is None:
+                shape = lcfg.get("batch_input_shape",
+                                 lcfg.get("batch_shape"))
+                if shape is not None:
+                    input_type = _input_type_from_shape(shape)
+            conv = convert_layer(ld["class_name"], lcfg, version)
+            converted.append((lcfg.get("name", ld["class_name"]), conv))
+        if input_type is None:
+            raise ValueError("model config declares no input shape")
+
+        kept = [(n, c) for n, c in converted if not c.skip]
+        if not kept:
+            raise ValueError("no convertible layers in model")
+        # final layer must bear a loss for fit(); reference appends loss
+        # layers from training_config
+        last_name, last = kept[-1]
+        out_layer = _to_output_layer(last.layer, last.activation, keras_loss)
+        if out_layer is last.layer and not isinstance(
+                last.layer, (OutputLayer, LossLayer)):
+            kept.append(("loss", Converted(layer=LossLayer(
+                loss=_loss_for_activation(last.activation, keras_loss)))))
+        else:
+            kept[-1] = (last_name, dataclasses.replace(last,
+                                                       layer=out_layer))
+
+        lb = NeuralNetConfiguration.Builder().list()
+        for name, conv in kept:
+            lb.layer(dataclasses.replace(conv.layer, name=name))
+        conf = lb.set_input_type(input_type).build()
+
+        from deeplearning4j_tpu.models.multi_layer_network import (
+            MultiLayerNetwork)
+        model = MultiLayerNetwork(conf).init()
+        for name, conv in kept:
+            _set_imported(model, name, conv, archive.layer_weights(name))
+        return model
+
+
+# ---- functional ----------------------------------------------------------
+
+def _collect_histories(obj, out: List[str]):
+    """Walk a Keras 3 inbound-node args structure, collecting the source
+    layer name of every ``__keras_tensor__``."""
+    if isinstance(obj, dict):
+        if obj.get("class_name") == "__keras_tensor__":
+            out.append(obj["config"]["keras_history"][0])
+            return
+        for v in obj.values():
+            _collect_histories(v, out)
+    elif isinstance(obj, (list, tuple)):
+        for v in obj:
+            _collect_histories(v, out)
+
+
+def _inbound_names(ld: dict) -> List[str]:
+    nodes = ld.get("inbound_nodes", [])
+    if not nodes:
+        return []
+    first = nodes[0]
+    if isinstance(first, dict):          # Keras 3: {"args": [...tensors]}
+        out: List[str] = []
+        _collect_histories(first.get("args", []), out)
+        return out
+    return [n[0] for n in first]         # Keras 1/2: [[name, 0, 0, {}]...]
+
+
+def _io_layer_names(entry) -> List[str]:
+    """input_layers/output_layers: [[name,0,0],...] or single [name,0,0]."""
+    if not entry:
+        return []
+    if isinstance(entry[0], str):
+        return [entry[0]]
+    return [e[0] for e in entry]
+
+
+def import_keras_model_and_weights(path: str,
+                                   enforce_training_config: bool = False):
+    """Functional .h5 → ComputationGraph; Sequential falls through to the
+    sequential importer (reference: KerasModelImport
+    .importKerasModelAndWeights:50-218)."""
+    with Hdf5Archive(path) as archive:
+        mc = archive.model_config()
+    if mc.get("class_name") == "Sequential":
+        return import_keras_sequential_model_and_weights(
+            path, enforce_training_config)
+
+    with Hdf5Archive(path) as archive:
+        version = archive.keras_version()
+        cfg = mc["config"]
+        layer_dicts = cfg["layers"]
+        keras_loss = _training_loss(archive)
+        input_names = _io_layer_names(cfg["input_layers"])
+        output_names = _io_layer_names(cfg["output_layers"])
+
+        gb = NeuralNetConfiguration.Builder().graph_builder()
+        input_types: Dict[str, InputType] = {}
+        converted: Dict[str, Converted] = {}
+        renames: Dict[str, str] = {}   # skip-layer name → its input's name
+
+        for ld in layer_dicts:
+            name = ld["config"].get("name", ld.get("name"))
+            cname = ld["class_name"]
+            lcfg = ld["config"]
+            if cname == "InputLayer" or name in input_names:
+                shape = lcfg.get("batch_input_shape",
+                                 lcfg.get("batch_shape"))
+                input_types[name] = _input_type_from_shape(shape)
+                continue
+            conv = convert_layer(cname, lcfg, version)
+            inbound = [renames.get(i, i) for i in _inbound_names(ld)]
+            if conv.skip:
+                if len(inbound) != 1:
+                    raise ValueError(
+                        f"cannot skip multi-input layer {name}")
+                renames[name] = inbound[0]
+                continue
+            converted[name] = conv
+            if conv.vertex is not None:
+                gb.add_vertex(name, conv.vertex, *inbound)
+            else:
+                layer = conv.layer
+                if name in output_names:
+                    layer = _to_output_layer(layer, conv.activation,
+                                             keras_loss)
+                    converted[name] = dataclasses.replace(conv, layer=layer)
+                gb.add_layer(name, layer, *inbound)
+
+        gb.add_inputs(*input_names)
+        gb.set_input_types(*[input_types[n] for n in input_names])
+        gb.set_outputs(*[renames.get(n, n) for n in output_names])
+        conf = gb.build()
+
+        from deeplearning4j_tpu.models.computation_graph import (
+            ComputationGraph)
+        model = ComputationGraph(conf).init()
+        for name, conv in converted.items():
+            _set_imported(model, name, conv, archive.layer_weights(name))
+        return model
+
+
+class KerasModelImport:
+    """Static-method namespace matching the reference entry point
+    (KerasModelImport.java:41)."""
+
+    importKerasModelAndWeights = staticmethod(
+        import_keras_model_and_weights)
+    importKerasSequentialModelAndWeights = staticmethod(
+        import_keras_sequential_model_and_weights)
+    import_keras_model_and_weights = staticmethod(
+        import_keras_model_and_weights)
+    import_keras_sequential_model_and_weights = staticmethod(
+        import_keras_sequential_model_and_weights)

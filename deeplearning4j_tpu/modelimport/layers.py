@@ -1,0 +1,486 @@
+"""Keras layer → framework layer converters.
+
+Analog of the reference's per-Keras-layer converter classes
+(deeplearning4j-modelimport/.../layers/{core,convolutional,pooling,
+recurrent,embeddings,normalization,noise}/ and KerasLayer.java:42) plus
+the custom-layer registry (KerasLayer.registerCustomLayer:150).
+
+Each converter takes the Keras layer ``config`` dict (+ keras major
+version) and returns a ``Converted`` record: our layer/vertex (or a skip
+marker for shape-only layers like Flatten — shape adaptation is handled
+by this framework's auto-inserted preprocessors), and a ``weights``
+function mapping the layer's Keras weight dict to (params, state) trees.
+
+Weight-layout notes (Keras TF backend → this framework, both NHWC):
+  Dense kernel [in,out]           → W [in,out]        (identical)
+  Conv2D kernel HWIO              → W HWIO            (identical)
+  LSTM gate order  i,f,g,o        → ours i,f,o,g      (column permute)
+  BatchNorm moving stats          → model_state mean/var
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.layers.convolution import (
+    ConvolutionLayer,
+    ConvolutionMode,
+    Convolution1DLayer,
+    Cropping2D,
+    Deconvolution2D,
+    PoolingType,
+    SeparableConvolution2D,
+    SubsamplingLayer,
+    Subsampling1DLayer,
+    Upsampling2D,
+    ZeroPaddingLayer,
+)
+from deeplearning4j_tpu.nn.layers.feedforward import (
+    ActivationLayer,
+    DenseLayer,
+    DropoutLayer,
+    EmbeddingSequenceLayer,
+)
+from deeplearning4j_tpu.nn.layers.normalization import BatchNormalization
+from deeplearning4j_tpu.nn.layers.output import GlobalPoolingLayer
+from deeplearning4j_tpu.nn.layers.recurrent import (
+    Bidirectional,
+    LastTimeStep,
+    LSTM,
+    SimpleRnn,
+)
+from deeplearning4j_tpu.nn.graph.vertices import (
+    ElementWiseVertex,
+    MergeVertex,
+)
+from deeplearning4j_tpu.ops.activations import Activation
+
+WeightsFn = Callable[[Dict[str, np.ndarray]], Tuple[dict, dict]]
+
+
+@dataclasses.dataclass
+class Converted:
+    layer: Optional[object] = None        # a Layer config
+    vertex: Optional[object] = None       # a GraphVertex (merge nodes)
+    skip: bool = False                    # shape-only; drop from topology
+    weights: Optional[WeightsFn] = None
+    # activation the Keras layer carries inline; the final-layer importer
+    # uses it to pick the output loss
+    activation: Optional[Activation] = None
+
+
+# ---- helpers -------------------------------------------------------------
+
+_ACTIVATIONS = {
+    "linear": Activation.IDENTITY,
+    "relu": Activation.RELU,
+    "relu6": Activation.RELU6,
+    "elu": Activation.ELU,
+    "selu": Activation.SELU,
+    "gelu": Activation.GELU,
+    "sigmoid": Activation.SIGMOID,
+    "hard_sigmoid": Activation.HARDSIGMOID,
+    "tanh": Activation.TANH,
+    "softmax": Activation.SOFTMAX,
+    "softplus": Activation.SOFTPLUS,
+    "softsign": Activation.SOFTSIGN,
+    "swish": Activation.SWISH,
+    "silu": Activation.SWISH,
+    "mish": Activation.MISH,
+    "leaky_relu": Activation.LEAKYRELU,
+    "LeakyReLU": Activation.LEAKYRELU,
+    "thresholded_relu": Activation.THRESHOLDEDRELU,
+}
+
+
+def map_activation(name: str) -> Activation:
+    if name not in _ACTIVATIONS:
+        raise ValueError(f"unsupported Keras activation {name!r}")
+    return _ACTIVATIONS[name]
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (list, tuple)):
+        return (int(v[0]), int(v[1] if len(v) > 1 else v[0]))
+    return (int(v), int(v))
+
+
+def _conv_mode(border: str) -> Tuple[ConvolutionMode, Tuple[int, int]]:
+    if border == "same":
+        return ConvolutionMode.SAME, (0, 0)
+    return ConvolutionMode.TRUNCATE, (0, 0)
+
+
+def _dense_weights(w: Dict[str, np.ndarray]) -> Tuple[dict, dict]:
+    params = {}
+    if "kernel" in w:
+        params["W"] = w["kernel"]
+    elif "W" in w:
+        params["W"] = w["W"]
+    if "bias" in w:
+        params["b"] = w["bias"]
+    elif "b" in w:
+        params["b"] = w["b"]
+    return params, {}
+
+
+def _bn_weights(w: Dict[str, np.ndarray]) -> Tuple[dict, dict]:
+    params = {}
+    if "gamma" in w:
+        params["gamma"] = w["gamma"]
+    if "beta" in w:
+        params["beta"] = w["beta"]
+    state = {}
+    if "moving_mean" in w:
+        state["mean"] = w["moving_mean"]
+    if "moving_variance" in w:
+        state["var"] = w["moving_variance"]
+    return params, state
+
+
+def _lstm_permute(k: np.ndarray) -> np.ndarray:
+    """Keras packs gates [i, f, g(c), o]; ours are [i, f, o, g]."""
+    h = k.shape[-1] // 4
+    i, f, g, o = (k[..., :h], k[..., h:2 * h],
+                  k[..., 2 * h:3 * h], k[..., 3 * h:])
+    return np.concatenate([i, f, o, g], axis=-1)
+
+
+def _lstm_weights(w: Dict[str, np.ndarray]) -> Tuple[dict, dict]:
+    params = {}
+    if "kernel" in w:
+        params["Wx"] = _lstm_permute(w["kernel"])
+    if "recurrent_kernel" in w:
+        params["Wh"] = _lstm_permute(w["recurrent_kernel"])
+    if "bias" in w:
+        params["b"] = _lstm_permute(w["bias"])
+    return params, {}
+
+
+def _sep_conv_weights(w: Dict[str, np.ndarray]) -> Tuple[dict, dict]:
+    params = {}
+    if "depthwise_kernel" in w:
+        # Keras (kh, kw, c_in, dm) → our grouped-conv HWIO (kh, kw, 1,
+        # c_in*dm)
+        dk = w["depthwise_kernel"]
+        kh, kw, cin, dm = dk.shape
+        params["dW"] = dk.reshape(kh, kw, 1, cin * dm)
+    if "pointwise_kernel" in w:
+        params["pW"] = w["pointwise_kernel"]
+    if "bias" in w:
+        params["b"] = w["bias"]
+    return params, {}
+
+
+# ---- converters ----------------------------------------------------------
+
+def _conv_common(cfg: dict) -> dict:
+    mode, pad = _conv_mode(cfg.get("padding", cfg.get("border_mode",
+                                                      "valid")))
+    return dict(
+        n_out=int(cfg.get("filters", cfg.get("nb_filter", 0))),
+        kernel_size=_pair(cfg.get("kernel_size",
+                                  (cfg.get("nb_row", 1),
+                                   cfg.get("nb_col", 1)))),
+        stride=_pair(cfg.get("strides", cfg.get("subsample", (1, 1)))),
+        dilation=_pair(cfg.get("dilation_rate", (1, 1))),
+        convolution_mode=mode, padding=pad,
+        has_bias=bool(cfg.get("use_bias", cfg.get("bias", True))),
+    )
+
+
+def conv2d(cfg, _v):
+    act = map_activation(cfg.get("activation", "linear"))
+    return Converted(
+        layer=ConvolutionLayer(activation=act, **_conv_common(cfg)),
+        weights=_dense_weights, activation=act)
+
+
+def separable_conv2d(cfg, _v):
+    act = map_activation(cfg.get("activation", "linear"))
+    common = _conv_common(cfg)
+    return Converted(
+        layer=SeparableConvolution2D(
+            activation=act, depth_multiplier=int(
+                cfg.get("depth_multiplier", 1)), **common),
+        weights=_sep_conv_weights, activation=act)
+
+
+def conv2d_transpose(cfg, _v):
+    act = map_activation(cfg.get("activation", "linear"))
+    return Converted(
+        layer=Deconvolution2D(activation=act, **_conv_common(cfg)),
+        weights=_dense_weights, activation=act)
+
+
+def conv1d(cfg, _v):
+    act = map_activation(cfg.get("activation", "linear"))
+    mode, _pad = _conv_mode(cfg.get("padding", "valid"))
+    return Converted(
+        layer=Convolution1DLayer(
+            n_out=int(cfg.get("filters", cfg.get("nb_filter", 0))),
+            kernel_size=int(_first(cfg.get("kernel_size",
+                                           cfg.get("filter_length", 1)))),
+            stride=int(_first(cfg.get("strides",
+                                      cfg.get("subsample_length", 1)))),
+            convolution_mode=mode, activation=act,
+            has_bias=bool(cfg.get("use_bias", True))),
+        weights=_dense_weights, activation=act)
+
+
+def _first(v):
+    return v[0] if isinstance(v, (list, tuple)) else v
+
+
+def dense(cfg, _v):
+    act = map_activation(cfg.get("activation", "linear"))
+    return Converted(
+        layer=DenseLayer(
+            n_out=int(cfg.get("units", cfg.get("output_dim", 0))),
+            activation=act,
+            has_bias=bool(cfg.get("use_bias", cfg.get("bias", True)))),
+        weights=_dense_weights, activation=act)
+
+
+def _pool(cfg, ptype) -> SubsamplingLayer:
+    mode, _ = _conv_mode(cfg.get("padding", cfg.get("border_mode",
+                                                    "valid")))
+    k = _pair(cfg.get("pool_size", (2, 2)))
+    return SubsamplingLayer(
+        kernel_size=k, stride=_pair(cfg.get("strides") or k),
+        pooling_type=ptype, convolution_mode=mode)
+
+
+def max_pool2d(cfg, _v):
+    return Converted(layer=_pool(cfg, PoolingType.MAX))
+
+
+def avg_pool2d(cfg, _v):
+    return Converted(layer=_pool(cfg, PoolingType.AVG))
+
+
+def max_pool1d(cfg, _v):
+    k = int(_first(cfg.get("pool_size", cfg.get("pool_length", 2))))
+    return Converted(layer=Subsampling1DLayer(
+        kernel_size=k, stride=int(_first(cfg.get("strides") or k)),
+        pooling_type=PoolingType.MAX))
+
+
+def global_pool(ptype):
+    def conv(cfg, _v):
+        return Converted(layer=GlobalPoolingLayer(pooling_type=ptype))
+    return conv
+
+
+def batchnorm(cfg, _v):
+    return Converted(
+        layer=BatchNormalization(
+            decay=float(cfg.get("momentum", 0.99)),
+            eps=float(cfg.get("epsilon", 1e-3))),
+        weights=_bn_weights)
+
+
+def activation(cfg, _v):
+    act = map_activation(cfg["activation"])
+    return Converted(layer=ActivationLayer(activation=act), activation=act)
+
+
+def leaky_relu(cfg, _v):
+    return Converted(layer=ActivationLayer(activation=Activation.LEAKYRELU),
+                     activation=Activation.LEAKYRELU)
+
+
+def dropout(cfg, _v):
+    return Converted(layer=DropoutLayer(
+        dropout=float(cfg.get("rate", cfg.get("p", 0.5)))))
+
+
+def embedding(cfg, _v):
+    return Converted(
+        layer=EmbeddingSequenceLayer(
+            n_in=int(cfg.get("input_dim", 0)),
+            n_out=int(cfg.get("output_dim", 0))),
+        weights=lambda w: ({"W": w.get("embeddings",
+                                       next(iter(w.values())))}, {}))
+
+
+def lstm(cfg, _v):
+    act = map_activation(cfg.get("activation", "tanh"))
+    gate = map_activation(cfg.get("recurrent_activation",
+                                  cfg.get("inner_activation",
+                                          "hard_sigmoid")))
+    layer = LSTM(n_out=int(cfg.get("units", cfg.get("output_dim", 0))),
+                 activation=act, gate_activation=gate)
+    if not cfg.get("return_sequences", False):
+        layer = LastTimeStep(inner=layer)
+        return Converted(layer=layer,
+                         weights=lambda w: (_lstm_weights(w)[0], {}))
+    return Converted(layer=layer, weights=_lstm_weights)
+
+
+def simple_rnn(cfg, _v):
+    act = map_activation(cfg.get("activation", "tanh"))
+    layer = SimpleRnn(n_out=int(cfg.get("units", cfg.get("output_dim", 0))),
+                      activation=act)
+    def wfn(w):
+        params = {}
+        if "kernel" in w:
+            params["Wx"] = w["kernel"]
+        if "recurrent_kernel" in w:
+            params["Wh"] = w["recurrent_kernel"]
+        if "bias" in w:
+            params["b"] = w["bias"]
+        return params, {}
+    if not cfg.get("return_sequences", False):
+        return Converted(layer=LastTimeStep(inner=layer), weights=wfn)
+    return Converted(layer=layer, weights=wfn)
+
+
+def flatten(cfg, _v):
+    # shape-only: this framework auto-inserts Cnn→FF preprocessors from
+    # InputType inference (reference inserts KerasFlatten preprocessor)
+    return Converted(skip=True)
+
+
+def input_layer(cfg, _v):
+    return Converted(skip=True)
+
+
+def zero_padding2d(cfg, _v):
+    p = cfg.get("padding", (1, 1))
+    if isinstance(p, (list, tuple)) and p and isinstance(p[0],
+                                                         (list, tuple)):
+        (pt, pb), (pl, pr) = p
+    else:
+        (pt, pb) = (pl, pr) = _pair(p)
+    return Converted(layer=ZeroPaddingLayer(
+        pad=(int(pt), int(pb), int(pl), int(pr))))
+
+
+def cropping2d(cfg, _v):
+    c = cfg.get("cropping", ((0, 0), (0, 0)))
+    if isinstance(c[0], (list, tuple)):
+        (ct, cb), (cl, cr) = c
+    else:
+        (ct, cb) = (cl, cr) = _pair(c)
+    return Converted(layer=Cropping2D(
+        crop=(int(ct), int(cb), int(cl), int(cr))))
+
+
+def upsampling2d(cfg, _v):
+    return Converted(layer=Upsampling2D(size=_pair(cfg.get("size",
+                                                           (2, 2)))))
+
+
+def merge_add(cfg, _v):
+    return Converted(vertex=ElementWiseVertex(op="add"))
+
+
+def merge_sub(cfg, _v):
+    return Converted(vertex=ElementWiseVertex(op="subtract"))
+
+
+def merge_mul(cfg, _v):
+    return Converted(vertex=ElementWiseVertex(op="product"))
+
+
+def merge_avg(cfg, _v):
+    return Converted(vertex=ElementWiseVertex(op="average"))
+
+
+def merge_max(cfg, _v):
+    return Converted(vertex=ElementWiseVertex(op="max"))
+
+
+def concatenate(cfg, _v):
+    return Converted(vertex=MergeVertex())
+
+
+def bidirectional(cfg, v):
+    inner_cfg = cfg["layer"]
+    inner = convert_layer(inner_cfg["class_name"],
+                          inner_cfg["config"], v)
+    mode = {"concat": "concat", "sum": "add", "ave": "average",
+            "mul": "mul"}.get(cfg.get("merge_mode", "concat"), "concat")
+    inner_layer = inner.layer
+    if isinstance(inner_layer, LastTimeStep):
+        inner_layer = inner_layer.inner   # Bidirectional wraps the RNN itself
+    layer = Bidirectional(fwd=inner_layer, mode=mode)
+
+    def wfn(w):
+        fwd = {k[len("forward_"):] if k.startswith("forward_") else k: a
+               for k, a in w.items() if not k.startswith("backward_")}
+        bwd = {k[len("backward_"):]: a for k, a in w.items()
+               if k.startswith("backward_")}
+        fp, _ = inner.weights(fwd) if inner.weights else ({}, {})
+        bp, _ = inner.weights(bwd) if inner.weights else ({}, {})
+        return {"fwd": fp, "bwd": bp}, {}
+    return Converted(layer=layer, weights=wfn)
+
+
+# ---- registry ------------------------------------------------------------
+
+CONVERTERS: Dict[str, Callable[[dict, int], Converted]] = {
+    "Dense": dense,
+    "Conv2D": conv2d, "Convolution2D": conv2d,
+    "SeparableConv2D": separable_conv2d,
+    "SeparableConvolution2D": separable_conv2d,
+    "Conv2DTranspose": conv2d_transpose,
+    "Deconvolution2D": conv2d_transpose,
+    "Conv1D": conv1d, "Convolution1D": conv1d,
+    "MaxPooling2D": max_pool2d, "AveragePooling2D": avg_pool2d,
+    "MaxPooling1D": max_pool1d,
+    "GlobalMaxPooling2D": global_pool(PoolingType.MAX),
+    "GlobalAveragePooling2D": global_pool(PoolingType.AVG),
+    "GlobalMaxPooling1D": global_pool(PoolingType.MAX),
+    "GlobalAveragePooling1D": global_pool(PoolingType.AVG),
+    "BatchNormalization": batchnorm,
+    "Activation": activation,
+    "LeakyReLU": leaky_relu,
+    "Dropout": dropout, "SpatialDropout2D": dropout,
+    "GaussianDropout": dropout, "GaussianNoise": dropout,
+    "Embedding": embedding,
+    "LSTM": lstm,
+    "SimpleRNN": simple_rnn,
+    "Bidirectional": bidirectional,
+    "Flatten": flatten, "Reshape": flatten, "Permute": flatten,
+    "InputLayer": input_layer, "Input": input_layer,
+    "ZeroPadding2D": zero_padding2d,
+    "Cropping2D": cropping2d,
+    "UpSampling2D": upsampling2d,
+    "Add": merge_add, "add": merge_add,
+    "Subtract": merge_sub, "subtract": merge_sub,
+    "Multiply": merge_mul, "multiply": merge_mul,
+    "Average": merge_avg, "average": merge_avg,
+    "Maximum": merge_max, "maximum": merge_max,
+    "Concatenate": concatenate, "concatenate": concatenate,
+    "Merge": None,  # resolved by mode in keras.py (Keras 1)
+}
+
+_CUSTOM: Dict[str, Callable[[dict, int], Converted]] = {}
+
+
+def register_custom_layer(class_name: str,
+                          converter: Callable[[dict, int], Converted]):
+    """Custom-layer hook (reference: KerasLayer.registerCustomLayer:150)."""
+    _CUSTOM[class_name] = converter
+
+
+def convert_layer(class_name: str, cfg: dict, keras_version: int
+                  ) -> Converted:
+    if class_name in _CUSTOM:
+        return _CUSTOM[class_name](cfg, keras_version)
+    conv = CONVERTERS.get(class_name)
+    if conv is None and class_name == "Merge":
+        mode = cfg.get("mode", "concat")
+        conv = {"concat": concatenate, "sum": merge_add,
+                "mul": merge_mul, "ave": merge_avg,
+                "max": merge_max}.get(mode)
+    if conv is None:
+        raise ValueError(
+            f"unsupported Keras layer {class_name!r}; register a converter "
+            "with modelimport.register_custom_layer()")
+    return conv(cfg, keras_version)

@@ -57,7 +57,10 @@ class ParagraphVectors(Word2Vec):
                 special_tokens=sorted(self._label_set))
         if self.syn0 is None:
             self._init_tables()
-        total = max(1, sum(len(t) for t, _ in tokenized) * self.epochs)
+        # lr anneal denominator: DBOW sees each token twice per epoch
+        # (once as a label-pair add, once in the joint word pass)
+        per_epoch = sum(len(t) for t, _ in tokenized)
+        total = max(1, per_epoch * self.epochs * (1 if self.dm else 2))
         k = self._k()
         batcher = sk.PairBatcher(self.batch_size, k)
         seen = 0
@@ -90,12 +93,13 @@ class ParagraphVectors(Word2Vec):
         if getattr(self, "_cbow_buf", None) is None or \
                 self._cbow_buf.ctx_w < ctx_w:
             from deeplearning4j_tpu.nlp.word2vec import _CbowBatcher
+            if getattr(self, "_cbow_buf", None) is not None:
+                # drain pending pairs before swapping in a wider batcher
+                self._flush_cbow(self._cbow_buf, self._lr(seen, total))
             self._cbow_buf = _CbowBatcher(self.batch_size, ctx_w, self._k())
         buf = self._cbow_buf
         for pos, center in enumerate(idxs):
-            b = int(self._rng.integers(window)) if window > 1 else 0
-            lo = max(0, pos - (window - b))
-            hi = min(len(idxs), pos + (window - b) + 1)
+            lo, hi = self._window_bounds(pos, len(idxs))
             ctx = [idxs[c] for c in range(lo, hi) if c != pos] + lidxs
             if not ctx:
                 seen += 1
@@ -132,10 +136,14 @@ class ParagraphVectors(Word2Vec):
         if not idxs:
             return np.asarray(vec)
         k = self._k()
-        targets = np.zeros((len(idxs), k), np.int32)
-        labels = np.zeros((len(idxs), k), np.float32)
-        mask = np.zeros((len(idxs), k), np.float32)
+        # pad rows to a power-of-two bucket so infer_step compiles once
+        # per bucket, not once per distinct text length
+        rows = 1 << (len(idxs) - 1).bit_length()
+        targets = np.zeros((rows, k), np.int32)
+        labels = np.zeros((rows, k), np.float32)
+        mask = np.zeros((rows, k), np.float32)
         for _step in range(steps):
+            mask[:] = 0.0
             for p, w in enumerate(idxs):
                 if self.use_hs:
                     t, l = sk.hs_targets(self.vocab.element_at_index(w))

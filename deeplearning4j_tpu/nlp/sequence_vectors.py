@@ -132,13 +132,18 @@ class SequenceVectors:
             out.append(idx)
         return out
 
+    def _window_bounds(self, pos: int, n: int) -> Tuple[int, int]:
+        """Randomized effective window (word2vec.c's ``b = rng % window``):
+        the one shared implementation for SkipGram/CBOW/DM paths."""
+        window = self.window_size
+        b = int(self._rng.integers(window)) if window > 1 else 0
+        return (max(0, pos - (window - b)),
+                min(n, pos + (window - b) + 1))
+
     def _train_sequence(self, idxs: List[int], batcher: sk.PairBatcher,
                         seen: int, total: int) -> int:
-        window = self.window_size
         for pos, center in enumerate(idxs):
-            b = int(self._rng.integers(window)) if window > 1 else 0
-            lo = max(0, pos - (window - b))
-            hi = min(len(idxs), pos + (window - b) + 1)
+            lo, hi = self._window_bounds(pos, len(idxs))
             for cpos in range(lo, hi):
                 if cpos == pos:
                     continue

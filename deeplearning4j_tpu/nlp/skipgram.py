@@ -136,8 +136,19 @@ class PairBatcher:
 def negative_sample_targets(pos: int, table: np.ndarray, n_neg: int,
                             rng: np.random.Generator
                             ) -> Tuple[np.ndarray, np.ndarray]:
-    """1 positive + n_neg negatives drawn from the unigram^0.75 table."""
+    """1 positive + n_neg negatives drawn from the unigram^0.75 table.
+    Negatives colliding with the positive are redrawn (word2vec.c skips
+    target==word), so a row never trains the same target toward both
+    labels at once."""
     negs = table[rng.integers(0, len(table), n_neg)]
+    for _ in range(4):
+        bad = negs == pos
+        if not bad.any():
+            break
+        negs[bad] = table[rng.integers(0, len(table), int(bad.sum()))]
+    if (negs == pos).any():  # tiny vocab: fall back to cycling indices
+        n_words = int(table.max()) + 1
+        negs[negs == pos] = (pos + 1) % max(n_words, 2)
     targets = np.concatenate(([pos], negs)).astype(np.int32)
     labels = np.zeros(1 + n_neg, np.float32)
     labels[0] = 1.0

@@ -63,9 +63,7 @@ class Word2Vec(SequenceVectors):
             self._cbow_buf = _CbowBatcher(self.batch_size, ctx_w, self._k())
         buf = self._cbow_buf
         for pos, center in enumerate(idxs):
-            b = int(self._rng.integers(window)) if window > 1 else 0
-            lo = max(0, pos - (window - b))
-            hi = min(len(idxs), pos + (window - b) + 1)
+            lo, hi = self._window_bounds(pos, len(idxs))
             ctx = [idxs[c] for c in range(lo, hi) if c != pos]
             if not ctx:
                 seen += 1
