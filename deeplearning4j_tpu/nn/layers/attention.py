@@ -1,0 +1,226 @@
+"""Attention and transformer layers.
+
+The reference has no attention layers (SURVEY §2.11: model/tensor/
+sequence parallelism and attention are ABSENT — "the TPU build must
+design these fresh", §7.2 stage 7). These are the framework-native
+building blocks for the BERT-class import target (BASELINE config 3) and
+for the long-context path: the same multi-head attention math runs
+single-chip here and sequence-parallel via parallel/ring_attention.py.
+
+TPU-first choices:
+- one packed QKV projection (a single MXU matmul) instead of three;
+- softmax in float32 regardless of compute dtype (bf16-safe);
+- masks are (N, T) sequence masks as everywhere else in the framework;
+- no data-dependent shapes: padding stays in the sequence, masked out.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.inputs import InputType, RecurrentType
+from deeplearning4j_tpu.nn.layers.base import (
+    FeedForwardLayer,
+    Layer,
+    LayerContext,
+)
+from deeplearning4j_tpu.nn.layers.normalization import LayerNormalization
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.initializers import WeightInit
+from deeplearning4j_tpu.utils.serde import register_serializable
+
+
+def scaled_dot_product_attention(q, k, v, mask=None, causal=False):
+    """Plain attention on (N, T, H, Dh) tensors; softmax in f32.
+
+    ``mask``: (N, T_k) key validity mask. The single-chip reference path
+    that parallel/ring_attention.py must match exactly.
+    """
+    dh = q.shape[-1]
+    # at least f32 for the softmax; f64 inputs stay f64 (gradient checks)
+    sdt = jnp.promote_types(jnp.float32, q.dtype)
+    s = jnp.einsum("nqhd,nkhd->nhqk", q, k).astype(sdt)
+    s = s / jnp.sqrt(jnp.asarray(dh, sdt))
+    # large-FINITE mask value: -inf rows make softmax's VJP emit NaN even
+    # when the forward output is where-guarded (NaN * 0 cotangent), so a
+    # fully-padded sequence would poison the whole batch's gradients
+    neg = jnp.asarray(jnp.finfo(sdt).min / 2, sdt)
+    valid = None
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        qpos = jnp.arange(tq)[:, None]
+        kpos = jnp.arange(tk)[None, :]
+        s = jnp.where(kpos <= qpos, s, neg)
+    if mask is not None:
+        valid = mask[:, None, None, :].astype(bool)
+        s = jnp.where(valid, s, neg)
+    p = jax.nn.softmax(s, axis=-1)
+    if valid is not None:
+        # fully-masked rows: uniform softmax garbage → exact zeros
+        p = jnp.where(valid.any(-1, keepdims=True), p, 0.0)
+    return jnp.einsum("nhqk,nkhd->nqhd", p.astype(v.dtype), v)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class SelfAttentionLayer(FeedForwardLayer):
+    """Multi-head self-attention over (N, T, F) with residual-free output
+    projection: y = Attn(xWq, xWk, xWv)Wo. n_out = model width."""
+    n_heads: int = 4
+    causal: bool = False
+    # queries/keys/values all from the input (self-attention)
+
+    def __post_init__(self):
+        if self.n_out and self.n_out % self.n_heads != 0:
+            raise ValueError(
+                f"n_out={self.n_out} not divisible by n_heads={self.n_heads}")
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t = (input_type.timesteps
+             if isinstance(input_type, RecurrentType) else None)
+        return RecurrentType(self.n_out, t)
+
+    def initialize(self, key, input_type):
+        n_in = self.resolved_n_in(input_type)
+        kq, ko = jax.random.split(key)
+        dt = self.param_dtype()
+        params = {
+            # packed QKV: one matmul on the MXU
+            "Wqkv": self.weight_init.init(kq, (n_in, 3 * self.n_out),
+                                          n_in, self.n_out, dt),
+            "Wo": self.weight_init.init(ko, (self.n_out, self.n_out),
+                                        self.n_out, self.n_out, dt),
+        }
+        if self.has_bias:
+            params["bqkv"] = jnp.zeros((3 * self.n_out,), dt)
+            params["bo"] = jnp.zeros((self.n_out,), dt)
+        return params
+
+    def _qkv(self, params, x):
+        qkv = jnp.einsum("ntf,fe->nte", x, params["Wqkv"])
+        if self.has_bias:
+            qkv = qkv + params["bqkv"]
+        n, t, _ = qkv.shape
+        h, dh = self.n_heads, self.n_out // self.n_heads
+        qkv = qkv.reshape(n, t, 3, h, dh)
+        return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+    def apply(self, params, state, x, ctx: LayerContext):
+        ctx, dk = ctx.split_rng()
+        x = self.maybe_dropout(x, ctx, dk)
+        q, k, v = self._qkv(params, x)
+        o = scaled_dot_product_attention(q, k, v, mask=ctx.mask,
+                                         causal=self.causal)
+        n, t = o.shape[0], o.shape[1]
+        y = o.reshape(n, t, self.n_out)
+        y = jnp.einsum("nte,eo->nto", y, params["Wo"])
+        if self.has_bias:
+            y = y + params["bo"]
+        if ctx.mask is not None:
+            y = y * ctx.mask[:, :, None].astype(y.dtype)
+        return self.activation.apply(y), state
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class LearnedPositionalEmbedding(Layer):
+    """Adds a learned position embedding to (N, T, F) inputs (BERT-style).
+    ``max_len`` bounds the trainable table; sequences must be ≤ max_len."""
+    max_len: int = 512
+    weight_init: WeightInit = WeightInit.XAVIER
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def initialize(self, key, input_type):
+        f = input_type.shape()[-1]
+        dt = self.param_dtype()
+        if self.weight_init == WeightInit.XAVIER:
+            # BERT-style truncated-scale init for position tables
+            return {"P": 0.02 * jax.random.normal(key, (self.max_len, f),
+                                                  dt)}
+        return {"P": self.weight_init.init(key, (self.max_len, f),
+                                           self.max_len, f, dt)}
+
+    def apply(self, params, state, x, ctx):
+        t = x.shape[1]
+        return x + params["P"][:t].astype(x.dtype), state
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class TransformerEncoderBlock(FeedForwardLayer):
+    """Pre-LN transformer block: x + MHA(LN(x)); x + FFN(LN(x)).
+    The composition unit for BERT-class models. ``n_out`` is the model
+    width (must equal the input width — residuals), ``ffn_mult`` the MLP
+    expansion."""
+    n_heads: int = 4
+    ffn_mult: int = 4
+    causal: bool = False
+    ffn_activation: Activation = Activation.GELU
+    attn_dropout: float = 0.0
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t = (input_type.timesteps
+             if isinstance(input_type, RecurrentType) else None)
+        return RecurrentType(self.n_out, t)
+
+    def _parts(self):
+        width = self.n_out
+        attn = SelfAttentionLayer(
+            n_in=width, n_out=width, n_heads=self.n_heads,
+            causal=self.causal, weight_init=self.weight_init,
+            dropout=self.attn_dropout, dtype=self.dtype,
+            has_bias=self.has_bias)
+        ln1 = LayerNormalization(dtype=self.dtype)
+        ln2 = LayerNormalization(dtype=self.dtype)
+        return attn, ln1, ln2
+
+    def initialize(self, key, input_type):
+        width = self.resolved_n_in(input_type)
+        if self.n_out and width != self.n_out:
+            raise ValueError(
+                f"TransformerEncoderBlock needs n_in == n_out "
+                f"(residuals); got {width} vs {self.n_out}")
+        attn, ln1, ln2 = self._parts()
+        ka, k1, k2, kf1, kf2 = jax.random.split(key, 5)
+        rt = RecurrentType(width, None)
+        dt = self.param_dtype()
+        hidden = self.ffn_mult * width
+        params = {
+            "attn": attn.initialize(ka, rt),
+            "ln1": ln1.initialize(k1, rt),
+            "ln2": ln2.initialize(k2, rt),
+            "W1": self.weight_init.init(kf1, (width, hidden), width,
+                                        hidden, dt),
+            "W2": self.weight_init.init(kf2, (hidden, width), hidden,
+                                        width, dt),
+        }
+        if self.has_bias:
+            params["b1"] = jnp.zeros((hidden,), dt)
+            params["b2"] = jnp.zeros((width,), dt)
+        return params
+
+    def apply(self, params, state, x, ctx: LayerContext):
+        ctx, dk = ctx.split_rng()
+        x = self.maybe_dropout(x, ctx, dk)
+        attn, ln1, ln2 = self._parts()
+        h, _ = ln1.apply(params["ln1"], {}, x, ctx)
+        a, _ = attn.apply(params["attn"], {}, h, ctx)
+        x = x + a
+        h, _ = ln2.apply(params["ln2"], {}, x, ctx)
+        f = jnp.einsum("ntf,fh->nth", h, params["W1"])
+        if self.has_bias:
+            f = f + params["b1"]
+        f = self.ffn_activation.apply(f)
+        f = jnp.einsum("nth,hf->ntf", f, params["W2"])
+        if self.has_bias:
+            f = f + params["b2"]
+        y = x + f
+        if ctx.mask is not None:
+            y = y * ctx.mask[:, :, None].astype(y.dtype)
+        return y, state

@@ -1,0 +1,125 @@
+"""Ring attention: exact attention over sequence-sharded inputs.
+
+The reference's longest-sequence story is truncated BPTT (SURVEY §5.7);
+sequence/context parallelism is ABSENT there and is designed fresh here
+(SURVEY §7.2 stage 7, §7.3 item 4): each device in a mesh axis holds a
+T/P slice of the sequence; K/V blocks rotate around the ring via
+``lax.ppermute`` (ICI neighbor exchange) while each device accumulates
+its queries' attention with a numerically-stable online softmax
+(flash-attention style running max/denominator). After P steps every
+query has seen every key — EXACT attention, O(T/P) memory per chip,
+compute/communication overlapped by XLA.
+
+``ring_self_attention`` matches nn/layers/attention.py's
+``scaled_dot_product_attention`` bit-for-all-practical-purposes (f32
+softmax accumulation) — asserted by tests/test_attention.py.
+
+Masking uses large-FINITE score floors (not -inf): -inf produces NaN in
+the softmax/exp VJPs for fully-masked rows, which would poison batch
+gradients (same rationale as scaled_dot_product_attention).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+SEQ_AXIS = "sp"
+
+_NEG = float(jnp.finfo(jnp.float32).min) / 2
+
+
+def _ring_attention_local(q, k, v, mask, axis_name: str, causal: bool):
+    """Per-device body (runs under shard_map).
+
+    q, k, v: (N, Tl, H, Dh) local sequence shards.
+    mask:    (N, Tl) local key-validity shard, or None (statically known:
+             the mask carry/permute/where work is skipped entirely).
+    """
+    n_dev = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    tl = q.shape[1]
+    dh = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(dh, jnp.float32))
+    qf = q.astype(jnp.float32)
+    has_mask = mask is not None
+
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    m0 = jnp.full(q.shape[:1] + (q.shape[2], tl), _NEG, jnp.float32)
+    l0 = jnp.zeros_like(m0)                       # (N, H, Tq)
+    acc0 = jnp.zeros(q.shape, jnp.float32)        # (N, Tq, H, Dh)
+
+    def loop_body(i, carry):
+        if has_mask:
+            m, l, acc, k_c, v_c, mask_c = carry
+        else:
+            m, l, acc, k_c, v_c = carry
+        src = (my - i) % n_dev                    # owner of this K/V block
+        s = jnp.einsum("nqhd,nkhd->nhqk", qf,
+                       k_c.astype(jnp.float32)) * scale
+        if causal:
+            qpos = my * tl + jnp.arange(tl)
+            kpos = src * tl + jnp.arange(tl)
+            s = jnp.where(kpos[None, None, None, :]
+                          <= qpos[None, None, :, None], s, _NEG)
+        if has_mask:
+            s = jnp.where(mask_c[:, None, None, :].astype(bool), s, _NEG)
+        m_new = jnp.maximum(m, s.max(-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        # masked entries: s == _NEG underflows exp to exact 0 for any
+        # m_new ≥ O(1); for all-masked rows (m_new == _NEG) zero explicitly
+        p = jnp.where(s <= _NEG, 0.0, p)
+        l_new = l * corr + p.sum(-1)
+        acc_new = (acc * corr.transpose(0, 2, 1)[..., None]
+                   + jnp.einsum("nhqk,nkhd->nqhd", p,
+                                v_c.astype(jnp.float32)))
+        k_c = lax.ppermute(k_c, axis_name, perm)
+        v_c = lax.ppermute(v_c, axis_name, perm)
+        if has_mask:
+            mask_c = lax.ppermute(mask_c, axis_name, perm)
+            return m_new, l_new, acc_new, k_c, v_c, mask_c
+        return m_new, l_new, acc_new, k_c, v_c
+
+    init = ((m0, l0, acc0, k, v, mask) if has_mask
+            else (m0, l0, acc0, k, v))
+    out_carry = lax.fori_loop(0, n_dev, loop_body, init)
+    l, acc = out_carry[1], out_carry[2]
+    # (N, H, Tq) → (N, Tq, H); fully-masked rows (l == 0) emit zeros
+    denom = l.transpose(0, 2, 1)[..., None]
+    out = jnp.where(denom > 0, acc / jnp.maximum(denom, 1e-30), 0.0)
+    return out.astype(q.dtype)
+
+
+def ring_self_attention(q, k, v, mesh: Mesh, *, axis: str = SEQ_AXIS,
+                        mask: Optional[jax.Array] = None,
+                        causal: bool = False,
+                        batch_axis: Optional[str] = None):
+    """Exact attention with q/k/v sharded along time over ``mesh[axis]``.
+
+    q, k, v: (N, T, H, Dh) GLOBAL shapes; T must divide by the axis size.
+    mask:    (N, T) key-validity mask (or None).
+    Returns the (N, T, H, Dh) attention output, same sharding as q.
+    """
+    bspec = batch_axis if batch_axis else None
+    spec_qkv = P(bspec, axis, None, None)
+    spec_mask = P(bspec, axis)
+
+    fn = functools.partial(_ring_attention_local, axis_name=axis,
+                           causal=causal)
+    if mask is None:
+        shard_fn = jax.shard_map(
+            lambda q_, k_, v_: fn(q_, k_, v_, None),
+            mesh=mesh, in_specs=(spec_qkv,) * 3, out_specs=spec_qkv,
+            check_vma=False)
+        return shard_fn(q, k, v)
+    shard_fn = jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec_qkv, spec_qkv, spec_qkv, spec_mask),
+        out_specs=spec_qkv, check_vma=False)
+    return shard_fn(q, k, v, mask)
