@@ -1,0 +1,126 @@
+"""ParallelInference: thread-safe serving with dynamic batching.
+
+Analog of the reference's ParallelInference.java:35 (SURVEY §2.11):
+``InferenceMode.BATCHED`` aggregates concurrent requests into one device
+batch (observable queue, ParallelInference.java:55-65), INPLACE runs the
+caller's request directly.
+
+TPU-first adjustments: the reference pins one model replica per GPU and
+round-robins requests; under XLA a single jitted forward already owns the
+chip, so "workers" collapse into one dispatcher. Batches are padded to
+power-of-two buckets so every request size reuses a cached executable
+instead of triggering recompiles.
+"""
+
+from __future__ import annotations
+
+import enum
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class InferenceMode(enum.Enum):
+    INPLACE = "inplace"
+    BATCHED = "batched"   # reference default (ParallelInference.java:55)
+
+
+class ParallelInference:
+    def __init__(self, model, inference_mode: InferenceMode =
+                 InferenceMode.BATCHED, batch_limit: int = 32,
+                 queue_limit: int = 64, timeout_ms: float = 5.0):
+        self.model = model
+        self.mode = inference_mode
+        self.batch_limit = batch_limit
+        self.timeout_ms = timeout_ms
+        self._queue: "queue.Queue[Tuple[np.ndarray, Future]]" = \
+            queue.Queue(maxsize=queue_limit)
+        self._shutdown = threading.Event()
+        self._worker: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        if self.mode == InferenceMode.BATCHED:
+            self._worker = threading.Thread(target=self._run, daemon=True)
+            self._worker.start()
+
+    # ---- public API ------------------------------------------------------
+    def output(self, features) -> np.ndarray:
+        """Blocking inference (reference: ParallelInference.output:113)."""
+        x = np.asarray(features)
+        if self.mode == InferenceMode.INPLACE:
+            with self._lock:
+                return np.asarray(self.model.output(x))
+        if self._shutdown.is_set():
+            raise RuntimeError("ParallelInference is shut down")
+        f: Future = Future()
+        self._queue.put((x, f))
+        return f.result()
+
+    def shutdown(self):
+        self._shutdown.set()
+        if self._worker is not None:
+            self._worker.join(timeout=5)
+        # fail, don't hang, any request that raced past the worker's exit
+        while True:
+            try:
+                _x, f = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if not f.done():
+                f.set_exception(
+                    RuntimeError("ParallelInference shut down"))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+    # ---- batching worker -------------------------------------------------
+    def _run(self):
+        while not self._shutdown.is_set():
+            try:
+                first = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            batch: List[Tuple[np.ndarray, Future]] = [first]
+            total = first[0].shape[0]
+            # one absolute aggregation deadline per batch; later arrivals
+            # don't extend the first caller's latency window
+            deadline = time.monotonic() + self.timeout_ms / 1000.0
+            while total < self.batch_limit:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    item = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                batch.append(item)
+                total += item[0].shape[0]
+            self._process(batch)
+
+    def _process(self, batch):
+        arrays = [x for x, _f in batch]
+        futures = [f for _x, f in batch]
+        try:
+            x = np.concatenate(arrays, axis=0)
+            n = x.shape[0]
+            # pad to a power-of-two bucket: one cached executable per
+            # bucket, never a recompile per request size
+            bucket = 1 << (n - 1).bit_length()
+            if bucket != n:
+                pad = np.repeat(x[-1:], bucket - n, axis=0)
+                x = np.concatenate([x, pad], axis=0)
+            out = np.asarray(self.model.output(x))[:n]
+            ofs = 0
+            for arr, f in zip(arrays, futures):
+                f.set_result(out[ofs:ofs + arr.shape[0]])
+                ofs += arr.shape[0]
+        except Exception as e:   # propagate to every waiter
+            for f in futures:
+                if not f.done():
+                    f.set_exception(e)

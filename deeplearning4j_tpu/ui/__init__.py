@@ -1,0 +1,19 @@
+"""Observability: stats collection, storage, web dashboard.
+
+TPU-native analog of deeplearning4j-ui-parent (SURVEY §2.12): the
+StatsListener → StatsStorage → UI server pipeline, with HTTP-POST remote
+routing for multi-host training (§5.5). SBE binary codecs become compact
+JSON records; the Play server becomes a dependency-free http.server
+dashboard.
+"""
+
+from deeplearning4j_tpu.ui.stats import StatsListener
+from deeplearning4j_tpu.ui.storage import (
+    InMemoryStatsStorage,
+    SqliteStatsStorage,
+    RemoteUIStatsStorageRouter,
+)
+from deeplearning4j_tpu.ui.server import UIServer
+
+__all__ = ["StatsListener", "InMemoryStatsStorage", "SqliteStatsStorage",
+           "RemoteUIStatsStorageRouter", "UIServer"]

@@ -1,0 +1,207 @@
+"""UIServer: embedded training dashboard.
+
+Analog of the reference's PlayUIServer (deeplearning4j-play/.../
+PlayUIServer.java:53, SURVEY §2.12): attach a StatsStorage, serve the
+train overview (score chart, throughput), per-layer mean-magnitude
+charts, system info, and receive remote-routed records
+(RemoteReceiverModule analog at POST /remote). Zero dependencies: a
+ThreadingHTTPServer + one self-contained HTML page drawing charts on a
+<canvas>.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+from urllib.parse import parse_qs, urlparse
+
+from deeplearning4j_tpu.ui.storage import StatsStorage
+
+_PAGE = """<!doctype html>
+<html><head><title>deeplearning4j_tpu training UI</title><style>
+body{font-family:sans-serif;margin:20px;background:#fafafa}
+h2{margin:8px 0} .card{background:#fff;border:1px solid #ddd;
+border-radius:6px;padding:12px;margin-bottom:14px}
+canvas{width:100%;height:220px} td,th{padding:2px 10px;text-align:left}
+</style></head><body>
+<h2>Training overview</h2>
+<div class=card><b>Score vs iteration</b><canvas id=score></canvas></div>
+<div class=card><b>Samples/sec</b><canvas id=tput></canvas></div>
+<div class=card><b>Per-layer mean |param|</b><canvas id=pm></canvas></div>
+<div class=card><b>Session</b><table id=info></table></div>
+<script>
+function draw(cv, series, labels){
+  const c = cv.getContext('2d');
+  const W = cv.width = cv.clientWidth, H = cv.height = cv.clientHeight;
+  c.clearRect(0,0,W,H);
+  let vals = series.flat().filter(v=>isFinite(v));
+  if(!vals.length) return;
+  const lo = Math.min(...vals), hi = Math.max(...vals)||1;
+  const colors=['#1668b8','#c2410c','#15803d','#7c3aed','#be123c',
+                '#0e7490','#a16207','#4d7c0f'];
+  series.forEach((s,si)=>{
+    c.strokeStyle=colors[si%colors.length]; c.beginPath();
+    s.forEach((v,i)=>{
+      const x=i/(Math.max(s.length-1,1))*(W-40)+30;
+      const y=H-15-(v-lo)/(hi-lo||1)*(H-30);
+      i?c.lineTo(x,y):c.moveTo(x,y)});
+    c.stroke();
+    if(labels&&labels[si]){c.fillStyle=colors[si%colors.length];
+      c.fillText(labels[si],35,12+12*si)}});
+  c.fillStyle='#333';
+  c.fillText(hi.toPrecision(4),2,12); c.fillText(lo.toPrecision(4),2,H-4);
+}
+async function tick(){
+  const sessions = await (await fetch('api/sessions')).json();
+  if(!sessions.length) return;
+  const s = sessions[sessions.length-1];
+  const d = await (await fetch('api/overview?session='+s)).json();
+  draw(document.getElementById('score'), [d.scores]);
+  draw(document.getElementById('tput'), [d.samples_per_sec]);
+  const names = Object.keys(d.param_mean_magnitude||{});
+  draw(document.getElementById('pm'),
+       names.map(n=>d.param_mean_magnitude[n]), names);
+  const info = d.static_info||{};
+  const tbl = document.getElementById('info');
+  tbl.replaceChildren(...Object.entries(info).map(([k,v])=>{
+    const tr=document.createElement('tr');
+    const th=document.createElement('th'); th.textContent=k;
+    const td=document.createElement('td'); td.textContent=JSON.stringify(v);
+    tr.append(th,td); return tr;}));
+}
+tick(); setInterval(tick, 2000);
+</script></body></html>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "DL4JTpuUI/1.0"
+    storage: StatsStorage = None   # set by UIServer
+
+    def log_message(self, *a):   # silence request logging
+        pass
+
+    def _json(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        u = urlparse(self.path)
+        if u.path in ("/", "/train", "/train/overview"):
+            body = _PAGE.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if u.path == "/api/sessions":
+            self._json(self.storage.list_session_ids())
+            return
+        if u.path == "/api/overview":
+            q = parse_qs(u.query)
+            sess = q.get("session", [None])[0]
+            if not sess:
+                ids = self.storage.list_session_ids()
+                sess = ids[-1] if ids else None
+            self._json(self._overview(sess))
+            return
+        if u.path == "/api/updates":
+            q = parse_qs(u.query)
+            sess = q.get("session", [None])[0]
+            self._json(self.storage.get_all_updates(sess) if sess else [])
+            return
+        self._json({"error": "not found"}, 404)
+
+    def do_POST(self):
+        # RemoteReceiverModule analog: accept remote-routed records
+        if urlparse(self.path).path != "/remote":
+            self._json({"error": "not found"}, 404)
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(n) or b"{}")
+            record = payload.get("record", {})
+            if "session_id" not in record:
+                raise ValueError("record missing session_id")
+            if payload.get("kind") == "static":
+                self.storage.put_static_info(record)
+            else:
+                self.storage.put_update(record)
+        except (ValueError, KeyError, json.JSONDecodeError) as e:
+            self._json({"error": str(e)}, 400)
+            return
+        self._json({"ok": True})
+
+    def _overview(self, session_id: Optional[str]) -> dict:
+        if not session_id:
+            return {}
+        ups = self.storage.get_all_updates(session_id)
+        pm: dict = {}
+        for u in ups:
+            for lname, st in (u.get("param_stats") or {}).items():
+                pm.setdefault(lname, []).append(st.get("mean_magnitude"))
+        return {
+            "session": session_id,
+            "iterations": [u.get("iteration") for u in ups],
+            "scores": [u.get("score") for u in ups],
+            "samples_per_sec": [u.get("samples_per_sec") or 0.0
+                                for u in ups],
+            "etl_ms": [u.get("etl_ms") for u in ups],
+            "param_mean_magnitude": pm,
+            "static_info": self.storage.get_static_info(session_id),
+        }
+
+
+class UIServer:
+    """reference: api/UIServer.getInstance().attach(statsStorage). Serves
+    on localhost; ``url`` gives the address for RemoteUIStatsStorageRouter
+    peers."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self, port: int = 9000):
+        self.port = port
+        self.storage: Optional[StatsStorage] = None
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def get_instance(cls, port: int = 9000) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = cls(port)
+        return cls._instance
+
+    def attach(self, storage: StatsStorage):
+        self.storage = storage
+        if self._httpd is not None:
+            self._httpd.RequestHandlerClass.storage = storage
+        return self
+
+    def start(self):
+        if self._httpd is not None:
+            return self
+        handler = type("BoundHandler", (_Handler,),
+                       {"storage": self.storage})
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port),
+                                          handler)
+        self.port = self._httpd.server_address[1]   # resolves port 0
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

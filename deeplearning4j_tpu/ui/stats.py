@@ -1,0 +1,162 @@
+"""StatsListener: per-iteration training statistics.
+
+Analog of the reference's BaseStatsListener
+(deeplearning4j-ui-model/.../stats/BaseStatsListener.java:43,
+iterationDone:304; SURVEY §2.12, §5.5): collects score, timing
+(samples/sec, minibatches/sec), per-layer parameter/update histograms and
+mean-magnitude norms, plus device/runtime static info, and routes records
+into a StatsStorageRouter. Where the reference polls JVM/GC/JITA
+counters, this reads jax device memory stats when the backend exposes
+them.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+import uuid
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+from deeplearning4j_tpu.ui.storage import StatsStorageRouter
+
+
+def _histogram(a: np.ndarray, bins: int = 20) -> dict:
+    a = np.asarray(a, np.float64).ravel()
+    if a.size == 0:
+        return {"counts": [], "min": 0.0, "max": 0.0}
+    lo, hi = float(a.min()), float(a.max())
+    if lo == hi:
+        hi = lo + 1e-12
+    counts, _edges = np.histogram(a, bins=bins, range=(lo, hi))
+    return {"counts": counts.tolist(), "min": lo, "max": hi}
+
+
+class StatsListener(TrainingListener):
+    """Attach to a model with ``model.set_listeners(StatsListener(storage))``
+    then open the dashboard (ui/server.py)."""
+
+    def __init__(self, router: StatsStorageRouter,
+                 session_id: Optional[str] = None,
+                 worker_id: str = "w0",
+                 update_frequency: int = 1,
+                 collect_histograms: bool = True,
+                 histogram_bins: int = 20):
+        self.router = router
+        self.session_id = session_id or f"sess_{uuid.uuid4().hex[:10]}"
+        self.worker_id = worker_id
+        self.update_frequency = max(1, update_frequency)
+        self.collect_histograms = collect_histograms
+        self.histogram_bins = histogram_bins
+        self._static_sent = False
+        self._last_time: Optional[float] = None
+        self._prev_params: Optional[Dict] = None
+        # accumulated across skipped iterations when update_frequency > 1
+        self._acc_samples = 0
+        self._acc_iters = 0
+
+    # ---- TrainingListener hooks -----------------------------------------
+    def iteration_done(self, model, iteration: int, epoch: int, loss,
+                       etl_ms: float, batch_size: int):
+        if not self._static_sent:
+            self._send_static(model)
+        self._acc_samples += int(batch_size)
+        self._acc_iters += 1
+        if iteration % self.update_frequency != 0:
+            return
+        now = time.time()
+        dt = (now - self._last_time) if self._last_time else None
+        self._last_time = now
+        samples, iters = self._acc_samples, self._acc_iters
+        self._acc_samples = 0
+        self._acc_iters = 0
+
+        record = {
+            "session_id": self.session_id,
+            "worker_id": self.worker_id,
+            "timestamp": now,
+            "iteration": iteration,
+            "epoch": epoch,
+            "score": float(loss),
+            "etl_ms": float(etl_ms),
+            "batch_size": int(batch_size),
+            # throughput over ALL iterations since the last report, not
+            # just the reported one
+            "samples_per_sec": (samples / dt) if dt else None,
+            "minibatches_per_sec": (iters / dt) if dt else None,
+        }
+        params = model.train_state.params
+        if self.collect_histograms:
+            record["param_stats"] = self._layer_stats(params)
+            if self._prev_params is not None:
+                record["update_stats"] = self._update_stats(
+                    self._prev_params, params)
+        record["memory"] = self._memory_stats()
+        self._prev_params = jax.tree_util.tree_map(np.asarray, params)
+        self.router.put_update(record)
+
+    # ---- payload builders ------------------------------------------------
+    def _send_static(self, model):
+        devs = jax.devices()
+        self.router.put_static_info({
+            "session_id": self.session_id,
+            "worker_id": self.worker_id,
+            "timestamp": time.time(),
+            "hostname": socket.gethostname(),
+            "backend": devs[0].platform if devs else "unknown",
+            "device_count": len(devs),
+            "device_kind": getattr(devs[0], "device_kind", "?")
+            if devs else "?",
+            "model_class": type(model).__name__,
+            "num_params": int(model.num_params()),
+            "layer_names": list(getattr(model, "layer_names", ())) or
+            list(model.train_state.params.keys()),
+        })
+        self._static_sent = True
+
+    def _layer_stats(self, params) -> Dict[str, dict]:
+        out: Dict[str, dict] = {}
+        for lname, tree in params.items():
+            leaves = jax.tree_util.tree_leaves(tree)
+            if not leaves:
+                continue
+            flat = np.concatenate([np.asarray(l, np.float64).ravel()
+                                   for l in leaves])
+            out[lname] = {
+                "mean_magnitude": float(np.mean(np.abs(flat))),
+                "stdev": float(np.std(flat)),
+                "histogram": _histogram(flat, self.histogram_bins),
+            }
+        return out
+
+    def _update_stats(self, prev, cur) -> Dict[str, dict]:
+        out: Dict[str, dict] = {}
+        for lname, tree in cur.items():
+            pl = jax.tree_util.tree_leaves(prev.get(lname, {}))
+            cl = jax.tree_util.tree_leaves(tree)
+            if not cl or len(pl) != len(cl):
+                continue
+            diffs = np.concatenate([
+                (np.asarray(c, np.float64) - np.asarray(p, np.float64))
+                .ravel() for p, c in zip(pl, cl)])
+            out[lname] = {
+                "mean_magnitude": float(np.mean(np.abs(diffs))),
+                "histogram": _histogram(diffs, self.histogram_bins),
+            }
+        return out
+
+    @staticmethod
+    def _memory_stats() -> dict:
+        try:
+            stats = jax.devices()[0].memory_stats()
+            if stats:
+                return {"bytes_in_use": stats.get("bytes_in_use"),
+                        "peak_bytes_in_use": stats.get(
+                            "peak_bytes_in_use"),
+                        "bytes_limit": stats.get("bytes_limit")}
+        except Exception:   # backend without memory_stats
+            pass
+        return {}

@@ -1,0 +1,206 @@
+"""Stats storage: persistence for training-stats records.
+
+Analog of the reference's StatsStorage SPI
+(deeplearning4j-core/.../api/storage/StatsStorage.java, SURVEY §2.2) and
+its implementations (ui-model mapdb/sqlite/in-memory, §2.12). Records are
+JSON dicts (the SBE wire format's role is served by compact JSON):
+  {"session_id", "type_id", "worker_id", "timestamp", ...payload}
+
+``RemoteUIStatsStorageRouter`` posts records to a remote UI server
+(reference: RemoteUIStatsStorageRouter HTTP POST → RemoteReceiverModule),
+which is how distributed workers report to one dashboard (§5.5).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import sqlite3
+import threading
+import urllib.request
+from typing import Callable, Dict, List, Optional
+
+
+class StatsStorageRouter:
+    """Write-side SPI (reference: api/storage/StatsStorageRouter.java)."""
+
+    def put_static_info(self, record: dict):
+        raise NotImplementedError
+
+    def put_update(self, record: dict):
+        raise NotImplementedError
+
+
+class StatsStorage(StatsStorageRouter):
+    """Read side (reference: StatsStorage.java): list sessions/workers,
+    fetch updates; listeners fire on new records."""
+
+    def __init__(self):
+        self._listeners: List[Callable[[dict], None]] = []
+
+    def register_stats_storage_listener(self, fn: Callable[[dict], None]):
+        self._listeners.append(fn)
+
+    def _notify(self, record: dict):
+        for fn in self._listeners:
+            fn(record)
+
+    # read API
+    def list_session_ids(self) -> List[str]:
+        raise NotImplementedError
+
+    def list_workers(self, session_id: str) -> List[str]:
+        raise NotImplementedError
+
+    def get_all_updates(self, session_id: str,
+                        worker_id: Optional[str] = None) -> List[dict]:
+        raise NotImplementedError
+
+    def get_static_info(self, session_id: str) -> Optional[dict]:
+        raise NotImplementedError
+
+    def get_latest_update(self, session_id: str) -> Optional[dict]:
+        ups = self.get_all_updates(session_id)
+        return ups[-1] if ups else None
+
+
+class InMemoryStatsStorage(StatsStorage):
+    """reference: ui-model/.../storage/impl/ InMemoryStatsStorage."""
+
+    def __init__(self):
+        super().__init__()
+        self._static: Dict[str, dict] = {}
+        self._updates: Dict[str, List[dict]] = {}
+        self._lock = threading.Lock()
+
+    def put_static_info(self, record: dict):
+        with self._lock:
+            self._static[record["session_id"]] = record
+        self._notify(record)
+
+    def put_update(self, record: dict):
+        with self._lock:
+            self._updates.setdefault(record["session_id"], []).append(record)
+        self._notify(record)
+
+    def list_session_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(set(self._static) | set(self._updates))
+
+    def list_workers(self, session_id: str) -> List[str]:
+        with self._lock:
+            return sorted({u.get("worker_id", "w0")
+                           for u in self._updates.get(session_id, [])})
+
+    def get_all_updates(self, session_id: str,
+                        worker_id: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            ups = list(self._updates.get(session_id, []))
+        if worker_id is not None:
+            ups = [u for u in ups if u.get("worker_id") == worker_id]
+        return ups
+
+    def get_static_info(self, session_id: str) -> Optional[dict]:
+        with self._lock:
+            return self._static.get(session_id)
+
+
+class SqliteStatsStorage(StatsStorage):
+    """File-backed storage (reference: J7FileStatsStorage over MapDB /
+    sqlite, §2.12). One table of JSON blobs; safe across processes."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = path
+        self._lock = threading.Lock()
+        with self._conn() as c:
+            c.execute("CREATE TABLE IF NOT EXISTS records ("
+                      "session_id TEXT, kind TEXT, ts REAL, blob TEXT)")
+            c.execute("CREATE INDEX IF NOT EXISTS idx_sess ON records "
+                      "(session_id, kind, ts)")
+
+    @contextlib.contextmanager
+    def _conn(self):
+        # sqlite3's context manager only commits; close explicitly so a
+        # per-iteration put doesn't leak a file descriptor
+        conn = sqlite3.connect(self.path)
+        try:
+            with conn:
+                yield conn
+        finally:
+            conn.close()
+
+    def put_static_info(self, record: dict):
+        self._put(record, "static")
+
+    def put_update(self, record: dict):
+        self._put(record, "update")
+
+    def _put(self, record: dict, kind: str):
+        with self._lock, self._conn() as c:
+            c.execute("INSERT INTO records VALUES (?,?,?,?)",
+                      (record["session_id"], kind,
+                       record.get("timestamp", 0.0), json.dumps(record)))
+        self._notify(record)
+
+    def list_session_ids(self) -> List[str]:
+        with self._lock, self._conn() as c:
+            rows = c.execute(
+                "SELECT DISTINCT session_id FROM records").fetchall()
+        return sorted(r[0] for r in rows)
+
+    def list_workers(self, session_id: str) -> List[str]:
+        return sorted({u.get("worker_id", "w0")
+                       for u in self.get_all_updates(session_id)})
+
+    def get_all_updates(self, session_id: str,
+                        worker_id: Optional[str] = None) -> List[dict]:
+        with self._lock, self._conn() as c:
+            rows = c.execute(
+                "SELECT blob FROM records WHERE session_id=? AND kind="
+                "'update' ORDER BY ts", (session_id,)).fetchall()
+        ups = [json.loads(r[0]) for r in rows]
+        if worker_id is not None:
+            ups = [u for u in ups if u.get("worker_id") == worker_id]
+        return ups
+
+    def get_static_info(self, session_id: str) -> Optional[dict]:
+        with self._lock, self._conn() as c:
+            rows = c.execute(
+                "SELECT blob FROM records WHERE session_id=? AND kind="
+                "'static' ORDER BY ts DESC LIMIT 1",
+                (session_id,)).fetchall()
+        return json.loads(rows[0][0]) if rows else None
+
+
+class RemoteUIStatsStorageRouter(StatsStorageRouter):
+    """POST records to a remote UI server (reference:
+    api/storage/impl/RemoteUIStatsStorageRouter.java → received by
+    RemoteReceiverModule)."""
+
+    def __init__(self, url: str, timeout: float = 5.0,
+                 retry_count: int = 3):
+        self.url = url.rstrip("/") + "/remote"
+        self.timeout = timeout
+        self.retry_count = retry_count
+
+    def put_static_info(self, record: dict):
+        self._post({"kind": "static", "record": record})
+
+    def put_update(self, record: dict):
+        self._post({"kind": "update", "record": record})
+
+    def _post(self, payload: dict):
+        data = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            self.url, data=data,
+            headers={"Content-Type": "application/json"})
+        last = None
+        for _ in range(self.retry_count):
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout):
+                    return
+            except Exception as e:    # noqa: BLE001 — network layer
+                last = e
+        raise ConnectionError(
+            f"failed to post stats to {self.url}: {last}")

@@ -1,0 +1,132 @@
+"""ParallelInference + stats/UI pipeline tests (SURVEY §2.11, §2.12)."""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel.inference import (
+    InferenceMode,
+    ParallelInference,
+)
+from deeplearning4j_tpu.ui import (
+    InMemoryStatsStorage,
+    RemoteUIStatsStorageRouter,
+    SqliteStatsStorage,
+    StatsListener,
+    UIServer,
+)
+
+
+def _tiny_model():
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+    from deeplearning4j_tpu.models.multi_layer_network import (
+        MultiLayerNetwork)
+    from deeplearning4j_tpu.ops.losses import LossFunction
+    from deeplearning4j_tpu.optimize.updaters import Adam
+    conf = (NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT))
+            .set_input_type(InputType.feed_forward(5)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestParallelInference:
+    def test_inplace(self):
+        m = _tiny_model()
+        pi = ParallelInference(m, InferenceMode.INPLACE)
+        x = np.random.default_rng(0).normal(size=(4, 5)).astype(np.float32)
+        np.testing.assert_allclose(pi.output(x), np.asarray(m.output(x)),
+                                   rtol=1e-6)
+
+    def test_batched_concurrent(self):
+        m = _tiny_model()
+        rng = np.random.default_rng(1)
+        xs = [rng.normal(size=(n, 5)).astype(np.float32)
+              for n in (1, 2, 3, 1, 4, 2)]
+        expected = [np.asarray(m.output(x)) for x in xs]
+        results = [None] * len(xs)
+        with ParallelInference(m, InferenceMode.BATCHED,
+                               batch_limit=8) as pi:
+            threads = [threading.Thread(
+                target=lambda i=i: results.__setitem__(
+                    i, pi.output(xs[i]))) for i in range(len(xs))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        for got, want in zip(results, expected):
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_error_propagates(self):
+        class Broken:
+            def output(self, x):
+                raise RuntimeError("boom")
+        with ParallelInference(Broken(), InferenceMode.BATCHED) as pi:
+            with pytest.raises(RuntimeError, match="boom"):
+                pi.output(np.zeros((1, 5), np.float32))
+
+
+def _fit_with_listener(storage):
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    m = _tiny_model()
+    listener = StatsListener(storage, session_id="s1")
+    m.set_listeners(listener)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 5)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    for _ in range(5):
+        m.fit(DataSet(x, y))
+    return m
+
+
+class TestStatsPipeline:
+    def test_listener_to_memory(self):
+        st = InMemoryStatsStorage()
+        _fit_with_listener(st)
+        assert st.list_session_ids() == ["s1"]
+        ups = st.get_all_updates("s1")
+        assert len(ups) == 5
+        assert all(np.isfinite(u["score"]) for u in ups)
+        assert "param_stats" in ups[0]
+        info = st.get_static_info("s1")
+        assert info["num_params"] > 0
+        # update (delta) stats appear from iteration 2 on
+        assert "update_stats" in ups[1]
+
+    def test_sqlite_roundtrip(self, tmp_path):
+        st = SqliteStatsStorage(str(tmp_path / "stats.db"))
+        _fit_with_listener(st)
+        st2 = SqliteStatsStorage(str(tmp_path / "stats.db"))
+        assert st2.list_session_ids() == ["s1"]
+        assert len(st2.get_all_updates("s1")) == 5
+        assert st2.get_static_info("s1")["model_class"] == \
+            "MultiLayerNetwork"
+
+    def test_ui_server_and_remote_router(self):
+        st = InMemoryStatsStorage()
+        server = UIServer(port=0).attach(st)
+        server.start()
+        try:
+            # remote worker posts through the HTTP router
+            router = RemoteUIStatsStorageRouter(server.url)
+            router.put_static_info({"session_id": "r1", "hostname": "h"})
+            router.put_update({"session_id": "r1", "iteration": 0,
+                               "score": 1.5, "timestamp": 1.0})
+            router.put_update({"session_id": "r1", "iteration": 1,
+                               "score": 1.0, "timestamp": 2.0})
+            with urllib.request.urlopen(
+                    server.url + "/api/overview?session=r1") as r:
+                data = json.loads(r.read())
+            assert data["scores"] == [1.5, 1.0]
+            assert data["static_info"]["hostname"] == "h"
+            with urllib.request.urlopen(server.url + "/") as r:
+                assert b"Training overview" in r.read()
+        finally:
+            server.stop()
