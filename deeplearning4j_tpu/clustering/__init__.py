@@ -1,0 +1,20 @@
+"""Clustering + nearest neighbors.
+
+TPU-native analog of deeplearning4j-nearestneighbors-parent (SURVEY
+§2.10): KMeans runs as jitted device iterations (distance matrix +
+assignment matmuls on the MXU — the TPU replacement for the reference's
+multi-threaded host loops); the space-partitioning trees (VPTree, KDTree,
+SPTree) are host-side index structures, as in the reference.
+"""
+
+from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
+from deeplearning4j_tpu.clustering.vptree import VPTree
+from deeplearning4j_tpu.clustering.kdtree import KDTree
+from deeplearning4j_tpu.clustering.sptree import SpTree
+from deeplearning4j_tpu.clustering.lsh import (
+    RandomProjection,
+    RandomProjectionLSH,
+)
+
+__all__ = ["KMeansClustering", "VPTree", "KDTree", "SpTree",
+           "RandomProjectionLSH", "RandomProjection"]

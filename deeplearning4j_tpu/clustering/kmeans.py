@@ -1,0 +1,104 @@
+"""KMeans on device.
+
+Analog of the reference's clustering/kmeans/KMeansClustering.java (SURVEY
+§2.10). TPU-first: each Lloyd iteration is one jitted step — the N×K
+distance matrix is a single matmul (MXU), assignment is an argmin, and
+the centroid update is a one-hot-matmul segment-sum. The reference's
+thread-pool over points becomes data parallelism inside XLA.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _assign(x, centers):
+    """argmin_k ||x_i - c_k||² via the expanded-quadratic matmul form."""
+    x2 = jnp.sum(x * x, axis=1, keepdims=True)          # [N, 1]
+    c2 = jnp.sum(centers * centers, axis=1)[None, :]    # [1, K]
+    d2 = x2 - 2.0 * (x @ centers.T) + c2                # [N, K] one matmul
+    labels = jnp.argmin(d2, axis=1)
+    return labels, jnp.take_along_axis(
+        d2, labels[:, None], axis=1)[:, 0]
+
+
+@jax.jit
+def _update(x, labels, centers):
+    k = centers.shape[0]
+    onehot = jax.nn.one_hot(labels, k, dtype=x.dtype)   # [N, K]
+    sums = onehot.T @ x                                  # [K, D] MXU
+    counts = onehot.sum(0)[:, None]
+    # empty clusters keep their previous center
+    return jnp.where(counts > 0, sums / jnp.maximum(counts, 1.0), centers)
+
+
+class KMeansClustering:
+    """reference API: KMeansClustering.setup(nClusters, maxIterations,
+    distanceFunction); applyTo(points) → ClusterSet."""
+
+    def __init__(self, n_clusters: int, max_iterations: int = 100,
+                 tol: float = 1e-6, seed: int = 0):
+        self.n_clusters = n_clusters
+        self.max_iterations = max_iterations
+        self.tol = tol
+        self.seed = seed
+        self.cluster_centers_: Optional[np.ndarray] = None
+        self.labels_: Optional[np.ndarray] = None
+        self.inertia_: Optional[float] = None
+
+    @classmethod
+    def setup(cls, n_clusters: int, max_iterations: int = 100,
+              distance_function: str = "euclidean",
+              seed: int = 0) -> "KMeansClustering":
+        if distance_function not in ("euclidean", "sqeuclidean"):
+            raise ValueError("only euclidean distances are supported")
+        return cls(n_clusters, max_iterations, seed=seed)
+
+    def _init_centers(self, x: np.ndarray) -> np.ndarray:
+        """kmeans++ seeding (host; O(N·K) distance evals on device)."""
+        rng = np.random.default_rng(self.seed)
+        n = x.shape[0]
+        centers = [x[rng.integers(n)]]
+        for _ in range(1, self.n_clusters):
+            c = jnp.asarray(np.stack(centers))
+            _lab, d2 = _assign(jnp.asarray(x), c)
+            p = np.maximum(np.asarray(d2), 0)
+            s = p.sum()
+            probs = p / s if s > 0 else np.full(n, 1.0 / n)
+            centers.append(x[rng.choice(n, p=probs)])
+        return np.stack(centers)
+
+    def apply_to(self, points: np.ndarray) -> "KMeansClustering":
+        x = np.asarray(points, np.float32)
+        if x.shape[0] < self.n_clusters:
+            raise ValueError(
+                f"{x.shape[0]} points < {self.n_clusters} clusters")
+        xd = jnp.asarray(x)
+        centers = jnp.asarray(self._init_centers(x))
+        prev_inertia = np.inf
+        for _i in range(self.max_iterations):
+            labels, d2 = _assign(xd, centers)
+            centers = _update(xd, labels, centers)
+            inertia = float(d2.sum())
+            if abs(prev_inertia - inertia) <= self.tol * max(
+                    abs(prev_inertia), 1.0):
+                break
+            prev_inertia = inertia
+        labels, d2 = _assign(xd, centers)
+        self.cluster_centers_ = np.asarray(centers)
+        self.labels_ = np.asarray(labels)
+        self.inertia_ = float(d2.sum())
+        return self
+
+    fit = apply_to
+
+    def predict(self, points: np.ndarray) -> np.ndarray:
+        labels, _ = _assign(jnp.asarray(np.asarray(points, np.float32)),
+                            jnp.asarray(self.cluster_centers_))
+        return np.asarray(labels)

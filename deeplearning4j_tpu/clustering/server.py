@@ -1,0 +1,84 @@
+"""NearestNeighborsServer: REST k-NN serving.
+
+Analog of the reference's deeplearning4j-nearestneighbor-server
+(NearestNeighborsServer.java:42, a Play REST app — SURVEY §2.10). POST
+/knn with {"vector": [...], "k": N} (query by vector) or {"index": i,
+"k": N} (query by stored point) returns {"results": [{"index",
+"distance"}...]}, mirroring the reference's NearestNeighborRequest/
+NearestNeighborsResults DTOs.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.clustering.vptree import VPTree
+
+
+class _Handler(BaseHTTPRequestHandler):
+    tree: VPTree = None
+
+    def log_message(self, *a):
+        pass
+
+    def _json(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        if self.path != "/knn":
+            self._json({"error": "not found"}, 404)
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(n) or b"{}")
+            k = int(req.get("k", 5))
+            if "vector" in req:
+                q = np.asarray(req["vector"], np.float64)
+            elif "index" in req:
+                q = self.tree.points[int(req["index"])]
+            else:
+                raise ValueError("request needs 'vector' or 'index'")
+            idxs, dists = self.tree.search(q, k)
+            self._json({"results": [
+                {"index": int(i), "distance": float(d)}
+                for i, d in zip(idxs, dists)]})
+        except (ValueError, KeyError, IndexError,
+                json.JSONDecodeError) as e:
+            self._json({"error": str(e)}, 400)
+
+
+class NearestNeighborsServer:
+    def __init__(self, points: np.ndarray, port: int = 0,
+                 distance: str = "euclidean"):
+        self.tree = VPTree(points, distance=distance)
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    def start(self) -> "NearestNeighborsServer":
+        handler = type("BoundNN", (_Handler,), {"tree": self.tree})
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port),
+                                          handler)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -1,0 +1,12 @@
+"""Graph embeddings — analog of deeplearning4j-graph (SURVEY §2.8)."""
+
+from deeplearning4j_tpu.graph.api import Edge, Graph, Vertex
+from deeplearning4j_tpu.graph.walks import (
+    RandomWalkIterator,
+    WeightedRandomWalkIterator,
+)
+from deeplearning4j_tpu.graph.deepwalk import DeepWalk
+from deeplearning4j_tpu.graph.vectors import GraphVectors
+
+__all__ = ["Graph", "Vertex", "Edge", "RandomWalkIterator",
+           "WeightedRandomWalkIterator", "DeepWalk", "GraphVectors"]

@@ -1,0 +1,58 @@
+"""Random-walk sequence generators.
+
+Analog of the reference's graph/iterator/RandomWalkIterator.java and
+WeightedRandomWalkIterator.java (SURVEY §2.8): fixed-length walks from
+every vertex, with NoEdgeHandling semantics (self-loop on dead ends).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import numpy as np
+
+from deeplearning4j_tpu.graph.api import Graph
+
+
+class RandomWalkIterator:
+    """Uniform-neighbor walks, one walk per starting vertex per pass."""
+
+    def __init__(self, graph: Graph, walk_length: int, seed: int = 0,
+                 walks_per_vertex: int = 1):
+        self.graph = graph
+        self.walk_length = walk_length
+        self.seed = seed
+        self.walks_per_vertex = walks_per_vertex
+
+    def _next_step(self, rng, cur: int) -> int:
+        nbrs = self.graph.get_connected_vertices(cur)
+        if not nbrs:
+            return cur   # NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED
+        return nbrs[rng.integers(len(nbrs))]
+
+    def __iter__(self) -> Iterator[List[int]]:
+        rng = np.random.default_rng(self.seed)
+        n = self.graph.num_vertices()
+        for _rep in range(self.walks_per_vertex):
+            order = rng.permutation(n)
+            for start in order:
+                walk = [int(start)]
+                cur = int(start)
+                for _ in range(self.walk_length - 1):
+                    cur = int(self._next_step(rng, cur))
+                    walk.append(cur)
+                yield walk
+
+
+class WeightedRandomWalkIterator(RandomWalkIterator):
+    """Edge-weight-proportional transition probabilities."""
+
+    def _next_step(self, rng, cur: int) -> int:
+        edges = self.graph.get_edges_out(cur)
+        if not edges:
+            return cur
+        weights = np.asarray([w for _d, w in edges], np.float64)
+        s = weights.sum()
+        if s <= 0:
+            return edges[rng.integers(len(edges))][0]
+        return edges[rng.choice(len(edges), p=weights / s)][0]

@@ -1,0 +1,5 @@
+"""Manifold learning (t-SNE) — analog of deeplearning4j-manifold."""
+
+from deeplearning4j_tpu.manifold.tsne import BarnesHutTsne, Tsne
+
+__all__ = ["Tsne", "BarnesHutTsne"]

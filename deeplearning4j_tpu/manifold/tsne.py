@@ -1,0 +1,178 @@
+"""t-SNE on device.
+
+Analog of deeplearning4j-manifold (SURVEY §2.9): Tsne.java (exact) and
+BarnesHutTsne.java (SpTree-approximated). TPU-first inversion: the exact
+O(N²) gradient is two dense matmuls + elementwise work — exactly what the
+MXU does at full tilt — so for the N ≤ ~50k regime DL4J targets, the
+exact device kernel outruns a host-side Barnes-Hut walk. ``BarnesHutTsne``
+keeps the reference's class name/knobs (theta, perplexity, momentum
+schedule, early exaggeration) and delegates: theta == 0 → exact device
+path; theta > 0 → SpTree approximation on host (clustering/sptree.py)
+for memory-bound N.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.clustering.sptree import SpTree
+
+
+def _hbeta(d2_row: np.ndarray, beta: float):
+    p = np.exp(-d2_row * beta)
+    sum_p = max(p.sum(), 1e-12)
+    h = np.log(sum_p) + beta * float(d2_row @ p) / sum_p
+    return h, p / sum_p
+
+
+def _binary_search_perplexity(d2: np.ndarray, perplexity: float,
+                              tol: float = 1e-5) -> np.ndarray:
+    """Per-row beta search so each conditional P has the target entropy
+    (reference: Tsne.java computeGaussianPerplexity)."""
+    n = d2.shape[0]
+    target = np.log(perplexity)
+    p = np.zeros_like(d2)
+    for i in range(n):
+        row = np.delete(d2[i], i)
+        beta, lo, hi = 1.0, -np.inf, np.inf
+        for _ in range(50):
+            h, pr = _hbeta(row, beta)
+            if abs(h - target) < tol:
+                break
+            if h > target:
+                lo = beta
+                beta = beta * 2 if hi == np.inf else (beta + hi) / 2
+            else:
+                hi = beta
+                beta = beta / 2 if lo == -np.inf else (beta + lo) / 2
+        p[i] = np.insert(pr, i, 0.0)
+    return p
+
+
+@functools.partial(jax.jit, donate_argnums=(1, 2, 3))
+def _tsne_step(P, y, vel, gains, momentum, lr):
+    """One exact gradient-descent step with gains + momentum (reference:
+    Tsne.java gradient/step math). All O(N²) terms are device matmuls."""
+    y2 = jnp.sum(y * y, axis=1)
+    d2 = y2[:, None] - 2.0 * (y @ y.T) + y2[None, :]
+    num = 1.0 / (1.0 + d2)
+    num = num * (1.0 - jnp.eye(y.shape[0], dtype=y.dtype))
+    Q = num / jnp.maximum(num.sum(), 1e-12)
+    PQ = (P - jnp.maximum(Q, 1e-12)) * num
+    grad = 4.0 * ((jnp.diag(PQ.sum(1)) - PQ) @ y)
+    gains = jnp.where(jnp.sign(grad) != jnp.sign(vel),
+                      gains + 0.2, gains * 0.8)
+    gains = jnp.maximum(gains, 0.01)
+    vel = momentum * vel - lr * gains * grad
+    y = y + vel
+    y = y - y.mean(0)
+    kl = jnp.sum(jnp.where(P > 0,
+                           P * jnp.log(jnp.maximum(P, 1e-12)
+                                       / jnp.maximum(Q, 1e-12)), 0.0))
+    return y, vel, gains, kl
+
+
+class Tsne:
+    """Exact t-SNE (reference: plot/Tsne.java builder knobs)."""
+
+    def __init__(self, n_components: int = 2, perplexity: float = 30.0,
+                 learning_rate: float = 200.0, n_iter: int = 500,
+                 early_exaggeration: float = 12.0,
+                 exaggeration_iters: int = 100,
+                 initial_momentum: float = 0.5,
+                 final_momentum: float = 0.8,
+                 momentum_switch: int = 250, seed: int = 0):
+        self.n_components = n_components
+        self.perplexity = perplexity
+        self.learning_rate = learning_rate
+        self.n_iter = n_iter
+        self.early_exaggeration = early_exaggeration
+        self.exaggeration_iters = exaggeration_iters
+        self.initial_momentum = initial_momentum
+        self.final_momentum = final_momentum
+        self.momentum_switch = momentum_switch
+        self.seed = seed
+        self.kl_divergence_: Optional[float] = None
+
+    def _p_matrix(self, x: np.ndarray) -> np.ndarray:
+        x2 = np.sum(x * x, axis=1)
+        d2 = np.maximum(x2[:, None] - 2.0 * (x @ x.T) + x2[None, :], 0.0)
+        p = _binary_search_perplexity(d2, self.perplexity)
+        p = (p + p.T) / (2.0 * p.shape[0])
+        return np.maximum(p, 1e-12)
+
+    def fit_transform(self, x: np.ndarray) -> np.ndarray:
+        x = np.asarray(x, np.float64)
+        n = x.shape[0]
+        P = jnp.asarray(self._p_matrix(x), jnp.float32)
+        rng = np.random.default_rng(self.seed)
+        y = jnp.asarray(rng.normal(scale=1e-4,
+                                   size=(n, self.n_components))
+                        .astype(np.float32))
+        vel = jnp.zeros_like(y)
+        gains = jnp.ones_like(y)
+        kl = jnp.asarray(jnp.nan)
+        for it in range(self.n_iter):
+            ex = (self.early_exaggeration
+                  if it < self.exaggeration_iters else 1.0)
+            mom = (self.initial_momentum
+                   if it < self.momentum_switch else self.final_momentum)
+            y, vel, gains, kl = _tsne_step(
+                P * ex if ex != 1.0 else P, y, vel, gains,
+                jnp.float32(mom), jnp.float32(self.learning_rate))
+        self.kl_divergence_ = float(kl)
+        return np.asarray(y)
+
+
+class BarnesHutTsne(Tsne):
+    """reference: plot/BarnesHutTsne.java — theta-approximated t-SNE.
+    theta == 0 runs the exact device kernel; theta > 0 runs the SpTree
+    approximation on host for memory-bound N."""
+
+    def __init__(self, theta: float = 0.5, **kwargs):
+        super().__init__(**kwargs)
+        self.theta = theta
+
+    def fit_transform(self, x: np.ndarray) -> np.ndarray:
+        if self.theta <= 0.0:
+            return super().fit_transform(x)
+        return self._fit_bh(np.asarray(x, np.float64))
+
+    def _fit_bh(self, x: np.ndarray) -> np.ndarray:
+        n = x.shape[0]
+        P = self._p_matrix(x)          # dense input affinities
+        rng = np.random.default_rng(self.seed)
+        y = rng.normal(scale=1e-4, size=(n, self.n_components))
+        vel = np.zeros_like(y)
+        gains = np.ones_like(y)
+        for it in range(self.n_iter):
+            ex = (self.early_exaggeration
+                  if it < self.exaggeration_iters else 1.0)
+            mom = (self.initial_momentum
+                   if it < self.momentum_switch else self.final_momentum)
+            tree = SpTree(y)
+            neg = np.zeros_like(y)
+            sum_q = 0.0
+            for i in range(n):
+                f, q = tree.compute_non_edge_forces(i, self.theta)
+                neg[i] = f
+                sum_q += q
+            sum_q = max(sum_q, 1e-12)
+            # attractive forces from P (dense; sparse in the reference)
+            diff = y[:, None, :] - y[None, :, :]
+            w = (P * ex) / (1.0 + np.sum(diff * diff, axis=2))
+            pos = np.einsum("ij,ijk->ik", w, diff)
+            grad = pos - neg / sum_q
+            gains = np.where(np.sign(grad) != np.sign(vel),
+                             gains + 0.2, gains * 0.8)
+            gains = np.maximum(gains, 0.01)
+            vel = mom * vel - self.learning_rate * gains * grad
+            y = y + vel
+            y = y - y.mean(0)
+        self.kl_divergence_ = None
+        return y

@@ -53,17 +53,31 @@ class ParallelInference:
         if self.mode == InferenceMode.INPLACE:
             with self._lock:
                 return np.asarray(self.model.output(x))
-        if self._shutdown.is_set():
-            raise RuntimeError("ParallelInference is shut down")
         f: Future = Future()
-        self._queue.put((x, f))
+        while True:
+            if self._shutdown.is_set():
+                raise RuntimeError("ParallelInference is shut down")
+            try:
+                # bounded wait so a full queue + dead worker can't block
+                # the caller forever
+                self._queue.put((x, f), timeout=0.1)
+                break
+            except queue.Full:
+                continue
+        if self._shutdown.is_set():
+            # raced with shutdown(): the worker/drain may already be done
+            # and will never pop this item — fail it ourselves
+            self._drain()
         return f.result()
 
     def shutdown(self):
         self._shutdown.set()
         if self._worker is not None:
             self._worker.join(timeout=5)
-        # fail, don't hang, any request that raced past the worker's exit
+        self._drain()
+
+    def _drain(self):
+        """Fail any still-queued request (post-shutdown)."""
         while True:
             try:
                 _x, f = self._queue.get_nowait()

@@ -186,6 +186,10 @@ class UIServer:
     def start(self):
         if self._httpd is not None:
             return self
+        if self.storage is None:
+            raise RuntimeError(
+                "attach(stats_storage) before start() — the UI has "
+                "nothing to serve otherwise")
         handler = type("BoundHandler", (_Handler,),
                        {"storage": self.storage})
         self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port),

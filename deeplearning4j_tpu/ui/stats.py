@@ -94,8 +94,9 @@ class StatsListener(TrainingListener):
             if self._prev_params is not None:
                 record["update_stats"] = self._update_stats(
                     self._prev_params, params)
+            # device→host param copy only when histograms consume it
+            self._prev_params = jax.tree_util.tree_map(np.asarray, params)
         record["memory"] = self._memory_stats()
-        self._prev_params = jax.tree_util.tree_map(np.asarray, params)
         self.router.put_update(record)
 
     # ---- payload builders ------------------------------------------------

@@ -15,8 +15,11 @@ from __future__ import annotations
 
 import contextlib
 import json
+import logging
+import queue
 import sqlite3
 import threading
+import urllib.error
 import urllib.request
 from typing import Callable, Dict, List, Optional
 
@@ -158,7 +161,7 @@ class SqliteStatsStorage(StatsStorage):
         with self._lock, self._conn() as c:
             rows = c.execute(
                 "SELECT blob FROM records WHERE session_id=? AND kind="
-                "'update' ORDER BY ts", (session_id,)).fetchall()
+                "'update' ORDER BY ts, rowid", (session_id,)).fetchall()
         ups = [json.loads(r[0]) for r in rows]
         if worker_id is not None:
             ups = [u for u in ups if u.get("worker_id") == worker_id]
@@ -176,19 +179,66 @@ class SqliteStatsStorage(StatsStorage):
 class RemoteUIStatsStorageRouter(StatsStorageRouter):
     """POST records to a remote UI server (reference:
     api/storage/impl/RemoteUIStatsStorageRouter.java → received by
-    RemoteReceiverModule)."""
+    RemoteReceiverModule).
+
+    Posts happen on a background thread (``async_mode=True``, the
+    default, matching the reference's async queue): a dead dashboard
+    slows nothing and, after retries, records are logged-and-dropped
+    rather than crashing the training loop. ``async_mode=False`` posts
+    synchronously and raises — for tests and one-shot scripts.
+    """
 
     def __init__(self, url: str, timeout: float = 5.0,
-                 retry_count: int = 3):
+                 retry_count: int = 3, async_mode: bool = True,
+                 queue_limit: int = 1000):
         self.url = url.rstrip("/") + "/remote"
         self.timeout = timeout
         self.retry_count = retry_count
+        self.async_mode = async_mode
+        self._queue: "queue.Queue[dict]" = queue.Queue(maxsize=queue_limit)
+        self._worker: Optional[threading.Thread] = None
+        if async_mode:
+            self._worker = threading.Thread(target=self._run, daemon=True)
+            self._worker.start()
 
     def put_static_info(self, record: dict):
-        self._post({"kind": "static", "record": record})
+        self._submit({"kind": "static", "record": record})
 
     def put_update(self, record: dict):
-        self._post({"kind": "update", "record": record})
+        self._submit({"kind": "update", "record": record})
+
+    def _submit(self, payload: dict):
+        if not self.async_mode:
+            self._post(payload)
+            return
+        try:
+            self._queue.put_nowait(payload)
+        except queue.Full:    # monitoring never stalls training
+            logging.getLogger(__name__).warning(
+                "stats queue full; dropping record")
+
+    def _run(self):
+        while True:
+            payload = self._queue.get()
+            try:
+                self._post(payload)
+            except Exception as e:   # noqa: BLE001 — log-and-drop
+                logging.getLogger(__name__).warning(
+                    "dropping stats record after %d retries: %s",
+                    self.retry_count, e)
+            finally:
+                self._queue.task_done()
+
+    def flush(self, timeout: float = 10.0):
+        """Block until queued records are posted (best effort)."""
+        done = threading.Event()
+
+        def waiter():
+            self._queue.join()   # waits for task_done on every record
+            done.set()
+
+        threading.Thread(target=waiter, daemon=True).start()
+        done.wait(timeout)
 
     def _post(self, payload: dict):
         data = json.dumps(payload).encode()
@@ -200,6 +250,9 @@ class RemoteUIStatsStorageRouter(StatsStorageRouter):
             try:
                 with urllib.request.urlopen(req, timeout=self.timeout):
                     return
+            except urllib.error.HTTPError as e:
+                raise ConnectionError(
+                    f"stats POST rejected by {self.url}: {e}") from e
             except Exception as e:    # noqa: BLE001 — network layer
                 last = e
         raise ConnectionError(

@@ -1,0 +1,219 @@
+"""Clustering/NN (SURVEY §2.10), t-SNE (§2.9), graph embeddings (§2.8)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (
+    KDTree,
+    KMeansClustering,
+    RandomProjection,
+    RandomProjectionLSH,
+    SpTree,
+    VPTree,
+)
+from deeplearning4j_tpu.clustering.server import NearestNeighborsServer
+from deeplearning4j_tpu.graph import (
+    DeepWalk,
+    Graph,
+    GraphVectors,
+    RandomWalkIterator,
+    WeightedRandomWalkIterator,
+)
+from deeplearning4j_tpu.manifold import BarnesHutTsne, Tsne
+
+
+def _blobs(n_per=40, seed=0):
+    rng = np.random.default_rng(seed)
+    cs = np.array([[0, 0], [8, 8], [0, 8]], np.float64)
+    pts = np.concatenate([c + rng.normal(scale=0.5, size=(n_per, 2))
+                          for c in cs])
+    labels = np.repeat(np.arange(3), n_per)
+    return pts, labels
+
+
+class TestKMeans:
+    def test_recovers_blobs(self):
+        pts, labels = _blobs()
+        km = KMeansClustering.setup(3, max_iterations=50).apply_to(pts)
+        assert km.inertia_ < 200
+        # each true cluster maps to exactly one predicted cluster
+        for t in range(3):
+            pred = km.labels_[labels == t]
+            assert len(set(pred.tolist())) == 1
+        # predict matches training assignment
+        np.testing.assert_array_equal(km.predict(pts), km.labels_)
+
+    def test_too_few_points(self):
+        with pytest.raises(ValueError, match="points < "):
+            KMeansClustering(5).apply_to(np.zeros((3, 2)))
+
+
+class TestTrees:
+    def test_vptree_exact(self):
+        pts, _ = _blobs(seed=1)
+        tree = VPTree(pts)
+        q = pts[7]
+        idxs, dists = tree.search(q, 5)
+        # brute force reference
+        d = np.linalg.norm(pts - q, axis=1)
+        want = np.argsort(d)[:5]
+        assert set(idxs) == set(want.tolist())
+        assert dists == sorted(dists)
+
+    def test_vptree_cosine(self):
+        rng = np.random.default_rng(3)
+        pts = rng.normal(size=(50, 8))
+        tree = VPTree(pts, distance="cosine")
+        q = pts[11]
+        idxs, _ = tree.search(q, 1)
+        assert idxs[0] == 11
+
+    def test_kdtree_matches_bruteforce(self):
+        pts, _ = _blobs(seed=2)
+        tree = KDTree(pts)
+        q = np.array([1.0, 1.0])
+        idxs, dists = tree.knn(q, 4)
+        d = np.linalg.norm(pts - q, axis=1)
+        assert set(idxs) == set(np.argsort(d)[:4].tolist())
+        idx, dist = tree.nearest(q)
+        assert idx == int(np.argmin(d))
+
+    def test_sptree_forces_match_exact(self):
+        rng = np.random.default_rng(4)
+        y = rng.normal(size=(30, 2))
+        tree = SpTree(y)
+        i = 5
+        # theta=0 → exact: compare against brute-force negative forces
+        neg, sum_q = tree.compute_non_edge_forces(i, theta=0.0)
+        diff = y[i] - np.delete(y, i, axis=0)
+        q = 1.0 / (1.0 + np.sum(diff * diff, axis=1))
+        np.testing.assert_allclose(sum_q, q.sum(), rtol=1e-9)
+        np.testing.assert_allclose(neg, ((q * q)[:, None] * diff).sum(0),
+                                   rtol=1e-9, atol=1e-12)
+
+    def test_lsh_and_projection(self):
+        rng = np.random.default_rng(5)
+        pts = rng.normal(size=(200, 16))
+        lsh = RandomProjectionLSH(n_bits=8, n_tables=6).index(pts)
+        idxs, dists = lsh.search(pts[17], 3)
+        assert idxs[0] == 17 and dists[0] < 1e-9
+        rp = RandomProjection(4)
+        out = rp.fit_transform(pts)
+        assert out.shape == (200, 4)
+
+
+class TestNearestNeighborsServer:
+    def test_rest_knn(self):
+        pts, _ = _blobs(seed=6)
+        server = NearestNeighborsServer(pts).start()
+        try:
+            req = urllib.request.Request(
+                server.url + "/knn",
+                data=json.dumps({"vector": pts[3].tolist(),
+                                 "k": 3}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as r:
+                res = json.loads(r.read())["results"]
+            assert res[0]["index"] == 3
+            assert len(res) == 3
+            # query by stored index + bad request
+            req = urllib.request.Request(
+                server.url + "/knn",
+                data=json.dumps({"index": 5, "k": 2}).encode())
+            with urllib.request.urlopen(req) as r:
+                assert json.loads(r.read())["results"][0]["index"] == 5
+            req = urllib.request.Request(server.url + "/knn",
+                                         data=b"{}")
+            try:
+                urllib.request.urlopen(req)
+                assert False, "expected 400"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        finally:
+            server.stop()
+
+
+class TestTsne:
+    def test_separates_blobs(self):
+        pts, labels = _blobs(n_per=25, seed=7)
+        ts = Tsne(perplexity=12.0, n_iter=300, seed=0)
+        y = ts.fit_transform(pts)
+        assert y.shape == (75, 2)
+        assert np.isfinite(ts.kl_divergence_)
+        # cluster centroids in embedding space are separated vs intra-spread
+        cents = np.stack([y[labels == t].mean(0) for t in range(3)])
+        intra = max(np.linalg.norm(y[labels == t] - cents[t], axis=1)
+                    .mean() for t in range(3))
+        inter = min(np.linalg.norm(cents[a] - cents[b])
+                    for a in range(3) for b in range(a + 1, 3))
+        assert inter > 2 * intra
+
+    def test_barnes_hut_runs(self):
+        pts, labels = _blobs(n_per=15, seed=8)
+        bh = BarnesHutTsne(theta=0.5, perplexity=10.0, n_iter=120, seed=0)
+        y = bh.fit_transform(pts)
+        assert y.shape == (45, 2)
+        assert np.isfinite(y).all()
+        cents = np.stack([y[labels == t].mean(0) for t in range(3)])
+        inter = min(np.linalg.norm(cents[a] - cents[b])
+                    for a in range(3) for b in range(a + 1, 3))
+        assert inter > 0.1
+
+
+def _two_cliques(k=6):
+    """Two k-cliques joined by one bridge edge → embeddings must cluster."""
+    edges = []
+    for a in range(k):
+        for b in range(a + 1, k):
+            edges.append((a, b))
+            edges.append((k + a, k + b))
+    edges.append((0, k))
+    return Graph.from_edges(2 * k, edges)
+
+
+class TestGraph:
+    def test_walks(self):
+        g = _two_cliques()
+        walks = list(RandomWalkIterator(g, walk_length=5, seed=0))
+        assert len(walks) == g.num_vertices()
+        for w in walks:
+            assert len(w) == 5
+            for a, b in zip(w, w[1:]):
+                assert b in g.get_connected_vertices(a) or a == b
+
+    def test_weighted_walks_respect_weights(self):
+        g = Graph(3, directed=True)
+        g.add_edge(0, 1, weight=1000.0)
+        g.add_edge(0, 2, weight=0.001)
+        nxt = []
+        for s in range(20):
+            it = WeightedRandomWalkIterator(g, 2, seed=s)
+            walk0 = next(w for w in it if w[0] == 0)
+            nxt.append(walk0[1])
+        assert nxt.count(1) >= 18
+
+    def test_deepwalk_clusters_cliques(self):
+        g = _two_cliques()
+        dw = DeepWalk(vector_size=16, window_size=3, walk_length=10,
+                      walks_per_vertex=8, epochs=5, seed=1,
+                      learning_rate=0.05)
+        dw.initialize(g)
+        dw.fit(g)
+        same = dw.similarity_vertices(1, 2)      # same clique
+        cross = dw.similarity_vertices(1, 8)     # other clique
+        assert same > cross
+        gv = GraphVectors.from_deepwalk(dw)
+        assert gv.num_vertices() == 12
+        assert gv.similarity(1, 2) == pytest.approx(same, abs=1e-5)
+
+    def test_graph_vectors_roundtrip(self, tmp_path):
+        gv = GraphVectors(np.random.default_rng(0).normal(
+            size=(5, 4)).astype(np.float32))
+        p = str(tmp_path / "gv.npz")
+        gv.save(p)
+        gv2 = GraphVectors.load(p)
+        np.testing.assert_allclose(gv2.get_vertex_vector(2),
+                                   gv.get_vertex_vector(2))

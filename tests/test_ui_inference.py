@@ -121,6 +121,7 @@ class TestStatsPipeline:
                                "score": 1.5, "timestamp": 1.0})
             router.put_update({"session_id": "r1", "iteration": 1,
                                "score": 1.0, "timestamp": 2.0})
+            router.flush()
             with urllib.request.urlopen(
                     server.url + "/api/overview?session=r1") as r:
                 data = json.loads(r.read())
