@@ -61,17 +61,22 @@ class KMeansClustering:
         return cls(n_clusters, max_iterations, seed=seed)
 
     def _init_centers(self, x: np.ndarray) -> np.ndarray:
-        """kmeans++ seeding (host; O(N·K) distance evals on device)."""
+        """kmeans++ seeding, on host. The running min-distance is updated
+        incrementally against only the newest center (O(N·D) numpy per
+        step) — routing this through the jitted ``_assign`` would compile
+        K-1 distinct center shapes before Lloyd iterations even start."""
         rng = np.random.default_rng(self.seed)
         n = x.shape[0]
-        centers = [x[rng.integers(n)]]
+        center = x[rng.integers(n)]
+        centers = [center]
+        d2 = np.sum((x - center) ** 2, axis=1)
         for _ in range(1, self.n_clusters):
-            c = jnp.asarray(np.stack(centers))
-            _lab, d2 = _assign(jnp.asarray(x), c)
-            p = np.maximum(np.asarray(d2), 0)
+            p = np.maximum(d2, 0)
             s = p.sum()
             probs = p / s if s > 0 else np.full(n, 1.0 / n)
-            centers.append(x[rng.choice(n, p=probs)])
+            center = x[rng.choice(n, p=probs)]
+            centers.append(center)
+            d2 = np.minimum(d2, np.sum((x - center) ** 2, axis=1))
         return np.stack(centers)
 
     def apply_to(self, points: np.ndarray) -> "KMeansClustering":

@@ -163,11 +163,17 @@ class BarnesHutTsne(Tsne):
                 neg[i] = f
                 sum_q += q
             sum_q = max(sum_q, 1e-12)
-            # attractive forces from P (dense; sparse in the reference)
-            diff = y[:, None, :] - y[None, :, :]
-            w = (P * ex) / (1.0 + np.sum(diff * diff, axis=2))
-            pos = np.einsum("ij,ijk->ik", w, diff)
-            grad = pos - neg / sum_q
+            # attractive forces from P (dense; sparse in the reference).
+            # O(N^2) memory: pairwise distances via the norm expansion and
+            # pos_i = sum_j w_ij (y_i - y_j) = rowsum(w)*y_i - (w @ y)_j —
+            # never materializing the (N, N, D) difference tensor.
+            sq = np.sum(y * y, axis=1)
+            dist2 = np.maximum(sq[:, None] + sq[None, :] - 2.0 * (y @ y.T),
+                               0.0)
+            w = (P * ex) / (1.0 + dist2)
+            pos = w.sum(axis=1)[:, None] * y - w @ y
+            # same 4x scale as the exact-path gradient (_tsne_step)
+            grad = 4.0 * (pos - neg / sum_q)
             gains = np.where(np.sign(grad) != np.sign(vel),
                              gains + 0.2, gains * 0.8)
             gains = np.maximum(gains, 0.01)

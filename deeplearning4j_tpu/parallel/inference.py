@@ -50,6 +50,9 @@ class ParallelInference:
     def output(self, features) -> np.ndarray:
         """Blocking inference (reference: ParallelInference.output:113)."""
         x = np.asarray(features)
+        if x.ndim == 0:
+            raise ValueError("features must have a batch dimension; got a"
+                             " 0-d array")
         if self.mode == InferenceMode.INPLACE:
             with self._lock:
                 return np.asarray(self.model.output(x))
@@ -101,20 +104,28 @@ class ParallelInference:
             except queue.Empty:
                 continue
             batch: List[Tuple[np.ndarray, Future]] = [first]
-            total = first[0].shape[0]
-            # one absolute aggregation deadline per batch; later arrivals
-            # don't extend the first caller's latency window
-            deadline = time.monotonic() + self.timeout_ms / 1000.0
-            while total < self.batch_limit:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
-                try:
-                    item = self._queue.get(timeout=remaining)
-                except queue.Empty:
-                    break
-                batch.append(item)
-                total += item[0].shape[0]
+            try:
+                total = first[0].shape[0]
+                # one absolute aggregation deadline per batch; later
+                # arrivals don't extend the first caller's latency window
+                deadline = time.monotonic() + self.timeout_ms / 1000.0
+                while total < self.batch_limit:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    try:
+                        item = self._queue.get(timeout=remaining)
+                    except queue.Empty:
+                        break
+                    batch.append(item)
+                    total += item[0].shape[0]
+            except Exception as e:
+                # a malformed request must fail its future, not kill the
+                # worker thread (waiters would then hang forever)
+                for _x, f in batch:
+                    if not f.done():
+                        f.set_exception(e)
+                continue
             self._process(batch)
 
     def _process(self, batch):

@@ -19,6 +19,7 @@ import logging
 import queue
 import sqlite3
 import threading
+import time
 import urllib.error
 import urllib.request
 from typing import Callable, Dict, List, Optional
@@ -230,15 +231,15 @@ class RemoteUIStatsStorageRouter(StatsStorageRouter):
                 self._queue.task_done()
 
     def flush(self, timeout: float = 10.0):
-        """Block until queued records are posted (best effort)."""
-        done = threading.Event()
-
-        def waiter():
-            self._queue.join()   # waits for task_done on every record
-            done.set()
-
-        threading.Thread(target=waiter, daemon=True).start()
-        done.wait(timeout)
+        """Block until queued records are posted (best effort). Polls the
+        queue's unfinished count with a deadline — no helper thread, so a
+        never-draining queue (remote down) can't leak blocked threads."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._queue.all_tasks_done:
+                if self._queue.unfinished_tasks == 0:
+                    return
+            time.sleep(0.05)
 
     def _post(self, payload: dict):
         data = json.dumps(payload).encode()
@@ -251,8 +252,11 @@ class RemoteUIStatsStorageRouter(StatsStorageRouter):
                 with urllib.request.urlopen(req, timeout=self.timeout):
                     return
             except urllib.error.HTTPError as e:
-                raise ConnectionError(
-                    f"stats POST rejected by {self.url}: {e}") from e
+                if e.code < 500:
+                    # client error: retrying the same payload can't help
+                    raise ConnectionError(
+                        f"stats POST rejected by {self.url}: {e}") from e
+                last = e          # transient server error: retry
             except Exception as e:    # noqa: BLE001 — network layer
                 last = e
         raise ConnectionError(
