@@ -113,8 +113,10 @@ class SelfAttentionLayer(FeedForwardLayer):
         ctx, dk = ctx.split_rng()
         x = self.maybe_dropout(x, ctx, dk)
         q, k, v = self._qkv(params, x)
-        o = scaled_dot_product_attention(q, k, v, mask=ctx.mask,
-                                         causal=self.causal)
+        # helper-SPI dispatch: Pallas flash kernel on TPU, plain XLA
+        # lowering elsewhere (ops/pallas_kernels.py)
+        from deeplearning4j_tpu.ops.pallas_kernels import attention as _attn
+        o = _attn(q, k, v, mask=ctx.mask, causal=self.causal)
         n, t = o.shape[0], o.shape[1]
         y = o.reshape(n, t, self.n_out)
         y = jnp.einsum("nte,eo->nto", y, params["Wo"])

@@ -1,0 +1,246 @@
+"""Pallas TPU kernels — the "accelerated layer helper" tier.
+
+The reference accelerates its hot layers with hand-written cuDNN helpers
+loaded reflectively (deeplearning4j-cuda/.../BaseCudnnHelper.java:1,
+ConvolutionLayer.java:75-85 — SURVEY §2.4). The TPU analog: XLA already
+lowers conv/BN/LSTM onto the MXU, so helpers are only written where a
+fused kernel beats XLA's default lowering. Attention is the headline case:
+the blockwise (flash) kernel below keeps the running softmax in VMEM and
+never materializes the (Tq, Tk) score matrix in HBM.
+
+Layout: q/k/v are (N, H, T, Dh) inside the kernel (the layer-facing
+wrapper accepts the framework-standard (N, T, H, Dh)). The grid is
+(batch, head, q-block); each program streams the full K/V for its head
+through VMEM in ``block_k`` chunks with an online softmax.
+
+Like the reference's helper SPI, failure is safe: `attention()` silently
+falls back to the plain XLA path when shapes/platform don't fit the
+kernel (ConvolutionLayer.java:173 helperCountFail analog).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Large-finite instead of -inf: -inf scores make softmax VJPs emit NaN for
+# fully-masked rows (matches nn/layers/attention.py's choice).
+_NEG = float(jnp.finfo(jnp.float32).min) / 2.0
+
+_DEF_BLOCK_Q = 128
+_DEF_BLOCK_K = 128
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *,
+                      block_k: int, causal: bool, scale: float):
+    q = q_ref[0, 0].astype(jnp.float32)                    # (bq, dh)
+    bq, dh = q.shape
+    tk = k_ref.shape[2]
+    nk = tk // block_k
+    qi = pl.program_id(2)
+
+    m0 = jnp.full((bq,), _NEG, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros((bq, dh), jnp.float32)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        kvalid = mask_ref[0, pl.ds(kb * block_k, block_k)] > 0.0
+        s = jnp.where(kvalid[None, :], s, _NEG)
+        if causal:
+            qpos = qi * bq + lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            kpos = kb * block_k + lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[:, None] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    l_safe = jnp.where(l > 0.0, l, 1.0)                    # all-masked rows
+    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0] = jnp.where(l > 0.0, m + jnp.log(l_safe), _NEG)
+
+
+def _flash_forward(q, k, v, mask, causal: bool, block_q: int, block_k: int,
+                   interpret: bool):
+    n, h, tq, dh = q.shape
+    tk = k.shape[2]
+    scale = 1.0 / float(dh) ** 0.5
+    grid = (n, h, tq // block_q)
+
+    kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
+                               causal=causal, scale=scale)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, dh),
+                         lambda i, j, qi: (i, j, qi, 0),
+                         memory_space=pl.ANY if interpret
+                         else pltpu.VMEM),
+            pl.BlockSpec((1, 1, tk, dh), lambda i, j, qi: (i, j, 0, 0),
+                         memory_space=pl.ANY if interpret
+                         else pltpu.VMEM),
+            pl.BlockSpec((1, 1, tk, dh), lambda i, j, qi: (i, j, 0, 0),
+                         memory_space=pl.ANY if interpret
+                         else pltpu.VMEM),
+            pl.BlockSpec((1, tk), lambda i, j, qi: (i, 0),
+                         memory_space=pl.ANY if interpret
+                         else pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, dh),
+                         lambda i, j, qi: (i, j, qi, 0),
+                         memory_space=pl.ANY if interpret
+                         else pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda i, j, qi: (i, j, qi),
+                         memory_space=pl.ANY if interpret
+                         else pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, h, tq, dh), q.dtype),
+            jax.ShapeDtypeStruct((n, h, tq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, mask)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_attention(q, k, v, mask, causal, block_q, block_k, interpret):
+    out, _ = _flash_forward(q, k, v, mask, causal, block_q, block_k,
+                            interpret)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, mask, causal, block_q, block_k, interpret):
+    out, lse = _flash_forward(q, k, v, mask, causal, block_q, block_k,
+                              interpret)
+    return out, (q, k, v, mask, out, lse)
+
+
+def _flash_bwd_rule(causal, block_q, block_k, interpret, res, do):
+    """Flash backward from saved (O, logsumexp): P is recomputed from the
+    normalizer instead of being saved — the standard flash-attention VJP.
+    Chunked over k blocks with lax.scan so peak memory is
+    O(Tq * block_k) per (batch, head), not O(Tq * Tk)."""
+    q, k, v, mask, out, lse = res
+    dh = q.shape[-1]
+    scale = 1.0 / float(dh) ** 0.5
+    f32 = jnp.float32
+    qf, kf, vf, dof = (x.astype(f32) for x in (q, k, v, do))
+    delta = jnp.sum(dof * out.astype(f32), axis=-1)        # (n, h, tq)
+    tq, tk = q.shape[2], k.shape[2]
+
+    def p_block(kb):
+        """(n, h, tq, bk) probability block at k offset kb*block_k."""
+        ks = lax.dynamic_slice_in_dim(kf, kb * block_k, block_k, axis=2)
+        s = jnp.einsum("nhqd,nhkd->nhqk", qf, ks) * scale
+        mk = lax.dynamic_slice_in_dim(mask, kb * block_k, block_k, axis=1)
+        s = jnp.where(mk[:, None, None, :] > 0, s, _NEG)
+        if causal:
+            qpos = jnp.arange(tq)[:, None]
+            kpos = kb * block_k + jnp.arange(block_k)[None, :]
+            s = jnp.where(kpos <= qpos, s, _NEG)
+        return jnp.exp(s - lse[..., None]), ks
+
+    def scan_body(dq, kb):
+        p, ks = p_block(kb)
+        vs = lax.dynamic_slice_in_dim(vf, kb * block_k, block_k, axis=2)
+        dp = jnp.einsum("nhqd,nhkd->nhqk", dof, vs)
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("nhqk,nhkd->nhqd", ds, ks)
+        dv_b = jnp.einsum("nhqk,nhqd->nhkd", p, dof)
+        dk_b = jnp.einsum("nhqk,nhqd->nhkd", ds, qf)
+        return dq, (dk_b, dv_b)
+
+    nk = tk // block_k
+    dq0 = jnp.zeros_like(qf)
+    dq, (dk_blocks, dv_blocks) = lax.scan(scan_body, dq0, jnp.arange(nk))
+    # (nk, n, h, bk, d) -> (n, h, tk, d)
+    dk = jnp.moveaxis(dk_blocks, 0, 2).reshape(kf.shape)
+    dv = jnp.moveaxis(dv_blocks, 0, 2).reshape(vf.shape)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            jnp.zeros_like(mask))
+
+
+_flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def _pad_len(t: int, block: int) -> int:
+    return (-t) % block
+
+
+def flash_attention(q, k, v, mask=None, causal: bool = False,
+                    block_q: int = _DEF_BLOCK_Q,
+                    block_k: int = _DEF_BLOCK_K,
+                    interpret: Optional[bool] = None):
+    """Blockwise (flash) attention on (N, T, H, Dh) tensors.
+
+    Drop-in for nn.layers.attention.scaled_dot_product_attention. ``mask``
+    is the (N, T_k) key-validity mask. Sequences are padded to the block
+    size internally (padding is masked out, query padding sliced off).
+    ``interpret`` defaults to True off-TPU so tests exercise the same
+    kernel on the CPU mesh.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n, tq, h, dh = q.shape
+    tk = k.shape[1]
+    block_q = min(block_q, max(tq, 1))
+    block_k = min(block_k, max(tk, 1))
+
+    # NTHD -> NHTD
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    if mask is None:
+        mask = jnp.ones((n, tk), jnp.float32)
+    mask = mask.astype(jnp.float32)
+
+    pq, pk = _pad_len(tq, block_q), _pad_len(tk, block_k)
+    if pq:
+        qt = jnp.pad(qt, ((0, 0), (0, 0), (0, pq), (0, 0)))
+    if pk:
+        kt = jnp.pad(kt, ((0, 0), (0, 0), (0, pk), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, 0), (0, pk), (0, 0)))
+        mask = jnp.pad(mask, ((0, 0), (0, pk)))
+
+    out = _flash_attention(qt, kt, vt, mask, causal, block_q, block_k,
+                           interpret)
+    if pq:
+        out = out[:, :, :tq, :]
+    return jnp.swapaxes(out, 1, 2)                          # NHTD -> NTHD
+
+
+def attention(q, k, v, mask=None, causal: bool = False,
+              prefer_flash: Optional[bool] = None):
+    """Helper-SPI dispatch (the reflective cuDNN-hook analog): use the
+    Pallas kernel when it applies, else the plain XLA lowering."""
+    from deeplearning4j_tpu.nn.layers.attention import (
+        scaled_dot_product_attention)
+    if prefer_flash is None:
+        prefer_flash = jax.default_backend() == "tpu"
+    if not prefer_flash:
+        return scaled_dot_product_attention(q, k, v, mask=mask,
+                                            causal=causal)
+    try:
+        return flash_attention(q, k, v, mask=mask, causal=causal)
+    except Exception:          # helper fallback, never fatal
+        return scaled_dot_product_attention(q, k, v, mask=mask,
+                                            causal=causal)

@@ -108,8 +108,13 @@ def encode_bitmap(signs: np.ndarray) -> np.ndarray:
 
 def encode(signs: np.ndarray) -> np.ndarray:
     """Pick FLEXIBLE vs BITMAP by density, as the reference's native
-    ThresholdCompression does (EncodedGradientsAccumulator.java:255-292)."""
+    ThresholdCompression does (EncodedGradientsAccumulator.java:255-292).
+    Uses the C++ codec (native/dl4j_native.cpp) when built."""
     signs = np.asarray(signs)
+    from deeplearning4j_tpu.utils import native
+    msg = native.encode(signs)
+    if msg is not None:
+        return msg
     nnz = int(np.count_nonzero(signs))
     density = nnz / max(signs.size, 1)
     if density > _BITMAP_DENSITY_CUTOFF:
@@ -120,6 +125,10 @@ def encode(signs: np.ndarray) -> np.ndarray:
 def decode(message: np.ndarray, shape=None) -> np.ndarray:
     """Decode either codec back to an int8 sign array."""
     message = np.asarray(message, dtype=np.int32)
+    from deeplearning4j_tpu.utils import native
+    if native.available():
+        out = native.decode(message)
+        return out.reshape(shape) if shape is not None else out
     kind, length = int(message[0]), int(message[1])
     out = np.zeros(length, dtype=np.int8)
     if kind == FLEXIBLE_ENCODING:

@@ -1,0 +1,257 @@
+// dl4j_tpu native host runtime — C ABI, loaded via ctypes.
+//
+// TPU-native counterpart of the reference's native host-side components
+// (SURVEY §2.14): the libnd4j ThresholdCompression encode/decode pair
+// (used by EncodedGradientsAccumulator.java:255-292) and the DataVec
+// record-reading hot loops (CSV text -> float tensors, IDX image files)
+// that feed device infeed. Device math stays in XLA/Pallas; this library
+// only accelerates the host paths that would otherwise bottleneck ETL or
+// DCN gradient exchange.
+//
+// Build: `make` in this directory (g++ -O3 -shared). The Python wrapper
+// (deeplearning4j_tpu/utils/native.py) builds on demand and falls back to
+// pure numpy when no toolchain is present.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Threshold codec (1-bit gradient compression wire format)
+//   message layout (int32 words):
+//     [kind, length, n_payload, payload...]
+//   kind 0 = FLEXIBLE (sparse signed indices: (idx+1)*sign)
+//   kind 1 = BITMAP   (2 bits/element, 16 elements per word: 01=+1, 10=-1)
+// ---------------------------------------------------------------------------
+
+static const int32_t FLEXIBLE = 0;
+static const int32_t BITMAP = 1;
+
+// Returns message length in int32 words (<= 3 + n).
+int64_t dl4j_encode_flexible(const int8_t* signs, int64_t n, int32_t* out) {
+    int64_t w = 3;
+    int64_t nnz = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        int8_t s = signs[i];
+        if (s != 0) {
+            out[w++] = (int32_t)((i + 1) * (s > 0 ? 1 : -1));
+            ++nnz;
+        }
+    }
+    out[0] = FLEXIBLE;
+    out[1] = (int32_t)n;
+    out[2] = (int32_t)nnz;
+    return w;
+}
+
+int64_t dl4j_encode_bitmap(const int8_t* signs, int64_t n, int32_t* out) {
+    int64_t n_words = (n + 15) / 16;
+    out[0] = BITMAP;
+    out[1] = (int32_t)n;
+    out[2] = (int32_t)n_words;
+    for (int64_t wi = 0; wi < n_words; ++wi) {
+        uint32_t word = 0;
+        int64_t base = wi * 16;
+        int64_t lim = (n - base) < 16 ? (n - base) : 16;
+        for (int64_t j = 0; j < lim; ++j) {
+            int8_t s = signs[base + j];
+            uint32_t code = s > 0 ? 1u : (s < 0 ? 2u : 0u);
+            word |= code << (2 * j);
+        }
+        out[3 + wi] = (int32_t)word;
+    }
+    return 3 + n_words;
+}
+
+// Auto-select codec by density (cutoff 2/32 as in the reference's native
+// ThresholdCompression: index list = 32 bits/nnz vs bitmap = 2 bits/elem).
+int64_t dl4j_encode(const int8_t* signs, int64_t n, int32_t* out) {
+    int64_t nnz = 0;
+    for (int64_t i = 0; i < n; ++i)
+        nnz += signs[i] != 0;
+    if (nnz * 32 > n * 2)
+        return dl4j_encode_bitmap(signs, n, out);
+    return dl4j_encode_flexible(signs, n, out);
+}
+
+// Returns decoded length, or -1 on malformed input.
+int64_t dl4j_decode(const int32_t* msg, int64_t msg_len, int8_t* out,
+                    int64_t max_out) {
+    if (msg_len < 3) return -1;
+    int32_t kind = msg[0];
+    int64_t n = msg[1];
+    if (n < 0 || n > max_out) return -1;
+    std::memset(out, 0, (size_t)n);
+    if (kind == FLEXIBLE) {
+        int64_t nnz = msg[2];
+        if (msg_len < 3 + nnz) return -1;
+        for (int64_t i = 0; i < nnz; ++i) {
+            int32_t e = msg[3 + i];
+            int64_t idx = (e > 0 ? e : -e) - 1;
+            if (idx < 0 || idx >= n) return -1;
+            out[idx] = e > 0 ? 1 : -1;
+        }
+    } else if (kind == BITMAP) {
+        int64_t n_words = msg[2];
+        if (msg_len < 3 + n_words) return -1;
+        for (int64_t wi = 0; wi < n_words; ++wi) {
+            uint32_t word = (uint32_t)msg[3 + wi];
+            int64_t base = wi * 16;
+            int64_t lim = (n - base) < 16 ? (n - base) : 16;
+            for (int64_t j = 0; j < lim; ++j) {
+                uint32_t code = (word >> (2 * j)) & 3u;
+                out[base + j] = code == 1 ? 1 : (code == 2 ? -1 : 0);
+            }
+        }
+    } else {
+        return -1;
+    }
+    return n;
+}
+
+// Fused: decode message and accumulate signs*threshold into a float
+// buffer (the EncodedGradientsAccumulator apply path — one pass, no
+// intermediate sign array).
+int64_t dl4j_decode_axpy(const int32_t* msg, int64_t msg_len,
+                         float threshold, float* acc, int64_t acc_len) {
+    if (msg_len < 3) return -1;
+    int32_t kind = msg[0];
+    int64_t n = msg[1];
+    if (n < 0 || n > acc_len) return -1;
+    if (kind == FLEXIBLE) {
+        int64_t nnz = msg[2];
+        if (msg_len < 3 + nnz) return -1;
+        for (int64_t i = 0; i < nnz; ++i) {
+            int32_t e = msg[3 + i];
+            int64_t idx = (e > 0 ? e : -e) - 1;
+            if (idx < 0 || idx >= n) return -1;
+            acc[idx] += e > 0 ? threshold : -threshold;
+        }
+    } else if (kind == BITMAP) {
+        int64_t n_words = msg[2];
+        if (msg_len < 3 + n_words) return -1;
+        for (int64_t wi = 0; wi < n_words; ++wi) {
+            uint32_t word = (uint32_t)msg[3 + wi];
+            int64_t base = wi * 16;
+            int64_t lim = (n - base) < 16 ? (n - base) : 16;
+            for (int64_t j = 0; j < lim; ++j) {
+                uint32_t code = (word >> (2 * j)) & 3u;
+                if (code == 1) acc[base + j] += threshold;
+                else if (code == 2) acc[base + j] -= threshold;
+            }
+        }
+    } else {
+        return -1;
+    }
+    return n;
+}
+
+// ---------------------------------------------------------------------------
+// CSV record reader (DataVec CSVRecordReader hot loop)
+// Parses a delimited numeric text buffer into a float32 matrix.
+// ---------------------------------------------------------------------------
+
+// Counts rows (non-empty lines). Fills n_cols from the first row.
+int64_t dl4j_csv_dims(const char* data, int64_t len, char delim,
+                      int64_t* n_cols) {
+    int64_t rows = 0;
+    int64_t cols = 0;
+    int64_t cur_cols = 0;
+    bool in_row = false;
+    for (int64_t i = 0; i < len; ++i) {
+        char c = data[i];
+        if (c == '\n') {
+            if (in_row) {
+                ++rows;
+                ++cur_cols;
+                if (cols == 0) cols = cur_cols;
+            }
+            cur_cols = 0;
+            in_row = false;
+        } else if (c == delim) {
+            if (in_row) ++cur_cols;
+        } else if (c != '\r') {
+            in_row = true;
+        }
+    }
+    if (in_row) {
+        ++rows;
+        ++cur_cols;
+        if (cols == 0) cols = cur_cols;
+    }
+    *n_cols = cols;
+    return rows;
+}
+
+// Parses into out[rows*cols]; returns rows parsed or -1 on ragged rows /
+// unparsable fields.
+int64_t dl4j_csv_parse(const char* data, int64_t len, char delim,
+                       float* out, int64_t max_rows, int64_t n_cols) {
+    int64_t row = 0;
+    int64_t col = 0;
+    const char* p = data;
+    const char* end = data + len;
+    char buf[64];
+    while (p < end && row < max_rows) {
+        // skip blank lines
+        while (p < end && (*p == '\n' || *p == '\r')) ++p;
+        if (p >= end) break;
+        col = 0;
+        while (p < end && *p != '\n') {
+            const char* field = p;
+            while (p < end && *p != delim && *p != '\n' && *p != '\r') ++p;
+            int64_t flen = p - field;
+            if (flen >= (int64_t)sizeof(buf)) return -1;
+            std::memcpy(buf, field, (size_t)flen);
+            buf[flen] = 0;
+            char* endp = nullptr;
+            float v = std::strtof(buf, &endp);
+            if (endp == buf && flen > 0) return -1;
+            if (col >= n_cols) return -1;
+            out[row * n_cols + col] = v;
+            ++col;
+            if (p < end && *p == delim) ++p;
+            while (p < end && *p == '\r') ++p;
+        }
+        if (col != n_cols) return -1;
+        ++row;
+    }
+    return row;
+}
+
+// ---------------------------------------------------------------------------
+// IDX (MNIST/EMNIST container) decoder: big-endian header + u8 payload
+// scaled to [0,1] float32. (MnistDataFetcher's binary reader.)
+// ---------------------------------------------------------------------------
+
+static uint32_t be32(const uint8_t* p) {
+    return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+           ((uint32_t)p[2] << 8) | (uint32_t)p[3];
+}
+
+// Returns element count written to out, or -1. dims_out must hold 4.
+int64_t dl4j_idx_decode(const uint8_t* data, int64_t len, float* out,
+                        int64_t max_out, int64_t* dims_out,
+                        int64_t* n_dims_out) {
+    if (len < 4) return -1;
+    if (data[0] != 0 || data[1] != 0) return -1;
+    uint8_t dtype = data[2];
+    uint8_t nd = data[3];
+    if (dtype != 0x08 || nd < 1 || nd > 4) return -1;  // u8 only
+    if (len < 4 + 4 * (int64_t)nd) return -1;
+    int64_t total = 1;
+    for (int i = 0; i < nd; ++i) {
+        dims_out[i] = be32(data + 4 + 4 * i);
+        total *= dims_out[i];
+    }
+    *n_dims_out = nd;
+    if (total > max_out || len < 4 + 4 * nd + total) return -1;
+    const uint8_t* payload = data + 4 + 4 * nd;
+    for (int64_t i = 0; i < total; ++i)
+        out[i] = (float)payload[i] * (1.0f / 255.0f);
+    return total;
+}
+
+}  // extern "C"

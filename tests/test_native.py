@@ -1,0 +1,95 @@
+"""Native C++ host runtime vs the numpy reference implementations.
+
+The reference validates native helpers against the built-in path
+(SURVEY §4, accelerated-vs-reference); here the ctypes-bound C++ codec
+and record readers must agree exactly with the numpy fallbacks. Skipped
+wholesale when no toolchain can build the library.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel import compression as C
+from deeplearning4j_tpu.utils import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library unavailable")
+
+
+def test_codec_matches_numpy_roundtrip(rng):
+    signs = rng.choice([-1, 0, 0, 1], size=1000).astype(np.int8)
+    msg_native = native.encode(signs)
+    msg_numpy = (C.encode_bitmap(signs)
+                 if int(msg_native[0]) == C.BITMAP_ENCODING
+                 else C.encode_flexible(signs))
+    np.testing.assert_array_equal(msg_native, msg_numpy)
+    np.testing.assert_array_equal(native.decode(msg_numpy), signs)
+
+
+@pytest.mark.parametrize("density", [0.01, 0.5])
+def test_codec_both_kinds(rng, density):
+    signs = np.where(rng.random(513) < density,
+                     rng.choice([-1, 1], size=513), 0).astype(np.int8)
+    msg = native.encode(signs)
+    np.testing.assert_array_equal(native.decode(msg), signs)
+
+
+def test_decode_axpy_fused(rng):
+    signs = rng.choice([-1, 0, 1], size=257).astype(np.int8)
+    msg = native.encode(signs)
+    acc = rng.normal(size=257).astype(np.float32)
+    expect = acc + signs.astype(np.float32) * 0.125
+    assert native.decode_axpy(msg, 0.125, acc)
+    np.testing.assert_allclose(acc, expect, rtol=1e-6)
+
+
+def test_decode_rejects_malformed():
+    with pytest.raises(ValueError):
+        native.decode(np.array([7, 10, 1, 3], np.int32))   # unknown kind
+    with pytest.raises(ValueError):
+        # flexible message with out-of-range index
+        native.decode(np.array([0, 4, 1, 99], np.int32))
+
+
+def test_csv_parser(rng):
+    mat = rng.normal(size=(37, 5)).astype(np.float32)
+    text = "\n".join(",".join(f"{v:.6g}" for v in row) for row in mat)
+    out = native.parse_csv(text)
+    np.testing.assert_allclose(out, mat, rtol=1e-5)
+
+
+def test_csv_parser_crlf_and_blank_lines():
+    text = "1,2,3\r\n\r\n4,5,6\r\n"
+    out = native.parse_csv(text)
+    np.testing.assert_allclose(out, [[1, 2, 3], [4, 5, 6]])
+
+
+def test_csv_parser_rejects_ragged():
+    with pytest.raises(ValueError):
+        native.parse_csv("1,2,3\n4,5\n")
+
+
+def test_idx_decoder():
+    imgs = np.arange(2 * 3 * 4, dtype=np.uint8).reshape(2, 3, 4)
+    raw = struct.pack(">BBBB", 0, 0, 0x08, 3)
+    raw += struct.pack(">III", 2, 3, 4)
+    raw += imgs.tobytes()
+    arr, shape = native.decode_idx(raw)
+    assert shape == (2, 3, 4)
+    np.testing.assert_allclose(arr, imgs.astype(np.float32) / 255.0,
+                               rtol=1e-6)
+
+
+def test_idx_decoder_rejects_garbage():
+    with pytest.raises(ValueError):
+        native.decode_idx(b"\x00\x00\x42\x01\x00")
+
+
+def test_compression_module_uses_native(rng):
+    """compression.encode/decode route through the C++ codec and stay
+    wire-compatible with the numpy implementation."""
+    signs = rng.choice([-1, 0, 1], size=129).astype(np.int8)
+    msg = C.encode(signs)
+    np.testing.assert_array_equal(C.decode(msg), signs)
