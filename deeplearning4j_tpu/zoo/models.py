@@ -35,6 +35,7 @@ from deeplearning4j_tpu.nn.layers.feedforward import (
 from deeplearning4j_tpu.nn.layers.normalization import BatchNormalization
 from deeplearning4j_tpu.nn.layers.output import (
     GlobalPoolingLayer,
+    LossLayer,
     OutputLayer,
     RnnOutputLayer,
 )
@@ -329,3 +330,558 @@ class AlexNet(ZooModel):
 
     def init(self) -> MultiLayerNetwork:
         return MultiLayerNetwork(self.conf()).init()
+
+
+@dataclasses.dataclass
+class VGG19(ZooModel):
+    """reference: model/VGG19.java — VGG16 with the deeper [2,2,4,4,4]
+    conv plan."""
+    num_classes: int = 1000
+    height: int = 224
+    width: int = 224
+    channels: int = 3
+    seed: int = 123
+    compute_dtype: str = "float32"
+
+    def conf(self):
+        b = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(Nesterovs(1e-2, 0.9))
+             .compute_dtype(self.compute_dtype)
+             .list())
+        plan = [(64, 2), (128, 2), (256, 4), (512, 4), (512, 4)]
+        for n_out, reps in plan:
+            for _ in range(reps):
+                b = b.layer(ConvolutionLayer(
+                    n_out=n_out, kernel_size=(3, 3),
+                    convolution_mode=ConvolutionMode.SAME,
+                    activation=Activation.RELU))
+            b = b.layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        return (b.layer(DenseLayer(n_out=4096, activation=Activation.RELU,
+                                   dropout=0.5))
+                .layer(DenseLayer(n_out=4096, activation=Activation.RELU,
+                                  dropout=0.5))
+                .layer(OutputLayer(n_out=self.num_classes))
+                .set_input_type(InputType.convolutional(
+                    self.height, self.width, self.channels))
+                .build())
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init()
+
+
+def _darknet_block(b, n_out, kernel):
+    """conv + BN + leaky-relu (reference: model/helper/DarknetHelper.java
+    addLayers — conv/BN/LeakyReLU triple)."""
+    return (b.layer(ConvolutionLayer(
+                n_out=n_out, kernel_size=kernel,
+                convolution_mode=ConvolutionMode.SAME, has_bias=False,
+                activation=Activation.IDENTITY))
+            .layer(BatchNormalization())
+            .layer(ActivationLayer(activation=Activation.LEAKYRELU)))
+
+
+@dataclasses.dataclass
+class Darknet19(ZooModel):
+    """reference: model/Darknet19.java — the YOLO2 classification
+    backbone (19 convs, 1x1 bottlenecks between 3x3s)."""
+    num_classes: int = 1000
+    height: int = 224
+    width: int = 224
+    channels: int = 3
+    seed: int = 123
+    compute_dtype: str = "float32"
+
+    def conf(self):
+        b = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(Nesterovs(1e-3, 0.9))
+             .compute_dtype(self.compute_dtype)
+             .list())
+        b = _darknet_block(b, 32, (3, 3))
+        b = b.layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        b = _darknet_block(b, 64, (3, 3))
+        b = b.layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        for mid, outer in ((64, 128), (128, 256)):
+            b = _darknet_block(b, outer, (3, 3))
+            b = _darknet_block(b, mid, (1, 1))
+            b = _darknet_block(b, outer, (3, 3))
+            b = b.layer(SubsamplingLayer(kernel_size=(2, 2),
+                                         stride=(2, 2)))
+        for mid, outer in ((256, 512), (512, 1024)):
+            b = _darknet_block(b, outer, (3, 3))
+            b = _darknet_block(b, mid, (1, 1))
+            b = _darknet_block(b, outer, (3, 3))
+            b = _darknet_block(b, mid, (1, 1))
+            b = _darknet_block(b, outer, (3, 3))
+            if outer == 512:
+                b = b.layer(SubsamplingLayer(kernel_size=(2, 2),
+                                             stride=(2, 2)))
+        b = b.layer(ConvolutionLayer(n_out=self.num_classes,
+                                     kernel_size=(1, 1),
+                                     convolution_mode=ConvolutionMode.SAME,
+                                     activation=Activation.IDENTITY))
+        return (b.layer(GlobalPoolingLayer(pooling_type=PoolingType.AVG))
+                .layer(LossLayer(loss=LossFunction.MCXENT,
+                                 activation=Activation.SOFTMAX))
+                .set_input_type(InputType.convolutional(
+                    self.height, self.width, self.channels))
+                .build())
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init()
+
+
+@dataclasses.dataclass
+class TinyYOLO(ZooModel):
+    """reference: model/TinyYOLO.java — tiny-YOLOv2 detector: 6 darknet
+    conv/pool stages then a 1x1 head into Yolo2OutputLayer. Default
+    anchors are the reference's (in 13x13-grid units)."""
+    num_classes: int = 20
+    height: int = 416
+    width: int = 416
+    channels: int = 3
+    boxes: Tuple = ((1.08, 1.19), (3.42, 4.41), (6.63, 11.38),
+                    (9.42, 5.11), (16.62, 10.52))
+    seed: int = 123
+    compute_dtype: str = "float32"
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.layers.objdetect import Yolo2OutputLayer
+        b = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(Adam(1e-3))
+             .compute_dtype(self.compute_dtype)
+             .list())
+        for n_out in (16, 32, 64, 128, 256):
+            b = _darknet_block(b, n_out, (3, 3))
+            b = b.layer(SubsamplingLayer(kernel_size=(2, 2),
+                                         stride=(2, 2)))
+        b = _darknet_block(b, 512, (3, 3))
+        b = b.layer(SubsamplingLayer(
+            kernel_size=(2, 2), stride=(1, 1),
+            convolution_mode=ConvolutionMode.SAME))
+        b = _darknet_block(b, 1024, (3, 3))
+        b = _darknet_block(b, 1024, (3, 3))
+        n_b = len(self.boxes)
+        b = b.layer(ConvolutionLayer(
+            n_out=n_b * (5 + self.num_classes), kernel_size=(1, 1),
+            convolution_mode=ConvolutionMode.SAME,
+            activation=Activation.IDENTITY))
+        return (b.layer(Yolo2OutputLayer(boxes=self.boxes))
+                .set_input_type(InputType.convolutional(
+                    self.height, self.width, self.channels))
+                .build())
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init()
+
+
+@dataclasses.dataclass
+class YOLO2(ZooModel):
+    """reference: model/YOLO2.java — Darknet19 backbone + passthrough:
+    the 512-channel stage-5 map rides a SpaceToDepth into the head merge
+    (reference uses a route/reorg pair; here MergeVertex + SpaceToDepth)."""
+    num_classes: int = 20
+    height: int = 416
+    width: int = 416
+    channels: int = 3
+    boxes: Tuple = ((0.57273, 0.677385), (1.87446, 2.06253),
+                    (3.33843, 5.47434), (7.88282, 3.52778),
+                    (9.77052, 9.16828))
+    seed: int = 123
+    compute_dtype: str = "float32"
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.graph.vertices import MergeVertex
+        from deeplearning4j_tpu.nn.layers.convolution import (
+            SpaceToDepthLayer)
+        from deeplearning4j_tpu.nn.layers.objdetect import Yolo2OutputLayer
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(Adam(1e-3))
+             .compute_dtype(self.compute_dtype)
+             .graph_builder()
+             .add_inputs("in")
+             .set_input_types(InputType.convolutional(
+                 self.height, self.width, self.channels)))
+
+        def block(name, src, n_out, kernel):
+            g.add_layer(f"{name}_conv", ConvolutionLayer(
+                n_out=n_out, kernel_size=kernel,
+                convolution_mode=ConvolutionMode.SAME, has_bias=False,
+                activation=Activation.IDENTITY), src)
+            g.add_layer(f"{name}_bn", BatchNormalization(), f"{name}_conv")
+            g.add_layer(f"{name}_act",
+                        ActivationLayer(activation=Activation.LEAKYRELU),
+                        f"{name}_bn")
+            return f"{name}_act"
+
+        def pool(name, src):
+            g.add_layer(name, SubsamplingLayer(kernel_size=(2, 2),
+                                               stride=(2, 2)), src)
+            return name
+
+        x = block("c1", "in", 32, (3, 3))
+        x = pool("p1", x)
+        x = block("c2", x, 64, (3, 3))
+        x = pool("p2", x)
+        for i, (mid, outer) in enumerate(((64, 128), (128, 256))):
+            x = block(f"s{i}a", x, outer, (3, 3))
+            x = block(f"s{i}b", x, mid, (1, 1))
+            x = block(f"s{i}c", x, outer, (3, 3))
+            x = pool(f"s{i}p", x)
+        # stage 5 (512): its output is the passthrough source
+        x = block("s2a", x, 512, (3, 3))
+        x = block("s2b", x, 256, (1, 1))
+        x = block("s2c", x, 512, (3, 3))
+        x = block("s2d", x, 256, (1, 1))
+        passthrough = block("s2e", x, 512, (3, 3))
+        x = pool("s2p", passthrough)
+        # stage 6 (1024)
+        x = block("s3a", x, 1024, (3, 3))
+        x = block("s3b", x, 512, (1, 1))
+        x = block("s3c", x, 1024, (3, 3))
+        x = block("s3d", x, 512, (1, 1))
+        x = block("s3e", x, 1024, (3, 3))
+        # head
+        x = block("h1", x, 1024, (3, 3))
+        x = block("h2", x, 1024, (3, 3))
+        g.add_layer("reorg", SpaceToDepthLayer(block_size=2), passthrough)
+        g.add_vertex("cat", MergeVertex(), "reorg", "h2_act")
+        x = block("h3", "cat", 1024, (3, 3))
+        n_b = len(self.boxes)
+        g.add_layer("head", ConvolutionLayer(
+            n_out=n_b * (5 + self.num_classes), kernel_size=(1, 1),
+            convolution_mode=ConvolutionMode.SAME,
+            activation=Activation.IDENTITY), x)
+        g.add_layer("yolo", Yolo2OutputLayer(boxes=self.boxes), "head")
+        g.set_outputs("yolo")
+        return g.build()
+
+    def init(self) -> ComputationGraph:
+        return ComputationGraph(self.conf()).init()
+
+
+@dataclasses.dataclass
+class GoogLeNet(ZooModel):
+    """reference: model/GoogLeNet.java — Inception-v1: stem + 9 inception
+    modules (4-branch MergeVertex each) + avg-pool head."""
+    num_classes: int = 1000
+    height: int = 224
+    width: int = 224
+    channels: int = 3
+    seed: int = 123
+    compute_dtype: str = "float32"
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.graph.vertices import MergeVertex
+        from deeplearning4j_tpu.nn.layers.normalization import (
+            LocalResponseNormalization)
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(Nesterovs(1e-2, 0.9))
+             .compute_dtype(self.compute_dtype)
+             .graph_builder()
+             .add_inputs("in")
+             .set_input_types(InputType.convolutional(
+                 self.height, self.width, self.channels)))
+
+        def conv(name, src, n_out, k, s=(1, 1)):
+            g.add_layer(name, ConvolutionLayer(
+                n_out=n_out, kernel_size=k, stride=s,
+                convolution_mode=ConvolutionMode.SAME,
+                activation=Activation.RELU), src)
+            return name
+
+        def inception(name, src, c1, c3r, c3, c5r, c5, cp):
+            """4 branches: 1x1 / 1x1->3x3 / 1x1->5x5 / pool->1x1
+            (reference: GoogLeNet.java inception helper)."""
+            b1 = conv(f"{name}_b1", src, c1, (1, 1))
+            conv(f"{name}_b3r", src, c3r, (1, 1))
+            b3 = conv(f"{name}_b3", f"{name}_b3r", c3, (3, 3))
+            conv(f"{name}_b5r", src, c5r, (1, 1))
+            b5 = conv(f"{name}_b5", f"{name}_b5r", c5, (5, 5))
+            g.add_layer(f"{name}_pool", SubsamplingLayer(
+                kernel_size=(3, 3), stride=(1, 1),
+                convolution_mode=ConvolutionMode.SAME), src)
+            bp = conv(f"{name}_bp", f"{name}_pool", cp, (1, 1))
+            g.add_vertex(name, MergeVertex(), b1, b3, b5, bp)
+            return name
+
+        x = conv("conv1", "in", 64, (7, 7), (2, 2))
+        g.add_layer("pool1", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2),
+            convolution_mode=ConvolutionMode.SAME), x)
+        g.add_layer("lrn1", LocalResponseNormalization(), "pool1")
+        x = conv("conv2r", "lrn1", 64, (1, 1))
+        x = conv("conv2", x, 192, (3, 3))
+        g.add_layer("lrn2", LocalResponseNormalization(), x)
+        g.add_layer("pool2", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2),
+            convolution_mode=ConvolutionMode.SAME), "lrn2")
+        x = inception("i3a", "pool2", 64, 96, 128, 16, 32, 32)
+        x = inception("i3b", x, 128, 128, 192, 32, 96, 64)
+        g.add_layer("pool3", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2),
+            convolution_mode=ConvolutionMode.SAME), x)
+        x = inception("i4a", "pool3", 192, 96, 208, 16, 48, 64)
+        x = inception("i4b", x, 160, 112, 224, 24, 64, 64)
+        x = inception("i4c", x, 128, 128, 256, 24, 64, 64)
+        x = inception("i4d", x, 112, 144, 288, 32, 64, 64)
+        x = inception("i4e", x, 256, 160, 320, 32, 128, 128)
+        g.add_layer("pool4", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2),
+            convolution_mode=ConvolutionMode.SAME), x)
+        x = inception("i5a", "pool4", 256, 160, 320, 32, 128, 128)
+        x = inception("i5b", x, 384, 192, 384, 48, 128, 128)
+        g.add_layer("avgpool", GlobalPoolingLayer(
+            pooling_type=PoolingType.AVG), x)
+        g.add_layer("drop", DropoutLayer(dropout=0.4), "avgpool")
+        g.add_layer("out", OutputLayer(n_out=self.num_classes,
+                                       loss=LossFunction.MCXENT,
+                                       activation=Activation.SOFTMAX),
+                    "drop")
+        g.set_outputs("out")
+        return g.build()
+
+    def init(self) -> ComputationGraph:
+        return ComputationGraph(self.conf()).init()
+
+
+@dataclasses.dataclass
+class InceptionResNetV1(ZooModel):
+    """reference: model/InceptionResNetV1.java (+ helper/
+    InceptionResNetHelper.java) — FaceNet-style embedding net: stem,
+    5x block35, reduction-A, 10x block17, reduction-B, 5x block8,
+    128-d L2-normalized embedding, center-loss softmax head."""
+    num_classes: int = 1001
+    embedding_size: int = 128
+    height: int = 160
+    width: int = 160
+    channels: int = 3
+    seed: int = 123
+    compute_dtype: str = "float32"
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.graph.vertices import (
+            ElementWiseVertex, L2NormalizeVertex, MergeVertex, ScaleVertex)
+        from deeplearning4j_tpu.nn.layers.output import (
+            CenterLossOutputLayer)
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(Adam(1e-3))
+             .compute_dtype(self.compute_dtype)
+             .graph_builder()
+             .add_inputs("in")
+             .set_input_types(InputType.convolutional(
+                 self.height, self.width, self.channels)))
+
+        def conv(name, src, n_out, k, s=(1, 1), act=Activation.RELU):
+            g.add_layer(f"{name}_c", ConvolutionLayer(
+                n_out=n_out, kernel_size=k, stride=s,
+                convolution_mode=ConvolutionMode.SAME, has_bias=False,
+                activation=Activation.IDENTITY), src)
+            g.add_layer(f"{name}_bn", BatchNormalization(), f"{name}_c")
+            if act is None:
+                return f"{name}_bn"
+            g.add_layer(f"{name}_a", ActivationLayer(activation=act),
+                        f"{name}_bn")
+            return f"{name}_a"
+
+        def residual(name, src, branches, n_channels, scale):
+            """merge(branches) -> linear 1x1 up-projection -> scaled
+            residual add -> relu (InceptionResNetHelper block pattern)."""
+            g.add_vertex(f"{name}_cat", MergeVertex(), *branches)
+            up = conv(f"{name}_up", f"{name}_cat", n_channels, (1, 1),
+                      act=None)
+            g.add_vertex(f"{name}_scale", ScaleVertex(scale=scale), up)
+            g.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), src,
+                         f"{name}_scale")
+            g.add_layer(f"{name}_out",
+                        ActivationLayer(activation=Activation.RELU),
+                        f"{name}_add")
+            return f"{name}_out"
+
+        def block35(name, src):
+            b1 = conv(f"{name}_b1", src, 32, (1, 1))
+            b2 = conv(f"{name}_b2b", conv(f"{name}_b2a", src, 32, (1, 1)),
+                      32, (3, 3))
+            b3 = conv(f"{name}_b3c",
+                      conv(f"{name}_b3b",
+                           conv(f"{name}_b3a", src, 32, (1, 1)), 32,
+                           (3, 3)), 32, (3, 3))
+            return residual(name, src, (b1, b2, b3), 256, 0.17)
+
+        def block17(name, src):
+            b1 = conv(f"{name}_b1", src, 128, (1, 1))
+            b2 = conv(f"{name}_b2c",
+                      conv(f"{name}_b2b",
+                           conv(f"{name}_b2a", src, 128, (1, 1)), 128,
+                           (1, 7)), 128, (7, 1))
+            return residual(name, src, (b1, b2), 896, 0.10)
+
+        def block8(name, src):
+            b1 = conv(f"{name}_b1", src, 192, (1, 1))
+            b2 = conv(f"{name}_b2c",
+                      conv(f"{name}_b2b",
+                           conv(f"{name}_b2a", src, 192, (1, 1)), 192,
+                           (1, 3)), 192, (3, 1))
+            return residual(name, src, (b1, b2), 1792, 0.20)
+
+        # stem
+        x = conv("stem1", "in", 32, (3, 3), (2, 2))
+        x = conv("stem2", x, 32, (3, 3))
+        x = conv("stem3", x, 64, (3, 3))
+        g.add_layer("stem_pool", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2),
+            convolution_mode=ConvolutionMode.SAME), x)
+        x = conv("stem4", "stem_pool", 80, (1, 1))
+        x = conv("stem5", x, 192, (3, 3))
+        x = conv("stem6", x, 256, (3, 3), (2, 2))
+        for i in range(5):
+            x = block35(f"b35_{i}", x)
+        # reduction-A -> 896 channels
+        g.add_layer("redA_pool", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2),
+            convolution_mode=ConvolutionMode.SAME), x)
+        ra1 = conv("redA_b1", x, 384, (3, 3), (2, 2))
+        ra2 = conv("redA_b2c",
+                   conv("redA_b2b", conv("redA_b2a", x, 192, (1, 1)),
+                        192, (3, 3)), 256, (3, 3), (2, 2))
+        g.add_vertex("redA", MergeVertex(), "redA_pool", ra1, ra2)
+        x = "redA"
+        for i in range(10):
+            x = block17(f"b17_{i}", x)
+        # reduction-B -> 1792 channels
+        g.add_layer("redB_pool", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2),
+            convolution_mode=ConvolutionMode.SAME), x)
+        rb1 = conv("redB_b1b", conv("redB_b1a", x, 256, (1, 1)), 384,
+                   (3, 3), (2, 2))
+        rb2 = conv("redB_b2b", conv("redB_b2a", x, 256, (1, 1)), 256,
+                   (3, 3), (2, 2))
+        rb3 = conv("redB_b3c",
+                   conv("redB_b3b", conv("redB_b3a", x, 256, (1, 1)),
+                        256, (3, 3)), 256, (3, 3), (2, 2))
+        g.add_vertex("redB", MergeVertex(), "redB_pool", rb1, rb2, rb3)
+        x = "redB"
+        for i in range(5):
+            x = block8(f"b8_{i}", x)
+        g.add_layer("avgpool", GlobalPoolingLayer(
+            pooling_type=PoolingType.AVG), x)
+        g.add_layer("bottleneck", DenseLayer(
+            n_out=self.embedding_size, activation=Activation.IDENTITY),
+            "avgpool")
+        g.add_vertex("embeddings", L2NormalizeVertex(), "bottleneck")
+        g.add_layer("out", CenterLossOutputLayer(
+            n_out=self.num_classes, loss=LossFunction.MCXENT,
+            activation=Activation.SOFTMAX), "embeddings")
+        g.set_outputs("out")
+        return g.build()
+
+    def init(self) -> ComputationGraph:
+        return ComputationGraph(self.conf()).init()
+
+
+@dataclasses.dataclass
+class FaceNetNN4Small2(ZooModel):
+    """reference: model/FaceNetNN4Small2.java (+ helper/FaceNetHelper.java)
+    — the NN4-small2 GoogLeNet-style face embedding net: stem, mixed
+    3a/3b/3c/4a/4e/5a/5b inception blocks, 128-d L2-normalized embedding,
+    center-loss softmax head."""
+    num_classes: int = 5749
+    embedding_size: int = 128
+    height: int = 96
+    width: int = 96
+    channels: int = 3
+    seed: int = 123
+    compute_dtype: str = "float32"
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.graph.vertices import (
+            L2NormalizeVertex, MergeVertex)
+        from deeplearning4j_tpu.nn.layers.normalization import (
+            LocalResponseNormalization)
+        from deeplearning4j_tpu.nn.layers.output import (
+            CenterLossOutputLayer)
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .updater(Adam(1e-3))
+             .compute_dtype(self.compute_dtype)
+             .graph_builder()
+             .add_inputs("in")
+             .set_input_types(InputType.convolutional(
+                 self.height, self.width, self.channels)))
+
+        def conv(name, src, n_out, k, s=(1, 1)):
+            g.add_layer(f"{name}_c", ConvolutionLayer(
+                n_out=n_out, kernel_size=k, stride=s,
+                convolution_mode=ConvolutionMode.SAME,
+                activation=Activation.IDENTITY), src)
+            g.add_layer(f"{name}_bn", BatchNormalization(), f"{name}_c")
+            g.add_layer(f"{name}_a",
+                        ActivationLayer(activation=Activation.RELU),
+                        f"{name}_bn")
+            return f"{name}_a"
+
+        def inception(name, src, c3r, c3, c5r, c5, cp, c1, pool_stride=1,
+                      strided=False):
+            """FaceNetHelper.appendGraph-style mixed block; ``strided``
+            blocks (3c, 4e) drop the 1x1 branch and downsample."""
+            stride = (2, 2) if strided else (1, 1)
+            branches = []
+            b3 = conv(f"{name}_3", conv(f"{name}_3r", src, c3r, (1, 1)),
+                      c3, (3, 3), stride)
+            branches.append(b3)
+            if c5:
+                b5 = conv(f"{name}_5",
+                          conv(f"{name}_5r", src, c5r, (1, 1)), c5,
+                          (5, 5), stride)
+                branches.append(b5)
+            g.add_layer(f"{name}_pool", SubsamplingLayer(
+                kernel_size=(3, 3),
+                stride=(2, 2) if strided else (pool_stride, pool_stride),
+                convolution_mode=ConvolutionMode.SAME), src)
+            if cp:
+                branches.append(conv(f"{name}_pp", f"{name}_pool", cp,
+                                     (1, 1)))
+            else:
+                branches.append(f"{name}_pool")
+            if c1:
+                branches.append(conv(f"{name}_1", src, c1, (1, 1)))
+            g.add_vertex(name, MergeVertex(), *branches)
+            return name
+
+        x = conv("conv1", "in", 64, (7, 7), (2, 2))
+        g.add_layer("pool1", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2),
+            convolution_mode=ConvolutionMode.SAME), x)
+        g.add_layer("lrn1", LocalResponseNormalization(), "pool1")
+        x = conv("conv2", "lrn1", 64, (1, 1))
+        x = conv("conv3", x, 192, (3, 3))
+        g.add_layer("lrn2", LocalResponseNormalization(), x)
+        g.add_layer("pool2", SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2),
+            convolution_mode=ConvolutionMode.SAME), "lrn2")
+        x = inception("mixed3a", "pool2", 96, 128, 16, 32, 32, 64)
+        x = inception("mixed3b", x, 96, 128, 32, 64, 64, 64)
+        x = inception("mixed3c", x, 128, 256, 32, 64, 0, 0, strided=True)
+        x = inception("mixed4a", x, 96, 192, 32, 64, 128, 256)
+        x = inception("mixed4e", x, 160, 256, 64, 128, 0, 0, strided=True)
+        x = inception("mixed5a", x, 96, 384, 0, 0, 96, 256)
+        x = inception("mixed5b", x, 96, 384, 0, 0, 96, 256)
+        g.add_layer("avgpool", GlobalPoolingLayer(
+            pooling_type=PoolingType.AVG), x)
+        g.add_layer("bottleneck", DenseLayer(
+            n_out=self.embedding_size, activation=Activation.IDENTITY),
+            "avgpool")
+        g.add_vertex("embeddings", L2NormalizeVertex(), "bottleneck")
+        g.add_layer("out", CenterLossOutputLayer(
+            n_out=self.num_classes, loss=LossFunction.MCXENT,
+            activation=Activation.SOFTMAX), "embeddings")
+        g.set_outputs("out")
+        return g.build()
+
+    def init(self) -> ComputationGraph:
+        return ComputationGraph(self.conf()).init()

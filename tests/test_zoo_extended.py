@@ -1,0 +1,71 @@
+"""Instantiation tests for the extended model zoo (SURVEY §2.6 full list).
+
+Mirrors deeplearning4j-zoo's TestInstantiation: build each architecture at
+reduced input size, run a forward pass, check the output arity. Small
+shapes keep the CPU-mesh compile times reasonable; topology (branching,
+residuals, passthrough, reductions) is identical to full size.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.zoo.models import (
+    Darknet19,
+    FaceNetNN4Small2,
+    GoogLeNet,
+    InceptionResNetV1,
+    TinyYOLO,
+    VGG19,
+    YOLO2,
+)
+
+
+def _img(rng, n, h, w, c=3):
+    return rng.normal(size=(n, h, w, c)).astype(np.float32)
+
+
+def test_vgg19_forward(rng):
+    m = VGG19(num_classes=10, height=32, width=32).init()
+    out = np.asarray(m.output(_img(rng, 2, 32, 32)))
+    assert out.shape == (2, 10)
+    np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-4)
+
+
+def test_darknet19_forward(rng):
+    m = Darknet19(num_classes=12, height=64, width=64).init()
+    out = np.asarray(m.output(_img(rng, 2, 64, 64)))
+    assert out.shape == (2, 12)
+    np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-4)
+
+
+def test_tiny_yolo_forward(rng):
+    m = TinyYOLO(num_classes=4, height=64, width=64).init()
+    out = np.asarray(m.output(_img(rng, 1, 64, 64)))
+    # 64 / 2^5 = 2 grid; 5 boxes * (5 + 4 classes)
+    assert out.shape == (1, 2, 2, 5 * 9)
+
+
+def test_yolo2_forward(rng):
+    m = YOLO2(num_classes=4, height=64, width=64).init()
+    out = np.asarray(m.output(_img(rng, 1, 64, 64)))
+    assert out.shape == (1, 2, 2, 5 * 9)
+
+
+def test_googlenet_forward(rng):
+    m = GoogLeNet(num_classes=7, height=64, width=64).init()
+    out = np.asarray(m.output(_img(rng, 2, 64, 64)))
+    assert out.shape == (2, 7)
+    np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-4)
+
+
+def test_inception_resnet_v1_embeddings(rng):
+    m = InceptionResNetV1(num_classes=9, height=64, width=64).init()
+    out = np.asarray(m.output(_img(rng, 2, 64, 64)))
+    assert out.shape == (2, 9)
+
+
+def test_facenet_nn4_small2_forward(rng):
+    m = FaceNetNN4Small2(num_classes=11, height=64, width=64).init()
+    out = np.asarray(m.output(_img(rng, 2, 64, 64)))
+    assert out.shape == (2, 11)
+    np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-4)
