@@ -163,6 +163,10 @@ class ComputationGraph(BaseModel):
             total = total + loss.astype(acc)
         for n in self._layer_nodes:
             total = total + n.layer.regularization_loss(params.get(n.name, {}))
+        # auxiliary losses surfaced via layer state (MoE load balancing)
+        for s in new_state.values():
+            if isinstance(s, dict) and "moe_aux_loss" in s:
+                total = total + s["moe_aux_loss"].astype(acc)
         return total, new_state
 
     def _constraint_layers(self):

@@ -145,9 +145,13 @@ class MultiLayerNetwork(BaseModel):
                                       x, labels, ctx)
         reg = sum((l.regularization_loss(params.get(l.name, {}))
                    for l in self.layers), jnp.zeros((), jnp.float32))
+        # auxiliary losses surfaced via layer state (MoE load balancing)
+        aux = sum((s["moe_aux_loss"] for s in new_state.values()
+                   if isinstance(s, dict) and "moe_aux_loss" in s),
+                  jnp.zeros((), jnp.float32))
         # promote (not truncate): float64 under gradient checks, else float32
         acc = jnp.promote_types(jnp.float32, loss.dtype)
-        return loss.astype(acc) + reg.astype(acc), new_state
+        return loss.astype(acc) + reg.astype(acc) + aux.astype(acc), new_state
 
     def _constraint_layers(self):
         return self.layers

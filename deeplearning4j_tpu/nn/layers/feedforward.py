@@ -194,3 +194,60 @@ class AutoEncoder(FeedForwardLayer):
         v = self.activation.apply(
             jnp.einsum("...o,io->...i", h, params["W"]) + params["vb"])
         return jnp.mean(jnp.sum(jnp.square(x - v), axis=-1))
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class MixtureOfExperts(FeedForwardLayer):
+    """Sparse MoE FFN (no reference analog — SURVEY §2.11 row 7 lists
+    expert parallelism as ABSENT there; designed fresh per §7.2 stage 7).
+    Top-k routed expert FFNs over the feature dim; expert weights are
+    stacked (E, ...) so ``parallel.moe.set_default_mesh`` shards them over
+    the ``expert`` mesh axis and GSPMD inserts the dispatch all-to-alls.
+    The load-balancing + router-z losses are surfaced through layer state
+    (``moe_aux_loss``) and added to the training loss by the models."""
+
+    num_experts: int = 4
+    hidden: int = 0              # d_ff; 0 → 4 * n_out
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_weight: float = 0.01
+    z_weight: float = 0.001
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if isinstance(input_type, RecurrentType):
+            return RecurrentType(self.n_out, input_type.timesteps)
+        return FeedForwardType(self.n_out)
+
+    def initialize(self, key, input_type):
+        n_in = self.resolved_n_in(input_type)
+        d_ff = self.hidden or 4 * self.n_out
+        dt = self.param_dtype()
+        kg, k1, k2 = jax.random.split(key, 3)
+        e = self.num_experts
+        return {
+            "gate": self.weight_init.init(kg, (n_in, e), n_in, e, dt),
+            "w_in": self.weight_init.init(k1, (e, n_in, d_ff), n_in, d_ff, dt),
+            "b_in": jnp.zeros((e, d_ff), dt),
+            "w_out": self.weight_init.init(k2, (e, d_ff, self.n_out), d_ff,
+                                           self.n_out, dt),
+            "b_out": jnp.zeros((e, self.n_out), dt),
+        }
+
+    def init_state(self, input_type):
+        return {"moe_aux_loss": jnp.zeros((), jnp.float32)}
+
+    def apply(self, params, state, x, ctx):
+        from deeplearning4j_tpu.parallel.moe import moe_ffn
+        ctx, dk = ctx.split_rng()
+        x = self.maybe_dropout(x, ctx, dk)
+        # (N, T) padding mask for sequence inputs: padded tokens are not
+        # routed, consume no capacity, and don't skew the aux loss
+        tmask = ctx.mask if (ctx.mask is not None and x.ndim == 3) else None
+        out = moe_ffn(x, params["gate"], params["w_in"], params["b_in"],
+                      params["w_out"], params["b_out"], top_k=self.top_k,
+                      capacity_factor=self.capacity_factor,
+                      activation=self.activation.apply, token_mask=tmask)
+        aux = (self.aux_weight * out.aux_loss
+               + self.z_weight * out.router_z_loss)
+        return out.y, {"moe_aux_loss": aux}

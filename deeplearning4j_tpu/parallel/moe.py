@@ -1,0 +1,155 @@
+"""Expert parallelism: mixture-of-experts FFN with top-k routing.
+
+ABSENT in the reference (SURVEY §2.11 row 7); designed fresh per SURVEY
+§7.2 stage 7. GShard/Switch-style dense dispatch: routing builds
+(tokens, experts, capacity) dispatch/combine tensors so the whole layer is
+three einsums + the expert FFN — fully static shapes, MXU-friendly, no
+gather/scatter. Expert parallelism is expressed the XLA-native way: the
+expert-stacked weights and the (E, C, d) expert-batch tensor carry
+sharding constraints on the ``expert`` mesh axis, and GSPMD inserts the
+all-to-all dispatch/return collectives over ICI — no hand-written
+communication (the reference's Aeron mesh analog is the compiler).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+EXPERT_AXIS = "expert"
+
+_default_mesh: Optional[Mesh] = None
+_default_axis: str = EXPERT_AXIS
+
+
+def set_default_mesh(mesh: Optional[Mesh], axis: str = EXPERT_AXIS) -> None:
+    """Install the mesh used for expert-sharding constraints. Training
+    code sets this once; layers then shard without threading a mesh
+    through the (serializable) layer configs."""
+    global _default_mesh, _default_axis
+    _default_mesh = mesh
+    _default_axis = axis
+
+
+def _constrain(x: jnp.ndarray, spec: P) -> jnp.ndarray:
+    if _default_mesh is None or _default_axis not in _default_mesh.shape:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(_default_mesh, spec))
+
+
+@dataclasses.dataclass
+class MoEOutput:
+    y: jnp.ndarray              # (tokens..., d_out) combined expert outputs
+    aux_loss: jnp.ndarray       # load-balancing loss (scalar)
+    router_z_loss: jnp.ndarray  # router logit magnitude penalty (scalar)
+
+
+def route_top_k(logits: jnp.ndarray, k: int, capacity: int,
+                token_mask: Optional[jnp.ndarray] = None):
+    """Top-k routing → dense dispatch/combine tensors.
+
+    logits: (T, E). token_mask: optional (T,) validity mask — masked
+    (padding) tokens are never dispatched, consume no expert capacity,
+    and are excluded from the aux/z statistics. Returns (dispatch
+    (T,E,C) bool-ish float, combine (T,E,C) float, aux_loss, z_loss).
+    Tokens overflowing an expert's capacity C are dropped (combine
+    weight 0) — Switch semantics.
+    """
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), -1)
+    tm = (jnp.ones((t,), jnp.float32) if token_mask is None
+          else token_mask.reshape(-1).astype(jnp.float32))
+    n_valid = jnp.maximum(jnp.sum(tm), 1.0)
+
+    # aux loss (Switch eq.4): E * sum_e( frac_tokens_e * mean_prob_e ),
+    # computed from the top-1 assignment over VALID tokens only.
+    top1 = jnp.argmax(probs, -1)
+    frac = jnp.sum(jax.nn.one_hot(top1, e, dtype=jnp.float32)
+                   * tm[:, None], 0) / n_valid
+    aux = e * jnp.sum(frac * jnp.sum(probs * tm[:, None], 0) / n_valid)
+    z = jnp.sum(jax.nn.logsumexp(logits.astype(jnp.float32), -1) ** 2
+                * tm) / n_valid
+
+    dispatch = jnp.zeros((t, e, capacity), jnp.float32)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    # Iterate the k choices (k is tiny and static); later choices see
+    # occupancy from earlier ones via the running per-expert counts.
+    counts = jnp.zeros((e,), jnp.int32)
+    valid = tm > 0
+    masked = probs * tm[:, None]
+    for _ in range(k):
+        choice = jnp.argmax(masked, -1)                     # (T,)
+        gate = jnp.take_along_axis(masked, choice[:, None], 1)[:, 0]
+        sel = jax.nn.one_hot(choice, e, dtype=jnp.int32)     # (T, E)
+        # position of each token within its chosen expert's queue;
+        # padding tokens don't advance the queue or claim a slot
+        sel_eff = sel * valid[:, None].astype(jnp.int32)
+        pos_in_expert = (jnp.cumsum(sel_eff, 0) - sel_eff) + counts[None, :]
+        pos = jnp.sum(sel_eff * pos_in_expert, -1)           # (T,)
+        keep = jnp.logical_and(pos < capacity, valid)
+        oh_pos = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)
+        d = (sel_eff.astype(jnp.float32)[:, :, None] * oh_pos[:, None, :]
+             * keep[:, None, None])
+        dispatch = dispatch + d
+        combine = combine + d * gate[:, None, None]
+        counts = counts + jnp.sum(sel_eff * keep[:, None].astype(jnp.int32),
+                                  0)
+        masked = masked * (1.0 - sel.astype(jnp.float32))    # exclude chosen
+    return dispatch, combine, aux, z
+
+
+def moe_ffn(x: jnp.ndarray,
+            gate_w: jnp.ndarray,
+            w_in: jnp.ndarray, b_in: jnp.ndarray,
+            w_out: jnp.ndarray, b_out: jnp.ndarray,
+            *,
+            top_k: int = 2,
+            capacity_factor: float = 1.25,
+            activation=jax.nn.gelu,
+            token_mask: Optional[jnp.ndarray] = None) -> MoEOutput:
+    """Mixture-of-experts FFN over the last dim of ``x``.
+
+    x: (..., d_model); gate_w: (d_model, E);
+    w_in: (E, d_model, d_ff); b_in: (E, d_ff);
+    w_out: (E, d_ff, d_model); b_out: (E, d_model).
+    token_mask: optional validity mask broadcastable to x.shape[:-1]
+    (padding tokens are not routed; their output is 0).
+    """
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    xt = x.reshape(-1, d)
+    t = xt.shape[0]
+    e = gate_w.shape[-1]
+    capacity = max(1, int(capacity_factor * top_k * t / e))
+
+    flat_mask = None
+    if token_mask is not None:
+        flat_mask = jnp.broadcast_to(
+            token_mask, orig_shape[:-1]).reshape(-1)
+
+    logits = xt @ gate_w.astype(xt.dtype)
+    dispatch, combine, aux, z = route_top_k(logits, top_k, capacity,
+                                            token_mask=flat_mask)
+    dispatch = dispatch.astype(xt.dtype)
+    combine = combine.astype(xt.dtype)
+
+    # (T,E,C),(T,d) -> (E,C,d): the all-to-all boundary under GSPMD.
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, xt)
+    expert_in = _constrain(expert_in, P(_default_axis))
+    w_in = _constrain(w_in, P(_default_axis))
+    w_out = _constrain(w_out, P(_default_axis))
+
+    h = activation(jnp.einsum("ecd,edf->ecf", expert_in, w_in)
+                   + b_in[:, None, :].astype(xt.dtype))
+    expert_out = (jnp.einsum("ecf,efd->ecd", h, w_out)
+                  + b_out[:, None, :].astype(xt.dtype))
+    expert_out = _constrain(expert_out, P(_default_axis))
+
+    y = jnp.einsum("tec,ecd->td", combine, expert_out)
+    return MoEOutput(y.reshape(orig_shape[:-1] + (y.shape[-1],)),
+                     aux.astype(jnp.float32), z.astype(jnp.float32))

@@ -1,0 +1,118 @@
+"""Pipeline parallelism: GPipe-style microbatched stage execution.
+
+ABSENT in the reference (SURVEY §2.11 row 7 — no PP/TP/SP/EP anywhere);
+designed fresh for TPU per SURVEY §7.2 stage 7 / §7.3 item 4. The design is
+the canonical TPU pipelining recipe (scaling-book style): the ``pipe`` mesh
+axis holds one pipeline *stage* per device slice; activations move
+stage-to-stage with ``lax.ppermute`` hops over ICI neighbours; a
+``lax.scan`` over ticks runs ``num_microbatches + num_stages - 1`` steps
+(the GPipe bubble). Everything is pure, differentiable jax: ``jax.grad``
+through this function IS the backward pipeline (the VJP of ``ppermute`` is
+the reverse permute, so the cool-down schedule falls out of autodiff — no
+hand-written 1F1B machinery).
+
+Constraints (standard for SPMD pipelining):
+- stages are *homogeneous*: one ``stage_fn`` whose params are stacked with
+  a leading ``num_stages`` dim (the transformer-block case). Heterogeneous
+  first/last layers (embed/unembed) stay outside the pipelined region.
+- activation shape is identical at every stage boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+PIPE_AXIS = "pipe"
+
+
+def stack_stage_params(params_per_stage: Sequence[Any]) -> Any:
+    """Stack a list of per-stage parameter pytrees (identical structure)
+    into one pytree with a leading ``num_stages`` dim — the layout
+    ``pipeline_apply`` expects (shard dim 0 over the pipe axis)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves, 0), *params_per_stage)
+
+
+def _pipeline_local(stacked_params, x_mb, stage_fn, axis_name: str,
+                    num_microbatches: int):
+    """Per-device body under shard_map.
+
+    stacked_params: this stage's params, leading dim 1 (shard of the stack).
+    x_mb: (num_microbatches, mb, ...) — full microbatch stream (replicated;
+          only stage 0 reads it).
+    """
+    n_stages = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    my_params = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
+
+    mb_shape = x_mb.shape[1:]
+    n_ticks = num_microbatches + n_stages - 1
+
+    # stage i sends to i+1; the wraparound last→0 edge carries garbage that
+    # stage 0 never reads (it always selects from the input stream).
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    out0 = jnp.zeros((num_microbatches,) + mb_shape, x_mb.dtype)
+    recv0 = jnp.zeros(mb_shape, x_mb.dtype)
+
+    def tick(carry, t):
+        recv, out = carry
+        # Stage 0 ingests microbatch t (clamped; ticks ≥ M recompute the
+        # last microbatch into the bubble — discarded downstream).
+        inp = lax.dynamic_index_in_dim(
+            x_mb, jnp.minimum(t, num_microbatches - 1), 0, keepdims=False)
+        x_in = jnp.where(stage == 0, inp, recv)
+        y = stage_fn(my_params, x_in)
+        # Last stage records microbatch (t - (n_stages-1)) once warm.
+        mb_idx = jnp.clip(t - (n_stages - 1), 0, num_microbatches - 1)
+        record = jnp.logical_and(stage == n_stages - 1, t >= n_stages - 1)
+        cur = lax.dynamic_index_in_dim(out, mb_idx, 0, keepdims=False)
+        out = lax.dynamic_update_index_in_dim(
+            out, jnp.where(record, y, cur), mb_idx, 0)
+        recv = lax.ppermute(y, axis_name, perm)
+        return (recv, out), None
+
+    (_, out), _ = lax.scan(tick, (recv0, out0), jnp.arange(n_ticks))
+    # Replicate the last stage's output buffer to every stage (psum of a
+    # one-hot-selected buffer == broadcast from last stage).
+    out = lax.psum(jnp.where(stage == n_stages - 1, out,
+                             jnp.zeros_like(out)), axis_name)
+    return out
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+                   stacked_params: Any,
+                   x: jnp.ndarray,
+                   mesh: Mesh,
+                   *,
+                   axis: str = PIPE_AXIS,
+                   num_microbatches: Optional[int] = None) -> jnp.ndarray:
+    """Run ``x`` through ``num_stages`` copies of ``stage_fn`` pipelined
+    over ``mesh[axis]``.
+
+    stage_fn: (stage_params, activation(mb, ...)) -> activation(mb, ...).
+    stacked_params: pytree, leaves with leading dim == mesh.shape[axis].
+    x: (batch, ...) global batch; split into ``num_microbatches`` equal
+       microbatches along dim 0 (default: one per stage).
+    Returns stage_fn^S applied to x, shape (batch, ...), replicated over
+    the pipe axis.
+    """
+    n_stages = mesh.shape[axis]
+    m = num_microbatches or n_stages
+    if x.shape[0] % m != 0:
+        raise ValueError(f"batch {x.shape[0]} not divisible into {m}"
+                         " microbatches")
+    x_mb = x.reshape((m, x.shape[0] // m) + x.shape[1:])
+
+    pspec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    fn = jax.shard_map(
+        lambda p, xm: _pipeline_local(p, xm, stage_fn, axis, m),
+        mesh=mesh, in_specs=(pspec, P()), out_specs=P(),
+        check_vma=False)
+    out_mb = fn(stacked_params, x_mb)
+    return out_mb.reshape((x.shape[0],) + out_mb.shape[2:])

@@ -825,7 +825,7 @@ class FaceNetNN4Small2(ZooModel):
                         f"{name}_bn")
             return f"{name}_a"
 
-        def inception(name, src, c3r, c3, c5r, c5, cp, c1, pool_stride=1,
+        def inception(name, src, c3r, c3, c5r, c5, cp, c1,
                       strided=False):
             """FaceNetHelper.appendGraph-style mixed block; ``strided``
             blocks (3c, 4e) drop the 1x1 branch and downsample."""
@@ -841,7 +841,7 @@ class FaceNetNN4Small2(ZooModel):
                 branches.append(b5)
             g.add_layer(f"{name}_pool", SubsamplingLayer(
                 kernel_size=(3, 3),
-                stride=(2, 2) if strided else (pool_stride, pool_stride),
+                stride=(2, 2) if strided else (1, 1),
                 convolution_mode=ConvolutionMode.SAME), src)
             if cp:
                 branches.append(conv(f"{name}_pp", f"{name}_pool", cp,
