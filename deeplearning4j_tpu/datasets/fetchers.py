@@ -63,6 +63,27 @@ def _synthetic_image_classes(num: int, h: int, w: int, c: int, classes: int,
     return images.astype(np.float32), labels
 
 
+class _ArrayBackedIterator(DataSetIterator):
+    """Shared delegation for fetcher-backed iterators: subclasses build a
+    DataSet and call ``_wrap``; iteration/reset delegate to one
+    ArrayDataSetIterator."""
+
+    def _wrap(self, ds: DataSet, batch_size: int, seed: int,
+              shuffle: bool = True):
+        self._it = ArrayDataSetIterator(ds, batch_size, shuffle=shuffle,
+                                        seed=seed, drop_last=True)
+
+    def __iter__(self):
+        return iter(self._it)
+
+    def reset(self):
+        self._it.reset()
+
+    @property
+    def batch_size(self):
+        return self._it.batch_size
+
+
 class MnistDataFetcher:
     """Reads the canonical IDX-format files if cached locally, else builds
     a synthetic 10-class 28x28 set (reference: MnistDataFetcher)."""
@@ -109,7 +130,7 @@ class MnistDataFetcher:
             return np.frombuffer(f.read(), np.uint8).astype(np.int64)
 
 
-class MnistDataSetIterator(DataSetIterator):
+class MnistDataSetIterator(_ArrayBackedIterator):
     """(reference: MnistDataSetIterator) — yields flattened 784-float
     features + one-hot 10 labels."""
 
@@ -121,18 +142,9 @@ class MnistDataSetIterator(DataSetIterator):
         self._it = ArrayDataSetIterator(ds, batch_size, shuffle=shuffle,
                                         seed=seed, drop_last=True)
 
-    def __iter__(self):
-        return iter(self._it)
-
-    def reset(self):
-        self._it.reset()
-
-    @property
-    def batch_size(self):
-        return self._it.batch_size
 
 
-class IrisDataSetIterator(DataSetIterator):
+class IrisDataSetIterator(_ArrayBackedIterator):
     """(reference: IrisDataSetIterator) — the classic 150x4 set, generated
     deterministically from the published means/stds when no cache exists."""
 
@@ -154,15 +166,6 @@ class IrisDataSetIterator(DataSetIterator):
         ds = DataSet(x[perm], _one_hot(y[perm], 3))
         self._it = ArrayDataSetIterator(ds, batch_size)
 
-    def __iter__(self):
-        return iter(self._it)
-
-    def reset(self):
-        self._it.reset()
-
-    @property
-    def batch_size(self):
-        return self._it.batch_size
 
 
 class TinyImageNetFetcher:
@@ -184,22 +187,213 @@ class TinyImageNetFetcher:
                                         self.CLASSES, self.seed)
 
 
-class TinyImageNetDataSetIterator(DataSetIterator):
+class TinyImageNetDataSetIterator(_ArrayBackedIterator):
     def __init__(self, batch_size: int, subset: int = 10000, seed: int = 7,
                  num_classes: Optional[int] = None):
         images, labels = TinyImageNetFetcher(subset, seed).fetch()
         n_cls = num_classes or TinyImageNetFetcher.CLASSES
         labels = labels % n_cls
         ds = DataSet(images, _one_hot(labels, n_cls))
-        self._it = ArrayDataSetIterator(ds, batch_size, shuffle=True,
-                                        seed=seed, drop_last=True)
+        self._wrap(ds, batch_size, seed)
 
-    def __iter__(self):
-        return iter(self._it)
 
-    def reset(self):
-        self._it.reset()
 
-    @property
-    def batch_size(self):
-        return self._it.batch_size
+class EmnistDataSetIterator(_ArrayBackedIterator):
+    """(reference: EmnistDataSetIterator + EmnistDataFetcher) — MNIST-format
+    IDX files per EMNIST split; synthetic fallback with the split's class
+    count. Splits mirror EmnistDataSetIterator.Set."""
+
+    SETS = {"COMPLETE": 62, "MERGE": 47, "BALANCED": 47, "LETTERS": 26,
+            "DIGITS": 10, "MNIST": 10}
+
+    def __init__(self, batch_size: int, dataset: str = "BALANCED",
+                 train: bool = True, subset: Optional[int] = None,
+                 seed: int = 123):
+        dataset = dataset.upper()
+        if dataset not in self.SETS:
+            raise ValueError(f"unknown EMNIST split {dataset!r}; "
+                             f"one of {sorted(self.SETS)}")
+        n_cls = self.SETS[dataset]
+        base = os.path.join(DATA_DIR, "emnist")
+        prefix = f"emnist-{dataset.lower()}-" + ("train" if train else "test")
+        img_path = os.path.join(base, f"{prefix}-images-idx3-ubyte.gz")
+        lbl_path = os.path.join(base, f"{prefix}-labels-idx1-ubyte.gz")
+        if os.path.exists(img_path) and os.path.exists(lbl_path):
+            images = MnistDataFetcher._read_idx_images(img_path)
+            labels = MnistDataFetcher._read_idx_labels(lbl_path)
+            if dataset == "LETTERS":
+                labels = labels - 1  # EMNIST letters are 1-indexed (a=1)
+            labels = labels % n_cls
+            if subset:
+                images, labels = images[:subset], labels[:subset]
+        else:
+            n = min(subset or 10000, 10000)
+            images4d, labels = _synthetic_image_classes(
+                n, 28, 28, 1, n_cls, seed + (0 if train else 1))
+            images = images4d.reshape(n, 784)
+        ds = DataSet(images.astype(np.float32), _one_hot(labels, n_cls))
+        self.num_classes = n_cls
+        self._wrap(ds, batch_size, seed)
+
+
+
+class SvhnDataFetcher:
+    """32x32x3 street-view house numbers, 10 classes (reference:
+    SvhnDataFetcher). Reads cached ``svhn/{train,test}_32x32.npz`` with
+    arrays ``X`` (N,32,32,3 uint8) and ``y`` (N,); synthetic fallback."""
+
+    H = W = 32
+    C = 3
+    CLASSES = 10
+
+    def __init__(self, train: bool = True, subset: Optional[int] = None,
+                 seed: int = 11):
+        self.train = train
+        self.subset = subset
+        self.seed = seed
+
+    def fetch(self) -> Tuple[np.ndarray, np.ndarray]:
+        split = "train" if self.train else "test"
+        path = os.path.join(DATA_DIR, "svhn", f"{split}_32x32.npz")
+        if os.path.exists(path):
+            with np.load(path) as z:
+                images = z["X"].astype(np.float32) / 255.0
+                labels = z["y"].astype(np.int64) % self.CLASSES
+            if self.subset:
+                images, labels = images[:self.subset], labels[:self.subset]
+            return images, labels
+        n = min(self.subset or 5000, 5000)
+        return _synthetic_image_classes(
+            n, self.H, self.W, self.C, self.CLASSES,
+            self.seed + (0 if self.train else 1))
+
+
+class SvhnDataSetIterator(_ArrayBackedIterator):
+    def __init__(self, batch_size: int, train: bool = True,
+                 subset: Optional[int] = None, seed: int = 11):
+        images, labels = SvhnDataFetcher(train, subset, seed).fetch()
+        ds = DataSet(images, _one_hot(labels, SvhnDataFetcher.CLASSES))
+        self._wrap(ds, batch_size, seed)
+
+
+
+class CifarDataSetIterator(_ArrayBackedIterator):
+    """32x32x3, 10 classes (reference: CifarDataSetIterator). Reads the
+    canonical ``cifar-10-batches-bin`` layout if cached, else synthetic."""
+
+    H = W = 32
+    C = 3
+    CLASSES = 10
+
+    def __init__(self, batch_size: int, train: bool = True,
+                 subset: Optional[int] = None, seed: int = 17):
+        base = os.path.join(DATA_DIR, "cifar-10-batches-bin")
+        files = ([f"data_batch_{i}.bin" for i in range(1, 6)] if train
+                 else ["test_batch.bin"])
+        paths = [os.path.join(base, f) for f in files]
+        if all(os.path.exists(p) for p in paths):
+            images, labels = self._read_bin(paths)
+            if subset:
+                images, labels = images[:subset], labels[:subset]
+        else:
+            n = min(subset or 5000, 5000)
+            images, labels = _synthetic_image_classes(
+                n, self.H, self.W, self.C, self.CLASSES,
+                seed + (0 if train else 1))
+        ds = DataSet(images, _one_hot(labels, self.CLASSES))
+        self._wrap(ds, batch_size, seed)
+
+    @classmethod
+    def _read_bin(cls, paths) -> Tuple[np.ndarray, np.ndarray]:
+        rec = 1 + 3072
+        imgs, lbls = [], []
+        for p in paths:
+            raw = np.fromfile(p, np.uint8).reshape(-1, rec)
+            lbls.append(raw[:, 0].astype(np.int64))
+            chw = raw[:, 1:].reshape(-1, 3, 32, 32)
+            imgs.append(chw.transpose(0, 2, 3, 1).astype(np.float32) / 255.0)
+        return np.concatenate(imgs), np.concatenate(lbls)
+
+
+
+class LFWDataSetIterator(_ArrayBackedIterator):
+    """Labeled-faces-in-the-wild (reference: LFWDataSetIterator). The
+    reference decodes JPEGs via DataVec's image reader; here a cached
+    ``lfw/lfw.npz`` (``X`` float images NHWC, ``y`` int labels) is used,
+    else a synthetic multi-class face-shaped set."""
+
+    def __init__(self, batch_size: int, num_examples: int = 1000,
+                 image_shape: Tuple[int, int, int] = (64, 64, 3),
+                 num_labels: int = 40, train: bool = True, seed: int = 42):
+        h, w, c = image_shape
+        path = os.path.join(DATA_DIR, "lfw", "lfw.npz")
+        if os.path.exists(path):
+            with np.load(path) as z:
+                images = z["X"].astype(np.float32)
+                labels = z["y"].astype(np.int64) % num_labels
+            images, labels = images[:num_examples], labels[:num_examples]
+        else:
+            images, labels = _synthetic_image_classes(
+                min(num_examples, 2000), h, w, c, num_labels,
+                seed + (0 if train else 1))
+        self.num_labels = num_labels
+        ds = DataSet(images, _one_hot(labels, num_labels))
+        self._wrap(ds, batch_size, seed)
+
+
+
+class UciSequenceDataSetIterator(_ArrayBackedIterator):
+    """UCI synthetic-control time series: 600 univariate length-60 series,
+    6 classes (reference: UciSequenceDataFetcher/-Iterator). Reads cached
+    ``uci/synthetic_control.data`` (600x60 whitespace floats, class = row
+    block of 100), else generates the same six regimes procedurally."""
+
+    CLASSES = 6
+    LENGTH = 60
+
+    def __init__(self, batch_size: int, train: bool = True, seed: int = 23):
+        path = os.path.join(DATA_DIR, "uci", "synthetic_control.data")
+        if os.path.exists(path):
+            series = np.loadtxt(path).astype(np.float32)
+            labels = np.repeat(np.arange(6), 100)
+        else:
+            series, labels = self._synthesize(seed)
+        # reference split: alternating 450 train / 150 test after shuffle
+        rng = np.random.default_rng(seed)
+        order = rng.permutation(series.shape[0])
+        cut = int(0.75 * len(order))
+        keep = order[:cut] if train else order[cut:]
+        series, labels = series[keep], labels[keep]
+        # normalize per-series, shape (N, T, 1)
+        mu = series.mean(axis=1, keepdims=True)
+        sd = series.std(axis=1, keepdims=True) + 1e-6
+        feats = ((series - mu) / sd)[:, :, None].astype(np.float32)
+        # sequence labels: one-hot at every step (RnnOutputLayer format)
+        lab = np.repeat(_one_hot(labels, self.CLASSES)[:, None, :],
+                        self.LENGTH, axis=1)
+        ds = DataSet(feats, lab)
+        self._wrap(ds, batch_size, seed)
+
+    @classmethod
+    def _synthesize(cls, seed: int) -> Tuple[np.ndarray, np.ndarray]:
+        rng = np.random.default_rng(seed)
+        t = np.arange(cls.LENGTH, dtype=np.float32)
+        rows, labels = [], []
+        for k in range(cls.CLASSES):
+            for _ in range(100):
+                base = 30 + rng.normal(0, 2, cls.LENGTH)
+                if k == 1:    # cyclic
+                    base += 15 * np.sin(2 * np.pi * t / rng.uniform(10, 15))
+                elif k == 2:  # increasing trend
+                    base += rng.uniform(0.2, 0.5) * t
+                elif k == 3:  # decreasing trend
+                    base -= rng.uniform(0.2, 0.5) * t
+                elif k == 4:  # upward shift
+                    base[cls.LENGTH // 2:] += rng.uniform(7.5, 20)
+                elif k == 5:  # downward shift
+                    base[cls.LENGTH // 2:] -= rng.uniform(7.5, 20)
+                rows.append(base)
+                labels.append(k)
+        return (np.asarray(rows, np.float32),
+                np.asarray(labels, np.int64))
+

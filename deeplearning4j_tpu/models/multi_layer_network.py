@@ -53,6 +53,7 @@ class MultiLayerNetwork(BaseModel):
         self._input_types = conf.layer_input_types()
         self._output_fn = None
         self._loss_eval_fn = None
+        self._tbptt_step = None
 
     @property
     def conf_global(self):
@@ -92,10 +93,13 @@ class MultiLayerNetwork(BaseModel):
 
     # ---- functional forward --------------------------------------------
     def _forward(self, params, model_state, x, fmask, train: bool, rng,
-                 upto: Optional[int] = None, collect: bool = False):
+                 upto: Optional[int] = None, collect: bool = False,
+                 carries: Optional[dict] = None):
         """Pure forward through layers [0, upto). Returns (activation,
         new_state) or (list_of_activations, new_state) when collect
-        (reference: feedForwardToLayer:955)."""
+        (reference: feedForwardToLayer:955). ``carries`` maps recurrent
+        layer name → initial hidden state (TBPTT chunk chaining,
+        reference: rnnActivateUsingStoredState:2881)."""
         g = self.conf.global_config
         x = _compute_cast(jnp.asarray(x), g.compute_dtype)
         n = len(self.layers) if upto is None else upto
@@ -115,20 +119,24 @@ class MultiLayerNetwork(BaseModel):
                     lambda a: a.astype(jnp.bfloat16)
                     if jnp.issubdtype(a.dtype, jnp.floating) else a, lp)
             lp = layer.apply_weight_noise(lp, ctx, key)
-            x, s = layer.apply(lp, model_state.get(layer.name, {}), x, ctx)
+            if carries is not None and layer.name in carries:
+                x, s = layer.apply(lp, model_state.get(layer.name, {}), x,
+                                   ctx, initial_state=carries[layer.name])
+            else:
+                x, s = layer.apply(lp, model_state.get(layer.name, {}), x, ctx)
             new_state[layer.name] = s
             if collect:
                 acts.append(x)
         return (acts if collect else x), new_state
 
     def _loss(self, params, model_state, features, labels, fmask, lmask, rng,
-              iteration):
+              iteration, carries: Optional[dict] = None):
         """Full training loss: forward to the last hidden layer, output
         layer loss, plus L1/L2 (reference: computeGradientAndScore:2360 +
         outputLayer.computeScore)."""
         n = len(self.layers)
         x, new_state = self._forward(params, model_state, features, fmask,
-                                     True, rng, upto=n - 1)
+                                     True, rng, upto=n - 1, carries=carries)
         out_layer = self.layers[-1]
         pp = self._preprocessors.get(n - 1)
         if pp is not None:
@@ -165,6 +173,85 @@ class MultiLayerNetwork(BaseModel):
             loss_fn, self._tx,
             constrain_fn=make_constrain_fn(
                 [l for l in self._constraint_layers()]))
+
+    # ---- truncated BPTT (reference: doTruncatedBPTT:1521, SURVEY §5.7) --
+    def _recurrent_carry_layers(self):
+        from deeplearning4j_tpu.nn.layers.recurrent import LSTM, SimpleRnn
+        return [(l, isinstance(l, LSTM)) for l in self.layers
+                if isinstance(l, (LSTM, SimpleRnn))]
+
+    def _zero_carries(self, batch_size: int):
+        dt = (jnp.bfloat16 if self.conf.global_config.compute_dtype ==
+              "bfloat16" else jnp.float32)
+        out = {}
+        for layer, is_lstm in self._recurrent_carry_layers():
+            h = jnp.zeros((batch_size, layer.n_out), dt)
+            out[layer.name] = (h, h) if is_lstm else h
+        return out
+
+    def _build_tbptt_step(self):
+        import optax
+        from deeplearning4j_tpu.optimize.solver import TrainState
+        constrain_fn = make_constrain_fn(list(self._constraint_layers()))
+        carry_layers = self._recurrent_carry_layers()
+
+        def step(ts, features, labels, fmask, lmask, rng, carries):
+            def lf(params):
+                return self._loss(params, ts.model_state, features, labels,
+                                  fmask, lmask, rng, ts.iteration,
+                                  carries=carries)
+            (loss, new_ms), grads = jax.value_and_grad(
+                lf, has_aux=True)(ts.params)
+            updates, new_opt = self._tx.update(grads, ts.opt_state, ts.params)
+            new_params = optax.apply_updates(ts.params, updates)
+            if constrain_fn is not None:
+                new_params = constrain_fn(new_params)
+            # carries cross the chunk boundary with gradients cut — this IS
+            # the truncation (reference: tbpttBackLength; here back==fwd)
+            new_carries = {}
+            for layer, is_lstm in carry_layers:
+                s = new_ms[layer.name]
+                c = ((s["last_h"], s["last_c"]) if is_lstm else s["last_h"])
+                new_carries[layer.name] = jax.lax.stop_gradient(c)
+            return (TrainState(new_params, new_ms, new_opt,
+                               ts.iteration + 1), loss, new_carries)
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    def _fit_batch(self, batch, etl_ms: float = 0.0):
+        conf = self.conf
+        feats = np.asarray(batch.features)
+        if (conf.backprop_type != "tbptt" or feats.ndim != 3
+                or not self._recurrent_carry_layers()):
+            return super()._fit_batch(batch, etl_ms=etl_ms)
+        if self._tbptt_step is None:
+            self._tbptt_step = self._build_tbptt_step()
+        k = conf.tbptt_fwd_length
+        T = feats.shape[1]
+        labels = np.asarray(batch.labels)
+        seq_labels = labels.ndim == 3
+        fmask = (None if batch.features_mask is None
+                 else np.asarray(batch.features_mask))
+        lmask = (None if batch.labels_mask is None
+                 else np.asarray(batch.labels_mask))
+        carries = self._zero_carries(feats.shape[0])
+        loss = None
+        for lo in range(0, T, k):
+            hi = min(lo + k, T)
+            if hi - lo < k and lo > 0:
+                break  # drop ragged tail chunk (keeps one compiled shape)
+            self._rng, step_key = jax.random.split(self._rng)
+            f = jnp.asarray(feats[:, lo:hi])
+            l = jnp.asarray(labels[:, lo:hi] if seq_labels else labels)
+            fm = None if fmask is None else jnp.asarray(fmask[:, lo:hi])
+            lm = None if lmask is None else jnp.asarray(lmask[:, lo:hi])
+            self.train_state, loss, carries = self._tbptt_step(
+                self.train_state, f, l, fm, lm, step_key, carries)
+        it = int(self.train_state.iteration)
+        for lst in self.listeners:
+            lst.iteration_done(self, it, self.epoch_count, loss, etl_ms,
+                               batch.num_examples())
+        self._last_loss = loss
 
     # ---- inference ------------------------------------------------------
     def output(self, features, train: bool = False, mask=None):

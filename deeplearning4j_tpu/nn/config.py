@@ -106,6 +106,35 @@ class ListBuilder:
         self._layers: List[Layer] = []
         self._input_type: Optional[InputType] = None
         self._preprocessors: Dict[int, Preprocessor] = {}
+        self._backprop_type: str = "standard"
+        self._tbptt_fwd_length: int = 20
+        self._tbptt_back_length: int = 20
+
+    def backprop_type(self, kind: str) -> "ListBuilder":
+        """'standard' or 'tbptt' (reference: BackpropType.TruncatedBPTT,
+        MultiLayerConfiguration builder — SURVEY §5.7)."""
+        kind = kind.lower()
+        if kind not in ("standard", "tbptt", "truncated_bptt"):
+            raise ValueError(f"unknown backprop type {kind!r}")
+        self._backprop_type = "tbptt" if kind != "standard" else "standard"
+        return self
+
+    def tbptt_fwd_length(self, k: int) -> "ListBuilder":
+        self._tbptt_fwd_length = int(k)
+        return self
+
+    def tbptt_back_length(self, k: int) -> "ListBuilder":
+        """Stored for config parity; truncation happens at the chunk
+        boundary, so the effective backward length always equals
+        tbptt_fwd_length (a warning is emitted when they differ)."""
+        self._tbptt_back_length = int(k)
+        if self._tbptt_back_length != self._tbptt_fwd_length:
+            import warnings
+            warnings.warn(
+                "tbptt_back_length != tbptt_fwd_length: gradients truncate "
+                "at the fwd-length chunk boundary; back length is ignored",
+                stacklevel=2)
+        return self
 
     def layer(self, layer: Layer) -> "ListBuilder":
         self._layers.append(layer)
@@ -137,6 +166,9 @@ class ListBuilder:
             layers=tuple(layers),
             input_type=self._input_type,
             manual_preprocessors=dict(self._preprocessors),
+            backprop_type=self._backprop_type,
+            tbptt_fwd_length=self._tbptt_fwd_length,
+            tbptt_back_length=self._tbptt_back_length,
         )
         conf.resolve_shapes()  # validate at build time, like the reference
         return conf
@@ -151,6 +183,13 @@ class MultiLayerConfiguration:
     input_type: Optional[InputType] = None
     manual_preprocessors: Dict[int, Preprocessor] = dataclasses.field(
         default_factory=dict)
+    # Truncated BPTT (reference: BackpropType.TruncatedBPTT +
+    # tbpttFwdLength/tbpttBackLength — SURVEY §5.7). On TPU the truncation
+    # boundary is the jitted-step boundary: each tbptt_fwd_length chunk is
+    # one optimizer step and RNN carries cross chunks via stop_gradient.
+    backprop_type: str = "standard"
+    tbptt_fwd_length: int = 20
+    tbptt_back_length: int = 20
 
     def resolve_shapes(self):
         """Compute per-layer input types + auto preprocessors.

