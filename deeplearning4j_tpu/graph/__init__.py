@@ -2,11 +2,14 @@
 
 from deeplearning4j_tpu.graph.api import Edge, Graph, Vertex
 from deeplearning4j_tpu.graph.walks import (
+    Node2VecWalkIterator,
     RandomWalkIterator,
     WeightedRandomWalkIterator,
 )
 from deeplearning4j_tpu.graph.deepwalk import DeepWalk
+from deeplearning4j_tpu.graph.node2vec import Node2Vec
 from deeplearning4j_tpu.graph.vectors import GraphVectors
 
 __all__ = ["Graph", "Vertex", "Edge", "RandomWalkIterator",
-           "WeightedRandomWalkIterator", "DeepWalk", "GraphVectors"]
+           "WeightedRandomWalkIterator", "Node2VecWalkIterator",
+           "DeepWalk", "Node2Vec", "GraphVectors"]

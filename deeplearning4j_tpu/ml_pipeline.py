@@ -1,0 +1,135 @@
+"""Pipeline-style estimator API around networks.
+
+Analog of the reference's ``dl4j-spark-ml`` module (SURVEY §2.11:
+``SparkDl4jNetwork.scala`` / ``SparkDl4jModel`` — Spark ML Pipeline
+stages wrapping a DL4J network). The TPU build has no Spark DataFrames;
+the equivalent composable-pipeline surface is estimator/transformer
+stages over arrays (the scikit-learn convention), so networks slot into
+feature pipelines exactly the way the reference slots into Spark ML.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import ArrayDataSetIterator, DataSet
+
+
+class Transformer:
+    """A fitted stage: transform(X) -> X'."""
+
+    def transform(self, X: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+
+class Estimator:
+    """An unfitted stage: fit(X, y) -> Transformer."""
+
+    def fit(self, X: np.ndarray, y: Optional[np.ndarray] = None
+            ) -> Transformer:
+        raise NotImplementedError
+
+
+class StandardScaler(Estimator):
+    """Feature standardization stage (the VectorAssembler/scaler role in
+    reference pipelines)."""
+
+    class Model(Transformer):
+        def __init__(self, mean: np.ndarray, std: np.ndarray):
+            self.mean = mean
+            self.std = std
+
+        def transform(self, X: np.ndarray) -> np.ndarray:
+            return (np.asarray(X, np.float32) - self.mean) / self.std
+
+    def fit(self, X: np.ndarray, y=None) -> "StandardScaler.Model":
+        X = np.asarray(X, np.float32)
+        return self.Model(X.mean(0), X.std(0) + 1e-8)
+
+
+class NetworkModel(Transformer):
+    """Fitted network stage (reference: SparkDl4jModel.transform adds a
+    prediction column; here transform returns class probabilities)."""
+
+    def __init__(self, model):
+        self.model = model
+
+    def transform(self, X: np.ndarray) -> np.ndarray:
+        return np.asarray(self.model.output(np.asarray(X, np.float32)))
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        return self.transform(X).argmax(axis=-1)
+
+
+class NetworkEstimator(Estimator):
+    """Trains a network from a configuration inside a pipeline
+    (reference: SparkDl4jNetwork(conf, ...).fit(dataset))."""
+
+    def __init__(self, conf, epochs: int = 5, batch_size: int = 32,
+                 model_factory: Optional[Callable] = None):
+        self.conf = conf
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self._factory = model_factory
+
+    def fit(self, X: np.ndarray, y: Optional[np.ndarray] = None
+            ) -> NetworkModel:
+        if y is None:
+            raise ValueError("NetworkEstimator requires labels")
+        if self._factory is not None:
+            model = self._factory(self.conf)
+        else:
+            from deeplearning4j_tpu.models.multi_layer_network import (
+                MultiLayerNetwork)
+            model = MultiLayerNetwork(self.conf)
+        model.init()
+        y = np.asarray(y)
+        if y.ndim == 1:  # integer labels → one-hot, like the reference's
+            n_cls = int(y.max()) + 1
+            oh = np.zeros((len(y), n_cls), np.float32)
+            oh[np.arange(len(y)), y.astype(int)] = 1.0
+            y = oh
+        ds = DataSet(np.asarray(X, np.float32), y)
+        # clamp so datasets smaller than batch_size still yield a batch
+        bs = min(self.batch_size, ds.features.shape[0])
+        it = ArrayDataSetIterator(ds, bs, shuffle=True,
+                                  seed=0, drop_last=True)
+        model.fit(it, epochs=self.epochs)
+        return NetworkModel(model)
+
+
+class PipelineModel(Transformer):
+    def __init__(self, stages: List[Transformer]):
+        self.stages = stages
+
+    def transform(self, X: np.ndarray) -> np.ndarray:
+        for s in self.stages:
+            X = s.transform(X)
+        return X
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        out = self.transform(X)
+        return np.asarray(out).argmax(axis=-1)
+
+
+class Pipeline(Estimator):
+    """Chains estimators/transformers; fitting threads transformed
+    features through (reference: Spark ML Pipeline.fit)."""
+
+    def __init__(self, stages: Sequence[Union[Estimator, Transformer]]):
+        self.stages = list(stages)
+
+    def fit(self, X: np.ndarray, y: Optional[np.ndarray] = None
+            ) -> PipelineModel:
+        fitted: List[Transformer] = []
+        cur = np.asarray(X)
+        for stage in self.stages:
+            if isinstance(stage, Estimator):
+                t = stage.fit(cur, y)
+            else:
+                t = stage
+            cur = t.transform(cur)
+            fitted.append(t)
+        return PipelineModel(fitted)

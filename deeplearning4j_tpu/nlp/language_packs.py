@@ -1,0 +1,328 @@
+"""Language-pack tokenizers: Chinese, Japanese, Korean, and a UIMA-style
+annotator pipeline.
+
+Analogs of the reference's per-language NLP modules (SURVEY §2.7):
+``deeplearning4j-nlp-chinese`` (vendored Ansj segmenter),
+``-japanese`` (Kuromoji), ``-korean`` (KoreanAnalyzer twitter-text), and
+``-uima`` (UIMA annotator pipeline). Those modules vendor large
+dictionary-driven analyzers; here each language gets a self-contained
+statistical/rule segmenter with the same ``TokenizerFactory`` contract, so
+``Word2Vec``/``SequenceVectors`` pipelines work identically across
+languages. A user-supplied dictionary (one word per line, cached under
+``DL4J_TPU_DATA_DIR``) upgrades segmentation quality without code changes
+— the same posture as the dataset fetchers' cache contract.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Iterable, List, Optional, Sequence
+
+from deeplearning4j_tpu.nlp.tokenization import (
+    Tokenizer,
+    TokenizerFactory,
+)
+
+_DATA_DIR = os.environ.get("DL4J_TPU_DATA_DIR",
+                           os.path.expanduser("~/.deeplearning4j_tpu/data"))
+
+
+def _load_dict(name: str) -> Optional[set]:
+    path = os.path.join(_DATA_DIR, "dicts", name)
+    if os.path.exists(path):
+        with open(path, encoding="utf-8") as f:
+            return {line.strip() for line in f if line.strip()}
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Chinese — forward maximum matching over a dictionary, char fallback
+# (reference: deeplearning4j-nlp-chinese vendored Ansj)
+# ---------------------------------------------------------------------------
+
+_CJK = r"一-鿿㐀-䶿"
+# minimal seed vocabulary of common multi-char words so the segmenter is
+# useful out of the box; a cached dict file extends it
+_ZH_SEED = {
+    "中国", "我们", "你们", "他们", "什么", "没有", "可以", "自己",
+    "现在", "知道", "时候", "学习", "机器", "深度", "神经", "网络",
+    "模型", "数据", "训练", "人工", "智能", "因为", "所以", "如果",
+    "但是", "就是", "这个", "那个", "已经", "还是", "或者", "今天",
+    "明天", "问题", "工作", "生活", "世界", "非常", "喜欢", "谢谢",
+}
+
+
+class ChineseTokenizerFactory(TokenizerFactory):
+    """Forward-maximum-matching segmenter (reference:
+    ChineseTokenizerFactory over Ansj). Longest dictionary word wins;
+    unmatched CJK runs fall back to single characters; Latin/digit runs
+    stay whole."""
+
+    def __init__(self, dictionary: Optional[Iterable[str]] = None):
+        super().__init__()
+        words = set(_ZH_SEED)
+        cached = _load_dict("chinese.txt")
+        if cached:
+            words |= cached
+        if dictionary:
+            words |= set(dictionary)
+        self._dict = words
+        self._max_len = max((len(w) for w in words), default=1)
+        self._scanner = re.compile(
+            rf"([{_CJK}]+)|([A-Za-z0-9]+)|(\S)")
+
+    def _segment_cjk(self, run: str) -> List[str]:
+        out = []
+        i = 0
+        n = len(run)
+        while i < n:
+            for l in range(min(self._max_len, n - i), 1, -1):
+                if run[i:i + l] in self._dict:
+                    out.append(run[i:i + l])
+                    i += l
+                    break
+            else:
+                out.append(run[i])
+                i += 1
+        return out
+
+    def create(self, sentence: str) -> Tokenizer:
+        tokens: List[str] = []
+        for cjk, latin, other in self._scanner.findall(sentence):
+            if cjk:
+                tokens.extend(self._segment_cjk(cjk))
+            elif latin:
+                tokens.append(latin)
+        return Tokenizer(tokens, self._pre)
+
+
+# ---------------------------------------------------------------------------
+# Japanese — script-transition segmentation (reference:
+# deeplearning4j-nlp-japanese vendored Kuromoji)
+# ---------------------------------------------------------------------------
+
+_HIRA = r"぀-ゟ"
+_KATA = r"゠-ヿㇰ-ㇿ"
+
+
+class JapaneseTokenizerFactory(TokenizerFactory):
+    """Segments on script transitions (kanji→hiragana starts a new
+    content+inflection unit; katakana runs and Latin runs are single
+    tokens), with hiragana particles split off. This is the classic
+    "tiny segmenter" heuristic family; a cached ``japanese.txt``
+    dictionary refines kanji compound splits via maximum matching."""
+
+    _PARTICLES = {"は", "が", "を", "に", "へ", "と", "で", "の", "も",
+                  "や", "から", "まで", "より", "ね", "よ", "か", "な"}
+
+    def __init__(self, dictionary: Optional[Iterable[str]] = None):
+        super().__init__()
+        d = set(_load_dict("japanese.txt") or ())
+        if dictionary:
+            d |= set(dictionary)
+        self._dict = d
+        self._max_len = max((len(w) for w in self._dict), default=1)
+        self._scanner = re.compile(
+            rf"([{_CJK}]+[{_HIRA}]*)|([{_KATA}]+)|([{_HIRA}]+)"
+            rf"|([A-Za-z0-9]+)|(\S)")
+
+    def _split_compound(self, run: str) -> List[str]:
+        """Maximum-matching split of a kanji(+inflection) run against the
+        dictionary; the whole run stays one token when nothing matches."""
+        if not self._dict:
+            return [run]
+        out: List[str] = []
+        buf = ""  # unmatched span stays one token, not per-char
+        i, n = 0, len(run)
+        while i < n:
+            for l in range(min(self._max_len, n - i), 1, -1):
+                if run[i:i + l] in self._dict:
+                    if buf:
+                        out.append(buf)
+                        buf = ""
+                    out.append(run[i:i + l])
+                    i += l
+                    break
+            else:
+                buf += run[i]
+                i += 1
+        if buf:
+            out.append(buf)
+        return out
+
+    def _split_particles(self, run: str) -> List[str]:
+        # peel trailing particles off a hiragana run, longest first
+        out: List[str] = []
+        while run:
+            for l in (2, 1):
+                if len(run) > l and run[-l:] in self._PARTICLES:
+                    out.insert(0, run[-l:])
+                    run = run[:-l]
+                    break
+            else:
+                out.insert(0, run)
+                break
+        return out
+
+    def create(self, sentence: str) -> Tokenizer:
+        tokens: List[str] = []
+        for kanji_mix, kata, hira, latin, _other in \
+                self._scanner.findall(sentence):
+            if kanji_mix:
+                tokens.extend(self._split_compound(kanji_mix))
+            elif kata:
+                tokens.append(kata)
+            elif hira:
+                tokens.extend(self._split_particles(hira))
+            elif latin:
+                tokens.append(latin)
+        return Tokenizer(tokens, self._pre)
+
+
+# ---------------------------------------------------------------------------
+# Korean — whitespace eojeol + particle (josa) stripping (reference:
+# deeplearning4j-nlp-korean KoreanAnalyzer)
+# ---------------------------------------------------------------------------
+
+
+class KoreanTokenizerFactory(TokenizerFactory):
+    """Splits on whitespace into eojeol, then strips common trailing
+    particles (josa) so inflected forms share a stem token."""
+
+    _JOSA = ("은", "는", "이", "가", "을", "를", "에", "의", "도",
+             "으로", "로", "와", "과", "에서", "에게", "부터", "까지",
+             "입니다", "합니다", "했다", "하다")
+
+    def __init__(self, strip_josa: bool = True):
+        super().__init__()
+        self.strip_josa = strip_josa
+
+    def _strip(self, word: str) -> str:
+        if not self.strip_josa:
+            return word
+        for j in sorted(self._JOSA, key=len, reverse=True):
+            if len(word) > len(j) and word.endswith(j):
+                return word[:-len(j)]
+        return word
+
+    def create(self, sentence: str) -> Tokenizer:
+        tokens = [self._strip(w) for w in re.findall(r"\S+", sentence)]
+        return Tokenizer([t for t in tokens if t], self._pre)
+
+
+# ---------------------------------------------------------------------------
+# UIMA-style annotator pipeline (reference: deeplearning4j-nlp-uima —
+# UimaTokenizerFactory / UimaSentenceIterator over an AnalysisEngine)
+# ---------------------------------------------------------------------------
+
+
+class Annotation:
+    """A typed text span (the CAS annotation analog)."""
+
+    __slots__ = ("type", "begin", "end", "text", "features")
+
+    def __init__(self, type_: str, begin: int, end: int, text: str,
+                 **features):
+        self.type = type_
+        self.begin = begin
+        self.end = end
+        self.text = text
+        self.features = features
+
+    def __repr__(self):
+        return f"Annotation({self.type!r}, {self.begin}, {self.end}, " \
+               f"{self.text!r})"
+
+
+class CAS:
+    """Common Analysis Structure: the document plus annotations by type."""
+
+    def __init__(self, text: str):
+        self.text = text
+        self._by_type: dict = {}
+
+    def add(self, ann: Annotation):
+        self._by_type.setdefault(ann.type, []).append(ann)
+
+    def select(self, type_: str) -> List[Annotation]:
+        return list(self._by_type.get(type_, []))
+
+
+class AnalysisEngine:
+    """An annotator: process(cas) adds annotations."""
+
+    def process(self, cas: CAS) -> None:
+        raise NotImplementedError
+
+
+class SentenceAnnotator(AnalysisEngine):
+    _SPLIT = re.compile(r"[^.!?。！？]+[.!?。！？]?")
+
+    def process(self, cas: CAS) -> None:
+        for m in self._SPLIT.finditer(cas.text):
+            s = m.group().strip()
+            if s:
+                cas.add(Annotation("sentence", m.start(), m.end(), s))
+
+
+class TokenAnnotator(AnalysisEngine):
+    def __init__(self, factory: Optional[TokenizerFactory] = None):
+        from deeplearning4j_tpu.nlp.tokenization import (
+            DefaultTokenizerFactory)
+        self.factory = factory or DefaultTokenizerFactory()
+
+    def process(self, cas: CAS) -> None:
+        sentences = cas.select("sentence") or [
+            Annotation("sentence", 0, len(cas.text), cas.text)]
+        for sent in sentences:
+            pos = sent.begin
+            for tok in self.factory.create(sent.text).get_tokens():
+                found = cas.text.find(tok, pos)
+                b = found if found >= 0 else pos
+                cas.add(Annotation("token", b, b + len(tok), tok))
+                if found >= 0:
+                    pos = found + len(tok)
+
+
+class AnalysisPipeline:
+    """Chains engines over a document (the AnalysisEngine aggregate)."""
+
+    def __init__(self, engines: Sequence[AnalysisEngine]):
+        self.engines = list(engines)
+
+    def process(self, text: str) -> CAS:
+        cas = CAS(text)
+        for e in self.engines:
+            e.process(cas)
+        return cas
+
+
+class UimaTokenizerFactory(TokenizerFactory):
+    """Tokenizes via an annotator pipeline (reference:
+    UimaTokenizerFactory) so custom annotators can rewrite the stream."""
+
+    def __init__(self, pipeline: Optional[AnalysisPipeline] = None):
+        super().__init__()
+        self.pipeline = pipeline or AnalysisPipeline(
+            [SentenceAnnotator(), TokenAnnotator()])
+
+    def create(self, sentence: str) -> Tokenizer:
+        cas = self.pipeline.process(sentence)
+        return Tokenizer([a.text for a in cas.select("token")],
+                         self._pre)
+
+
+class UimaSentenceIterator:
+    """Sentence iterator over documents via the pipeline (reference:
+    UimaSentenceIterator)."""
+
+    def __init__(self, documents: Sequence[str],
+                 pipeline: Optional[AnalysisPipeline] = None):
+        self.documents = list(documents)
+        self.pipeline = pipeline or AnalysisPipeline([SentenceAnnotator()])
+
+    def __iter__(self):
+        for doc in self.documents:
+            for ann in self.pipeline.process(doc).select("sentence"):
+                yield ann.text

@@ -1,0 +1,75 @@
+"""MagicQueue — device-affinity-aware batch distribution.
+
+Analog of the reference's ``MagicQueue``
+(deeplearning4j-core/.../parallelism/MagicQueue.java — SURVEY §2.2): a
+queue that fans incoming minibatches out to per-device buckets so each
+worker always dequeues data already resident on *its* device. The
+reference relocates buffers via the CUDA AffinityManager; here enqueue
+triggers an async ``jax.device_put`` onto the bucket's device, so the
+host→HBM copy overlaps the producer loop and workers dequeue
+device-resident arrays (the infeed side of SPMD training; SURVEY §2.14
+"AffinityManager → device mesh addressing").
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import List, Optional, Sequence
+
+import jax
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+class MagicQueue:
+    """Round-robin per-device buckets with async device placement.
+
+    Modes (reference: MagicQueue.Mode): SEQUENTIAL hands each batch to
+    the next device in turn (data parallelism); THROUGHPUT replicates
+    every batch to all devices (each worker sees the full stream).
+    """
+
+    SEQUENTIAL = "sequential"
+    THROUGHPUT = "throughput"
+
+    def __init__(self, devices: Optional[Sequence] = None,
+                 mode: str = SEQUENTIAL, capacity: int = 8):
+        self.devices = list(devices) if devices else list(jax.devices())
+        if mode not in (self.SEQUENTIAL, self.THROUGHPUT):
+            raise ValueError(f"unknown mode {mode!r}")
+        self.mode = mode
+        self._buckets: List[queue.Queue] = [
+            queue.Queue(maxsize=capacity) for _ in self.devices]
+        self._next = 0
+        self._lock = threading.Lock()
+
+    def _place(self, batch: DataSet, device) -> DataSet:
+        put = lambda a: None if a is None else jax.device_put(a, device)
+        return DataSet(put(batch.features), put(batch.labels),
+                       put(batch.features_mask), put(batch.labels_mask))
+
+    def add(self, batch: DataSet) -> None:
+        """Producer side: place + enqueue (async; device_put does not
+        block on the copy)."""
+        if self.mode == self.THROUGHPUT:
+            for i, dev in enumerate(self.devices):
+                self._buckets[i].put(self._place(batch, dev))
+            return
+        with self._lock:
+            i = self._next
+            self._next = (self._next + 1) % len(self.devices)
+        self._buckets[i].put(self._place(batch, self.devices[i]))
+
+    def poll(self, device_index: int, timeout: float = 1.0
+             ) -> Optional[DataSet]:
+        """Worker side: dequeue the next batch resident on this device."""
+        try:
+            return self._buckets[device_index].get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def size(self, device_index: Optional[int] = None) -> int:
+        if device_index is not None:
+            return self._buckets[device_index].qsize()
+        return sum(b.qsize() for b in self._buckets)

@@ -1,0 +1,155 @@
+"""Cluster provisioning — TPU-VM / GKE job generation.
+
+Analog of the reference's ``deeplearning4j-aws`` module (SURVEY §2.11:
+``ec2/provision/ClusterSetup.java``, ``emr/SparkEMRClient.java``, ``s3/``):
+where the reference provisions EC2/EMR clusters for Spark training, the
+TPU-native equivalent targets Cloud TPU VMs and GKE. This module
+*generates* the provisioning artifacts (gcloud command scripts, GKE
+JobSet-style manifests, multi-host launch wrappers around
+``jax.distributed.initialize``) rather than calling cloud APIs directly,
+so it works air-gapped and the artifacts are auditable before running —
+the same role ClusterSetup's command builders play.
+"""
+
+from __future__ import annotations
+
+import json
+import shlex
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class TpuClusterSpec:
+    """What to provision (reference: ClusterSetup's CLI params)."""
+
+    name: str = "dl4j-tpu-job"
+    accelerator_type: str = "v5litepod-8"   # e.g. v4-32, v5p-128
+    zone: str = "us-central2-b"
+    runtime_version: str = "tpu-ubuntu2204-base"
+    project: Optional[str] = None
+    preemptible: bool = False
+    num_slices: int = 1                      # >1 → multislice over DCN
+    setup_commands: List[str] = field(default_factory=lambda: [
+        "pip install -U jax[tpu] -f "
+        "https://storage.googleapis.com/jax-releases/libtpu_releases.html",
+    ])
+    env: Dict[str, str] = field(default_factory=dict)
+
+
+def gcloud_create_script(spec: TpuClusterSpec) -> str:
+    """gcloud commands that create the TPU VM(s) (ClusterSetup analog)."""
+    lines = ["#!/usr/bin/env bash", "set -euo pipefail", ""]
+    proj = f" --project={shlex.quote(spec.project)}" if spec.project else ""
+    for s in range(spec.num_slices):
+        name = spec.name if spec.num_slices == 1 else f"{spec.name}-s{s}"
+        cmd = (f"gcloud compute tpus tpu-vm create {shlex.quote(name)}"
+               f" --zone={shlex.quote(spec.zone)}"
+               f" --accelerator-type={shlex.quote(spec.accelerator_type)}"
+               f" --version={shlex.quote(spec.runtime_version)}{proj}")
+        if spec.preemptible:
+            cmd += " --preemptible"
+        lines.append(cmd)
+    lines.append("")
+    for s in range(spec.num_slices):
+        name = spec.name if spec.num_slices == 1 else f"{spec.name}-s{s}"
+        for setup in spec.setup_commands:
+            lines.append(
+                f"gcloud compute tpus tpu-vm ssh {shlex.quote(name)}"
+                f" --zone={shlex.quote(spec.zone)}{proj} --worker=all"
+                f" --command={shlex.quote(setup)}")
+    return "\n".join(lines) + "\n"
+
+
+def gcloud_delete_script(spec: TpuClusterSpec) -> str:
+    proj = f" --project={shlex.quote(spec.project)}" if spec.project else ""
+    lines = ["#!/usr/bin/env bash", "set -euo pipefail", ""]
+    for s in range(spec.num_slices):
+        name = spec.name if spec.num_slices == 1 else f"{spec.name}-s{s}"
+        lines.append(
+            f"gcloud compute tpus tpu-vm delete {shlex.quote(name)}"
+            f" --zone={shlex.quote(spec.zone)}{proj} --quiet")
+    return "\n".join(lines) + "\n"
+
+
+def launch_script(spec: TpuClusterSpec, train_command: str) -> str:
+    """Run a training command on every worker of every slice. The command
+    sees standard TPU env (the runtime wires coordinator discovery;
+    ``jax.distributed.initialize()`` picks it up with no args)."""
+    proj = f" --project={shlex.quote(spec.project)}" if spec.project else ""
+    env_prefix = " ".join(
+        f"{k}={shlex.quote(v)}" for k, v in spec.env.items())
+    full = (env_prefix + " " if env_prefix else "") + train_command
+    lines = ["#!/usr/bin/env bash", "set -euo pipefail", ""]
+    for s in range(spec.num_slices):
+        name = spec.name if spec.num_slices == 1 else f"{spec.name}-s{s}"
+        lines.append(
+            f"gcloud compute tpus tpu-vm ssh {shlex.quote(name)}"
+            f" --zone={shlex.quote(spec.zone)}{proj} --worker=all"
+            f" --command={shlex.quote(full)} &")
+    lines.append("wait")
+    return "\n".join(lines) + "\n"
+
+
+def gke_jobset_manifest(spec: TpuClusterSpec, image: str,
+                        train_command: List[str]) -> str:
+    """Kubernetes JobSet-style manifest for TPU slices on GKE (the EMR
+    analog: managed-cluster submission instead of raw VMs)."""
+    chips_per_host = 4
+    topo = spec.accelerator_type
+    manifest = {
+        "apiVersion": "jobset.x-k8s.io/v1alpha2",
+        "kind": "JobSet",
+        "metadata": {"name": spec.name},
+        "spec": {
+            "replicatedJobs": [{
+                "name": "workers",
+                "replicas": spec.num_slices,
+                "template": {"spec": {
+                    "backoffLimit": 0,
+                    "completions": 1,
+                    "parallelism": 1,
+                    "template": {"spec": {
+                        "nodeSelector": {
+                            "cloud.google.com/gke-tpu-accelerator": topo,
+                        },
+                        "containers": [{
+                            "name": "train",
+                            "image": image,
+                            "command": train_command,
+                            "env": [{"name": k, "value": v}
+                                    for k, v in spec.env.items()],
+                            "resources": {"limits": {
+                                "google.com/tpu": chips_per_host}},
+                        }],
+                        "restartPolicy": "Never",
+                    }},
+                }},
+            }],
+        },
+    }
+    return json.dumps(manifest, indent=2)
+
+
+def write_provisioning_bundle(spec: TpuClusterSpec, out_dir: str,
+                              train_command: str = "python train.py"
+                              ) -> List[str]:
+    """Emit create/launch/delete scripts + GKE manifest into out_dir."""
+    import os
+    os.makedirs(out_dir, exist_ok=True)
+    files = {
+        "create_cluster.sh": gcloud_create_script(spec),
+        "launch.sh": launch_script(spec, train_command),
+        "delete_cluster.sh": gcloud_delete_script(spec),
+        "gke_jobset.json": gke_jobset_manifest(
+            spec, "python:3.12", train_command.split()),
+    }
+    written = []
+    for name, content in files.items():
+        path = os.path.join(out_dir, name)
+        with open(path, "w") as f:
+            f.write(content)
+        if name.endswith(".sh"):
+            os.chmod(path, 0o755)
+        written.append(path)
+    return written

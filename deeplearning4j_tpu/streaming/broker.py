@@ -1,0 +1,209 @@
+"""Transports + publisher/consumer client.
+
+Analog of ``NDArrayKafkaClient`` (dl4j-streaming, SURVEY §2.11) with the
+broker abstracted: ``InProcessTransport`` (queue per topic — the test/
+single-host path, like the reference's Camel direct: routes) and
+``TcpTransport`` (length-prefixed frames over a socket — cross-process).
+A Kafka/PubSub transport is the same interface against a real broker.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import socketserver
+import struct
+import threading
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.streaming.serde import NDArrayMessage
+
+
+class Transport:
+    """publish/poll on named topics."""
+
+    def publish(self, topic: str, payload: bytes) -> None:
+        raise NotImplementedError
+
+    def poll(self, topic: str, timeout: float = 1.0) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class InProcessTransport(Transport):
+    """Thread-safe per-topic queues; every subscriber pool shares one
+    stream (competing consumers, like one Kafka consumer group)."""
+
+    def __init__(self, max_queue: int = 1024):
+        self._queues: Dict[str, queue.Queue] = defaultdict(
+            lambda: queue.Queue(maxsize=max_queue))
+        self._lock = threading.Lock()
+
+    def _q(self, topic: str) -> queue.Queue:
+        with self._lock:
+            return self._queues[topic]
+
+    def publish(self, topic: str, payload: bytes) -> None:
+        self._q(topic).put(payload)
+
+    def poll(self, topic: str, timeout: float = 1.0) -> Optional[bytes]:
+        try:
+            return self._q(topic).get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+
+class _FrameHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        broker: InProcessTransport = self.server.broker  # type: ignore
+        try:
+            while True:
+                hdr = self._recv_exact(9)
+                if hdr is None:
+                    return
+                op, tlen, plen = struct.unpack("<BII", hdr)
+                tbytes = self._recv_exact(tlen)
+                if tbytes is None:
+                    return
+                topic = tbytes.decode("utf-8")
+                if op == 0:  # publish
+                    payload = self._recv_exact(plen)
+                    if payload is None:
+                        return
+                    broker.publish(topic, payload)
+                elif op == 1:  # poll
+                    payload = broker.poll(topic, timeout=float(plen) / 1000)
+                    body = payload or b""
+                    self.request.sendall(
+                        struct.pack("<I", len(body)) + body)
+        except (ConnectionError, OSError):
+            return
+
+    def _recv_exact(self, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.request.recv(n - len(buf))
+            if not chunk:
+                return None  # disconnect (mid-frame partials discarded)
+            buf += chunk
+        return buf
+
+
+class TcpTransport(Transport):
+    """Client side of the socket broker; ``serve()`` starts the broker
+    (an InProcessTransport behind a threaded TCP server)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._sock: Optional[socket.socket] = None
+        self._server = None
+        self._lock = threading.Lock()
+
+    def serve(self) -> "TcpTransport":
+        srv = socketserver.ThreadingTCPServer(
+            (self.host, self.port), _FrameHandler)
+        srv.daemon_threads = True
+        srv.broker = InProcessTransport()  # type: ignore
+        self.port = srv.server_address[1]
+        self._server = srv
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return self
+
+    def _conn(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection((self.host, self.port),
+                                                  timeout=10)
+        return self._sock
+
+    def publish(self, topic: str, payload: bytes) -> None:
+        tb = topic.encode("utf-8")
+        with self._lock:
+            self._conn().sendall(
+                struct.pack("<BII", 0, len(tb), len(payload)) + tb + payload)
+
+    def poll(self, topic: str, timeout: float = 1.0) -> Optional[bytes]:
+        tb = topic.encode("utf-8")
+        with self._lock:
+            s = self._conn()
+            # socket deadline must outlast the server-side poll wait, or a
+            # mid-exchange timeout desyncs the framed protocol
+            s.settimeout(timeout + 10)
+            s.sendall(struct.pack("<BII", 1, len(tb),
+                                  int(timeout * 1000)) + tb)
+            hdr = self._recv_exact(s, 4)
+            (plen,) = struct.unpack("<I", hdr)
+            if plen == 0:
+                return None
+            return self._recv_exact(s, plen)
+
+    @staticmethod
+    def _recv_exact(s: socket.socket, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = s.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("broker closed connection")
+            buf += chunk
+        return buf
+
+    def close(self) -> None:
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
+
+
+class NDArrayPublisher:
+    """Pushes arrays to a topic (reference: NDArrayPublisher)."""
+
+    def __init__(self, transport: Transport, topic: str):
+        self.transport = transport
+        self.topic = topic
+
+    def publish(self, array: np.ndarray, key: str = "") -> None:
+        self.transport.publish(
+            self.topic, NDArrayMessage(np.asarray(array), key).to_bytes())
+
+
+class NDArrayConsumer:
+    """Pulls arrays from a topic (reference: NDArrayConsumer)."""
+
+    def __init__(self, transport: Transport, topic: str):
+        self.transport = transport
+        self.topic = topic
+
+    def poll(self, timeout: float = 1.0) -> Optional[NDArrayMessage]:
+        payload = self.transport.poll(self.topic, timeout)
+        return None if payload is None else NDArrayMessage.from_bytes(payload)
+
+    def poll_batch(self, n: int, timeout: float = 1.0
+                   ) -> List[NDArrayMessage]:
+        out = []
+        for _ in range(n):
+            msg = self.poll(timeout)
+            if msg is None:
+                break
+            out.append(msg)
+        return out
+
+
+class NDArrayStreamingClient:
+    """Facade bundling both directions on one transport (reference:
+    NDArrayKafkaClient)."""
+
+    def __init__(self, transport: Optional[Transport] = None):
+        self.transport = transport or InProcessTransport()
+
+    def publisher(self, topic: str) -> NDArrayPublisher:
+        return NDArrayPublisher(self.transport, topic)
+
+    def consumer(self, topic: str) -> NDArrayConsumer:
+        return NDArrayConsumer(self.transport, topic)

@@ -1,0 +1,249 @@
+"""Tests for streaming pub-sub, node2vec, language packs, MagicQueue,
+provisioning generation, UI components, and the ML pipeline API."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graph import (
+    Graph,
+    Node2Vec,
+    Node2VecWalkIterator,
+)
+from deeplearning4j_tpu.ml_pipeline import (
+    NetworkEstimator,
+    Pipeline,
+    StandardScaler,
+)
+from deeplearning4j_tpu.nlp.language_packs import (
+    AnalysisPipeline,
+    ChineseTokenizerFactory,
+    JapaneseTokenizerFactory,
+    KoreanTokenizerFactory,
+    SentenceAnnotator,
+    UimaSentenceIterator,
+    UimaTokenizerFactory,
+)
+from deeplearning4j_tpu.parallel.magic_queue import MagicQueue
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.streaming import (
+    InProcessTransport,
+    NDArrayMessage,
+    NDArrayStreamingClient,
+    Route,
+    TcpTransport,
+    deserialize_ndarray,
+    serialize_ndarray,
+)
+from deeplearning4j_tpu.ui.components import (
+    ChartHistogram,
+    ChartLine,
+    ChartScatter,
+    ComponentTable,
+    ComponentText,
+    render_html,
+)
+
+
+class TestStreaming:
+    def test_serde_roundtrip_dtypes(self, rng):
+        for dtype in ("float32", "float64", "int32", "uint8", "bool"):
+            a = (rng.normal(size=(3, 4)) * 10).astype(dtype)
+            b, ts = deserialize_ndarray(serialize_ndarray(a))
+            np.testing.assert_array_equal(a, b)
+            assert ts > 0
+
+    def test_serde_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            deserialize_ndarray(b"NOTMAGIC" + b"\x00" * 32)
+
+    def test_message_key_roundtrip(self, rng):
+        a = rng.normal(size=(2, 2)).astype(np.float32)
+        m = NDArrayMessage.from_bytes(NDArrayMessage(a, "k9").to_bytes())
+        assert m.key == "k9"
+        np.testing.assert_array_equal(m.array, a)
+
+    def test_inprocess_pubsub(self, rng):
+        c = NDArrayStreamingClient()
+        a = rng.normal(size=(4,)).astype(np.float32)
+        c.publisher("t").publish(a, key="x")
+        msg = c.consumer("t").poll()
+        assert msg.key == "x"
+        np.testing.assert_array_equal(msg.array, a)
+        assert c.consumer("t").poll(timeout=0.05) is None
+
+    def test_route_transform(self, rng):
+        c = NDArrayStreamingClient()
+        route = (Route(c.transport).from_topic("in")
+                 .process(lambda x: x * 2).to_topic("out").start())
+        a = rng.normal(size=(3,)).astype(np.float32)
+        c.publisher("in").publish(a)
+        out = c.consumer("out").poll(timeout=5)
+        route.stop()
+        np.testing.assert_allclose(out.array, a * 2, rtol=1e-6)
+
+    def test_tcp_transport(self, rng):
+        srv = TcpTransport().serve()
+        try:
+            client = NDArrayStreamingClient(TcpTransport(port=srv.port))
+            a = rng.normal(size=(5,)).astype(np.float32)
+            client.publisher("x").publish(a, key="remote")
+            msg = client.consumer("x").poll(timeout=5)
+            assert msg.key == "remote"
+            np.testing.assert_array_equal(msg.array, a)
+            client.transport.close()
+        finally:
+            srv.close()
+
+
+class TestNode2Vec:
+    def _two_communities(self):
+        edges = [(a, b) for a in range(10) for b in range(a + 1, 10)]
+        edges += [(a, b) for a in range(10, 20) for b in range(a + 1, 20)]
+        edges.append((9, 10))
+        return Graph.from_edges(20, edges)
+
+    def test_walk_shapes(self):
+        g = self._two_communities()
+        walks = list(Node2VecWalkIterator(g, 10, p=0.5, q=2.0, seed=1))
+        assert len(walks) == 20
+        assert all(len(w) == 10 for w in walks)
+        # walks stay on edges (or self-loop)
+        for w in walks:
+            for a, b in zip(w, w[1:]):
+                assert b in g.get_connected_vertices(a) or b == a
+
+    def test_community_embeddings(self):
+        g = self._two_communities()
+        n2v = Node2Vec(vector_size=16, walk_length=20, walks_per_vertex=8,
+                       window_size=4, seed=3, epochs=3)
+        n2v.fit(g)
+        assert n2v.similarity("0", "5") > n2v.similarity("0", "15")
+
+
+class TestLanguagePacks:
+    def test_chinese_segmentation(self):
+        toks = ChineseTokenizerFactory().create(
+            "我们在学习深度神经网络").get_tokens()
+        assert "我们" in toks and "学习" in toks and "网络" in toks
+
+    def test_chinese_custom_dictionary(self):
+        f = ChineseTokenizerFactory(dictionary={"甲乙丙"})
+        assert "甲乙丙" in f.create("甲乙丙丁").get_tokens()
+
+    def test_japanese_scripts(self):
+        toks = JapaneseTokenizerFactory().create(
+            "私はカタカナとJAXで学習します").get_tokens()
+        assert "カタカナ" in toks and "JAX" in toks
+
+    def test_korean_josa_stripping(self):
+        toks = KoreanTokenizerFactory().create("나는 학교에 갑니다").get_tokens()
+        assert "학교" in toks  # 에 stripped
+
+    def test_uima_pipeline(self):
+        toks = UimaTokenizerFactory().create("Hello world. Bye!").get_tokens()
+        assert toks == ["Hello", "world.", "Bye!"]
+        sents = list(UimaSentenceIterator(["One. Two! Three?"]))
+        assert len(sents) == 3
+        cas = AnalysisPipeline([SentenceAnnotator()]).process("A. B.")
+        spans = cas.select("sentence")
+        assert [s.text for s in spans] == ["A.", "B."]
+
+
+class TestMagicQueue:
+    def test_sequential_round_robin(self, rng, devices):
+        q = MagicQueue(devices=devices[:2])
+        for i in range(4):
+            q.add(DataSet(rng.normal(size=(2, 3)).astype(np.float32),
+                          rng.normal(size=(2, 1)).astype(np.float32)))
+        assert q.size(0) == 2 and q.size(1) == 2
+        b = q.poll(0)
+        assert b.features.devices() == {devices[0]}
+        b = q.poll(1)
+        assert b.features.devices() == {devices[1]}
+
+    def test_throughput_replicates(self, rng, devices):
+        q = MagicQueue(devices=devices[:3], mode=MagicQueue.THROUGHPUT)
+        q.add(DataSet(rng.normal(size=(2, 3)).astype(np.float32),
+                      rng.normal(size=(2, 1)).astype(np.float32)))
+        assert all(q.size(i) == 1 for i in range(3))
+
+    def test_poll_empty(self, devices):
+        q = MagicQueue(devices=devices[:1])
+        assert q.poll(0, timeout=0.05) is None
+
+
+class TestProvisioning:
+    def test_bundle(self, tmp_path):
+        from deeplearning4j_tpu.provision import (
+            TpuClusterSpec, write_provisioning_bundle)
+        spec = TpuClusterSpec(name="job1", num_slices=2,
+                              env={"FOO": "bar"})
+        files = write_provisioning_bundle(spec, str(tmp_path),
+                                          "python train.py --steps 10")
+        names = {os.path.basename(f) for f in files}
+        assert names == {"create_cluster.sh", "launch.sh",
+                         "delete_cluster.sh", "gke_jobset.json"}
+        create = open(os.path.join(tmp_path, "create_cluster.sh")).read()
+        assert "job1-s0" in create and "job1-s1" in create
+        launch = open(os.path.join(tmp_path, "launch.sh")).read()
+        assert "FOO=bar" in launch and "--worker=all" in launch
+        import json
+        manifest = json.load(
+            open(os.path.join(tmp_path, "gke_jobset.json")))
+        assert manifest["spec"]["replicatedJobs"][0]["replicas"] == 2
+
+
+class TestUIComponents:
+    def test_chart_json_and_html(self):
+        line = (ChartLine(title="loss")
+                .add_series("train", [0, 1, 2], [1.0, 0.5, 0.2]))
+        hist = ChartHistogram(title="weights")
+        hist.add_bin(-1, 0, 10).add_bin(0, 1, 20)
+        scatter = ChartScatter(title="pts").add_series("a", [1, 2], [3, 4])
+        table = ComponentTable(header=["k", "v"], rows=[["acc", "0.9"]],
+                               title="metrics")
+        text = ComponentText(text="hello")
+        for c in (line, hist, scatter, table, text):
+            d = c.to_dict()
+            assert d["componentType"] == c.component_type
+        html = render_html([line, hist, scatter, table, text])
+        assert "<svg" in html and "polyline" in html and "circle" in html
+        assert "<table" in html and "hello" in html
+
+    def test_series_length_mismatch(self):
+        with pytest.raises(ValueError):
+            ChartLine().add_series("bad", [1, 2], [1])
+
+
+class TestMlPipeline:
+    def test_pipeline_fit_predict(self):
+        from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.inputs import InputType
+        from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+        from deeplearning4j_tpu.nn.layers.output import OutputLayer
+        from deeplearning4j_tpu.ops.activations import Activation
+        from deeplearning4j_tpu.ops.losses import LossFunction
+        from deeplearning4j_tpu.optimize.updaters import Adam
+
+        rng = np.random.default_rng(0)
+        # two shifted gaussian blobs, unscaled features
+        X = np.concatenate([rng.normal(0, 1, (80, 4)) * 100,
+                            rng.normal(4, 1, (80, 4)) * 100])
+        y = np.concatenate([np.zeros(80, int), np.ones(80, int)])
+        conf = (NeuralNetConfiguration.Builder().seed(1)
+                .updater(Adam(1e-2)).list()
+                .layer(DenseLayer(n_out=8, activation=Activation.RELU))
+                .layer(OutputLayer(n_out=2, loss=LossFunction.MCXENT,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.feed_forward(4)).build())
+        pipe = Pipeline([StandardScaler(),
+                         NetworkEstimator(conf, epochs=10, batch_size=32)])
+        model = pipe.fit(X, y)
+        acc = (model.predict(X) == y).mean()
+        assert acc > 0.9
+        probs = model.transform(X)
+        assert probs.shape == (160, 2)
+        np.testing.assert_allclose(probs.sum(-1), 1.0, atol=1e-4)
