@@ -24,6 +24,24 @@ from deeplearning4j_tpu.optimize.listeners import TrainingListener
 from deeplearning4j_tpu.optimize.solver import TrainState
 
 
+def compute_cast(x, dt: str):
+    """Cast an activation to the configured compute dtype (bf16 policy)."""
+    if dt == "bfloat16" and jnp.issubdtype(x.dtype, jnp.floating):
+        return x.astype(jnp.bfloat16)
+    return x
+
+
+def cast_params(lp, dt: str):
+    """Cast a layer's float params to the compute dtype (master copies
+    stay f32 in the optimizer; this is the per-step working copy)."""
+    if dt != "bfloat16":
+        return lp
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16)
+        if jnp.issubdtype(a.dtype, jnp.floating) else a, lp)
+
+
+
 class BaseModel:
     def __init__(self):
         self.train_state: Optional[TrainState] = None

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
-from deeplearning4j_tpu.models.base import BaseModel
+from deeplearning4j_tpu.models.base import BaseModel, cast_params
 from deeplearning4j_tpu.nn.graph.config import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.inputs import RecurrentType
 from deeplearning4j_tpu.nn.layers.base import LayerContext
@@ -108,11 +108,7 @@ class ComputationGraph(BaseModel):
                     if mask is None:
                         mask = fmasks.get("__default__")
                 ctx = LayerContext(train=train, rng=key, mask=mask)
-                lp = params.get(name, {})
-                if g.compute_dtype == "bfloat16":
-                    lp = jax.tree_util.tree_map(
-                        lambda a: a.astype(jnp.bfloat16)
-                        if jnp.issubdtype(a.dtype, jnp.floating) else a, lp)
+                lp = cast_params(params.get(name, {}), g.compute_dtype)
                 lp = node.layer.apply_weight_noise(lp, ctx, key)
                 is_output = name in self.conf.network_outputs
                 if is_output and stop_before_loss and hasattr(

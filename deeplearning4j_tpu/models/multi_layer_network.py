@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
-from deeplearning4j_tpu.models.base import BaseModel
+from deeplearning4j_tpu.models.base import BaseModel, cast_params, compute_cast
 from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.inputs import RecurrentType
 from deeplearning4j_tpu.nn.layers.base import LayerContext
@@ -36,10 +36,6 @@ from deeplearning4j_tpu.optimize.solver import (
 )
 
 
-def _compute_cast(x, dt):
-    if dt == "bfloat16" and jnp.issubdtype(x.dtype, jnp.floating):
-        return x.astype(jnp.bfloat16)
-    return x
 
 
 class MultiLayerNetwork(BaseModel):
@@ -101,7 +97,7 @@ class MultiLayerNetwork(BaseModel):
         layer name → initial hidden state (TBPTT chunk chaining,
         reference: rnnActivateUsingStoredState:2881)."""
         g = self.conf.global_config
-        x = _compute_cast(jnp.asarray(x), g.compute_dtype)
+        x = compute_cast(jnp.asarray(x), g.compute_dtype)
         n = len(self.layers) if upto is None else upto
         new_state = dict(model_state)
         acts = []
@@ -113,11 +109,7 @@ class MultiLayerNetwork(BaseModel):
             key = None if rng is None else jax.random.fold_in(rng, i)
             mask = fmask if isinstance(self._input_types[i], RecurrentType) else None
             ctx = LayerContext(train=train, rng=key, mask=mask)
-            lp = params.get(layer.name, {})
-            if g.compute_dtype == "bfloat16":
-                lp = jax.tree_util.tree_map(
-                    lambda a: a.astype(jnp.bfloat16)
-                    if jnp.issubdtype(a.dtype, jnp.floating) else a, lp)
+            lp = cast_params(params.get(layer.name, {}), g.compute_dtype)
             lp = layer.apply_weight_noise(lp, ctx, key)
             if carries is not None and layer.name in carries:
                 x, s = layer.apply(lp, model_state.get(layer.name, {}), x,
@@ -148,7 +140,11 @@ class MultiLayerNetwork(BaseModel):
         if not hasattr(out_layer, "compute_loss"):
             raise TypeError(f"last layer {type(out_layer).__name__} is not an"
                             " output/loss layer")
-        loss = out_layer.compute_loss(params.get(out_layer.name, {}),
+        # keep the loss matmul in the compute dtype; a mixed-dtype einsum
+        # here leaks f32 cotangents into the bf16 backward pass
+        out_lp = cast_params(params.get(out_layer.name, {}),
+                             self.conf.global_config.compute_dtype)
+        loss = out_layer.compute_loss(out_lp,
                                       model_state.get(out_layer.name, {}),
                                       x, labels, ctx)
         reg = sum((l.regularization_loss(params.get(l.name, {}))

@@ -129,14 +129,15 @@ class ConvolutionLayer(FeedForwardLayer):
         ctx, dk = ctx.split_rng()
         x = self.maybe_dropout(x, ctx, dk)
         s, d, p = map(_pair, (self.stride, self.dilation, self.padding))
+        # bf16 convs: XLA accumulates on the MXU in f32 already, and an
+        # explicit preferred_element_type=f32 here breaks the transpose
+        # (f32 cotangent meets bf16 operands in grad-of-conv)
         y = lax.conv_general_dilated(
             x, params["W"], window_strides=s,
             padding=_padding_arg(self.convolution_mode, p),
             rhs_dilation=d, dimension_numbers=DIMENSION_NUMBERS,
             feature_group_count=self.groups,
-            preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
         )
-        y = y.astype(x.dtype)
         if self.has_bias:
             y = y + params["b"]
         return self.activation.apply(y), state

@@ -200,3 +200,55 @@ class TestExtendedFetchers:
         b = next(iter(it))
         lab = b.labels[:, 0, :].argmax(-1)
         assert len(np.unique(lab)) == 6
+
+
+class TestBfloat16Training:
+    """Mixed-precision training path (bf16 compute, f32 master params).
+    Regression: an uncast output layer or preferred_element_type on conv
+    used to leak f32 cotangents into the bf16 backward pass."""
+
+    def test_conv_net_bf16_step(self):
+        import jax
+        import jax.numpy as jnp
+        import jax.random as jrandom
+        from deeplearning4j_tpu.nn.layers.convolution import ConvolutionLayer
+        from deeplearning4j_tpu.nn.layers.normalization import (
+            BatchNormalization)
+
+        conf = (NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-3))
+                .compute_dtype("bfloat16").list()
+                .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3)))
+                .layer(BatchNormalization())
+                .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.convolutional(8, 8, 2)).build())
+        m = MultiLayerNetwork(conf).init()
+        m._train_step = m._build_train_step()
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(4, 8, 8, 2)).astype(np.float32))
+        y = np.zeros((4, 3), np.float32)
+        y[:, 0] = 1
+        ts = m.train_state
+        for i in range(3):
+            ts, loss = m._train_step(ts, x, jnp.asarray(y), None, None,
+                                     jrandom.PRNGKey(i))
+        assert np.isfinite(float(loss))
+        # master params stay f32
+        assert all(l.dtype == jnp.float32
+                   for l in jax.tree_util.tree_leaves(ts.params))
+
+    def test_resnet50_bf16_step(self):
+        import jax.numpy as jnp
+        import jax.random as jrandom
+        from deeplearning4j_tpu.zoo.models import ResNet50
+
+        model = ResNet50(num_classes=8, height=32, width=32, channels=3,
+                         compute_dtype="bfloat16").init()
+        step = model._build_train_step()
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(4, 32, 32, 3)).astype(np.float32))
+        y = np.zeros((4, 8), np.float32)
+        y[np.arange(4), rng.integers(0, 8, 4)] = 1.0
+        ts, loss = step(model.train_state, (x,), (jnp.asarray(y),),
+                        None, None, jrandom.PRNGKey(0))
+        assert np.isfinite(float(loss))
