@@ -18,7 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
-from deeplearning4j_tpu.models.base import BaseModel, cast_params
+from deeplearning4j_tpu.models.base import BaseModel, cast_params, compute_cast
 from deeplearning4j_tpu.nn.graph.config import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.inputs import RecurrentType
 from deeplearning4j_tpu.nn.layers.base import LayerContext
@@ -87,11 +87,7 @@ class ComputationGraph(BaseModel):
         g = self.conf.global_config
         acts: Dict[str, jnp.ndarray] = {}
         for k, v in inputs.items():
-            v = jnp.asarray(v)
-            if g.compute_dtype == "bfloat16" and jnp.issubdtype(
-                    v.dtype, jnp.floating):
-                v = v.astype(jnp.bfloat16)
-            acts[k] = v
+            acts[k] = compute_cast(jnp.asarray(v), g.compute_dtype)
         new_state = dict(model_state)
         for li, name in enumerate(self._topo):
             node = self._nodes[name]

@@ -144,6 +144,7 @@ class MultiLayerNetwork(BaseModel):
         # here leaks f32 cotangents into the bf16 backward pass
         out_lp = cast_params(params.get(out_layer.name, {}),
                              self.conf.global_config.compute_dtype)
+        out_lp = out_layer.apply_weight_noise(out_lp, ctx, key)
         loss = out_layer.compute_loss(out_lp,
                                       model_state.get(out_layer.name, {}),
                                       x, labels, ctx)

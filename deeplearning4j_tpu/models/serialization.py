@@ -31,6 +31,23 @@ from deeplearning4j_tpu.optimize.solver import TrainState
 from deeplearning4j_tpu.utils import serde
 
 
+def _ensure_registry():
+    """Import every module that registers serializable config types, so a
+    checkpoint loads in a fresh interpreter without the caller having
+    imported the layer zoo first (the reference gets this for free from
+    classpath scanning — NeuralNetConfiguration.java:434). Walks the whole
+    ``nn`` package so newly added layer modules register automatically."""
+    import importlib
+    import pkgutil
+
+    import deeplearning4j_tpu.nn as nn_pkg
+    for info in pkgutil.walk_packages(nn_pkg.__path__,
+                                      prefix="deeplearning4j_tpu.nn."):
+        importlib.import_module(info.name)
+    importlib.import_module("deeplearning4j_tpu.optimize.updaters")
+    importlib.import_module("deeplearning4j_tpu.optimize.schedules")
+
+
 def _flatten_with_paths(tree) -> Dict[str, np.ndarray]:
     flat = {}
     leaves_with_paths = jax.tree_util.tree_flatten_with_path(tree)[0]
@@ -102,6 +119,7 @@ def save_model(model, path: str, save_updater: bool = False):
 
 
 def _restore(path: str, expected_class: str, loader, load_updater: bool):
+    _ensure_registry()
     with zipfile.ZipFile(path, "r") as zf:
         meta = json.loads(zf.read("meta.json"))
         if meta["model_class"] != expected_class:
