@@ -114,14 +114,23 @@ class Hdf5Archive:
         if names:
             for wname in names:
                 arr = np.asarray(node[wname])
-                short = wname.split("/")[-1].split(":")[0]
-                out[short] = arr
+                parts = wname.split(":")[0].split("/")
+                out[parts[-1]] = arr
+                if len(parts) >= 2:
+                    # qualified key disambiguates sublayer weights that
+                    # share a leaf name (MultiHeadAttention query/kernel
+                    # vs key/kernel vs value/kernel)
+                    out["/".join(parts[-2:])] = arr
         else:
             def visit(prefix, n):
                 for k in n.keys():
                     item = n[k]
                     if isinstance(item, h5py.Dataset):
-                        out[k.split(":")[0]] = np.asarray(item)
+                        leaf = k.split(":")[0]
+                        arr = np.asarray(item)
+                        out[leaf] = arr
+                        if prefix != layer_name:
+                            out[prefix.split("/")[-1] + "/" + leaf] = arr
                     else:
                         visit(prefix + "/" + k, item)
             visit(layer_name, node)

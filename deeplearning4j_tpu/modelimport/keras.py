@@ -259,6 +259,11 @@ def import_keras_model_and_weights(path: str,
                 continue
             conv = convert_layer(cname, lcfg, version)
             inbound = [renames.get(i, i) for i in _inbound_names(ld)]
+            if conv.layer is not None and len(set(inbound)) == 1 \
+                    and len(inbound) > 1:
+                # self-attention style call (mha(x, x)): one source feeds
+                # every argument — a single-input layer node here
+                inbound = inbound[:1]
             if conv.skip:
                 if len(inbound) != 1:
                     raise ValueError(

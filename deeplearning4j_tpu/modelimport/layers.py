@@ -44,7 +44,11 @@ from deeplearning4j_tpu.nn.layers.feedforward import (
     DropoutLayer,
     EmbeddingSequenceLayer,
 )
-from deeplearning4j_tpu.nn.layers.normalization import BatchNormalization
+from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+from deeplearning4j_tpu.nn.layers.normalization import (
+    BatchNormalization,
+    LayerNormalization,
+)
 from deeplearning4j_tpu.nn.layers.output import GlobalPoolingLayer
 from deeplearning4j_tpu.nn.layers.recurrent import (
     Bidirectional,
@@ -423,6 +427,67 @@ def bidirectional(cfg, v):
 
 # ---- registry ------------------------------------------------------------
 
+def layer_norm(cfg, _v):
+    def _w(w):
+        params = {}
+        if "gamma" in w:
+            params["gamma"] = w["gamma"]
+        if "beta" in w:
+            params["beta"] = w["beta"]
+        return params, {}
+    return Converted(
+        layer=LayerNormalization(eps=float(cfg.get("epsilon", 1e-3))),
+        weights=_w)
+
+
+def multi_head_attention(cfg, _v):
+    """Keras MultiHeadAttention → SelfAttentionLayer. Keras stores per-head
+    projections query/key/value kernels [F, H, dh] and output kernel
+    [H, dh, F]; ours packs QKV into one [F, 3E] matmul (E = H*dh)."""
+    n_heads = int(cfg.get("num_heads", 1))
+    key_dim = int(cfg.get("key_dim", 64))
+    value_dim = cfg.get("value_dim")
+    if value_dim is not None and int(value_dim) != key_dim:
+        raise ValueError(
+            f"unsupported MultiHeadAttention config: value_dim={value_dim}"
+            f" != key_dim={key_dim} (packed-QKV layout needs equal dims)")
+    if cfg.get("output_shape") is not None:
+        raise ValueError("unsupported MultiHeadAttention config: "
+                         "output_shape is not supported")
+    n_out = n_heads * key_dim
+
+    def _w(w):
+        def req(name):
+            arr = w.get(f"{name}/kernel")
+            if arr is None:
+                raise KeyError(
+                    f"MultiHeadAttention weights missing '{name}/kernel'"
+                    f" (available: {sorted(w)})")
+            return arr
+        q, k, v, o = req("query"), req("key"), req("value"), \
+            req("attention_output")
+        f = q.shape[0]
+        pack = lambda a: a.reshape(f, -1)
+        params = {"Wqkv": np.concatenate([pack(q), pack(k), pack(v)],
+                                         axis=1),
+                  "Wo": o.reshape(-1, o.shape[-1])}
+        def b2(name):
+            return w.get(f"{name}/bias")
+        bq, bk, bv = b2("query"), b2("key"), b2("value")
+        bo = b2("attention_output")
+        if bq is not None and bk is not None and bv is not None:
+            params["bqkv"] = np.concatenate(
+                [bq.reshape(-1), bk.reshape(-1), bv.reshape(-1)])
+        if bo is not None:
+            params["bo"] = bo.reshape(-1)
+        return params, {}
+
+    return Converted(
+        layer=SelfAttentionLayer(n_out=n_out, n_heads=n_heads,
+                                 activation=Activation.IDENTITY),
+        weights=_w)
+
+
 CONVERTERS: Dict[str, Callable[[dict, int], Converted]] = {
     "Dense": dense,
     "Conv2D": conv2d, "Convolution2D": conv2d,
@@ -438,6 +503,14 @@ CONVERTERS: Dict[str, Callable[[dict, int], Converted]] = {
     "GlobalMaxPooling1D": global_pool(PoolingType.MAX),
     "GlobalAveragePooling1D": global_pool(PoolingType.AVG),
     "BatchNormalization": batchnorm,
+    "LayerNormalization": layer_norm,
+    "MultiHeadAttention": multi_head_attention,
+    "Softmax": lambda cfg, v: Converted(
+        layer=ActivationLayer(activation=Activation.SOFTMAX),
+        activation=Activation.SOFTMAX),
+    "ELU": lambda cfg, v: Converted(
+        layer=ActivationLayer(activation=Activation.ELU),
+        activation=Activation.ELU),
     "Activation": activation,
     "LeakyReLU": leaky_relu,
     "Dropout": dropout, "SpatialDropout2D": dropout,

@@ -31,12 +31,17 @@ from deeplearning4j_tpu.optimize.solver import TrainState
 from deeplearning4j_tpu.utils import serde
 
 
+import functools
+
+
+@functools.cache
 def _ensure_registry():
     """Import every module that registers serializable config types, so a
     checkpoint loads in a fresh interpreter without the caller having
     imported the layer zoo first (the reference gets this for free from
     classpath scanning — NeuralNetConfiguration.java:434). Walks the whole
-    ``nn`` package so newly added layer modules register automatically."""
+    ``nn`` package so newly added layer modules register automatically;
+    cached so repeated restores skip the filesystem walk."""
     import importlib
     import pkgutil
 
