@@ -111,27 +111,30 @@ class Hdf5Archive:
         except KeyError:
             names = []
         node = self._node(*groups)
+        def add_aliases(path_parts, arr):
+            # leaf, parent/leaf, and the full path relative to the layer
+            # group: deeper aliases disambiguate sublayer weights that
+            # share a leaf name (MHA query/kernel vs key/kernel;
+            # Bidirectional forward_lstm/... vs backward_lstm/...)
+            out[path_parts[-1]] = arr
+            if len(path_parts) >= 2:
+                out["/".join(path_parts[-2:])] = arr
+            if len(path_parts) > 2:
+                out["/".join(path_parts)] = arr
+
         if names:
             for wname in names:
                 arr = np.asarray(node[wname])
-                parts = wname.split(":")[0].split("/")
-                out[parts[-1]] = arr
-                if len(parts) >= 2:
-                    # qualified key disambiguates sublayer weights that
-                    # share a leaf name (MultiHeadAttention query/kernel
-                    # vs key/kernel vs value/kernel)
-                    out["/".join(parts[-2:])] = arr
+                add_aliases(wname.split(":")[0].split("/"), arr)
         else:
             def visit(prefix, n):
                 for k in n.keys():
                     item = n[k]
                     if isinstance(item, h5py.Dataset):
-                        leaf = k.split(":")[0]
-                        arr = np.asarray(item)
-                        out[leaf] = arr
-                        if prefix != layer_name:
-                            out[prefix.split("/")[-1] + "/" + leaf] = arr
+                        rel = (prefix + "/" + k.split(":")[0]) \
+                            if prefix else k.split(":")[0]
+                        add_aliases(rel.split("/"), np.asarray(item))
                     else:
-                        visit(prefix + "/" + k, item)
-            visit(layer_name, node)
+                        visit((prefix + "/" + k) if prefix else k, item)
+            visit("", node)
         return out

@@ -107,20 +107,30 @@ def _set_imported(model, name: str, conv: Converted,
     if conv.weights is None or not weights:
         return
     params, state = conv.weights(weights)
+
+    def merge(cur, new, path):
+        """Recursive merge: nested dicts (Bidirectional fwd/bwd) descend;
+        leaves are shape-checked against the initialized values."""
+        cur = dict(cur)
+        for k, v in new.items():
+            if isinstance(v, dict):
+                cur[k] = merge(cur.get(k, {}), v, f"{path}/{k}")
+                continue
+            v = np.asarray(v)
+            if k in cur and hasattr(cur[k], "shape") and \
+                    tuple(cur[k].shape) != tuple(v.shape):
+                raise ValueError(
+                    f"imported weight {path}/{k} has shape {v.shape}, "
+                    f"model expects {tuple(cur[k].shape)}")
+            tgt_dtype = cur[k].dtype if k in cur else jnp.float32
+            cur[k] = jnp.asarray(v, tgt_dtype)
+        return cur
+
     ts = model.train_state
     new_p = dict(ts.params)
     new_s = dict(ts.model_state)
     if params:
-        cur = dict(new_p.get(name, {}))
-        for k, v in params.items():
-            v = np.asarray(v)
-            if k in cur and tuple(cur[k].shape) != tuple(v.shape):
-                raise ValueError(
-                    f"imported weight {name}/{k} has shape {v.shape}, "
-                    f"model expects {tuple(cur[k].shape)}")
-            tgt_dtype = cur[k].dtype if k in cur else jnp.float32
-            cur[k] = jnp.asarray(v, tgt_dtype)
-        new_p[name] = cur
+        new_p[name] = merge(new_p.get(name, {}), params, name)
     if state:
         cur = dict(new_s.get(name, {}))
         for k, v in state.items():
@@ -264,6 +274,11 @@ def import_keras_model_and_weights(path: str,
                 # self-attention style call (mha(x, x)): one source feeds
                 # every argument — a single-input layer node here
                 inbound = inbound[:1]
+            elif cname == "MultiHeadAttention" and len(set(inbound)) > 1:
+                raise ValueError(
+                    f"unsupported: layer {name!r} is cross-attention "
+                    "(distinct query/value sources); only self-attention "
+                    "imports are supported")
             if conv.skip:
                 if len(inbound) != 1:
                     raise ValueError(

@@ -415,19 +415,53 @@ def bidirectional(cfg, v):
     layer = Bidirectional(fwd=inner_layer, mode=mode)
 
     def wfn(w):
-        fwd = {k[len("forward_"):] if k.startswith("forward_") else k: a
-               for k, a in w.items() if not k.startswith("backward_")}
-        bwd = {k[len("backward_"):]: a for k, a in w.items()
-               if k.startswith("backward_")}
-        fp, _ = inner.weights(fwd) if inner.weights else ({}, {})
-        bp, _ = inner.weights(bwd) if inner.weights else ({}, {})
+        # direction-qualified keys ("forward_lstm/.../kernel") are the
+        # only unambiguous ones — bare leaf aliases collide between
+        # directions. Select per direction, then re-leaf for the inner
+        # converter (which expects plain "kernel"/"recurrent_kernel").
+        def select(tag, other):
+            picked = {}
+            for k, a in w.items():
+                if tag in k and other not in k:
+                    picked.setdefault(k.split("/")[-1], a)
+            return picked
+        fwd = select("forward", "backward")
+        bwd = select("backward", "forward")
+        fp, _ = inner.weights(fwd) if inner.weights and fwd else ({}, {})
+        bp, _ = inner.weights(bwd) if inner.weights and bwd else ({}, {})
+        if not fp or not bp:
+            raise KeyError(
+                "Bidirectional weights missing forward_/backward_ "
+                f"qualified entries (available: {sorted(w)})")
         return {"fwd": fp, "bwd": bp}, {}
     return Converted(layer=layer, weights=wfn)
 
 
 # ---- registry ------------------------------------------------------------
 
+def softmax_layer(cfg, _v):
+    axis = cfg.get("axis", -1)
+    if axis not in (-1, None):
+        raise ValueError(f"unsupported Softmax config: axis={axis} "
+                         "(only the feature axis -1 is supported)")
+    return Converted(layer=ActivationLayer(activation=Activation.SOFTMAX),
+                     activation=Activation.SOFTMAX)
+
+
+def elu_layer(cfg, _v):
+    alpha = float(cfg.get("alpha", 1.0))
+    if alpha != 1.0:
+        raise ValueError(
+            f"unsupported ELU config: alpha={alpha} (only 1.0)")
+    return Converted(layer=ActivationLayer(activation=Activation.ELU),
+                     activation=Activation.ELU)
+
+
 def layer_norm(cfg, _v):
+    axis = cfg.get("axis", -1)
+    if axis not in (-1, [-1], None):
+        raise ValueError(f"unsupported LayerNormalization config: "
+                         f"axis={axis} (only the feature axis -1)")
     def _w(w):
         params = {}
         if "gamma" in w:
@@ -454,6 +488,15 @@ def multi_head_attention(cfg, _v):
     if cfg.get("output_shape") is not None:
         raise ValueError("unsupported MultiHeadAttention config: "
                          "output_shape is not supported")
+    axes = cfg.get("attention_axes")
+    if isinstance(axes, (list, tuple)):
+        axes = list(axes)
+    # for rank-3 (N, T, F) input the sequence axis is 1 (== -2)
+    if axes not in (None, 1, -2, [1], [-2]):
+        raise ValueError(
+            f"unsupported MultiHeadAttention config: attention_axes="
+            f"{cfg['attention_axes']} (only default sequence-axis "
+            "attention)")
     n_out = n_heads * key_dim
 
     def _w(w):
@@ -505,12 +548,8 @@ CONVERTERS: Dict[str, Callable[[dict, int], Converted]] = {
     "BatchNormalization": batchnorm,
     "LayerNormalization": layer_norm,
     "MultiHeadAttention": multi_head_attention,
-    "Softmax": lambda cfg, v: Converted(
-        layer=ActivationLayer(activation=Activation.SOFTMAX),
-        activation=Activation.SOFTMAX),
-    "ELU": lambda cfg, v: Converted(
-        layer=ActivationLayer(activation=Activation.ELU),
-        activation=Activation.ELU),
+    "Softmax": softmax_layer,
+    "ELU": elu_layer,
     "Activation": activation,
     "LeakyReLU": leaky_relu,
     "Dropout": dropout, "SpatialDropout2D": dropout,

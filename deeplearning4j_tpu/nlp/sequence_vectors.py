@@ -95,6 +95,8 @@ class SequenceVectors:
             self._init_tables()
         total_words = max(
             1, sum(len(s) for s in seqs) * self.epochs * self.iterations)
+        if self._fast_sgns_ok():
+            return self._fit_fast_sgns(seqs, total_words)
         k = self._k()
         batcher = sk.PairBatcher(self.batch_size, k)
         seen = 0
@@ -105,6 +107,103 @@ class SequenceVectors:
                     seen = self._train_sequence(
                         idxs, batcher, seen, total_words)
         self._flush(batcher, self._lr(seen, total_words))
+        return self
+
+    # ---- vectorized SGNS hot path ---------------------------------------
+    def _fast_sgns_ok(self) -> bool:
+        """The vectorized path covers plain skip-gram negative sampling.
+        Word2Vec's overrides delegate here for non-CBOW, so it qualifies;
+        ParagraphVectors/GloVe run their own fit loops and never reach
+        this. Subclasses that customize pair generation must override
+        ``_add_pair`` (which disqualifies them automatically)."""
+        return (not self.use_hs and not self.use_cbow
+                and self.iterations == 1
+                and type(self)._add_pair is SequenceVectors._add_pair)
+
+    def _fit_fast_sgns(self, seqs, total_words: int):
+        """Whole-corpus vectorized skip-gram with negative sampling: pair
+        generation is numpy over an offsets grid, negatives are one table
+        gather per chunk, and each chunk is a single donated device step —
+        the TPU-shaped version of the reference's AggregateSkipGram
+        batching (SkipGram.java:176-186) with the Python-per-pair loop
+        removed."""
+        rng = self._rng
+        W = self.window_size
+        offsets = np.concatenate([np.arange(-W, 0), np.arange(1, W + 1)])
+        # large chunks amortize per-call dispatch latency; update staleness
+        # within a chunk is the same hogwild-style race the reference's
+        # multithreaded native loop accepts (SURVEY §3.6). Scale the chunk
+        # to the corpus so small corpora still get enough sequential
+        # updates to converge (≥~64 steps over the whole fit).
+        est_pairs = total_words * (W + 1)
+        chunk = int(np.clip(est_pairs // 64, self.batch_size, 65536))
+        k = 1 + self.negative
+        cen_buf = np.zeros(chunk, np.int32)
+        tgt_buf = np.zeros((chunk, k), np.int32)
+        lab_np = np.zeros((chunk, k), np.float32)
+        lab_np[:, 0] = 1.0
+        # labels never change and the mask is all-ones except on the final
+        # partial chunk: keep both device-resident instead of re-uploading
+        # megabytes per step
+        lab_dev = jnp.asarray(lab_np)
+        ones_mask = jnp.ones((chunk, k), jnp.float32)
+        fill = 0
+        seen = 0
+        table = self._table
+        n_words = self.vocab.num_words()
+
+        def flush(n_valid):
+            nonlocal fill
+            if n_valid == 0:
+                return
+            negs = table[rng.integers(0, len(table), (n_valid, k - 1))]
+            pos = tgt_buf[:n_valid, 0:1]
+            bad = negs == pos
+            if bad.any():  # redraw collisions once, then cycle
+                negs[bad] = table[rng.integers(0, len(table),
+                                               int(bad.sum()))]
+                bad = negs == pos
+                negs[bad] = (np.broadcast_to(pos, negs.shape)[bad] + 1) \
+                    % max(n_words, 2)
+            tgt_buf[:n_valid, 1:] = negs
+            if n_valid == chunk:
+                mask = ones_mask
+            else:
+                m = np.zeros((chunk, k), np.float32)
+                m[:n_valid] = 1.0
+                mask = jnp.asarray(m)
+            lr = self._lr(seen, total_words)
+            self.syn0, self.syn1 = sk.skipgram_step(
+                self.syn0, self.syn1, jnp.asarray(cen_buf),
+                jnp.asarray(tgt_buf), lab_dev, mask, jnp.float32(lr))
+            fill = 0
+
+        for _epoch in range(self.epochs):
+            for seq in seqs:
+                idxs = np.asarray(self._indices(seq), np.int32)
+                n = len(idxs)
+                if n < 2:
+                    seen += n
+                    continue
+                # randomized effective window per center (word2vec.c's b)
+                eff = (rng.integers(1, W + 1, n) if W > 1
+                       else np.ones(n, np.int64))
+                grid = np.arange(n)[:, None] + offsets[None, :]
+                valid = (np.abs(offsets)[None, :] <= eff[:, None]) \
+                    & (grid >= 0) & (grid < n)
+                centers = np.repeat(idxs, valid.sum(axis=1))
+                contexts = idxs[grid[valid]]
+                seen += n
+                p = 0
+                while p < len(centers):
+                    take = min(chunk - fill, len(centers) - p)
+                    cen_buf[fill:fill + take] = centers[p:p + take]
+                    tgt_buf[fill:fill + take, 0] = contexts[p:p + take]
+                    fill += take
+                    p += take
+                    if fill == chunk:
+                        flush(chunk)
+        flush(fill)
         return self
 
     def _k(self) -> int:
