@@ -1,0 +1,55 @@
+"""Single-host data-parallel training over a device mesh — the
+ParallelWrapper workflow (SURVEY §3.3) the TPU way: mesh + sharded step.
+
+Run on 8 virtual devices:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python examples/data_parallel_mesh.py
+On a real TPU host the same code uses all local chips.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+from deeplearning4j_tpu.datasets.fetchers import MnistDataSetIterator  # noqa: E402
+from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork  # noqa: E402
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration  # noqa: E402
+from deeplearning4j_tpu.nn.inputs import InputType  # noqa: E402
+from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer  # noqa: E402
+from deeplearning4j_tpu.nn.layers.output import OutputLayer  # noqa: E402
+from deeplearning4j_tpu.ops.activations import Activation  # noqa: E402
+from deeplearning4j_tpu.ops.losses import LossFunction  # noqa: E402
+from deeplearning4j_tpu.optimize.updaters import Adam  # noqa: E402
+from deeplearning4j_tpu.parallel.wrapper import (  # noqa: E402
+    ParallelWrapper,
+    TrainingMode,
+)
+
+
+def main():
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(1).updater(Adam(1e-3)).list()
+            .layer(DenseLayer(n_out=256, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=10, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(784))
+            .build())
+    model = MultiLayerNetwork(conf).init()
+
+    # SHARED_GRADIENTS == per-step allreduce over the mesh's data axis;
+    # AVERAGING == local SGD with periodic parameter averaging
+    pw = (ParallelWrapper.Builder(model)
+          .workers(len(jax.devices()))
+          .training_mode(TrainingMode.SHARED_GRADIENTS)
+          .build())
+    train = MnistDataSetIterator(batch_size=256, subset=4096)
+    pw.fit(train, epochs=2)
+
+    test = MnistDataSetIterator(batch_size=256, subset=1024, train=False)
+    print("accuracy:", model.evaluate(test).accuracy())
+
+
+if __name__ == "__main__":
+    main()

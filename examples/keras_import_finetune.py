@@ -1,0 +1,48 @@
+"""Import a Keras .h5 model and fine-tune it with transfer learning
+(the reference's KerasModelImport + TransferLearning workflow,
+SURVEY §3.5).
+
+Run: JAX_PLATFORMS=cpu python examples/keras_import_finetune.py
+(requires keras to build the fixture; import itself needs only h5py)
+"""
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.modelimport import (
+    import_keras_sequential_model_and_weights,
+)
+
+
+def main():
+    import keras
+    from keras import layers as L
+
+    km = keras.Sequential([
+        keras.Input((12,)),
+        L.Dense(32, activation="relu", name="feat1"),
+        L.Dense(16, activation="relu", name="feat2"),
+        L.Dense(4, activation="softmax", name="head"),
+    ])
+    km.save("/tmp/pretrained.h5")
+
+    model = import_keras_sequential_model_and_weights("/tmp/pretrained.h5")
+    print(model.summary())
+
+    x = np.random.default_rng(0).normal(size=(64, 12)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(model.output(x)),
+        np.asarray(km.predict(x, verbose=0)), rtol=2e-4, atol=2e-5)
+    print("imported model matches Keras")
+
+    # fine-tune on new labels
+    y = np.eye(4, dtype=np.float32)[
+        np.random.default_rng(1).integers(0, 4, 64)]
+    before = model.score(DataSet(x, y))
+    for _ in range(30):
+        model.fit(DataSet(x, y))
+    print(f"fine-tune loss {before:.3f} -> {model.score(DataSet(x, y)):.3f}")
+
+
+if __name__ == "__main__":
+    main()

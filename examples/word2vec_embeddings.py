@@ -1,0 +1,35 @@
+"""Word2Vec embeddings: fit, query, serialize (the reference's
+Word2Vec tutorial workflow — SURVEY §3.6).
+
+Run: JAX_PLATFORMS=cpu python examples/word2vec_embeddings.py
+"""
+
+from deeplearning4j_tpu.nlp import serializer as WordVectorSerializer
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+CORPUS = [
+    "the cat sat on the mat",
+    "the dog sat on the rug",
+    "a cat chased the mouse",
+    "the dog chased the cat",
+    "mice fear the cat",
+    "dogs and cats are pets",
+] * 50
+
+
+def main():
+    w2v = Word2Vec(layer_size=32, window_size=3, negative=5,
+                   min_word_frequency=1, epochs=5, seed=7)
+    w2v.fit(CORPUS)
+
+    print("vocab size:", w2v.vocab.num_words())
+    print("nearest to 'cat':", w2v.words_nearest("cat", top_n=3))
+    print("sim(cat, dog) =", round(w2v.similarity("cat", "dog"), 3))
+
+    WordVectorSerializer.write_word_vectors(w2v, "/tmp/vecs.txt")
+    loaded = WordVectorSerializer.read_word_vectors("/tmp/vecs.txt")
+    print("reloaded", loaded.has_word("cat"))
+
+
+if __name__ == "__main__":
+    main()
