@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Test runner (analog of the reference's runtests.sh — SURVEY §2.13).
+# Runs the whole suite on a virtual 8-device CPU mesh; pass extra pytest
+# args through, e.g. ./runtests.sh -k keras
+set -euo pipefail
+cd "$(dirname "$0")"
+exec python -m pytest tests/ -q "$@"
