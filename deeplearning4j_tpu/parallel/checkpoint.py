@@ -62,6 +62,10 @@ def save_sharded(train_state: TrainState, directory: str,
     Returns the checkpoint path (one subdir per step)."""
     it = int(train_state.iteration) if step is None else int(step)
     path = os.path.join(directory, f"step_{it:010d}")
+    if os.path.exists(os.path.join(path, "COMMITTED")):
+        # this step is already durably saved; rewriting would open a
+        # crash window that destroys the only committed copy
+        return path
     tmp = path + ".tmp"
     if os.path.isdir(tmp):
         shutil.rmtree(tmp)
@@ -89,7 +93,7 @@ def save_sharded(train_state: TrainState, directory: str,
     # atomically, so a torn write can never look committed
     with open(os.path.join(tmp, "COMMITTED"), "w") as f:
         f.write("ok")
-    if os.path.isdir(path):
+    if os.path.isdir(path):  # uncommitted partial from a prior crash
         shutil.rmtree(path)
     os.rename(tmp, path)
     return path
@@ -99,7 +103,7 @@ def latest_checkpoint(directory: str) -> Optional[str]:
     if not os.path.isdir(directory):
         return None
     steps = [d for d in os.listdir(directory)
-             if d.startswith("step_") and
+             if d.startswith("step_") and not d.endswith(".tmp") and
              os.path.exists(os.path.join(directory, d, "COMMITTED"))]
     if not steps:
         return None
@@ -181,11 +185,26 @@ class ElasticTrainer:
 
     def __init__(self, model, directory: str,
                  checkpoint_every: int = 100,
-                 mesh: Optional[Mesh] = None):
+                 mesh: Optional[Mesh] = None,
+                 keep_last: Optional[int] = 5):
         self.model = model
         self.directory = directory
         self.checkpoint_every = checkpoint_every
         self.mesh = mesh
+        self.keep_last = keep_last
+
+    def _prune(self):
+        """Retention (the CheckpointListener keep-last policy): drop the
+        oldest committed checkpoints beyond ``keep_last``."""
+        if self.keep_last is None or not os.path.isdir(self.directory):
+            return
+        steps = sorted(
+            d for d in os.listdir(self.directory)
+            if d.startswith("step_") and not d.endswith(".tmp")
+            and os.path.exists(os.path.join(self.directory, d,
+                                            "COMMITTED")))
+        for d in steps[:-self.keep_last]:
+            shutil.rmtree(os.path.join(self.directory, d))
 
     def resume(self) -> bool:
         """Restore the newest committed checkpoint (resharding onto this
@@ -213,6 +232,7 @@ class ElasticTrainer:
                     self.last_saved = int(iteration) - 1
                 if iteration - self.last_saved >= trainer.checkpoint_every:
                     save_sharded(model.train_state, trainer.directory)
+                    trainer._prune()
                     self.last_saved = int(iteration)
 
         m = self.model
@@ -224,4 +244,5 @@ class ElasticTrainer:
             m.listeners.remove(saver)
         if saver.last_saved != int(m.train_state.iteration):
             save_sharded(m.train_state, self.directory)
+            self._prune()
         return m
