@@ -187,6 +187,9 @@ class ElasticTrainer:
                  checkpoint_every: int = 100,
                  mesh: Optional[Mesh] = None,
                  keep_last: Optional[int] = 5):
+        if keep_last is not None and keep_last < 1:
+            raise ValueError(
+                "keep_last must be >= 1 (or None to disable pruning)")
         self.model = model
         self.directory = directory
         self.checkpoint_every = checkpoint_every
