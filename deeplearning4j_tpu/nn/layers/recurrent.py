@@ -157,6 +157,41 @@ class GravesLSTM(LSTM):
 
 @register_serializable
 @dataclasses.dataclass(frozen=True)
+class GravesBidirectionalLSTM(FeedForwardLayer):
+    """Bidirectional Graves LSTM as one layer (reference:
+    GravesBidirectionalLSTM.java — independent fwd/bwd peephole cells,
+    concatenated output). Composes Bidirectional(GravesLSTM) rather than
+    subclassing LSTM so carry-based paths (TBPTT, rnn_time_step) don't
+    mistake its {"fwd","bwd"} param/state structure for a plain cell."""
+    activation: Activation = Activation.TANH
+    gate_activation: Activation = Activation.SIGMOID
+    forget_gate_bias_init: float = 1.0
+
+    def _wrapper(self) -> "Bidirectional":
+        inner = GravesLSTM(
+            **{f.name: getattr(self, f.name)
+               for f in dataclasses.fields(GravesLSTM)})
+        return Bidirectional(fwd=inner, mode="concat", name=self.name)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return self._wrapper().output_type(input_type)
+
+    def initialize(self, key, input_type):
+        return self._wrapper().initialize(key, input_type)
+
+    def init_state(self, input_type):
+        return self._wrapper().init_state(input_type)
+
+    def apply(self, params, state, x, ctx, initial_state=None):
+        if initial_state is not None:
+            raise ValueError(
+                "GravesBidirectionalLSTM cannot carry state across chunks:"
+                " the backward direction needs the full sequence")
+        return self._wrapper().apply(params, state, x, ctx)
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
 class SimpleRnn(FeedForwardLayer):
     """Vanilla RNN: h_t = act(x_t@Wx + h_{t-1}@Wh + b) (reference: SimpleRnn)."""
     activation: Activation = Activation.TANH

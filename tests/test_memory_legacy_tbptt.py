@@ -252,3 +252,34 @@ class TestBfloat16Training:
         ts, loss = step(model.train_state, (x,), (jnp.asarray(y),),
                         None, None, jrandom.PRNGKey(0))
         assert np.isfinite(float(loss))
+
+
+class TestGravesBidirectionalLSTM:
+    def test_trains_and_roundtrips(self, tmp_path):
+        from deeplearning4j_tpu.models.serialization import (
+            restore_multi_layer_network,
+            save_model,
+        )
+        from deeplearning4j_tpu.nn.layers.recurrent import (
+            GravesBidirectionalLSTM)
+
+        conf = (NeuralNetConfiguration.Builder().seed(1)
+                .updater(Adam(5e-3)).list()
+                .layer(GravesBidirectionalLSTM(n_out=8,
+                                               activation=Activation.TANH))
+                .layer(RnnOutputLayer(n_out=6, loss=LossFunction.MCXENT,
+                                      activation=Activation.SOFTMAX))
+                .set_input_type(InputType.recurrent(1, 60)).build())
+        m = MultiLayerNetwork(conf).init()
+        it = UciSequenceDataSetIterator(16)
+        m.fit(it)
+        assert np.isfinite(float(m._last_loss))
+        # fwd+bwd outputs concatenate: the output layer consumes 2*n_out
+        assert m.train_state.params["layer_1"]["W"].shape[0] == 16
+        p = str(tmp_path / "gb.zip")
+        save_model(m, p)
+        m2 = restore_multi_layer_network(p)
+        b = next(iter(it))
+        np.testing.assert_allclose(np.asarray(m.output(b.features)),
+                                   np.asarray(m2.output(b.features)),
+                                   rtol=1e-6)
