@@ -77,4 +77,12 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception:
+        # tunneled-device transports occasionally drop a compile/execute
+        # RPC; one retry protects the recorded metric
+        import traceback
+        traceback.print_exc()
+        time.sleep(5)
+        main()
