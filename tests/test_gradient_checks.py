@@ -226,3 +226,35 @@ def test_layernorm_gradients():
                OutputLayer(n_out=3)],
               InputType.feed_forward(4))
     assert check_model_gradients(m, small_ds())
+
+
+def test_self_attention_gradients():
+    from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+    n, t, f = 3, 6, 4
+    x = RNG.normal(size=(n, t, f))
+    y = onehot(RNG.integers(0, 3, n), 3)
+    m = build([SelfAttentionLayer(n_in=f, n_out=4, n_heads=2),
+               GlobalPoolingLayer(pooling_type=PoolingType.AVG),
+               OutputLayer(n_out=3)],
+              InputType.recurrent(f, t))
+    assert check_model_gradients(m, DataSet(x, y), max_params_per_leaf=6)
+
+
+def test_graves_bidirectional_lstm_gradients():
+    from deeplearning4j_tpu.nn.layers.recurrent import (
+        GravesBidirectionalLSTM)
+    n, t, f = 3, 5, 3
+    x = RNG.normal(size=(n, t, f))
+    y = np.stack([onehot(RNG.integers(0, 3, n), 3)] * t, axis=1)
+    m = build([GravesBidirectionalLSTM(n_in=f, n_out=4),
+               RnnOutputLayer(n_out=3)],
+              InputType.recurrent(f, t))
+    assert check_model_gradients(m, DataSet(x, y), max_params_per_leaf=4)
+
+
+def test_center_loss_gradients():
+    from deeplearning4j_tpu.nn.layers.output import CenterLossOutputLayer
+    m = build([DenseLayer(n_out=6, activation=Activation.TANH),
+               CenterLossOutputLayer(n_out=3, alpha=0.1, lambda_=0.01)],
+              InputType.feed_forward(4))
+    assert check_model_gradients(m, small_ds(), max_params_per_leaf=8)
