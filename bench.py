@@ -76,10 +76,29 @@ def main():
     }))
 
 
+def _is_transport_error(e: BaseException) -> bool:
+    """True only for dropped-RPC/tunnel failures. Real regressions (shape
+    errors, NaN asserts, OOM/RESOURCE_EXHAUSTED) must NOT be retried."""
+    if isinstance(e, (ConnectionError, TimeoutError)):
+        return True
+    try:
+        import jax
+        if isinstance(e, jax.errors.JaxRuntimeError):
+            msg = str(e).upper()
+            return any(t in msg for t in
+                       ("UNAVAILABLE", "DEADLINE_EXCEEDED", "CONNECTION",
+                        "SOCKET", "TRANSPORT", "RPC"))
+    except ImportError:
+        pass
+    return False
+
+
 if __name__ == "__main__":
     try:
         main()
-    except Exception:
+    except Exception as e:
+        if not _is_transport_error(e):
+            raise
         # tunneled-device transports occasionally drop a compile/execute
         # RPC; one retry protects the recorded metric
         import traceback

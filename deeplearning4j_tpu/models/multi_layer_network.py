@@ -38,6 +38,30 @@ from deeplearning4j_tpu.optimize.solver import (
 
 
 
+def _pad_tbptt_tail(f, l, fm, lm, k, seq_labels):
+    """Pad a ragged final TBPTT chunk to length k along time, masking the
+    padded steps out of both the recurrent math and the loss."""
+    n, t = f.shape[0], f.shape[1]
+    pad = k - t
+    f = np.concatenate(
+        [f, np.zeros((n, pad) + f.shape[2:], f.dtype)], axis=1)
+    base_fm = fm if fm is not None else np.ones((n, t), np.float32)
+    fm = np.concatenate([base_fm, np.zeros((n, pad), np.float32)], axis=1)
+    if seq_labels:
+        l = np.concatenate(
+            [l, np.zeros((n, pad) + l.shape[2:], l.dtype)], axis=1)
+        if lm is not None:
+            lm = np.concatenate(
+                [lm, np.zeros((n, pad), np.float32)], axis=1)
+        else:
+            # _loss falls back to fmask when lmask is None; the padded fm
+            # already carries per-example valid steps + zeroed padding, so
+            # synthesizing an all-ones lmask here would UNmask steps the
+            # features mask excludes
+            lm = fm
+    return f, l, fm, lm
+
+
 class MultiLayerNetwork(BaseModel):
     def __init__(self, conf: MultiLayerConfiguration):
         super().__init__()
@@ -235,15 +259,25 @@ class MultiLayerNetwork(BaseModel):
         loss = None
         for lo in range(0, T, k):
             hi = min(lo + k, T)
-            if hi - lo < k and lo > 0:
-                break  # drop ragged tail chunk (keeps one compiled shape)
+            f = feats[:, lo:hi]
+            l = labels[:, lo:hi] if seq_labels else labels
+            fm = None if fmask is None else fmask[:, lo:hi]
+            # a labels mask is per-timestep only for sequence labels; for
+            # 2-D labels it is per-output and must not be time-sliced
+            lm = (lmask if not seq_labels
+                  else None if lmask is None else lmask[:, lo:hi])
+            if hi - lo < k:
+                # Ragged tail: pad to length k with a zeroed feature mask so
+                # the final partial chunk still trains (reference:
+                # doTruncatedBPTT processes it; costs one extra compiled
+                # shape because fm/lm go from None to arrays).
+                f, l, fm, lm = _pad_tbptt_tail(f, l, fm, lm, k, seq_labels)
             self._rng, step_key = jax.random.split(self._rng)
-            f = jnp.asarray(feats[:, lo:hi])
-            l = jnp.asarray(labels[:, lo:hi] if seq_labels else labels)
-            fm = None if fmask is None else jnp.asarray(fmask[:, lo:hi])
-            lm = None if lmask is None else jnp.asarray(lmask[:, lo:hi])
+            fm = None if fm is None else jnp.asarray(fm)
+            lm = None if lm is None else jnp.asarray(lm)
             self.train_state, loss, carries = self._tbptt_step(
-                self.train_state, f, l, fm, lm, step_key, carries)
+                self.train_state, jnp.asarray(f), jnp.asarray(l), fm, lm,
+                step_key, carries)
         it = int(self.train_state.iteration)
         for lst in self.listeners:
             lst.iteration_done(self, it, self.epoch_count, loss, etl_ms,

@@ -115,10 +115,19 @@ class SequenceVectors:
         Word2Vec's overrides delegate here for non-CBOW, so it qualifies;
         ParagraphVectors/GloVe run their own fit loops and never reach
         this. Subclasses that customize pair generation must override
-        ``_add_pair`` (which disqualifies them automatically)."""
+        ``_add_pair`` or ``_train_sequence`` — either disqualifies them
+        automatically (the slow path's per-sequence hook is
+        ``_train_sequence``, so a subclass overriding only that must not
+        silently get generic SGNS behavior). A subclass whose override
+        merely delegates (Word2Vec) can opt back in by setting
+        ``_sgns_fast_path_safe = True`` on the override function."""
+        ts = type(self)._train_sequence
+        train_seq_ok = (ts is SequenceVectors._train_sequence
+                        or getattr(ts, "_sgns_fast_path_safe", False))
         return (not self.use_hs and not self.use_cbow
                 and self.iterations == 1
-                and type(self)._add_pair is SequenceVectors._add_pair)
+                and type(self)._add_pair is SequenceVectors._add_pair
+                and train_seq_ok)
 
     def _fit_fast_sgns(self, seqs, total_words: int):
         """Whole-corpus vectorized skip-gram with negative sampling: pair

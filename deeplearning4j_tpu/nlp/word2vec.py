@@ -96,6 +96,10 @@ class Word2Vec(SequenceVectors):
             jnp.asarray(targets), jnp.asarray(labels), jnp.asarray(mask),
             jnp.float32(lr))
 
+    # non-CBOW calls delegate straight to the base hook, so the vectorized
+    # SGNS fast path stays valid for Word2Vec (see _fast_sgns_ok)
+    _train_sequence._sgns_fast_path_safe = True
+
 
 class _CbowBatcher:
     def __init__(self, batch_size: int, ctx_w: int, k: int):

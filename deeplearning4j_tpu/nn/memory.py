@@ -160,11 +160,23 @@ def xla_memory_analysis(model, batch_size: int = 1,
     params = model.train_state.params
     mstate = model.train_state.model_state
 
-    def fwd(params, mstate, x):
-        out, _ = model._forward(params, mstate, x, None, False, None)
-        return out
+    if train:
+        # Lower the FULL train step (loss + backward + optimizer update) so
+        # gradients and updater state count toward the number reported —
+        # the forward alone badly underestimates training HBM.
+        step = model._build_train_step()
+        out_t = model.layers[-1].output_type(model._input_types[-1])
+        y_shape = (batch_size,) + tuple(
+            d if d > 0 else 8 for d in out_t.shape())
+        y = jnp.zeros(y_shape, jnp.float32)
+        lowered = step.lower(model.train_state, x, y, None, None,
+                             jax.random.PRNGKey(0))
+    else:
+        def fwd(params, mstate, x):
+            out, _ = model._forward(params, mstate, x, None, False, None)
+            return out
 
-    lowered = jax.jit(fwd).lower(params, mstate, x)
+        lowered = jax.jit(fwd).lower(params, mstate, x)
     compiled = lowered.compile()
     ma = compiled.memory_analysis()
     if ma is None:  # backend without memory analysis

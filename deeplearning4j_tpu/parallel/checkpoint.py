@@ -8,14 +8,18 @@ the job that restores it may have a different chip count (preemption,
 resize), so resharding is first-class (SURVEY §7.2 stage 7 "checkpoint
 resharding, elastic restart semantics"):
 
-- :func:`save_sharded` writes one ``.npz``-per-leaf layout with a JSON
-  manifest. Arrays are fetched through jax, which gathers across the
-  devices of a single-process mesh transparently. (Multi-host jobs need a
-  per-host gather — multihost_utils — before saving; process 0 writes.)
+- :func:`save_sharded` writes per-process shard files (format 2): every
+  process stores ONLY its addressable shards — no full-array gather
+  anywhere — and process 0 publishes the manifest after a global barrier,
+  so pod-scale models that never fit on one host checkpoint to a shared
+  filesystem orbax-style.
 - :func:`restore_sharded` loads the state and places it for a NEW mesh —
   any device count/topology — via the same sharding-inference rules used
-  at training start. Optimizer state is restored exactly, so an elastic
-  restart continues bit-identically modulo the data order.
+  at training start; each process assembles only the shard regions it
+  will hold (``jax.make_array_from_callback``), and optimizer-state
+  leaves that mirror a param get that param's sharding. Optimizer state
+  is restored exactly, so an elastic restart continues bit-identically
+  modulo the data order.
 - :class:`ElasticTrainer` wraps the fit loop with periodic sharded
   checkpoints and a ``resume()`` that reshards onto whatever mesh the
   restarted process has.
@@ -35,10 +39,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.optimize.solver import TrainState
-from deeplearning4j_tpu.parallel.sharding import (
-    apply_shardings,
-    infer_param_shardings,
-)
+from deeplearning4j_tpu.parallel.sharding import infer_param_shardings
 
 
 def _key_str(entry) -> str:
@@ -56,46 +57,99 @@ def _flatten(tree) -> Dict[str, Any]:
     return out
 
 
+def _barrier(name: str) -> None:
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)
+
+
+def _shard_starts(index, shape) -> list:
+    """Global start offsets of a shard's slice tuple."""
+    starts = []
+    for sl, dim in zip(index, shape):
+        starts.append(0 if sl.start is None else int(sl.start))
+    return starts
+
+
 def save_sharded(train_state: TrainState, directory: str,
                  step: Optional[int] = None) -> str:
     """Write params/model_state/opt_state + iteration under ``directory``.
-    Returns the checkpoint path (one subdir per step)."""
+
+    Multihost-safe: every process writes ONLY its addressable shards (one
+    ``{group}.proc{K}.npz`` + index sidecar per process per group — no
+    full-array gather anywhere, so a pod-scale model that never fits on
+    one host checkpoints fine on a shared filesystem, orbax-style).
+    Process 0 publishes the manifest + COMMITTED marker after a global
+    barrier. Returns the checkpoint path (one subdir per step).
+    """
     it = int(train_state.iteration) if step is None else int(step)
     path = os.path.join(directory, f"step_{it:010d}")
     if os.path.exists(os.path.join(path, "COMMITTED")):
         # this step is already durably saved; rewriting would open a
         # crash window that destroys the only committed copy
         return path
+    pidx = jax.process_index()
     tmp = path + ".tmp"
-    if os.path.isdir(tmp):
-        shutil.rmtree(tmp)
-    os.makedirs(tmp)
-    manifest = {"iteration": it, "groups": {}, "dtypes": {}}
+    if pidx == 0:
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+    _barrier(f"ckpt_mkdir_{it}")
+    manifest = {"format": 2, "iteration": it,
+                "process_count": jax.process_count(),
+                "groups": {}, "dtypes": {}, "shapes": {}}
     for group, tree in (("params", train_state.params),
                         ("model_state", train_state.model_state),
                         ("opt_state", train_state.opt_state)):
         leaves = _flatten(tree)
-        arrays = {}
+        arrays: Dict[str, np.ndarray] = {}
+        index: Dict[str, Dict[str, Any]] = {}
+        names = []
         for k, v in leaves.items():
             if not hasattr(v, "shape"):
                 continue
-            a = np.asarray(v)
-            if a.dtype == jnp.bfloat16:
-                # npz has no bf16: carry the raw bits, record the dtype
+            names.append(k)
+            is_bf16 = v.dtype == jnp.bfloat16
+            if is_bf16:
                 manifest["dtypes"][f"{group}/{k}"] = "bfloat16"
-                a = a.view(np.uint16)
-            arrays[k] = a
-        np.savez(os.path.join(tmp, f"{group}.npz"), **arrays)
-        manifest["groups"][group] = sorted(arrays)
-    with open(os.path.join(tmp, "manifest.json"), "w") as f:
-        json.dump(manifest, f)
-    # completion marker inside the staged dir; the rename publishes it
-    # atomically, so a torn write can never look committed
-    with open(os.path.join(tmp, "COMMITTED"), "w") as f:
-        f.write("ok")
-    if os.path.isdir(path):  # uncommitted partial from a prior crash
-        shutil.rmtree(path)
-    os.rename(tmp, path)
+            manifest["shapes"][f"{group}/{k}"] = list(np.shape(v))
+            if isinstance(v, jax.Array) and hasattr(v, "addressable_shards"):
+                # replica_id==0 dedups replicated copies (exactly one
+                # process/device owns each piece of the global array)
+                for i, s in enumerate(v.addressable_shards):
+                    if s.replica_id != 0:
+                        continue
+                    a = np.asarray(s.data)
+                    if is_bf16:
+                        a = a.view(np.uint16)
+                    ent = f"{k}::{i}"
+                    arrays[ent] = a
+                    index[ent] = {"leaf": k, "dtype": str(a.dtype),
+                                  "start": _shard_starts(s.index, v.shape)}
+            elif pidx == 0:  # plain numpy leaf: identical everywhere
+                a = np.asarray(v)
+                if is_bf16:
+                    a = a.view(np.uint16)
+                arrays[f"{k}::0"] = a
+                index[f"{k}::0"] = {"leaf": k, "dtype": str(a.dtype),
+                                    "start": [0] * np.ndim(v)}
+        np.savez(os.path.join(tmp, f"{group}.proc{pidx:04d}.npz"), **arrays)
+        with open(os.path.join(tmp, f"{group}.proc{pidx:04d}.idx.json"),
+                  "w") as f:
+            json.dump(index, f)
+        manifest["groups"][group] = sorted(set(names))
+    _barrier(f"ckpt_written_{it}")
+    if pidx == 0:
+        with open(os.path.join(tmp, "manifest.json"), "w") as f:
+            json.dump(manifest, f)
+        # completion marker inside the staged dir; the rename publishes it
+        # atomically, so a torn write can never look committed
+        with open(os.path.join(tmp, "COMMITTED"), "w") as f:
+            f.write("ok")
+        if os.path.isdir(path):  # uncommitted partial from a prior crash
+            shutil.rmtree(path)
+        os.rename(tmp, path)
+    _barrier(f"ckpt_commit_{it}")
     return path
 
 
@@ -110,6 +164,103 @@ def latest_checkpoint(directory: str) -> Optional[str]:
     return os.path.join(directory, sorted(steps)[-1])
 
 
+class _GroupReader:
+    """Lazy region reads over a checkpoint group: a leaf is assembled
+    piece-by-piece and only for the requested global region, so a process
+    restoring onto a sharded mesh never materializes the full array
+    (format 2; the legacy single-npz format 1 reads whole leaves)."""
+
+    def __init__(self, path: str, group: str, manifest: dict):
+        self.group = group
+        self.shapes = {k.split("/", 1)[1]: tuple(v)
+                       for k, v in manifest.get("shapes", {}).items()
+                       if k.startswith(group + "/")}
+        self._pieces: Dict[str, list] = {}
+        self._dtypes: Dict[str, np.dtype] = {}
+        self._legacy = None
+        if manifest.get("format", 1) < 2:
+            self._legacy = np.load(os.path.join(path, f"{group}.npz"))
+            for k in self._legacy.files:
+                self._pieces[k] = []
+                self.shapes.setdefault(k, tuple(self._legacy[k].shape))
+            return
+        for pf in sorted(f for f in os.listdir(path)
+                         if f.startswith(f"{group}.proc")
+                         and f.endswith(".npz")):
+            with open(os.path.join(
+                    path, pf[:-len(".npz")] + ".idx.json")) as fh:
+                index = json.load(fh)
+            npz = np.load(os.path.join(path, pf))  # lazy per-entry zip
+            for ent, meta in index.items():
+                self._pieces.setdefault(meta["leaf"], []).append(
+                    (tuple(meta["start"]), npz, ent))
+                if "dtype" in meta:
+                    self._dtypes[meta["leaf"]] = np.dtype(meta["dtype"])
+
+    def keys(self):
+        return set(self._pieces)
+
+    def read(self, key: str, region=None) -> np.ndarray:
+        """Assemble the leaf (or just ``region``, a tuple of slices into
+        the global shape) from the pieces that overlap it."""
+        if self._legacy is not None:
+            a = self._legacy[key]
+            return a if region is None else np.ascontiguousarray(a[region])
+        shape = self.shapes[key]
+        pieces = self._pieces[key]
+        if region is None:
+            region = tuple(slice(0, d) for d in shape)
+        lo = [0 if r.start is None else int(r.start) for r in region]
+        hi = [shape[i] if r.stop is None else int(r.stop)
+              for i, r in enumerate(region)]
+        dtype = self._dtypes.get(key)
+        if dtype is None:  # pre-sidecar-dtype save: probe the first piece
+            dtype = pieces[0][1][pieces[0][2]].dtype if pieces \
+                else np.float32
+        out = np.zeros([b - a for a, b in zip(lo, hi)], dtype)
+        for pstart, npz, ent in pieces:
+            piece = npz[ent]
+            src, dst, skip = [], [], False
+            for d in range(len(shape)):
+                a = max(lo[d], pstart[d])
+                b = min(hi[d], pstart[d] + piece.shape[d])
+                if a >= b:
+                    skip = True
+                    break
+                src.append(slice(a - pstart[d], b - pstart[d]))
+                dst.append(slice(a - lo[d], b - lo[d]))
+            if not skip:
+                out[tuple(dst)] = piece[tuple(src)]
+        return out
+
+
+def mirror_opt_shardings(opt_state, params, param_shardings, replicated):
+    """Sharding tree for an optimizer state: each leaf whose pytree path
+    ends with a param's path (optax states embed the param tree, e.g.
+    ScaleByAdamState.mu/nu) and matches its shape gets that param's
+    sharding; everything else (step counts, scalars) is replicated."""
+    pflat, _ = jax.tree_util.tree_flatten_with_path(params)
+    sflat, _ = jax.tree_util.tree_flatten_with_path(param_shardings)
+    by_path = {}
+    for (pp, leaf), (_, sh) in zip(pflat, sflat):
+        key = tuple(_key_str(q) for q in pp)
+        by_path[key] = (tuple(getattr(leaf, "shape", ())), sh)
+    oflat, otree = jax.tree_util.tree_flatten_with_path(opt_state)
+    out = []
+    for op, leaf in oflat:
+        okey = tuple(_key_str(q) for q in op)
+        sh = replicated
+        shape = tuple(getattr(leaf, "shape", ()))
+        if shape:
+            for pkey, (pshape, psh) in by_path.items():
+                if (pshape == shape and len(okey) >= len(pkey)
+                        and okey[-len(pkey):] == pkey):
+                    sh = psh
+                    break
+        out.append(sh)
+    return jax.tree_util.tree_unflatten(otree, out)
+
+
 def restore_sharded(model, path: str, mesh: Optional[Mesh] = None
                     ) -> TrainState:
     """Restore a sharded checkpoint into ``model`` (already init()ed so
@@ -117,31 +268,57 @@ def restore_sharded(model, path: str, mesh: Optional[Mesh] = None
     have a different device count than the mesh that saved it."""
     with open(os.path.join(path, "manifest.json")) as f:
         manifest = json.load(f)
-    loaded = {g: dict(np.load(os.path.join(path, f"{g}.npz")))
-              for g in manifest["groups"]}
-
     dtypes = manifest.get("dtypes", {})
+    ts = model.train_state
 
-    def rebuild(group, template, flat: Dict[str, np.ndarray]):
+    # Target shardings come from the TEMPLATE trees (shapes known before
+    # any data is read) so each leaf can be constructed directly with its
+    # final placement — a process on a sharded mesh reads only the shard
+    # regions it will hold, never the whole array.
+    if mesh is not None:
+        param_sh = infer_param_shardings(ts.params, mesh)
+        repl = NamedSharding(mesh, P())
+        opt_sh = mirror_opt_shardings(ts.opt_state, ts.params, param_sh,
+                                      repl)
+        mstate_sh = jax.tree_util.tree_map(lambda _: repl, ts.model_state)
+    else:
+        param_sh = opt_sh = mstate_sh = repl = None
+
+    def rebuild(group, template, shardings):
+        reader = _GroupReader(path, group, manifest)
         flat_t, treedef = jax.tree_util.tree_flatten_with_path(template)
+        sh_list = ([None] * len(flat_t) if shardings is None else
+                   jax.tree_util.tree_leaves(
+                       shardings, is_leaf=lambda x: x is None))
         leaves = []
         consumed = set()
-        for p, leaf in flat_t:
+        stored_keys = reader.keys()
+        for (p, leaf), sh in zip(flat_t, sh_list):
             key = "/".join(_key_str(q) for q in p)
-            if key in flat:
+            if key in stored_keys:
                 consumed.add(key)
-                arr = flat[key]
-                if dtypes.get(f"{group}/{key}") == "bfloat16":
-                    import ml_dtypes
-                    # stored as raw uint16 bits; reinterpret, don't convert
-                    arr = arr.view(ml_dtypes.bfloat16)
-                if hasattr(leaf, "shape") and \
-                        tuple(leaf.shape) != tuple(np.shape(arr)):
+                is_bf16 = dtypes.get(f"{group}/{key}") == "bfloat16"
+
+                def fetch(region=None, _k=key, _b=is_bf16):
+                    arr = reader.read(_k, region)
+                    if _b:
+                        import ml_dtypes
+                        # raw uint16 bits; reinterpret, don't convert
+                        arr = arr.view(ml_dtypes.bfloat16)
+                    return arr
+
+                stored_shape = reader.shapes.get(key)
+                if hasattr(leaf, "shape") and stored_shape is not None and \
+                        tuple(leaf.shape) != tuple(stored_shape):
                     raise ValueError(
                         f"checkpoint leaf {key} has shape "
-                        f"{np.shape(arr)}, model expects "
+                        f"{tuple(stored_shape)}, model expects "
                         f"{tuple(leaf.shape)}")
-                leaves.append(jnp.asarray(arr))
+                if sh is not None and hasattr(leaf, "shape"):
+                    leaves.append(jax.make_array_from_callback(
+                        tuple(leaf.shape), sh, fetch))
+                else:
+                    leaves.append(jnp.asarray(fetch()))
             elif hasattr(leaf, "shape") and np.size(leaf) > 0:
                 # an array the model expects but the checkpoint lacks:
                 # resuming would silently mix restored and random weights
@@ -150,28 +327,18 @@ def restore_sharded(model, path: str, mesh: Optional[Mesh] = None
                     "(layer added/renamed since the save?)")
             else:
                 leaves.append(leaf)  # non-array leaf (counts, None)
-        unconsumed = set(flat) - consumed
+        unconsumed = stored_keys - consumed
         if unconsumed:
             warnings.warn(
                 f"checkpoint {group} entries not used by this model: "
                 f"{sorted(unconsumed)[:5]}...", stacklevel=2)
         return jax.tree_util.tree_unflatten(treedef, leaves)
 
-    ts = model.train_state
-    params = rebuild("params", ts.params, loaded.get("params", {}))
-    mstate = rebuild("model_state", ts.model_state,
-                     loaded.get("model_state", {}))
-    opt = rebuild("opt_state", ts.opt_state, loaded.get("opt_state", {}))
+    params = rebuild("params", ts.params, param_sh)
+    mstate = rebuild("model_state", ts.model_state, mstate_sh)
+    opt = rebuild("opt_state", ts.opt_state, opt_sh)
     iteration = jnp.asarray(manifest["iteration"], jnp.int32)
-
     if mesh is not None:
-        # reshard for the new topology: params by inference rules,
-        # everything else replicated
-        shardings = infer_param_shardings(params, mesh)
-        params = apply_shardings(params, shardings)
-        repl = NamedSharding(mesh, P())
-        mstate = jax.device_put(mstate, repl)
-        opt = jax.device_put(opt, repl)
         iteration = jax.device_put(iteration, repl)
 
     new_ts = TrainState(params, mstate, opt, iteration)
