@@ -121,6 +121,11 @@ class Hdf5Archive:
                 out["/".join(path_parts[-2:])] = arr
             if len(path_parts) > 2:
                 out["/".join(path_parts)] = arr
+            # Keras-1 names carry the layer as a prefix, not a path
+            # ("dense_1_W", "lstm_1_W_i"): alias the bare suffix too
+            leaf = path_parts[-1]
+            if leaf.startswith(layer_name + "_"):
+                out[leaf[len(layer_name) + 1:]] = arr
 
         if names:
             for wname in names:

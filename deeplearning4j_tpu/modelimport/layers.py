@@ -33,9 +33,12 @@ from deeplearning4j_tpu.nn.layers.convolution import (
     Deconvolution2D,
     PoolingType,
     SeparableConvolution2D,
+    SpaceToDepthLayer,
     SubsamplingLayer,
     Subsampling1DLayer,
+    Upsampling1D,
     Upsampling2D,
+    ZeroPadding1DLayer,
     ZeroPaddingLayer,
 )
 from deeplearning4j_tpu.nn.layers.feedforward import (
@@ -48,6 +51,7 @@ from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
 from deeplearning4j_tpu.nn.layers.normalization import (
     BatchNormalization,
     LayerNormalization,
+    LocalResponseNormalization,
 )
 from deeplearning4j_tpu.nn.layers.output import GlobalPoolingLayer
 from deeplearning4j_tpu.nn.layers.recurrent import (
@@ -161,6 +165,16 @@ def _lstm_weights(w: Dict[str, np.ndarray]) -> Tuple[dict, dict]:
         params["Wh"] = _lstm_permute(w["recurrent_kernel"])
     if "bias" in w:
         params["b"] = _lstm_permute(w["bias"])
+    if "W_i" in w:
+        # genuine Keras-1 layout: one matrix per gate (lstm_1_W_i /
+        # U_i / b_i, ...); Keras gate letters i,f,c,o → our order i,f,o,c
+        params["Wx"] = np.concatenate(
+            [w["W_i"], w["W_f"], w["W_o"], w["W_c"]], axis=-1)
+        params["Wh"] = np.concatenate(
+            [w["U_i"], w["U_f"], w["U_o"], w["U_c"]], axis=-1)
+        if "b_i" in w:
+            params["b"] = np.concatenate(
+                [w["b_i"], w["b_f"], w["b_o"], w["b_c"]], axis=-1)
     return params, {}
 
 
@@ -221,8 +235,12 @@ def conv2d_transpose(cfg, _v):
 
 
 def conv1d(cfg, _v):
+    """Conv1D / Convolution1D, and Keras-1 AtrousConvolution1D (which
+    differs only in carrying dilation as ``atrous_rate`` — reference:
+    KerasAtrousConvolution1D.java)."""
     act = map_activation(cfg.get("activation", "linear"))
-    mode, _pad = _conv_mode(cfg.get("padding", "valid"))
+    mode, _pad = _conv_mode(cfg.get("padding", cfg.get("border_mode",
+                                                       "valid")))
     return Converted(
         layer=Convolution1DLayer(
             n_out=int(cfg.get("filters", cfg.get("nb_filter", 0))),
@@ -230,8 +248,10 @@ def conv1d(cfg, _v):
                                            cfg.get("filter_length", 1)))),
             stride=int(_first(cfg.get("strides",
                                       cfg.get("subsample_length", 1)))),
+            dilation=int(_first(cfg.get("atrous_rate",
+                                        cfg.get("dilation_rate", 1)))),
             convolution_mode=mode, activation=act,
-            has_bias=bool(cfg.get("use_bias", True))),
+            has_bias=bool(cfg.get("use_bias", cfg.get("bias", True)))),
         weights=_dense_weights, activation=act)
 
 
@@ -377,6 +397,55 @@ def cropping2d(cfg, _v):
 def upsampling2d(cfg, _v):
     return Converted(layer=Upsampling2D(size=_pair(cfg.get("size",
                                                            (2, 2)))))
+
+
+def atrous_conv2d(cfg, _v):
+    """Keras-1 AtrousConvolution2D: a Conv2D whose dilation comes from
+    ``atrous_rate`` (reference: KerasAtrousConvolution2D.java)."""
+    act = map_activation(cfg.get("activation", "linear"))
+    common = _conv_common(cfg)
+    common["dilation"] = _pair(cfg.get("atrous_rate", (1, 1)))
+    return Converted(
+        layer=ConvolutionLayer(activation=act, **common),
+        weights=_dense_weights, activation=act)
+
+
+def zero_padding1d(cfg, _v):
+    p = cfg.get("padding", 1)
+    if isinstance(p, (list, tuple)):
+        lo, hi = int(p[0]), int(p[1])
+    else:
+        lo = hi = int(p)
+    return Converted(layer=ZeroPadding1DLayer(pad=(lo, hi)))
+
+
+def upsampling1d(cfg, _v):
+    # Keras 2: "size"; Keras 1: "length"
+    return Converted(layer=Upsampling1D(
+        size=int(cfg.get("size", cfg.get("length", 2)))))
+
+
+def space_to_depth(cfg, _v):
+    """tf.nn.space_to_depth wrapper layer used by YOLO-family models
+    (reference: KerasSpaceToDepth.java)."""
+    return Converted(layer=SpaceToDepthLayer(
+        block_size=int(cfg.get("block_size", 2))))
+
+
+def lrn(cfg, _v):
+    """Community LRN layer from GoogLeNet-era Keras models (reference:
+    custom/KerasLRN.java — registered, not built-in)."""
+    return Converted(layer=LocalResponseNormalization(
+        k=float(cfg.get("k", 2.0)), n=int(cfg.get("n", 5)),
+        alpha=float(cfg.get("alpha", 1e-4)),
+        beta=float(cfg.get("beta", 0.75))))
+
+
+def pool_helper(cfg, _v):
+    """GoogLeNet PoolHelper: strips the first row and column to mimic
+    caffe's asymmetric pooling (reference: custom/KerasPoolHelper.java →
+    PoolHelperVertex)."""
+    return Converted(layer=Cropping2D(crop=(1, 0, 1, 0)))
 
 
 def merge_add(cfg, _v):
@@ -561,8 +630,17 @@ CONVERTERS: Dict[str, Callable[[dict, int], Converted]] = {
     "Flatten": flatten, "Reshape": flatten, "Permute": flatten,
     "InputLayer": input_layer, "Input": input_layer,
     "ZeroPadding2D": zero_padding2d,
+    "ZeroPadding1D": zero_padding1d,
     "Cropping2D": cropping2d,
     "UpSampling2D": upsampling2d,
+    "UpSampling1D": upsampling1d,
+    "AtrousConvolution2D": atrous_conv2d,
+    "AtrousConvolution1D": conv1d,
+    "SpaceToDepth": space_to_depth,
+    # GoogLeNet-era community layers — the reference requires
+    # registerCustomLayer for these; we ship them built-in
+    "LRN": lrn, "LRN2D": lrn,
+    "PoolHelper": pool_helper,
     "Add": merge_add, "add": merge_add,
     "Subtract": merge_sub, "subtract": merge_sub,
     "Multiply": merge_mul, "multiply": merge_mul,

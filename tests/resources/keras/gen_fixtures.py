@@ -1,0 +1,374 @@
+"""Generate the committed Keras fixture corpus (reference analog:
+deeplearning4j-modelimport/src/test/resources + KerasModelEndToEndTest).
+
+Writes genuine Keras-1-FORMAT and Keras-2-FORMAT .h5 files byte-by-byte
+with h5py (the installed Keras is v3 and cannot emit the old dialects),
+plus a ``<name>_io.npz`` with a fixed input and the expected output
+computed by independent numpy reference math — so the e2e test checks
+import fidelity against something other than our own layers.
+
+Run from the repo root to regenerate:  python tests/resources/keras/gen_fixtures.py
+"""
+
+import json
+import os
+
+import h5py
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+RNG = np.random.default_rng(20260730)
+
+
+# ---- numpy reference math -------------------------------------------------
+
+def relu(x):
+    return np.maximum(x, 0.0)
+
+
+def softmax(x):
+    e = np.exp(x - x.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def dense(x, W, b):
+    return x @ W + b
+
+
+def conv2d_valid(x, W, b, dilation=1):
+    n, h, w, cin = x.shape
+    kh, kw, _, cout = W.shape
+    eh, ew = (kh - 1) * dilation + 1, (kw - 1) * dilation + 1
+    oh, ow = h - eh + 1, w - ew + 1
+    y = np.zeros((n, oh, ow, cout))
+    for di in range(kh):
+        for dj in range(kw):
+            patch = x[:, di * dilation:di * dilation + oh,
+                      dj * dilation:dj * dilation + ow, :]
+            y += np.einsum("nhwc,co->nhwo", patch, W[di, dj])
+    return y + b
+
+
+def maxpool2d(x, k=2, s=2):
+    n, h, w, c = x.shape
+    oh, ow = (h - k) // s + 1, (w - k) // s + 1
+    y = np.full((n, oh, ow, c), -np.inf)
+    for di in range(k):
+        for dj in range(k):
+            y = np.maximum(y, x[:, di:di + oh * s:s, dj:dj + ow * s:s, :])
+    return y
+
+
+def conv1d_valid(x, W, b, dilation=1):
+    n, t, cin = x.shape
+    k, _, cout = W.shape
+    et = (k - 1) * dilation + 1
+    ot = t - et + 1
+    y = np.zeros((n, ot, cout))
+    for d in range(k):
+        y += np.einsum("ntc,co->nto", x[:, d * dilation:d * dilation + ot],
+                       W[d])
+    return y + b
+
+
+def lstm_last(x, Wg, Ug, bg):
+    """Per-gate Keras-1 LSTM (activation=tanh, inner_activation=sigmoid);
+    returns the last hidden state. Wg/Ug/bg keyed by gate letter."""
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    n, t, _ = x.shape
+    hdim = Wg["i"].shape[1]
+    h = np.zeros((n, hdim))
+    c = np.zeros((n, hdim))
+    for step in range(t):
+        xt = x[:, step]
+        i = sig(xt @ Wg["i"] + h @ Ug["i"] + bg["i"])
+        f = sig(xt @ Wg["f"] + h @ Ug["f"] + bg["f"])
+        o = sig(xt @ Wg["o"] + h @ Ug["o"] + bg["o"])
+        g = np.tanh(xt @ Wg["c"] + h @ Ug["c"] + bg["c"])
+        c = f * c + i * g
+        h = o * np.tanh(c)
+    return h
+
+
+def lrn(x, k=2.0, n=5, alpha=1e-4, beta=0.75):
+    half = n // 2
+    sq = np.square(x)
+    pad = [(0, 0)] * (x.ndim - 1) + [(half, half)]
+    sq_pad = np.pad(sq, pad)
+    ssum = sum(sq_pad[..., i:i + x.shape[-1]] for i in range(n))
+    return x / np.power(k + alpha * ssum, beta)
+
+
+def space_to_depth(x, b=2):
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // b, b, w // b, b, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // b, w // b,
+                                                 b * b * c)
+
+
+# ---- h5 writers -----------------------------------------------------------
+
+def write_k1(path, model_config_list, layer_weights, training_config=None):
+    """Genuine Keras-1 file layout: model_config is a bare LIST; weights
+    are flat per-layer datasets named '<layer>_W' etc. (no ':0', no
+    paths); keras_version 1.2.2 at root."""
+    with h5py.File(path, "w") as f:
+        f.attrs["keras_version"] = np.bytes_("1.2.2")
+        f.attrs["model_config"] = np.bytes_(json.dumps(
+            {"class_name": "Sequential", "config": model_config_list}))
+        if training_config:
+            f.attrs["training_config"] = np.bytes_(
+                json.dumps(training_config))
+        mw = f.create_group("model_weights")
+        mw.attrs["layer_names"] = np.array(
+            [np.bytes_(n) for n in layer_weights])
+        for lname, weights in layer_weights.items():
+            g = mw.create_group(lname)
+            g.attrs["weight_names"] = np.array(
+                [np.bytes_(wn) for wn in weights])
+            for wn, arr in weights.items():
+                g.create_dataset(wn, data=arr.astype(np.float32))
+
+
+def write_k2(path, layers_config, layer_weights, training_config=None):
+    """Keras-2 file layout: model_config {'layers': [...]}, weight names
+    '<layer>/<weight>:0', keras_version 2.2.4 on the weights group."""
+    with h5py.File(path, "w") as f:
+        f.attrs["keras_version"] = np.bytes_("2.2.4")
+        f.attrs["model_config"] = np.bytes_(json.dumps(
+            {"class_name": "Sequential",
+             "config": {"name": "sequential", "layers": layers_config}}))
+        if training_config:
+            f.attrs["training_config"] = np.bytes_(
+                json.dumps(training_config))
+        mw = f.create_group("model_weights")
+        mw.attrs["keras_version"] = np.bytes_("2.2.4")
+        mw.attrs["layer_names"] = np.array(
+            [np.bytes_(n) for n in layer_weights])
+        for lname, weights in layer_weights.items():
+            g = mw.create_group(lname)
+            names = [f"{lname}/{wn}:0" for wn in weights]
+            g.attrs["weight_names"] = np.array(
+                [np.bytes_(n) for n in names])
+            sub = g.create_group(lname)
+            for wn, arr in weights.items():
+                sub.create_dataset(f"{wn}:0", data=arr.astype(np.float32))
+
+
+def save_io(name, x, y):
+    np.savez(os.path.join(HERE, f"{name}_io.npz"),
+             x=x.astype(np.float32), y=y.astype(np.float32))
+
+
+# ---- fixtures -------------------------------------------------------------
+
+def k1_mlp():
+    W1 = RNG.normal(0, 0.4, (8, 16))
+    b1 = RNG.normal(0, 0.1, (16,))
+    W2 = RNG.normal(0, 0.4, (16, 4))
+    b2 = RNG.normal(0, 0.1, (4,))
+    cfg = [
+        {"class_name": "Dense", "config": {
+            "name": "dense_1", "output_dim": 16, "input_dim": 8,
+            "batch_input_shape": [None, 8], "activation": "relu",
+            "init": "glorot_uniform", "bias": True}},
+        {"class_name": "Dense", "config": {
+            "name": "dense_2", "output_dim": 4, "activation": "linear",
+            "init": "glorot_uniform", "bias": True}},
+        {"class_name": "Activation", "config": {
+            "name": "activation_1", "activation": "softmax"}},
+    ]
+    weights = {"dense_1": {"dense_1_W": W1, "dense_1_b": b1},
+               "dense_2": {"dense_2_W": W2, "dense_2_b": b2},
+               "activation_1": {}}
+    write_k1(os.path.join(HERE, "k1_mlp.h5"), cfg, weights,
+             {"loss": "categorical_crossentropy"})
+    x = RNG.normal(0, 1, (5, 8))
+    save_io("k1_mlp", x, softmax(dense(relu(dense(x, W1, b1)), W2, b2)))
+
+
+def k1_cnn_atrous():
+    Wc = RNG.normal(0, 0.3, (3, 3, 2, 4))
+    bc = RNG.normal(0, 0.05, (4,))
+    Wa = RNG.normal(0, 0.3, (3, 3, 4, 6))
+    ba = RNG.normal(0, 0.05, (6,))
+    Wd = RNG.normal(0, 0.2, (54, 3))
+    bd = RNG.normal(0, 0.05, (3,))
+    cfg = [
+        {"class_name": "Convolution2D", "config": {
+            "name": "convolution2d_1", "nb_filter": 4, "nb_row": 3,
+            "nb_col": 3, "border_mode": "valid", "subsample": [1, 1],
+            "dim_ordering": "tf", "activation": "relu",
+            "batch_input_shape": [None, 12, 12, 2], "bias": True}},
+        {"class_name": "AtrousConvolution2D", "config": {
+            "name": "atrousconvolution2d_1", "nb_filter": 6, "nb_row": 3,
+            "nb_col": 3, "atrous_rate": [2, 2], "border_mode": "valid",
+            "subsample": [1, 1], "dim_ordering": "tf",
+            "activation": "relu", "bias": True}},
+        {"class_name": "MaxPooling2D", "config": {
+            "name": "maxpooling2d_1", "pool_size": [2, 2],
+            "strides": [2, 2], "border_mode": "valid",
+            "dim_ordering": "tf"}},
+        {"class_name": "Flatten", "config": {"name": "flatten_1"}},
+        {"class_name": "Dense", "config": {
+            "name": "dense_1", "output_dim": 3, "activation": "softmax",
+            "init": "glorot_uniform", "bias": True}},
+    ]
+    weights = {
+        "convolution2d_1": {"convolution2d_1_W": Wc,
+                            "convolution2d_1_b": bc},
+        "atrousconvolution2d_1": {"atrousconvolution2d_1_W": Wa,
+                                  "atrousconvolution2d_1_b": ba},
+        "maxpooling2d_1": {}, "flatten_1": {},
+        "dense_1": {"dense_1_W": Wd, "dense_1_b": bd},
+    }
+    write_k1(os.path.join(HERE, "k1_cnn_atrous.h5"), cfg, weights,
+             {"loss": "categorical_crossentropy"})
+    x = RNG.normal(0, 1, (3, 12, 12, 2))
+    h = relu(conv2d_valid(x, Wc, bc))          # 10x10x4
+    h = relu(conv2d_valid(h, Wa, ba, dilation=2))  # 6x6x6
+    h = maxpool2d(h)                           # 3x3x6
+    h = h.reshape(h.shape[0], -1)              # 54
+    save_io("k1_cnn_atrous", x, softmax(dense(h, Wd, bd)))
+
+
+def k1_lstm():
+    F, H = 6, 8
+    Wg = {g: RNG.normal(0, 0.3, (F, H)) for g in "ifco"}
+    Ug = {g: RNG.normal(0, 0.3, (H, H)) for g in "ifco"}
+    bg = {g: RNG.normal(0, 0.05, (H,)) for g in "ifco"}
+    Wd = RNG.normal(0, 0.3, (H, 4))
+    bd = RNG.normal(0, 0.05, (4,))
+    cfg = [
+        {"class_name": "LSTM", "config": {
+            "name": "lstm_1", "output_dim": H, "activation": "tanh",
+            "inner_activation": "sigmoid", "return_sequences": False,
+            "batch_input_shape": [None, 7, F]}},
+        {"class_name": "Dense", "config": {
+            "name": "dense_1", "output_dim": 4, "activation": "softmax",
+            "init": "glorot_uniform", "bias": True}},
+    ]
+    lw = {}
+    for g in "ifco":
+        lw[f"lstm_1_W_{g}"] = Wg[g]
+        lw[f"lstm_1_U_{g}"] = Ug[g]
+        lw[f"lstm_1_b_{g}"] = bg[g]
+    weights = {"lstm_1": lw,
+               "dense_1": {"dense_1_W": Wd, "dense_1_b": bd}}
+    write_k1(os.path.join(HERE, "k1_lstm.h5"), cfg, weights,
+             {"loss": "categorical_crossentropy"})
+    x = RNG.normal(0, 1, (4, 7, F))
+    h = lstm_last(x, Wg, Ug, bg)
+    save_io("k1_lstm", x, softmax(dense(h, Wd, bd)))
+
+
+def k2_googlenet_bits():
+    """LRN + PoolHelper: the GoogLeNet-era community layers (reference
+    registers them via registerCustomLayer; we convert built-in)."""
+    Wc = RNG.normal(0, 0.3, (3, 3, 2, 4))
+    bc = RNG.normal(0, 0.05, (4,))
+    Wd = RNG.normal(0, 0.2, (64, 3))
+    bd = RNG.normal(0, 0.05, (3,))
+    cfg = [
+        {"class_name": "Conv2D", "config": {
+            "name": "conv2d_1", "filters": 4, "kernel_size": [3, 3],
+            "strides": [1, 1], "padding": "valid", "activation": "relu",
+            "use_bias": True, "batch_input_shape": [None, 11, 11, 2]}},
+        {"class_name": "LRN", "config": {
+            "name": "lrn_1", "alpha": 1e-4, "beta": 0.75, "k": 2, "n": 5}},
+        {"class_name": "PoolHelper", "config": {"name": "poolhelper_1"}},
+        {"class_name": "MaxPooling2D", "config": {
+            "name": "maxpooling2d_1", "pool_size": [2, 2],
+            "strides": [2, 2], "padding": "valid"}},
+        {"class_name": "Flatten", "config": {"name": "flatten_1"}},
+        {"class_name": "Dense", "config": {
+            "name": "dense_1", "units": 3, "activation": "softmax",
+            "use_bias": True}},
+    ]
+    weights = {"conv2d_1": {"kernel": Wc, "bias": bc},
+               "lrn_1": {}, "poolhelper_1": {}, "maxpooling2d_1": {},
+               "flatten_1": {},
+               "dense_1": {"kernel": Wd, "bias": bd}}
+    write_k2(os.path.join(HERE, "k2_googlenet_bits.h5"), cfg, weights,
+             {"loss": "categorical_crossentropy"})
+    x = RNG.normal(0, 1, (3, 11, 11, 2))
+    h = relu(conv2d_valid(x, Wc, bc))   # 9x9x4
+    h = lrn(h)
+    h = h[:, 1:, 1:, :]                 # PoolHelper: strip first row/col
+    h = maxpool2d(h)                    # 4x4x4
+    h = h.reshape(h.shape[0], -1)       # 64
+    save_io("k2_googlenet_bits", x, softmax(dense(h, Wd, bd)))
+
+
+def k2_yolo_bits():
+    """SpaceToDepth, the YOLO passthrough layer."""
+    Wc = RNG.normal(0, 0.3, (3, 3, 3, 4))
+    bc = RNG.normal(0, 0.05, (4,))
+    Wd = RNG.normal(0, 0.2, (144, 5))
+    bd = RNG.normal(0, 0.05, (5,))
+    cfg = [
+        {"class_name": "Conv2D", "config": {
+            "name": "conv2d_1", "filters": 4, "kernel_size": [3, 3],
+            "strides": [1, 1], "padding": "valid", "activation": "relu",
+            "use_bias": True, "batch_input_shape": [None, 8, 8, 3]}},
+        {"class_name": "SpaceToDepth", "config": {
+            "name": "space_to_depth_1", "block_size": 2}},
+        {"class_name": "Flatten", "config": {"name": "flatten_1"}},
+        {"class_name": "Dense", "config": {
+            "name": "dense_1", "units": 5, "activation": "softmax",
+            "use_bias": True}},
+    ]
+    weights = {"conv2d_1": {"kernel": Wc, "bias": bc},
+               "space_to_depth_1": {}, "flatten_1": {},
+               "dense_1": {"kernel": Wd, "bias": bd}}
+    write_k2(os.path.join(HERE, "k2_yolo_bits.h5"), cfg, weights,
+             {"loss": "categorical_crossentropy"})
+    x = RNG.normal(0, 1, (2, 8, 8, 3))
+    h = relu(conv2d_valid(x, Wc, bc))   # 6x6x4
+    h = space_to_depth(h)               # 3x3x16
+    h = h.reshape(h.shape[0], -1)       # 144
+    save_io("k2_yolo_bits", x, softmax(dense(h, Wd, bd)))
+
+
+def k2_temporal():
+    """ZeroPadding1D + dilated Conv1D + UpSampling1D."""
+    F = 3
+    Wc = RNG.normal(0, 0.3, (3, F, 5))
+    bc = RNG.normal(0, 0.05, (5,))
+    Wd = RNG.normal(0, 0.2, (5, 2))
+    bd = RNG.normal(0, 0.05, (2,))
+    cfg = [
+        {"class_name": "ZeroPadding1D", "config": {
+            "name": "zero_padding1d_1", "padding": 2,
+            "batch_input_shape": [None, 10, F]}},
+        {"class_name": "Conv1D", "config": {
+            "name": "conv1d_1", "filters": 5, "kernel_size": [3],
+            "strides": [1], "padding": "valid", "dilation_rate": [2],
+            "activation": "relu", "use_bias": True}},
+        {"class_name": "UpSampling1D", "config": {
+            "name": "up_sampling1d_1", "size": 2}},
+        {"class_name": "GlobalMaxPooling1D", "config": {
+            "name": "global_max_pooling1d_1"}},
+        {"class_name": "Dense", "config": {
+            "name": "dense_1", "units": 2, "activation": "softmax",
+            "use_bias": True}},
+    ]
+    weights = {"zero_padding1d_1": {},
+               "conv1d_1": {"kernel": Wc, "bias": bc},
+               "up_sampling1d_1": {}, "global_max_pooling1d_1": {},
+               "dense_1": {"kernel": Wd, "bias": bd}}
+    write_k2(os.path.join(HERE, "k2_temporal.h5"), cfg, weights,
+             {"loss": "categorical_crossentropy"})
+    x = RNG.normal(0, 1, (4, 10, F))
+    h = np.pad(x, ((0, 0), (2, 2), (0, 0)))
+    h = relu(conv1d_valid(h, Wc, bc, dilation=2))  # 14 -> 10
+    h = np.repeat(h, 2, axis=1)
+    h = h.max(axis=1)
+    save_io("k2_temporal", x, softmax(dense(h, Wd, bd)))
+
+
+if __name__ == "__main__":
+    for fn in (k1_mlp, k1_cnn_atrous, k1_lstm, k2_googlenet_bits,
+               k2_yolo_bits, k2_temporal):
+        fn()
+        print("wrote", fn.__name__)
