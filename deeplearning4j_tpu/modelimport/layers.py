@@ -579,17 +579,22 @@ def multi_head_attention(cfg, _v):
         q, k, v, o = req("query"), req("key"), req("value"), \
             req("attention_output")
         f = q.shape[0]
-        pack = lambda a: a.reshape(f, -1)
-        params = {"Wqkv": np.concatenate([pack(q), pack(k), pack(v)],
-                                         axis=1),
+        # Keras kernels are (f, h, dh); the framework packs QKV head-major
+        # ((head, which, dh) column order — see SelfAttentionLayer) so that
+        # tensor parallelism shards whole heads with contiguous tiles.
+        def hm(a):
+            return a.reshape(f, n_heads, key_dim)
+        params = {"Wqkv": np.stack([hm(q), hm(k), hm(v)],
+                                   axis=2).reshape(f, -1),
                   "Wo": o.reshape(-1, o.shape[-1])}
         def b2(name):
             return w.get(f"{name}/bias")
         bq, bk, bv = b2("query"), b2("key"), b2("value")
         bo = b2("attention_output")
         if bq is not None and bk is not None and bv is not None:
-            params["bqkv"] = np.concatenate(
-                [bq.reshape(-1), bk.reshape(-1), bv.reshape(-1)])
+            params["bqkv"] = np.stack(
+                [bq.reshape(n_heads, key_dim), bk.reshape(n_heads, key_dim),
+                 bv.reshape(n_heads, key_dim)], axis=1).reshape(-1)
         if bo is not None:
             params["bo"] = bo.reshape(-1)
         return params, {}

@@ -74,6 +74,9 @@ class MultiLayerNetwork(BaseModel):
         self._output_fn = None
         self._loss_eval_fn = None
         self._tbptt_step = None
+        # tensor-parallel activation specs (parallel/tensor_parallel.py);
+        # set by ParallelWrapper when TP is enabled
+        self._tp_plan = None
 
     @property
     def conf_global(self):
@@ -141,6 +144,10 @@ class MultiLayerNetwork(BaseModel):
             else:
                 x, s = layer.apply(lp, model_state.get(layer.name, {}), x, ctx)
             new_state[layer.name] = s
+            if self._tp_plan is not None:
+                # pin the boundary activation layout (Megatron pairing) so
+                # GSPMD places exactly one psum per row/column pair
+                x = self._tp_plan.constrain(layer.name, x)
             if collect:
                 acts.append(x)
         return (acts if collect else x), new_state

@@ -118,9 +118,53 @@ def save_model(model, path: str, save_updater: bool = False):
             "iteration": int(model.train_state.iteration),
             "epoch": model.epoch_count,
             "has_updater": save_updater,
-            "framework_version": "0.1.0",
+            "framework_version": "0.2.0",
+            # packed-QKV column order for attention layers; 0.1.0
+            # checkpoints (no tag) used which-major ([q|k|v] blocks)
+            "qkv_layout": "head_major",
         }
         zf.writestr("meta.json", json.dumps(meta))
+
+
+def _named_layers(model) -> Dict[str, Any]:
+    if hasattr(model, "layers"):          # MultiLayerNetwork
+        return {l.name: l for l in model.layers}
+    return {n.name: n.layer for n in model._layer_nodes}  # ComputationGraph
+
+
+def _migrate_qkv_layout(model, params):
+    """Upgrade pre-0.2.0 checkpoints: attention QKV packing changed from
+    which-major ([q|k|v] column blocks) to head-major ((head, which, dh))
+    so tensor parallelism can shard whole heads with contiguous tiles.
+    Returns params with every Wqkv/bqkv re-packed; other leaves shared."""
+    from deeplearning4j_tpu.nn.layers.attention import (
+        SelfAttentionLayer, TransformerEncoderBlock)
+
+    def repack(p, n_heads, n_out):
+        dh = n_out // n_heads
+        out = dict(p)
+        if "Wqkv" in p:
+            w = p["Wqkv"]
+            f = w.shape[0]
+            out["Wqkv"] = (w.reshape(f, 3, n_heads, dh)
+                           .transpose(0, 2, 1, 3).reshape(f, 3 * n_out))
+        if "bqkv" in p:
+            out["bqkv"] = (p["bqkv"].reshape(3, n_heads, dh)
+                           .transpose(1, 0, 2).reshape(-1))
+        return out
+
+    new = dict(params)
+    for name, layer in _named_layers(model).items():
+        lp = new.get(name)
+        if not isinstance(lp, dict):
+            continue
+        if isinstance(layer, TransformerEncoderBlock) and "attn" in lp:
+            lp = dict(lp)
+            lp["attn"] = repack(lp["attn"], layer.n_heads, layer.n_out)
+            new[name] = lp
+        elif isinstance(layer, SelfAttentionLayer) and "Wqkv" in lp:
+            new[name] = repack(lp, layer.n_heads, layer.n_out)
+    return new
 
 
 def _restore(path: str, expected_class: str, loader, load_updater: bool):
@@ -138,6 +182,8 @@ def _restore(path: str, expected_class: str, loader, load_updater: bool):
         model = cls(conf)
         model.init()
         params = _unflatten_like(model.train_state.params, _read_tree(zf, "params"))
+        if meta.get("qkv_layout") != "head_major":
+            params = _migrate_qkv_layout(model, params)
         state = _unflatten_like(model.train_state.model_state,
                                 _read_tree(zf, "state"))
         opt_state = model.train_state.opt_state

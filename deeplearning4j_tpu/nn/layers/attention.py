@@ -89,7 +89,11 @@ class SelfAttentionLayer(FeedForwardLayer):
         kq, ko = jax.random.split(key)
         dt = self.param_dtype()
         params = {
-            # packed QKV: one matmul on the MXU
+            # packed QKV: one matmul on the MXU. Column order is HEAD-major
+            # ((head, which, dh)), so a contiguous column shard of Wqkv is a
+            # set of whole heads — Megatron-style tensor parallelism
+            # (parallel/tensor_parallel.py) then shards heads with plain
+            # GSPMD dim tiling, no strided resharding.
             "Wqkv": self.weight_init.init(kq, (n_in, 3 * self.n_out),
                                           n_in, self.n_out, dt),
             "Wo": self.weight_init.init(ko, (self.n_out, self.n_out),
@@ -106,8 +110,8 @@ class SelfAttentionLayer(FeedForwardLayer):
             qkv = qkv + params["bqkv"]
         n, t, _ = qkv.shape
         h, dh = self.n_heads, self.n_out // self.n_heads
-        qkv = qkv.reshape(n, t, 3, h, dh)
-        return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        qkv = qkv.reshape(n, t, h, 3, dh)
+        return qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
 
     def apply(self, params, state, x, ctx: LayerContext):
         ctx, dk = ctx.split_rng()

@@ -1,26 +1,46 @@
-"""Pipeline parallelism: GPipe-style microbatched stage execution.
+"""Pipeline parallelism: microbatched stage execution over the ``pipe`` axis.
 
 ABSENT in the reference (SURVEY §2.11 row 7 — no PP/TP/SP/EP anywhere);
 designed fresh for TPU per SURVEY §7.2 stage 7 / §7.3 item 4. The design is
 the canonical TPU pipelining recipe (scaling-book style): the ``pipe`` mesh
-axis holds one pipeline *stage* per device slice; activations move
-stage-to-stage with ``lax.ppermute`` hops over ICI neighbours; a
-``lax.scan`` over ticks runs ``num_microbatches + num_stages - 1`` steps
-(the GPipe bubble). Everything is pure, differentiable jax: ``jax.grad``
-through this function IS the backward pipeline (the VJP of ``ppermute`` is
-the reverse permute, so the cool-down schedule falls out of autodiff — no
-hand-written 1F1B machinery).
+axis holds pipeline *stages*; activations move stage-to-stage with
+``lax.ppermute`` hops over ICI neighbours; a ``lax.scan`` over ticks runs
+the schedule. Everything is pure, differentiable jax: ``jax.grad`` through
+this function IS the backward pipeline (the VJP of ``ppermute`` is the
+reverse permute, so the cool-down schedule falls out of autodiff — no
+hand-written backward machinery).
+
+Two schedules:
+
+- **GPipe** (``repeats=1``): M microbatches through S stages,
+  ``M + S - 1`` ticks, bubble fraction ``(S-1)/(M+S-1)``.
+- **Circular / interleaved** (``repeats=R > 1``): each device holds R
+  *non-adjacent* stages (device d owns global stages d, S+d, 2S+d, …) and
+  microbatches recirculate around the ring R times — the interleaved-1F1B
+  layout (Megatron "virtual pipeline"). For a fixed per-device parameter
+  budget this divides the bubble by R: ``R*S`` layers cost
+  ``R*M + S - 1`` ticks instead of the ``M + R*S - 1`` a GPipe pipeline of
+  ``R*S`` devices would need.
+
+1F1B's *memory* motivation (don't hold every microbatch's activations) is
+answered the XLA way: ``remat=True`` wraps the stage in ``jax.checkpoint``
+so the scan saves one activation per tick instead of the stage's internal
+residuals, and backward recomputes — the rematerialisation trade the
+hardware guide prescribes for HBM-bound training.
 
 Constraints (standard for SPMD pipelining):
-- stages are *homogeneous*: one ``stage_fn`` whose params are stacked with
-  a leading ``num_stages`` dim (the transformer-block case). Heterogeneous
-  first/last layers (embed/unembed) stay outside the pipelined region.
+- stages are homogeneous in *shape*: one ``stage_fn`` whose params are
+  stacked with a leading ``num_stages`` dim. Heterogeneous first/last
+  layers (embed/unembed) stay OUTSIDE the pipelined region —
+  ``PipelinedTransformerLM`` below shows the composition.
 - activation shape is identical at every stage boundary.
+- per-microbatch side inputs (e.g. attention masks) ride along via
+  ``consts`` (leading dim = num_microbatches), gathered per tick.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Sequence
+from typing import Any, Callable, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -30,47 +50,78 @@ from jax.sharding import Mesh, PartitionSpec as P
 PIPE_AXIS = "pipe"
 
 
-def stack_stage_params(params_per_stage: Sequence[Any]) -> Any:
-    """Stack a list of per-stage parameter pytrees (identical structure)
-    into one pytree with a leading ``num_stages`` dim — the layout
-    ``pipeline_apply`` expects (shard dim 0 over the pipe axis)."""
+def stack_stage_params(params_per_stage: Sequence[Any],
+                       num_devices: Optional[int] = None) -> Any:
+    """Stack per-stage parameter pytrees (identical structure) into one
+    pytree with a leading ``num_stages`` dim — the layout
+    ``pipeline_apply`` expects (shard dim 0 over the pipe axis).
+
+    With ``num_devices`` given and ``len(params_per_stage) == R *
+    num_devices`` for R > 1, stages are re-ordered device-major for the
+    circular schedule: device d's contiguous block holds global stages
+    ``d, S+d, 2S+d, …`` (its R interleaved stages)."""
+    n = len(params_per_stage)
+    order = list(range(n))
+    if num_devices and n > num_devices:
+        if n % num_devices:
+            raise ValueError(f"{n} stages not divisible over"
+                             f" {num_devices} devices")
+        r = n // num_devices
+        order = [rep * num_devices + d
+                 for d in range(num_devices) for rep in range(r)]
     return jax.tree_util.tree_map(
-        lambda *leaves: jnp.stack(leaves, 0), *params_per_stage)
+        lambda *leaves: jnp.stack([leaves[i] for i in order], 0),
+        *params_per_stage)
 
 
-def _pipeline_local(stacked_params, x_mb, stage_fn, axis_name: str,
-                    num_microbatches: int):
+def _pipeline_local(stacked_params, x_mb, consts_mb, stage_fn,
+                    axis_name: str, num_microbatches: int, repeats: int,
+                    remat: bool):
     """Per-device body under shard_map.
 
-    stacked_params: this stage's params, leading dim 1 (shard of the stack).
-    x_mb: (num_microbatches, mb, ...) — full microbatch stream (replicated;
-          only stage 0 reads it).
+    stacked_params: this device's R stages, leading dim R.
+    x_mb: (M, mb, ...) full microbatch stream (replicated; only ring
+          position 0 ingests it).
+    consts_mb: pytree with leading dim M of per-microbatch side inputs.
     """
-    n_stages = lax.psum(1, axis_name)
-    stage = lax.axis_index(axis_name)
-    my_params = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
+    S = lax.psum(1, axis_name)
+    d = lax.axis_index(axis_name)
+    M, R = num_microbatches, repeats
 
     mb_shape = x_mb.shape[1:]
-    n_ticks = num_microbatches + n_stages - 1
+    n_ticks = M * R + S - 1
 
-    # stage i sends to i+1; the wraparound last→0 edge carries garbage that
-    # stage 0 never reads (it always selects from the input stream).
-    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    # ring: stage i sends to i+1. For R == 1 the wraparound edge carries
+    # garbage that position 0 never reads; for the circular schedule it is
+    # the real recirculation path (repeat r -> r+1).
+    perm = [(i, (i + 1) % S) for i in range(S)]
 
-    out0 = jnp.zeros((num_microbatches,) + mb_shape, x_mb.dtype)
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    out0 = jnp.zeros((M,) + mb_shape, x_mb.dtype)
     recv0 = jnp.zeros(mb_shape, x_mb.dtype)
 
     def tick(carry, t):
         recv, out = carry
-        # Stage 0 ingests microbatch t (clamped; ticks ≥ M recompute the
-        # last microbatch into the bubble — discarded downstream).
-        inp = lax.dynamic_index_in_dim(
-            x_mb, jnp.minimum(t, num_microbatches - 1), 0, keepdims=False)
-        x_in = jnp.where(stage == 0, inp, recv)
-        y = stage_fn(my_params, x_in)
-        # Last stage records microbatch (t - (n_stages-1)) once warm.
-        mb_idx = jnp.clip(t - (n_stages - 1), 0, num_microbatches - 1)
-        record = jnp.logical_and(stage == n_stages - 1, t >= n_stages - 1)
+        # device d at tick t works on repeat r of microbatch m, where the
+        # wavefront gives t = m + r*S + d (garbage outside the window —
+        # computed in lockstep anyway, never recorded)
+        r = jnp.clip((t - d) // S, 0, R - 1)
+        m = jnp.mod(t - d, M)
+        my_params = jax.tree_util.tree_map(
+            lambda a: lax.dynamic_index_in_dim(a, r, 0, keepdims=False),
+            stacked_params)
+        inp = lax.dynamic_index_in_dim(x_mb, m, 0, keepdims=False)
+        cst = jax.tree_util.tree_map(
+            lambda a: lax.dynamic_index_in_dim(a, m, 0, keepdims=False),
+            consts_mb)
+        # ring position 0 ingests fresh microbatches during the first
+        # injection phase; afterwards it reads the recirculated stream
+        x_in = jnp.where(jnp.logical_and(d == 0, t < M), inp, recv)
+        y = fn(my_params, x_in, cst)
+        # last ring position records once the final repeat's wave arrives
+        mb_idx = jnp.mod(t - (S - 1), M)
+        record = jnp.logical_and(d == S - 1, t >= (R - 1) * M + S - 1)
         cur = lax.dynamic_index_in_dim(out, mb_idx, 0, keepdims=False)
         out = lax.dynamic_update_index_in_dim(
             out, jnp.where(record, y, cur), mb_idx, 0)
@@ -78,41 +129,162 @@ def _pipeline_local(stacked_params, x_mb, stage_fn, axis_name: str,
         return (recv, out), None
 
     (_, out), _ = lax.scan(tick, (recv0, out0), jnp.arange(n_ticks))
-    # Replicate the last stage's output buffer to every stage (psum of a
-    # one-hot-selected buffer == broadcast from last stage).
-    out = lax.psum(jnp.where(stage == n_stages - 1, out,
-                             jnp.zeros_like(out)), axis_name)
+    # Replicate the last position's output buffer to every device (psum of
+    # a one-hot-selected buffer == broadcast from the last ring position).
+    out = lax.psum(jnp.where(d == S - 1, out, jnp.zeros_like(out)),
+                   axis_name)
     return out
 
 
-def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+def pipeline_apply(stage_fn: Callable,
                    stacked_params: Any,
                    x: jnp.ndarray,
                    mesh: Mesh,
                    *,
                    axis: str = PIPE_AXIS,
-                   num_microbatches: Optional[int] = None) -> jnp.ndarray:
-    """Run ``x`` through ``num_stages`` copies of ``stage_fn`` pipelined
-    over ``mesh[axis]``.
+                   num_microbatches: Optional[int] = None,
+                   consts: Any = None,
+                   repeats: int = 1,
+                   remat: bool = False) -> jnp.ndarray:
+    """Run ``x`` through ``repeats * mesh[axis]`` stage applications
+    pipelined over ``mesh[axis]``.
 
-    stage_fn: (stage_params, activation(mb, ...)) -> activation(mb, ...).
-    stacked_params: pytree, leaves with leading dim == mesh.shape[axis].
+    stage_fn: ``(stage_params, activation(mb, ...)) -> activation`` or,
+       when ``consts`` is given, ``(stage_params, activation, consts_mb)
+       -> activation``.
+    stacked_params: pytree, leaves with leading dim ``repeats *
+       mesh.shape[axis]`` in the device-major order produced by
+       ``stack_stage_params(..., num_devices=mesh.shape[axis])``.
     x: (batch, ...) global batch; split into ``num_microbatches`` equal
        microbatches along dim 0 (default: one per stage).
-    Returns stage_fn^S applied to x, shape (batch, ...), replicated over
-    the pipe axis.
+    consts: optional pytree of per-example side inputs with leading dim
+       ``batch`` (split like ``x``).
+    repeats: R > 1 selects the circular/interleaved schedule (requires
+       ``num_microbatches == mesh.shape[axis]``).
+    remat: checkpoint each stage application (recompute in backward).
+
+    Returns the composed stages applied to x, shape (batch, ...),
+    replicated over the pipe axis.
     """
-    n_stages = mesh.shape[axis]
-    m = num_microbatches or n_stages
+    S = mesh.shape[axis]
+    m = num_microbatches or S
     if x.shape[0] % m != 0:
         raise ValueError(f"batch {x.shape[0]} not divisible into {m}"
                          " microbatches")
+    if repeats > 1 and m != S:
+        raise ValueError(
+            f"circular schedule needs num_microbatches == num_stages"
+            f" ({S}); got {m} (injection would collide with"
+            " recirculation)")
     x_mb = x.reshape((m, x.shape[0] // m) + x.shape[1:])
+    takes_consts = consts is not None
+    consts_mb = jax.tree_util.tree_map(
+        lambda a: a.reshape((m, a.shape[0] // m) + a.shape[1:]),
+        consts if takes_consts else ())
+
+    def fn3(p, xm, cst):
+        return stage_fn(p, xm, cst) if takes_consts else stage_fn(p, xm)
 
     pspec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
     fn = jax.shard_map(
-        lambda p, xm: _pipeline_local(p, xm, stage_fn, axis, m),
-        mesh=mesh, in_specs=(pspec, P()), out_specs=P(),
+        lambda p, xm, cm: _pipeline_local(p, xm, cm, fn3, axis, m,
+                                          repeats, remat),
+        mesh=mesh, in_specs=(pspec, P(), P()), out_specs=P(),
         check_vma=False)
-    out_mb = fn(stacked_params, x_mb)
+    out_mb = fn(stacked_params, x_mb, consts_mb)
     return out_mb.reshape((x.shape[0],) + out_mb.shape[2:])
+
+
+class PipelinedTransformerLM:
+    """Causal transformer LM with heterogeneous embed/unembed OUTSIDE the
+    pipelined region and ``n_layers`` TransformerEncoderBlocks as the
+    pipelined stages (the upgrade VERDICT asked over the tanh toy).
+
+    Layout: token embedding + learned positions (replicated, every device
+    computes them — they are tiny next to the blocks), then
+    ``pipeline_apply`` over the block stack (GPipe or circular), then a
+    final LayerNorm and a weight-tied-optional unembedding, also outside
+    the region. ``loss()`` is pure and jit/grad-able; the golden test
+    asserts it matches the sequential (non-pipelined) stack exactly.
+    """
+
+    def __init__(self, vocab: int, width: int, n_heads: int, n_layers: int,
+                 max_len: int, mesh: Mesh, *, axis: str = PIPE_AXIS,
+                 ffn_mult: int = 4, num_microbatches: Optional[int] = None,
+                 remat: bool = True):
+        from deeplearning4j_tpu.nn.layers.attention import (
+            TransformerEncoderBlock)
+        S = int(mesh.shape[axis])
+        if n_layers % S:
+            raise ValueError(f"n_layers={n_layers} not divisible by"
+                             f" pipeline size {S}")
+        self.vocab, self.width, self.max_len = vocab, width, max_len
+        self.mesh, self.axis = mesh, axis
+        self.repeats = n_layers // S
+        self.num_microbatches = num_microbatches or S
+        self.remat = remat
+        self.n_layers = n_layers
+        self.block = TransformerEncoderBlock(
+            n_in=width, n_out=width, n_heads=n_heads, ffn_mult=ffn_mult,
+            causal=True)
+
+    def init(self, key) -> dict:
+        from deeplearning4j_tpu.nn.inputs import RecurrentType
+        ke, kp, kh, kb = jax.random.split(key, 4)
+        rt = RecurrentType(self.width, None)
+        per_stage = [self.block.initialize(jax.random.fold_in(kb, i), rt)
+                     for i in range(self.n_layers)]
+        S = int(self.mesh.shape[self.axis])
+        return {
+            "embed": 0.02 * jax.random.normal(ke, (self.vocab, self.width)),
+            "pos": 0.02 * jax.random.normal(kp, (self.max_len, self.width)),
+            "blocks": stack_stage_params(per_stage, num_devices=S),
+            "ln_g": jnp.ones((self.width,)),
+            "ln_b": jnp.zeros((self.width,)),
+            "head": 0.02 * jax.random.normal(kh, (self.width, self.vocab)),
+        }
+
+    def _stage_fn(self):
+        from deeplearning4j_tpu.nn.layers.base import LayerContext
+        block = self.block
+
+        def fn(p, h):
+            y, _ = block.apply(p, {}, h, LayerContext(train=False))
+            return y
+        return fn
+
+    def _trunk(self, params, tokens, pipelined: bool):
+        x = jnp.take(params["embed"], tokens, axis=0)
+        x = x + params["pos"][: tokens.shape[1]][None]
+        if pipelined:
+            h = pipeline_apply(self._stage_fn(), params["blocks"], x,
+                               self.mesh, axis=self.axis,
+                               num_microbatches=self.num_microbatches,
+                               repeats=self.repeats, remat=self.remat)
+        else:
+            fn = self._stage_fn()
+            # device-major stack order: walk repeats-within-device —
+            # global stage r*S + d sits at position d*R + r
+            S = int(self.mesh.shape[self.axis])
+            h = x
+            for r in range(self.repeats):
+                for d in range(S):
+                    p = jax.tree_util.tree_map(
+                        lambda a: a[d * self.repeats + r], params["blocks"])
+                    h = fn(p, h)
+        mu = h.mean(-1, keepdims=True)
+        var = ((h - mu) ** 2).mean(-1, keepdims=True)
+        h = (h - mu) * jax.lax.rsqrt(var + 1e-5)
+        h = h * params["ln_g"] + params["ln_b"]
+        return h
+
+    def logits(self, params, tokens, *, pipelined: bool = True):
+        return self._trunk(params, tokens, pipelined) @ params["head"]
+
+    def loss(self, params, tokens, targets, *, pipelined: bool = True):
+        """Mean next-token cross-entropy; ``pipelined=False`` runs the
+        sequential reference path (golden-test oracle)."""
+        lg = self.logits(params, tokens, pipelined=pipelined)
+        ll = jax.nn.log_softmax(lg, axis=-1)
+        nll = -jnp.take_along_axis(ll, targets[..., None], axis=-1)
+        return nll.mean()

@@ -1,0 +1,256 @@
+"""Megatron-style paired tensor parallelism for layer stacks.
+
+No reference analog (SURVEY §2.11 row 7: TP is ABSENT in DL4J — "the TPU
+build must design these fresh"). This module upgrades the round-1
+column-only rules in ``parallel/sharding.py`` to *paired* row/column
+sharding with activation partition specs:
+
+- Consecutive dense layers alternate **column-parallel** (``W: P(None,
+  model)``, bias sharded) and **row-parallel** (``W: P(model, None)``,
+  bias replicated). Between the pair the activation stays sharded on the
+  feature dim (elementwise activations commute with the tiling); after the
+  row layer a single psum (inserted by GSPMD from the sharding mismatch)
+  restores the replicated activation. Two matmuls, one collective — the
+  Megatron MLP recipe.
+- ``SelfAttentionLayer`` / ``TransformerEncoderBlock``: QKV projection
+  column-parallel over *heads* (the packed Wqkv column order is head-major
+  precisely so a contiguous tile is a set of whole heads), attention math
+  runs with the head dim sharded, output projection ``Wo`` row-parallel;
+  the FFN inside the block is the column→row dense pair. One psum after
+  attention, one after the FFN — per block, same as Megatron.
+- A final unpaired output layer still goes column-parallel when divisible
+  (vocab/class-sharded logits, the Megatron LM-head layout).
+- Activation partition specs are applied by the models via
+  ``jax.lax.with_sharding_constraint`` at layer boundaries
+  (``MultiLayerNetwork._forward``), so XLA never has to *infer* the
+  intermediate layout.
+
+Correctness is GSPMD's: shardings never change the math, so the TP train
+step is bit-compatible (up to reduction order) with the replicated one —
+asserted by the golden test ``tests/test_tensor_parallel.py`` (the analog
+of the reference's "Spark vs single machine identical" golden test,
+TestCompareParameterAveragingSparkVsSingleMachine.java:1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+# activation-layout states at layer boundaries
+_REPL = "replicated"      # features replicated over the model axis
+_SHARDED = "sharded"      # last (feature) dim sharded over the model axis
+
+
+@dataclasses.dataclass
+class TPPlan:
+    """Param shardings + per-layer-boundary activation layouts."""
+    param_shardings: Any                 # pytree of NamedSharding
+    act_kinds: Dict[str, str]            # layer name -> _REPL | _SHARDED
+    mesh: Mesh
+    model_axis: str = MODEL_AXIS
+    data_axis: str = DATA_AXIS
+
+    @property
+    def model_parallelism(self) -> int:
+        return int(self.mesh.shape.get(self.model_axis, 1))
+
+    def constrain(self, name: str, x):
+        """Apply this layer's boundary activation spec (inside jit)."""
+        kind = self.act_kinds.get(name)
+        if kind is None or not hasattr(x, "ndim") or x.ndim < 2:
+            return x
+        m = self.model_parallelism
+        data = self.data_axis if self.data_axis in self.mesh.shape else None
+        last = (self.model_axis
+                if kind == _SHARDED and x.shape[-1] % m == 0 else None)
+        spec = P(data, *([None] * (x.ndim - 2)), last)
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, spec))
+
+
+def _named(mesh, spec_tree, params):
+    """PartitionSpec pytree -> NamedSharding pytree matching ``params``."""
+    return jax.tree_util.tree_map(
+        lambda _, s: NamedSharding(mesh, s), params, spec_tree)
+
+
+def _repl_specs(params):
+    return jax.tree_util.tree_map(lambda _: P(), params)
+
+
+def _attention_specs(p, m, ax):
+    """Column(heads)/row pair for SelfAttentionLayer params. Requires the
+    packed QKV dim (3*n_out) and the Wo input dim to tile m-ways."""
+    if p["Wqkv"].shape[1] % m or p["Wo"].shape[0] % m:
+        return _repl_specs(p)
+    spec = {"Wqkv": P(None, ax), "Wo": P(ax, None)}
+    if "bqkv" in p:
+        spec["bqkv"] = P(ax)
+    if "bo" in p:
+        spec["bo"] = P()
+    return spec
+
+
+def _transformer_specs(p, m, ax, n_heads):
+    """Megatron block: head-parallel attention + column→row FFN."""
+    spec = {}
+    attn = p["attn"]
+    if n_heads % m == 0:
+        spec["attn"] = _attention_specs(attn, m, ax)
+    else:
+        spec["attn"] = _repl_specs(attn)
+    for ln in ("ln1", "ln2"):
+        if ln in p:
+            spec[ln] = _repl_specs(p[ln])
+    if p["W1"].shape[1] % m == 0:
+        spec["W1"] = P(None, ax)
+        spec["W2"] = P(ax, None)
+        if "b1" in p:
+            spec["b1"] = P(ax)
+        if "b2" in p:
+            spec["b2"] = P()
+    else:
+        for k in ("W1", "W2", "b1", "b2"):
+            if k in p:
+                spec[k] = P()
+    return spec
+
+
+def _fallback_specs(p, m, ax):
+    """Round-1 column-only rules for layer types without a pairing rule
+    (conv output channels, recurrent gate matrices, embeddings)."""
+    def rule(path, leaf):
+        key = getattr(path[-1], "key", "")
+        shape = getattr(leaf, "shape", ())
+        if key == "dW" and len(shape) == 4 and shape[-1] % m == 0:
+            return P(None, None, None, ax)
+        if key in ("Wx", "Wh", "pW") and len(shape) == 2 and shape[-1] % m == 0:
+            return P(None, ax)
+        return P()
+    flat, tree = jax.tree_util.tree_flatten_with_path(p)
+    return jax.tree_util.tree_unflatten(tree, [rule(pa, l) for pa, l in flat])
+
+
+def plan_tp(model, mesh: Mesh, *, model_axis: str = MODEL_AXIS,
+            data_axis: str = DATA_AXIS) -> TPPlan:
+    """Walk ``model.layers`` and build the paired TP plan.
+
+    ``model`` must be initialized (param shapes are read from the live
+    pytree). Layers the planner does not understand fall back to the
+    round-1 column rules; anything non-divisible stays replicated.
+    """
+    from deeplearning4j_tpu.nn.layers.attention import (
+        SelfAttentionLayer, TransformerEncoderBlock)
+    from deeplearning4j_tpu.nn.layers.feedforward import (
+        ActivationLayer, AutoEncoder, DenseLayer, DropoutLayer)
+
+    params = model.train_state.params
+    layers = list(model.layers)
+    m = int(mesh.shape.get(model_axis, 1))
+    ax = model_axis
+    spec_tree: Dict[str, Any] = {}
+    act_kinds: Dict[str, str] = {}
+
+    if m <= 1:
+        for layer in layers:
+            spec_tree[layer.name] = _repl_specs(params.get(layer.name, {}))
+            act_kinds[layer.name] = _REPL
+        return TPPlan(_named(mesh, spec_tree, params), act_kinds, mesh,
+                      model_axis, data_axis)
+
+    def dense_w(layer):
+        p = params.get(layer.name, {})
+        w = p.get("W")
+        return w if (w is not None and w.ndim == 2) else None
+
+    def pairable_ahead(i, width):
+        """Is there a row-parallel partner after layer i (skipping
+        shape-preserving no-param layers)?"""
+        for j in range(i + 1, len(layers)):
+            lj = layers[j]
+            if isinstance(lj, (ActivationLayer, DropoutLayer)):
+                continue
+            if isinstance(lj, (DenseLayer, AutoEncoder)):
+                w = dense_w(lj)
+                return (w is not None and w.shape[0] == width
+                        and w.shape[0] % m == 0)
+            return False
+        return False
+
+    state = _REPL
+    for i, layer in enumerate(layers):
+        p = params.get(layer.name, {})
+        name = layer.name
+        if isinstance(layer, TransformerEncoderBlock):
+            spec_tree[name] = _transformer_specs(p, m, ax, layer.n_heads)
+            act_kinds[name] = _REPL
+            state = _REPL
+        elif isinstance(layer, SelfAttentionLayer):
+            if layer.n_heads % m == 0:
+                spec_tree[name] = _attention_specs(p, m, ax)
+            else:
+                spec_tree[name] = _repl_specs(p)
+            act_kinds[name] = _REPL
+            state = _REPL
+        elif isinstance(layer, (DenseLayer, AutoEncoder)) and \
+                dense_w(layer) is not None:
+            w = dense_w(layer)
+            n_in, n_out = w.shape
+            spec = _repl_specs(p)
+            if state == _SHARDED and n_in % m == 0:
+                # row-parallel partner: closes the pair with one psum
+                spec["W"] = P(ax, None)
+                if "b" in p:
+                    spec["b"] = P()
+                act_kinds[name] = _REPL
+                state = _REPL
+            elif state == _REPL and n_out % m == 0 and (
+                    pairable_ahead(i, n_out) or i == len(layers) - 1):
+                # column-parallel: open a pair, or the final class/vocab-
+                # sharded logits layer (Megatron LM-head)
+                spec["W"] = P(None, ax)
+                if "b" in p:
+                    spec["b"] = P(ax)
+                act_kinds[name] = _SHARDED
+                state = _SHARDED
+            else:
+                act_kinds[name] = _REPL
+                state = _REPL
+            spec_tree[name] = spec
+        elif isinstance(layer, (ActivationLayer, DropoutLayer)):
+            spec_tree[name] = _repl_specs(p)
+            act_kinds[name] = state
+        else:
+            spec_tree[name] = _fallback_specs(p, m, ax)
+            act_kinds[name] = _REPL
+            state = _REPL
+
+    return TPPlan(_named(mesh, spec_tree, params), act_kinds, mesh,
+                  model_axis, data_axis)
+
+
+def shard_train_state(model, plan: TPPlan):
+    """device_put the model's TrainState onto the plan: params per the
+    plan, optimizer-state leaves that mirror a param with that param's
+    sharding, everything else replicated. Returns the new TrainState."""
+    from deeplearning4j_tpu.optimize.solver import TrainState
+    from deeplearning4j_tpu.parallel.checkpoint import mirror_opt_shardings
+
+    ts = model.train_state
+    repl = NamedSharding(plan.mesh, P())
+    opt_sh = mirror_opt_shardings(ts.opt_state, ts.params,
+                                  plan.param_shardings, repl)
+    put = jax.tree_util.tree_map
+    new = TrainState(
+        put(jax.device_put, ts.params, plan.param_shardings),
+        jax.device_put(ts.model_state, repl),
+        put(jax.device_put, ts.opt_state, opt_sh),
+        jax.device_put(ts.iteration, repl))
+    model.train_state = new
+    return new, TrainState(plan.param_shardings, repl, opt_sh, repl)

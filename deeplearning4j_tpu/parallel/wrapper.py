@@ -60,12 +60,21 @@ class ParallelWrapper:
     def __init__(self, model, mesh: Optional[Mesh] = None,
                  mode: TrainingMode = TrainingMode.SHARED_GRADIENTS,
                  averaging_frequency: int = 5,
-                 average_updaters: bool = True):
+                 average_updaters: bool = True,
+                 tensor_parallel: bool = False):
         self.model = model
         self.mesh = mesh if mesh is not None else create_mesh()
         self.mode = mode
         self.averaging_frequency = averaging_frequency
         self.average_updaters = average_updaters
+        self.tensor_parallel = tensor_parallel
+        if tensor_parallel and mode is not TrainingMode.SHARED_GRADIENTS:
+            # AVERAGING runs per-device replicas inside shard_map — params
+            # cannot simultaneously be model-axis sharded; silently
+            # ignoring the flag would fake TP at the user
+            raise ValueError(
+                f"tensor_parallel requires SHARED_GRADIENTS mode, not"
+                f" {mode.name}")
         self._step = None
         if model.train_state is None:
             model.init()
@@ -78,6 +87,7 @@ class ParallelWrapper:
             self._mode = TrainingMode.SHARED_GRADIENTS
             self._avg_freq = 5
             self._avg_updaters = True
+            self._tp = False
 
         def workers(self, n: int):
             devs = jax.devices()
@@ -103,9 +113,18 @@ class ParallelWrapper:
             self._avg_updaters = flag
             return self
 
+        def tensor_parallel(self, flag: bool = True):
+            """Shard parameters over the mesh's ``model`` axis with the
+            Megatron row/column pairing (parallel/tensor_parallel.py).
+            Requires a mesh with a ``model`` axis (e.g.
+            ``create_mesh({"data": 2, "model": 4})``)."""
+            self._tp = flag
+            return self
+
         def build(self) -> "ParallelWrapper":
             return ParallelWrapper(self._model, self._mesh, self._mode,
-                                   self._avg_freq, self._avg_updaters)
+                                   self._avg_freq, self._avg_updaters,
+                                   tensor_parallel=self._tp)
 
     @staticmethod
     def builder(model) -> "ParallelWrapper.Builder":
@@ -133,14 +152,27 @@ class ParallelWrapper:
         return loss_fn
 
     def _build_sync_step(self):
-        """SHARED_GRADIENTS: jit with sharded batch + replicated params.
-        XLA emits the psum over ICI in backward — the TPU-native
+        """SHARED_GRADIENTS: jit with sharded batch + replicated (or, with
+        ``tensor_parallel``, Megatron row/column-sharded) params. XLA emits
+        the gradient psum over ICI in backward — the TPU-native
         EncodingHandler.broadcastUpdates."""
         loss_fn = self._loss_adapter()
         tx = self.model._tx
         mesh = self.mesh
         batch_sh = NamedSharding(mesh, P(DATA_AXIS))
-        repl = NamedSharding(mesh, P())
+
+        ts_sh = None
+        if self.tensor_parallel:
+            from deeplearning4j_tpu.parallel.mesh import MODEL_AXIS
+            from deeplearning4j_tpu.parallel.tensor_parallel import (
+                plan_tp, shard_train_state)
+            if MODEL_AXIS not in mesh.shape:
+                raise ValueError(
+                    "tensor_parallel needs a mesh with a 'model' axis; got "
+                    f"{dict(mesh.shape)}")
+            plan = plan_tp(self.model, mesh)
+            _, ts_sh = shard_train_state(self.model, plan)
+            self.model._tp_plan = plan
 
         def step(ts: TrainState, feats, labels, fmask, lmask, rng):
             def lf(params):
@@ -155,8 +187,9 @@ class ParallelWrapper:
 
         return jax.jit(
             step,
-            in_shardings=(None, batch_sh, batch_sh, batch_sh, batch_sh, None),
-            out_shardings=(None, None),
+            in_shardings=(ts_sh, batch_sh, batch_sh, batch_sh, batch_sh,
+                          None),
+            out_shardings=(ts_sh, None),
             donate_argnums=(0,),
         ), batch_sh
 

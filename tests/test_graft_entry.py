@@ -50,4 +50,5 @@ def test_dryrun_multichip_driver_invocation():
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "ResNet50 train step OK" in proc.stdout
     assert "ring-attention + MoE train step OK" in proc.stdout
-    assert "GPipe train step OK" in proc.stdout
+    assert "circular pipeline" in proc.stdout
+    assert "Megatron-paired transformer train step OK" in proc.stdout

@@ -83,6 +83,112 @@ class TestPipeline:
             pipeline_apply(_stage_fn, stacked, jnp.zeros((7, d)), mesh,
                            num_microbatches=4)
 
+    def test_circular_schedule_matches_sequential(self, rng):
+        """R=2 interleaved stages per device (device d owns stages d and
+        S+d): forward + grads must equal the 8-layer sequential stack."""
+        d, batch, S, R = 8, 16, 4, 2
+        mesh = create_mesh({PIPE_AXIS: S}, jax.devices()[:S])
+        per_stage = _make_stage_params(jax.random.PRNGKey(4), d, S * R)
+        stacked = stack_stage_params(per_stage, num_devices=S)
+        x = jnp.asarray(rng.normal(size=(batch, d)).astype(np.float32))
+
+        def loss_pipe(p):
+            return jnp.sum(pipeline_apply(
+                _stage_fn, p, x, mesh, repeats=R, num_microbatches=S) ** 2)
+
+        def loss_seq(plist):
+            h = x
+            for p in plist:
+                h = _stage_fn(p, h)
+            return jnp.sum(h ** 2)
+
+        np.testing.assert_allclose(float(loss_pipe(stacked)),
+                                   float(loss_seq(per_stage)),
+                                   rtol=1e-5)
+        g_pipe = jax.grad(loss_pipe)(stacked)
+        g_seq = stack_stage_params(jax.grad(loss_seq)(per_stage),
+                                   num_devices=S)
+        for a, b in zip(jax.tree_util.tree_leaves(g_pipe),
+                        jax.tree_util.tree_leaves(g_seq)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+        with pytest.raises(ValueError):
+            pipeline_apply(_stage_fn, stacked, x, mesh, repeats=R,
+                           num_microbatches=8)
+
+    def test_consts_ride_with_microbatches(self, rng):
+        """Per-example side inputs (e.g. masks) are split like the batch
+        and delivered to whichever stage processes that microbatch."""
+        d, batch, S = 4, 8, 4
+        mesh = create_mesh({PIPE_AXIS: S}, jax.devices()[:S])
+        per_stage = _make_stage_params(jax.random.PRNGKey(5), d, S)
+        stacked = stack_stage_params(per_stage)
+        x = jnp.asarray(rng.normal(size=(batch, d)).astype(np.float32))
+        scale = jnp.arange(1.0, batch + 1.0)[:, None]
+
+        def fn(p, h, c):
+            return jnp.tanh(h @ p["w"] + p["b"]) * c
+
+        out = pipeline_apply(fn, stacked, x, mesh, consts=scale)
+        ref = x
+        for p in per_stage:
+            ref = fn(p, ref, scale)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestPipelinedTransformerLM:
+    def test_pipelined_lm_matches_sequential(self, rng):
+        """The real-model upgrade (VERDICT next#6): embed/unembed outside
+        the region, TransformerEncoderBlock stages, circular schedule,
+        remat on — loss and grads equal the non-pipelined run."""
+        from deeplearning4j_tpu.parallel.pipeline import (
+            PipelinedTransformerLM)
+        S, R = 4, 2
+        mesh = create_mesh({PIPE_AXIS: S}, jax.devices()[:S])
+        lm = PipelinedTransformerLM(vocab=16, width=8, n_heads=2,
+                                    n_layers=S * R, max_len=12, mesh=mesh,
+                                    remat=True)
+        params = lm.init(jax.random.PRNGKey(0))
+        toks = jnp.asarray(rng.integers(0, 16, (8, 10)))
+        tgts = jnp.asarray(rng.integers(0, 16, (8, 10)))
+
+        l_pipe, g_pipe = jax.value_and_grad(
+            lambda p: lm.loss(p, toks, tgts))(params)
+        l_seq, g_seq = jax.value_and_grad(
+            lambda p: lm.loss(p, toks, tgts, pipelined=False))(params)
+
+        np.testing.assert_allclose(float(l_pipe), float(l_seq), rtol=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(g_pipe),
+                        jax.tree_util.tree_leaves(g_seq)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_pipelined_lm_trains(self, rng):
+        """A few SGD steps on the pipelined loss reduce it — the train-a-
+        small-LM criterion."""
+        from deeplearning4j_tpu.parallel.pipeline import (
+            PipelinedTransformerLM)
+        S = 4
+        mesh = create_mesh({PIPE_AXIS: S}, jax.devices()[:S])
+        lm = PipelinedTransformerLM(vocab=12, width=8, n_heads=2,
+                                    n_layers=S, max_len=8, mesh=mesh)
+        params = lm.init(jax.random.PRNGKey(1))
+        # learnable sequences: next token = (token + 1) % vocab
+        toks = jnp.asarray(rng.integers(0, 12, (16, 7)))
+        tgts = (toks + 1) % 12
+
+        @jax.jit
+        def step(p):
+            l, g = jax.value_and_grad(lm.loss)(p, toks, tgts)
+            return jax.tree_util.tree_map(lambda a, b: a - 0.5 * b, p, g), l
+
+        losses = []
+        for _ in range(40):
+            params, l = step(params)
+            losses.append(float(l))
+        assert losses[-1] < losses[0] * 0.7, losses
+
 
 class TestRouting:
     def test_dispatch_combine_shapes_and_bounds(self):

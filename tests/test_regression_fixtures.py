@@ -26,7 +26,7 @@ def _expected():
         return json.load(f)
 
 
-@pytest.mark.parametrize("name", ["mlp_v1", "cnn_v1", "lstm_v1"])
+@pytest.mark.parametrize("name", ["mlp_v1", "cnn_v1", "lstm_v1", "attn_v1"])
 class TestRegressionFixtures:
     def test_restore_and_outputs_match(self, name):
         exp = _expected()[name]
