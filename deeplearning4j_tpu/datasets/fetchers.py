@@ -130,6 +130,60 @@ class MnistDataFetcher:
             return np.frombuffer(f.read(), np.uint8).astype(np.int64)
 
 
+def write_idx_gz(images: np.ndarray, labels: np.ndarray, directory: str,
+                 prefix: str) -> None:
+    """Write (N, H, W) uint8 images + (N,) labels as canonical gzipped
+    IDX files (``{prefix}-images-idx3-ubyte.gz`` etc.) — the exact byte
+    format of the MNIST distribution. Lets a user (or test) populate the
+    ``DL4J_TPU_DATA_DIR`` cache so fetchers take the real-file path; the
+    reference's MnistFetcher downloads these same files
+    (deeplearning4j-data/.../MnistDataFetcher.java:1)."""
+    images = np.asarray(images, np.uint8)
+    labels = np.asarray(labels, np.uint8)
+    n, rows, cols = images.shape
+    os.makedirs(directory, exist_ok=True)
+    with gzip.open(os.path.join(
+            directory, f"{prefix}-images-idx3-ubyte.gz"), "wb") as f:
+        f.write(struct.pack(">IIII", 0x803, n, rows, cols))
+        f.write(images.tobytes())
+    with gzip.open(os.path.join(
+            directory, f"{prefix}-labels-idx1-ubyte.gz"), "wb") as f:
+        f.write(struct.pack(">II", 0x801, n))
+        f.write(labels.tobytes())
+
+
+class DigitsDataSetIterator(_ArrayBackedIterator):
+    """REAL handwritten-digit data that ships inside scikit-learn (the
+    UCI optical-recognition test corpus: 1797 genuine 8x8 grayscale
+    digit scans). The in-image real-data correctness benchmark for
+    zero-egress environments where canonical MNIST cannot be fetched:
+    images are upscaled to 28x28 (3x nearest + 2px border) so LeNet-class
+    models run unchanged, with a deterministic 80/20 train/test split.
+    """
+
+    IMG = 28
+
+    def __init__(self, batch_size: int, train: bool = True, seed: int = 123,
+                 shuffle: bool = True):
+        images, labels = self.fetch(train)
+        ds = DataSet(images, _one_hot(labels, 10))
+        self._wrap(ds, batch_size, seed, shuffle=shuffle)
+
+    @classmethod
+    def fetch(cls, train: bool) -> Tuple[np.ndarray, np.ndarray]:
+        from sklearn.datasets import load_digits
+        digits = load_digits()
+        images = digits.images.astype(np.float32) / 16.0   # (1797, 8, 8)
+        labels = digits.target.astype(np.int64)
+        # 8x8 -> 24x24 nearest-neighbour, then 2px zero border -> 28x28
+        up = np.repeat(np.repeat(images, 3, axis=1), 3, axis=2)
+        up = np.pad(up, ((0, 0), (2, 2), (2, 2)))
+        # deterministic interleaved split: every 5th example is test
+        test = np.arange(up.shape[0]) % 5 == 0
+        sel = ~test if train else test
+        return up[sel].reshape(-1, cls.IMG * cls.IMG), labels[sel]
+
+
 class MnistDataSetIterator(_ArrayBackedIterator):
     """(reference: MnistDataSetIterator) — yields flattened 784-float
     features + one-hot 10 labels."""

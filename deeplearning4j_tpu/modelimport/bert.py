@@ -69,17 +69,16 @@ def import_bert_base(seq_len: int = 128, h5_path: Optional[str] = None,
         import_keras_model_and_weights)
     cfg = dict(BERT_BASE, **overrides)
     km = build_keras_bert(seq_len=seq_len, **cfg)
-    if h5_path is None:
+    cleanup = h5_path is None
+    if cleanup:
         fd, h5_path = tempfile.mkstemp(suffix=".h5")
         os.close(fd)
-        try:
-            km.save(h5_path)
-            model = import_keras_model_and_weights(h5_path)
-        finally:
-            os.unlink(h5_path)
-    else:
+    try:
         km.save(h5_path)
         model = import_keras_model_and_weights(h5_path)
+    finally:
+        if cleanup:
+            os.unlink(h5_path)
     return model, km
 
 

@@ -41,6 +41,9 @@ class ComputationGraph(BaseModel):
         self.layer_names = tuple(n.name for n in self._layer_nodes)
         self._output_fn = None
         self._loss_eval_fn = None
+        # tensor-parallel activation specs (parallel/tensor_parallel.py);
+        # set by ParallelWrapper when TP is enabled
+        self._tp_plan = None
 
     @property
     def conf_global(self):
@@ -113,6 +116,8 @@ class ComputationGraph(BaseModel):
                     continue
                 y, s = node.layer.apply(lp, model_state.get(name, {}), x, ctx)
                 new_state[name] = s
+                if self._tp_plan is not None:
+                    y = self._tp_plan.constrain(name, y)
                 acts[name] = y
             else:
                 from deeplearning4j_tpu.nn.graph.vertices import (

@@ -167,6 +167,44 @@ def _migrate_qkv_layout(model, params):
     return new
 
 
+def _migrate_qkv_opt_state(model, opt_state):
+    """Apply the same which-major → head-major repack to optimizer-state
+    leaves that mirror an attention param (Adam mu/nu etc.): each leaf's
+    path names the layer and ends in Wqkv/bqkv. Without this, restored
+    moments pair with the wrong weight columns after migration."""
+    from deeplearning4j_tpu.nn.layers.attention import (
+        SelfAttentionLayer, TransformerEncoderBlock)
+    heads = {}
+    for name, layer in _named_layers(model).items():
+        if isinstance(layer, (SelfAttentionLayer, TransformerEncoderBlock)):
+            heads[name] = (layer.n_heads, layer.n_out)
+
+    def fix(path, leaf):
+        keys = [getattr(p, "key", None) for p in path]
+        last = keys[-1] if keys else None
+        if last not in ("Wqkv", "bqkv"):
+            return leaf
+        layer_name = next((k for k in keys if k in heads), None)
+        if layer_name is None:
+            return leaf
+        n_heads, n_out = heads[layer_name]
+        dh = n_out // n_heads
+        if last == "Wqkv" and leaf.ndim == 2 \
+                and leaf.shape[1] == 3 * n_out:
+            f = leaf.shape[0]
+            return (leaf.reshape(f, 3, n_heads, dh)
+                    .transpose(0, 2, 1, 3).reshape(f, 3 * n_out))
+        if last == "bqkv" and leaf.ndim == 1 \
+                and leaf.shape[0] == 3 * n_out:
+            return (leaf.reshape(3, n_heads, dh)
+                    .transpose(1, 0, 2).reshape(-1))
+        return leaf
+
+    flat, tree = jax.tree_util.tree_flatten_with_path(opt_state)
+    return jax.tree_util.tree_unflatten(
+        tree, [fix(p, l) for p, l in flat])
+
+
 def _restore(path: str, expected_class: str, loader, load_updater: bool):
     _ensure_registry()
     with zipfile.ZipFile(path, "r") as zf:
@@ -181,14 +219,17 @@ def _restore(path: str, expected_class: str, loader, load_updater: bool):
                else ComputationGraph)
         model = cls(conf)
         model.init()
+        migrate = meta.get("qkv_layout") != "head_major"
         params = _unflatten_like(model.train_state.params, _read_tree(zf, "params"))
-        if meta.get("qkv_layout") != "head_major":
+        if migrate:
             params = _migrate_qkv_layout(model, params)
         state = _unflatten_like(model.train_state.model_state,
                                 _read_tree(zf, "state"))
         opt_state = model.train_state.opt_state
         if load_updater and meta.get("has_updater"):
             opt_state = _unflatten_like(opt_state, _read_tree(zf, "updater"))
+            if migrate:
+                opt_state = _migrate_qkv_opt_state(model, opt_state)
         model.train_state = TrainState(params, state, opt_state,
                                        jnp.asarray(meta["iteration"], jnp.int32))
         model.epoch_count = meta.get("epoch", 0)

@@ -227,10 +227,13 @@ class PipelinedTransformerLM:
         self.block = TransformerEncoderBlock(
             n_in=width, n_out=width, n_heads=n_heads, ffn_mult=ffn_mult,
             causal=True)
+        from deeplearning4j_tpu.nn.layers.normalization import (
+            LayerNormalization)
+        self._ln_f = LayerNormalization()
 
     def init(self, key) -> dict:
         from deeplearning4j_tpu.nn.inputs import RecurrentType
-        ke, kp, kh, kb = jax.random.split(key, 4)
+        ke, kp, kh, kb, kl = jax.random.split(key, 5)
         rt = RecurrentType(self.width, None)
         per_stage = [self.block.initialize(jax.random.fold_in(kb, i), rt)
                      for i in range(self.n_layers)]
@@ -239,8 +242,7 @@ class PipelinedTransformerLM:
             "embed": 0.02 * jax.random.normal(ke, (self.vocab, self.width)),
             "pos": 0.02 * jax.random.normal(kp, (self.max_len, self.width)),
             "blocks": stack_stage_params(per_stage, num_devices=S),
-            "ln_g": jnp.ones((self.width,)),
-            "ln_b": jnp.zeros((self.width,)),
+            "ln_f": self._ln_f.initialize(kl, rt),
             "head": 0.02 * jax.random.normal(kh, (self.width, self.vocab)),
         }
 
@@ -272,10 +274,9 @@ class PipelinedTransformerLM:
                     p = jax.tree_util.tree_map(
                         lambda a: a[d * self.repeats + r], params["blocks"])
                     h = fn(p, h)
-        mu = h.mean(-1, keepdims=True)
-        var = ((h - mu) ** 2).mean(-1, keepdims=True)
-        h = (h - mu) * jax.lax.rsqrt(var + 1e-5)
-        h = h * params["ln_g"] + params["ln_b"]
+        from deeplearning4j_tpu.nn.layers.base import LayerContext
+        h, _ = self._ln_f.apply(params["ln_f"], {}, h,
+                                LayerContext(train=False))
         return h
 
     def logits(self, params, tokens, *, pipelined: bool = True):

@@ -139,7 +139,11 @@ def _fallback_specs(p, m, ax):
 
 def plan_tp(model, mesh: Mesh, *, model_axis: str = MODEL_AXIS,
             data_axis: str = DATA_AXIS) -> TPPlan:
-    """Walk ``model.layers`` and build the paired TP plan.
+    """Build the paired TP plan for a MultiLayerNetwork (full pairing
+    across the layer stack) or a ComputationGraph (per-node rules: block-
+    internal attention/FFN pairing still applies — a transformer block is
+    a self-contained column→row pair regardless of DAG shape — but dense
+    pairing ACROSS nodes is skipped, since a DAG edge may fan out).
 
     ``model`` must be initialized (param shapes are read from the live
     pytree). Layers the planner does not understand fall back to the
@@ -151,7 +155,11 @@ def plan_tp(model, mesh: Mesh, *, model_axis: str = MODEL_AXIS,
         ActivationLayer, AutoEncoder, DenseLayer, DropoutLayer)
 
     params = model.train_state.params
-    layers = list(model.layers)
+    if hasattr(model, "layers"):
+        layers = list(model.layers)
+    else:
+        return _plan_tp_graph(model, mesh, model_axis=model_axis,
+                              data_axis=data_axis)
     m = int(mesh.shape.get(model_axis, 1))
     ax = model_axis
     spec_tree: Dict[str, Any] = {}
@@ -231,6 +239,37 @@ def plan_tp(model, mesh: Mesh, *, model_axis: str = MODEL_AXIS,
             act_kinds[name] = _REPL
             state = _REPL
 
+    return TPPlan(_named(mesh, spec_tree, params), act_kinds, mesh,
+                  model_axis, data_axis)
+
+
+def _plan_tp_graph(model, mesh: Mesh, *, model_axis: str = MODEL_AXIS,
+                   data_axis: str = DATA_AXIS) -> TPPlan:
+    """Per-node TP plan for a ComputationGraph: transformer blocks and
+    attention layers keep their internal Megatron pairing (input and
+    output replicated, so DAG fan-out is safe); everything else uses the
+    fallback column rules."""
+    from deeplearning4j_tpu.nn.layers.attention import (
+        SelfAttentionLayer, TransformerEncoderBlock)
+
+    params = model.train_state.params
+    m = int(mesh.shape.get(model_axis, 1))
+    ax = model_axis
+    spec_tree: Dict[str, Any] = {}
+    act_kinds: Dict[str, str] = {}
+    for node in model._layer_nodes:
+        name, layer = node.name, node.layer
+        p = params.get(name, {})
+        if m <= 1:
+            spec_tree[name] = _repl_specs(p)
+        elif isinstance(layer, TransformerEncoderBlock):
+            spec_tree[name] = _transformer_specs(p, m, ax, layer.n_heads)
+        elif isinstance(layer, SelfAttentionLayer) and "Wqkv" in p \
+                and layer.n_heads % m == 0:
+            spec_tree[name] = _attention_specs(p, m, ax)
+        else:
+            spec_tree[name] = _fallback_specs(p, m, ax)
+        act_kinds[name] = _REPL
     return TPPlan(_named(mesh, spec_tree, params), act_kinds, mesh,
                   model_axis, data_axis)
 

@@ -1,0 +1,79 @@
+"""Cluster text pipeline tests (the dl4j-spark-nlp analog).
+
+Golden-test pattern (SURVEY §4): sharded map/reduce vocab == single-host
+vocab; distributed Word2Vec with parameter averaging learns the same
+similarity structure as the single-host trainer.
+"""
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.cluster import DistributedWord2Vec, TextPipeline
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+CORPUS = [
+    "the cat sat on the mat",
+    "the dog sat on the rug",
+    "a cat and a dog played",
+    "the cat chased the dog",
+    "dogs and cats are pets",
+    "the mat and the rug are flat",
+] * 8
+
+
+def test_sharded_vocab_matches_single_host():
+    pipe = TextPipeline(num_shards=4, min_word_frequency=2)
+    vocab = pipe.build_vocab(CORPUS)
+
+    single = Word2Vec(min_word_frequency=2)
+    single.build_vocab(CORPUS)
+
+    assert set(vocab.words()) == set(single.vocab.words())
+    for w in vocab.words():
+        assert vocab.word_frequency(w) == single.vocab.word_frequency(w)
+    assert vocab.total_word_count == single.vocab.total_word_count
+    # frequency-descending order holds in both
+    counts = [vocab.word_frequency(w) for w in vocab.words()]
+    assert counts == sorted(counts, reverse=True)
+
+
+def test_shard_partition_covers_corpus():
+    pipe = TextPipeline(num_shards=3)
+    shards = pipe.shard(CORPUS)
+    assert sum(len(s) for s in shards) == len(CORPUS)
+    assert all(len(s) > 0 for s in shards)
+
+
+def test_distributed_word2vec_learns_structure():
+    # two topic clusters with disjoint vocabularies: within-cluster
+    # similarity must beat cross-cluster (which never co-occur)
+    corpus = (["the cat chased the dog past the mouse"] * 24
+              + ["red and blue mix into green and purple"] * 24)
+    dw = DistributedWord2Vec(num_workers=4, averaging_rounds=4,
+                             layer_size=24, window_size=3,
+                             min_word_frequency=1, epochs=32, negative=4,
+                             seed=7)
+    model = dw.fit(corpus)
+    assert model.has_word("cat") and model.has_word("dog")
+    assert model.similarity("cat", "dog") > model.similarity("cat", "red")
+    assert model.similarity("red", "blue") > model.similarity("blue", "dog")
+
+
+def test_distributed_matches_single_when_one_worker():
+    """num_workers=1, one round == plain single-host training on the
+    same vocab/tables: parameter averaging over one shard is the
+    identity. (The pipeline vocab breaks frequency ties differently
+    than corpus-order insertion, so the oracle shares its vocab.)"""
+    kw = dict(layer_size=16, window_size=2, min_word_frequency=1,
+              epochs=2, negative=3, seed=11)
+    dw = DistributedWord2Vec(num_workers=1, averaging_rounds=1, **kw)
+    dist = dw.fit(CORPUS)
+
+    single = Word2Vec(**kw)
+    single.vocab = dw.pipeline.build_vocab(CORPUS)
+    single._init_tables()
+    single.fit(CORPUS)
+    assert set(single.vocab.words()) == set(dist.vocab.words())
+    for w in ("cat", "dog", "mat"):
+        np.testing.assert_allclose(
+            np.asarray(dist.get_word_vector(w)),
+            np.asarray(single.get_word_vector(w)), rtol=1e-4, atol=1e-5)

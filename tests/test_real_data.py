@@ -1,0 +1,77 @@
+"""Real-data correctness tests (VERDICT weak#7 / next#8).
+
+Two claims, both previously resting on synthetic data:
+
+1. A LeNet-class model reaches high test accuracy on REAL handwritten
+   digits — using the genuine UCI optical-digits scans that ship inside
+   scikit-learn (the only real image corpus available in a zero-egress
+   environment).
+2. The cached-real-file MNIST path (IDX parsing) works end to end:
+   canonical gzipped IDX files are written byte-for-byte per the MNIST
+   format spec, the fetcher reads them back (NOT the synthetic
+   fallback), and training runs on their contents.
+   Reference: MnistDataFetcher.java:1 (same file contract).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import fetchers
+from deeplearning4j_tpu.datasets.fetchers import (
+    DigitsDataSetIterator,
+    MnistDataSetIterator,
+    write_idx_gz,
+)
+from deeplearning4j_tpu.zoo.models import LeNet
+
+
+def test_digits_iterator_is_real_data():
+    """The corpus is the 1797-scan UCI digits set, not a generator."""
+    it = DigitsDataSetIterator(batch_size=64, train=True)
+    imgs, labels = DigitsDataSetIterator.fetch(train=True)
+    t_imgs, t_labels = DigitsDataSetIterator.fetch(train=False)
+    assert imgs.shape[0] + t_imgs.shape[0] == 1797
+    assert imgs.shape[1] == 28 * 28
+    # disjoint deterministic split
+    assert set(np.arange(1797)[np.arange(1797) % 5 == 0]).isdisjoint(
+        np.arange(1797)[np.arange(1797) % 5 != 0])
+    # all ten classes present in both splits
+    assert set(labels.tolist()) == set(range(10))
+    assert set(t_labels.tolist()) == set(range(10))
+
+
+@pytest.mark.slow
+def test_lenet_real_digits_accuracy():
+    """LeNet >= 98% test accuracy on real handwritten digits — the
+    real-data replacement for the synthetic 'accuracy 1.0' claim."""
+    model = LeNet(compute_dtype="float32").init()
+    train_it = DigitsDataSetIterator(batch_size=64, train=True)
+    model.fit(train_it, epochs=12)
+    ev = model.evaluate(DigitsDataSetIterator(batch_size=64, train=False,
+                                              shuffle=False))
+    acc = ev.accuracy()
+    assert acc >= 0.98, f"accuracy {acc}"
+
+
+def test_mnist_real_file_path_roundtrip(tmp_path, monkeypatch):
+    """write_idx_gz -> MnistDataFetcher reads the REAL files: contents
+    match the written scans exactly (synthetic fallback would not)."""
+    imgs, labels = DigitsDataSetIterator.fetch(train=True)
+    scans = (imgs.reshape(-1, 28, 28) * 255).astype(np.uint8)[:256]
+    lab = labels[:256].astype(np.uint8)
+    base = tmp_path / "mnist"
+    write_idx_gz(scans, lab, str(base), "train")
+    write_idx_gz(scans[:64], lab[:64], str(base), "t10k")
+    monkeypatch.setattr(fetchers, "DATA_DIR", str(tmp_path))
+
+    got_imgs, got_labels = fetchers.MnistDataFetcher(train=True).fetch()
+    assert got_imgs.shape == (256, 784)
+    np.testing.assert_allclose(got_imgs,
+                               scans.reshape(256, 784) / 255.0, atol=1e-7)
+    np.testing.assert_array_equal(got_labels, lab)
+
+    # the iterator trains off the real files
+    it = MnistDataSetIterator(batch_size=64)
+    model = LeNet(compute_dtype="float32").init()
+    model.fit(it, epochs=1)
+    assert np.isfinite(float(model._last_loss))

@@ -60,6 +60,26 @@ class TestRegressionFixtures:
         assert not np.allclose(out, out2)  # a step actually happened
 
 
+class TestQkvMigrationExactResume:
+    def test_attn_fixture_resumes_bit_identically(self):
+        """attn_v1.zip is a pre-0.2.0 (which-major QKV) checkpoint with
+        TRAINED Adam moments; after migration, one more training step
+        must reproduce the original never-serialized model's output —
+        proving params AND optimizer moments were both re-packed."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        exp = _expected()["attn_v1"]
+        model = restore_multi_layer_network(
+            os.path.join(RES, "attn_v1.zip"), load_updater=True)
+        x = np.asarray(exp["input"], np.float32)
+        y = np.asarray(exp["labels"], np.float32)
+        model.fit(DataSet(x, y))
+        out = np.asarray(model.output(x))
+        np.testing.assert_allclose(
+            out, np.asarray(exp["output_after_step"]),
+            rtol=1e-5, atol=1e-6)
+
+
 class TestTbpttConfRoundtrip:
     def test_lstm_fixture_keeps_tbptt_conf(self):
         model = restore_multi_layer_network(

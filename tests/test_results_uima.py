@@ -1,0 +1,116 @@
+"""Result-API holders and the UIMA type-system/XMI surface."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.evaluation.results import (
+    BinaryClassificationResult,
+    RankClassificationResult,
+)
+from deeplearning4j_tpu.nlp.language_packs import (
+    AnalysisPipeline,
+    SentenceAnnotator,
+    TokenAnnotator,
+)
+from deeplearning4j_tpu.nlp.uima import (
+    DEFAULT_TYPE_SYSTEM,
+    TypeDescription,
+    TypeSystem,
+    from_xmi,
+    to_xmi,
+)
+
+
+class TestRankClassificationResult:
+    def test_ranks_descending_with_labels(self):
+        out = np.array([[0.1, 0.7, 0.2], [0.5, 0.2, 0.3]])
+        r = RankClassificationResult(out, labels=["a", "b", "c"])
+        assert r.ranked_indices.tolist() == [[1, 2, 0], [0, 2, 1]]
+        assert r.max_outcomes() == ["b", "a"]
+        assert r.max_outcome_for_row(1) == "a"
+
+    def test_vector_and_default_labels(self):
+        r = RankClassificationResult(np.array([0.2, 0.5, 0.3]))
+        assert r.max_outcomes() == ["1"]
+        assert r.labels == ["0", "1", "2"]
+
+    def test_rejects_rank3(self):
+        with pytest.raises(ValueError, match="vectors and matrices"):
+            RankClassificationResult(np.zeros((2, 2, 2)))
+
+    def test_label_count_mismatch(self):
+        with pytest.raises(ValueError):
+            RankClassificationResult(np.zeros((1, 3)), labels=["x"])
+
+
+class TestBinaryClassificationResult:
+    def test_threshold_decisions(self):
+        r = BinaryClassificationResult(np.array([0.2, 0.5, 0.9]),
+                                       decision_threshold=0.5)
+        assert r.decisions().tolist() == [0, 1, 1]
+
+    def test_softmax_column(self):
+        r = BinaryClassificationResult(
+            np.array([[0.8, 0.2], [0.1, 0.9]]), decision_threshold=0.6)
+        assert r.decisions().tolist() == [0, 1]
+
+    def test_class_weights_stored(self):
+        r = BinaryClassificationResult(class_weights=[1.0, 3.0])
+        assert r.class_weights.tolist() == [1.0, 3.0]
+        with pytest.raises(ValueError):
+            r.decisions()
+
+
+class TestTypeSystem:
+    def test_subsumption_and_inherited_features(self):
+        ts = TypeSystem([
+            TypeDescription("entity", features={"id": "uima.cas.String"}),
+            TypeDescription("person", supertype="entity",
+                            features={"role": "uima.cas.String"}),
+        ])
+        assert ts.subsumes("entity", "person")
+        assert not ts.subsumes("person", "entity")
+        assert set(ts.features_of("person")) == {"id", "role"}
+
+    def test_descriptor_xml_roundtrip(self):
+        xml = DEFAULT_TYPE_SYSTEM.to_xml()
+        ts2 = TypeSystem.from_xml(xml)
+        assert set(ts2.types) == set(DEFAULT_TYPE_SYSTEM.types)
+        assert ts2.features_of("token")["pos"] == "uima.cas.String"
+
+    def test_validation_catches_problems(self):
+        from deeplearning4j_tpu.nlp.language_packs import CAS, Annotation
+        cas = CAS("hi")
+        cas.add(Annotation("token", 0, 9, "hi"))           # span overflow
+        cas.add(Annotation("mystery", 0, 1, "h"))          # unknown type
+        cas.add(Annotation("token", 0, 2, "hi", color="x"))  # bad feature
+        problems = DEFAULT_TYPE_SYSTEM.validate(cas)
+        assert len(problems) == 3, problems
+
+
+class TestXmi:
+    def test_roundtrip_preserves_text_spans_features(self):
+        pipeline = AnalysisPipeline([SentenceAnnotator(), TokenAnnotator()])
+        cas = pipeline.process("Hello world. Goodbye now.")
+        for i, tok in enumerate(cas.select("token")):
+            tok.features["pos"] = "NN" if i % 2 else "VB"
+        xml = to_xmi(cas)
+        assert "sofaString" in xml and "cas:Sofa" in xml
+
+        cas2 = from_xmi(xml, DEFAULT_TYPE_SYSTEM)
+        assert cas2.text == cas.text
+        assert len(cas2.select("sentence")) == 2
+        toks, toks2 = cas.select("token"), cas2.select("token")
+        assert [(t.begin, t.end, t.text) for t in toks] == \
+               [(t.begin, t.end, t.text) for t in toks2]
+        assert toks2[0].features["pos"] == "VB"
+
+    def test_from_xmi_validates(self):
+        from deeplearning4j_tpu.nlp.language_packs import CAS, Annotation
+        cas = CAS("abc")
+        cas.add(Annotation("unknown_type", 0, 1, "a"))
+        xml = to_xmi(cas)
+        with pytest.raises(ValueError, match="unknown type"):
+            from_xmi(xml, DEFAULT_TYPE_SYSTEM)
+        # without a type system it parses fine
+        assert from_xmi(xml).select("unknown_type")[0].text == "a"
