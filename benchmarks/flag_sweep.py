@@ -1,0 +1,72 @@
+"""Try XLA flag/batch variants on the scanned ResNet50 step (run each
+variant in a fresh process: XLA_FLAGS are read at backend init)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+VARIANTS = {
+    "base": ("", 1024, 16),
+    "b2048": ("", 2048, 8),
+    "b512": ("", 512, 32),
+    "b256": ("", 256, 64),
+    "b384": ("", 384, 42),
+    "b512b": ("", 512, 32),
+    "b128": ("", 128, 128),
+    "b64": ("", 64, 256),
+}
+
+
+def run_one(name):
+    flags, batch, k = VARIANTS[name]
+    import jax.numpy as jnp
+    import jax.random as jrandom
+    from deeplearning4j_tpu.optimize.solver import make_scan_train_step
+    from deeplearning4j_tpu.optimize.updaters import Nesterovs
+    from deeplearning4j_tpu.zoo.models import ResNet50
+
+    model = ResNet50(num_classes=200, height=64, width=64, channels=3,
+                     compute_dtype="bfloat16",
+                     updater=Nesterovs(1e-2, 0.9)).init()
+
+    def loss_fn(params, mstate, feats, labels, fmask, lmask, rng, it):
+        return model._loss(params, mstate, (feats,), (labels,), fmask,
+                           lmask, rng, it)
+
+    steps_fn = make_scan_train_step(loss_fn, model._tx)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, 64, 64, 3)).astype(np.float32))
+    y = np.zeros((batch, 200), np.float32)
+    y[np.arange(batch), rng.integers(0, 200, batch)] = 1.0
+    xs = jnp.broadcast_to(x, (k,) + x.shape)
+    ys = jnp.broadcast_to(jnp.asarray(y), (k, batch, 200))
+    key = jrandom.PRNGKey(0)
+    ts = model.train_state
+    ts, losses = steps_fn(ts, xs, ys, None, None, key)
+    float(np.asarray(losses[-1]))
+    n = 3
+    t0 = time.perf_counter()
+    for i in range(n):
+        ts, losses = steps_fn(ts, xs, ys, None, None,
+                              jrandom.fold_in(key, i))
+    float(np.asarray(losses[-1]))
+    dt = time.perf_counter() - t0
+    print(json.dumps({"variant": name, "flags": flags, "batch": batch,
+                      "k": k,
+                      "img_per_sec": round(n * k * batch / dt, 1)}))
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1:
+        run_one(sys.argv[1])
+    else:
+        for name, (flags, _, _) in VARIANTS.items():
+            env = dict(os.environ)
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " "
+                                + flags).strip()
+            subprocess.run([sys.executable, __file__, name], env=env,
+                           timeout=560)

@@ -1,0 +1,102 @@
+"""Isolate fixed per-call overhead in the ResNet50 train step.
+
+Compares per-step time for batch 512/1024/2048 and for a k-step
+lax.scan-fused loop (one dispatch for k optimizer steps, batches staged
+on device). If step(batch)/img is flat while scan wins, the gap is
+host-dispatch overhead, not device work.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import jax.random as jrandom
+    import optax
+
+    from deeplearning4j_tpu.optimize.updaters import Nesterovs
+    from deeplearning4j_tpu.optimize.solver import TrainState
+    from deeplearning4j_tpu.zoo.models import ResNet50
+
+    model = ResNet50(num_classes=200, height=64, width=64, channels=3,
+                     compute_dtype="bfloat16",
+                     updater=Nesterovs(1e-2, 0.9)).init()
+    tx = model._tx
+    key = jrandom.PRNGKey(0)
+    rng = np.random.default_rng(0)
+
+    def data(b):
+        x = jnp.asarray(rng.normal(size=(b, 64, 64, 3)).astype(np.float32))
+        idx = rng.integers(0, 200, b)
+        y = np.zeros((b, 200), np.float32)
+        y[np.arange(b), idx] = 1.0
+        return x, jnp.asarray(y)
+
+    # ---- per-call step at several batch sizes ---------------------------
+    for b in (512, 1024, 2048):
+        m = ResNet50(num_classes=200, height=64, width=64, channels=3,
+                     compute_dtype="bfloat16",
+                     updater=Nesterovs(1e-2, 0.9)).init()
+        step = m._build_train_step()
+        x, y = data(b)
+        ts = m.train_state
+        for i in range(3):
+            ts, loss = step(ts, (x,), (y,), None, None,
+                            jrandom.fold_in(key, i))
+        float(loss)
+        t0 = time.perf_counter()
+        n = 20
+        for i in range(n):
+            ts, loss = step(ts, (x,), (y,), None, None,
+                            jrandom.fold_in(key, 100 + i))
+        float(loss)
+        dt = (time.perf_counter() - t0) / n
+        print(f"batch {b:5d}: {dt * 1e3:7.2f} ms/step "
+              f"({b / dt:10,.0f} img/s)")
+
+    # ---- k-step scan inside one dispatch --------------------------------
+    m = ResNet50(num_classes=200, height=64, width=64, channels=3,
+                 compute_dtype="bfloat16",
+                 updater=Nesterovs(1e-2, 0.9)).init()
+    b, k = 1024, 8
+    x, y = data(b)
+    xs = jnp.broadcast_to(x, (k,) + x.shape)
+    ys = jnp.broadcast_to(y, (k,) + y.shape)
+
+    def scan_steps(ts, xs, ys, rng):
+        def one(ts, inp):
+            xk, yk, i = inp
+            def lf(p):
+                return m._loss(p, ts.model_state, (xk,), (yk,), None,
+                               None, jax.random.fold_in(rng, i),
+                               ts.iteration)
+            (loss, new_ms), grads = jax.value_and_grad(
+                lf, has_aux=True)(ts.params)
+            updates, new_opt = tx.update(grads, ts.opt_state, ts.params)
+            new_params = optax.apply_updates(ts.params, updates)
+            return TrainState(new_params, new_ms, new_opt,
+                              ts.iteration + 1), loss
+        ts, losses = jax.lax.scan(one, ts, (xs, ys, jnp.arange(k)))
+        return ts, losses[-1]
+
+    jscan = jax.jit(scan_steps, donate_argnums=(0,))
+    ts = m.train_state
+    for i in range(2):
+        ts, loss = jscan(ts, xs, ys, jrandom.fold_in(key, i))
+    float(loss)
+    t0 = time.perf_counter()
+    n = 5
+    for i in range(n):
+        ts, loss = jscan(ts, xs, ys, jrandom.fold_in(key, 50 + i))
+    float(loss)
+    dt = (time.perf_counter() - t0) / (n * k)
+    print(f"scan k={k}, batch {b}: {dt * 1e3:7.2f} ms/step "
+          f"({b / dt:10,.0f} img/s)")
+
+
+if __name__ == "__main__":
+    main()

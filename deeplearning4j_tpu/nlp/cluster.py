@@ -57,43 +57,62 @@ class TextPipeline:
         return shards
 
     def tokenize_shard(self, sentences: Sequence[str]) -> List[List[str]]:
-        """The per-partition map: raw sentences → token sequences."""
+        """The per-partition map: raw sentences → token sequences.
+        Empty results are KEPT (as []) so local indices still invert the
+        round-robin sharding for count_shard's position keys."""
         out = []
         for s in sentences:
-            toks = [t for t in
-                    self.tokenizer_factory.create(s).get_tokens()
-                    if t and t not in self.stop_words]
-            if toks:
-                out.append(toks)
+            out.append([t for t in
+                        self.tokenizer_factory.create(s).get_tokens()
+                        if t and t not in self.stop_words])
         return out
 
-    @staticmethod
-    def count_shard(token_seqs: Iterable[Sequence[str]]) -> dict:
-        """Per-partition word counters (the accumulator)."""
+    def count_shard(self, token_seqs: Iterable[Sequence[str]],
+                    shard_index: int = 0) -> dict:
+        """Per-partition word counters (the accumulator): word →
+        [count, first_global_position]. The position key inverts the
+        round-robin sharding (global sentence = local_j * num_shards +
+        shard_index), so the reduce can break frequency ties in original
+        corpus-appearance order — the single-host constructor's Counter
+        insertion order."""
         counts: dict = {}
-        for seq in token_seqs:
-            for t in seq:
-                counts[t] = counts.get(t, 0) + 1
+        for j, seq in enumerate(token_seqs):
+            sent = j * self.num_shards + shard_index
+            for ti, t in enumerate(seq):
+                entry = counts.get(t)
+                if entry is None:
+                    counts[t] = [1, (sent, ti)]
+                else:
+                    entry[0] += 1
         return counts
 
     def reduce_vocab(self, shard_counts: Sequence[dict]) -> VocabCache:
-        """Merge counters, apply min frequency, deterministic ordering
-        (count desc, then word) — matches the single-host constructor."""
+        """Merge counters, apply min frequency; ordering = count desc
+        with ties in first-appearance order — index-identical to the
+        single-host VocabConstructor (Huffman codes / syn1 rows line up
+        across the two build paths)."""
         merged: dict = {}
         for counts in shard_counts:
-            for w, c in counts.items():
-                merged[w] = merged.get(w, 0) + c
+            for w, (c, first) in counts.items():
+                entry = merged.get(w)
+                if entry is None:
+                    merged[w] = [c, first]
+                else:
+                    entry[0] += c
+                    entry[1] = min(entry[1], first)
         vocab = VocabCache()
-        items = sorted(merged.items(), key=lambda kv: (-kv[1], kv[0]))
-        for w, c in items:
+        items = sorted(merged.items(), key=lambda kv: (-kv[1][0],
+                                                       kv[1][1]))
+        for w, (c, _first) in items:
             if c >= self.min_word_frequency:
                 vocab.add_token(VocabWord(word=w, count=c))
-        vocab.total_word_count = sum(merged.values())
+        vocab.total_word_count = sum(c for c, _ in merged.values())
         return vocab
 
     def build_vocab(self, corpus: Iterable[str]) -> VocabCache:
         shards = self.shard(corpus)
-        counts = [self.count_shard(self.tokenize_shard(s)) for s in shards]
+        counts = [self.count_shard(self.tokenize_shard(s), i)
+                  for i, s in enumerate(shards)]
         return self.reduce_vocab(counts)
 
 
@@ -120,7 +139,9 @@ class DistributedWord2Vec:
         token_shards = [self.pipeline.tokenize_shard(s)
                         for s in shards_raw]
         vocab = self.pipeline.reduce_vocab(
-            [self.pipeline.count_shard(ts) for ts in token_shards])
+            [self.pipeline.count_shard(ts, i)
+             for i, ts in enumerate(token_shards)])
+        token_shards = [[s for s in ts if s] for ts in token_shards]
 
         # global model: shared vocab + one set of initial tables
         master = Word2Vec(**self.w2v_kwargs)

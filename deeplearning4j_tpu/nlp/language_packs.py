@@ -50,6 +50,30 @@ _ZH_SEED = {
     "模型", "数据", "训练", "人工", "智能", "因为", "所以", "如果",
     "但是", "就是", "这个", "那个", "已经", "还是", "或者", "今天",
     "明天", "问题", "工作", "生活", "世界", "非常", "喜欢", "谢谢",
+    # high-frequency everyday vocabulary
+    "时间", "地方", "东西", "事情", "朋友", "老师", "学生", "学校",
+    "公司", "国家", "城市", "北京", "上海", "电话", "电脑", "手机",
+    "电视", "电影", "音乐", "新闻", "报纸", "文章", "历史", "文化",
+    "经济", "政治", "社会", "科学", "技术", "发展", "研究", "教育",
+    "医院", "医生", "健康", "身体", "运动", "比赛", "足球", "篮球",
+    "飞机", "火车", "汽车", "地铁", "公共", "交通", "旅游", "旅行",
+    "天气", "下雨", "下雪", "春天", "夏天", "秋天", "冬天", "早上",
+    "中午", "晚上", "昨天", "后天", "星期", "月份", "去年", "明年",
+    "大家", "别人", "先生", "小姐", "孩子", "父母", "家庭", "房子",
+    "厨房", "商店", "超市", "市场", "银行", "钱包", "价格", "便宜",
+    "开始", "结束", "继续", "停止", "出发", "到达", "回来", "离开",
+    "认识", "了解", "理解", "记得", "忘记", "希望", "觉得", "认为",
+    "应该", "必须", "需要", "帮助", "感谢", "对不起", "再见", "欢迎",
+    "高兴", "快乐", "幸福", "难过", "生气", "害怕", "担心", "放心",
+    "重要", "主要", "特别", "一般", "普通", "简单", "复杂", "容易",
+    "困难", "方便", "安全", "危险", "干净", "漂亮", "好看", "有趣",
+    "有名", "著名", "年轻", "聪明", "努力", "认真", "热情", "友好",
+    "计算", "程序", "软件", "系统", "信息", "互联网", "网站", "网上",
+    "语言", "文字", "汉语", "英语", "翻译", "词典", "意思", "内容",
+    "方法", "办法", "结果", "原因", "影响", "变化", "情况", "环境",
+    "大学", "中学", "小学", "处理", "分析", "设计", "管理", "服务",
+    "自然", "动物", "植物", "森林", "河流", "海洋", "太阳", "月亮",
+    "星星", "地球", "宇宙", "空气", "能源", "资源", "保护", "污染",
 }
 
 
@@ -105,6 +129,23 @@ class ChineseTokenizerFactory(TokenizerFactory):
 _HIRA = r"぀-ゟ"
 _KATA = r"゠-ヿㇰ-ㇿ"
 
+# common kanji compounds so compound splitting works out of the box; a
+# cached ``japanese.txt`` (Kuromoji/mecab-style word list) extends it
+_JA_SEED = {
+    "日本", "日本語", "東京", "会社", "仕事", "学校", "学生", "先生",
+    "電話", "電車", "時間", "今日", "明日", "昨日", "今年", "去年",
+    "毎日", "毎週", "午前", "午後", "世界", "国家", "社会", "経済",
+    "政治", "歴史", "文化", "科学", "技術", "研究", "開発", "教育",
+    "大学", "高校", "問題", "質問", "答え", "言葉", "文章", "意味",
+    "情報", "新聞", "映画", "音楽", "写真", "料理", "食事", "朝食",
+    "昼食", "夕食", "天気", "天気予報", "旅行", "観光", "案内",
+    "家族", "友達", "子供", "両親", "兄弟", "姉妹", "結婚", "誕生日",
+    "病院", "医者", "健康", "運動", "練習", "試験", "試合", "勉強",
+    "機械", "学習", "機械学習", "人工", "知能", "人工知能", "深層",
+    "自然", "言語", "処理", "自然言語", "計算", "計算機", "電脳",
+    "銀行", "会議", "書類", "説明", "説明書", "住所", "名前", "番号",
+}
+
 
 class JapaneseTokenizerFactory(TokenizerFactory):
     """Segments on script transitions (kanji→hiragana starts a new
@@ -118,7 +159,8 @@ class JapaneseTokenizerFactory(TokenizerFactory):
 
     def __init__(self, dictionary: Optional[Iterable[str]] = None):
         super().__init__()
-        d = set(_load_dict("japanese.txt") or ())
+        d = set(_JA_SEED)
+        d |= set(_load_dict("japanese.txt") or ())
         if dictionary:
             d |= set(dictionary)
         self._dict = d

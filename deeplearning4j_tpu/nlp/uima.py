@@ -59,22 +59,30 @@ class TypeSystem:
         self.types[t.name] = t
         return self
 
+    def _chain(self, name: str) -> List[TypeDescription]:
+        """Supertype chain with cycle detection (a hand-edited external
+        descriptor can declare A<-B<-A; report it, don't hang)."""
+        seen = set()
+        chain = []
+        while name in self.types:
+            if name in seen:
+                raise ValueError(f"type system has a supertype cycle at"
+                                 f" {name!r}")
+            seen.add(name)
+            chain.append(self.types[name])
+            name = self.types[name].supertype
+        return chain
+
     def subsumes(self, ancestor: str, name: str) -> bool:
-        while name is not None:
-            if name == ancestor:
-                return True
-            t = self.types.get(name)
-            name = t.supertype if t else None
-        return False
+        if name == ancestor:
+            return True
+        return any(t.name == ancestor or t.supertype == ancestor
+                   for t in self._chain(name))
 
     def features_of(self, name: str) -> Dict[str, str]:
         """Own + inherited features."""
         out: Dict[str, str] = {}
-        chain = []
-        while name in self.types:
-            chain.append(self.types[name])
-            name = self.types[name].supertype
-        for t in reversed(chain):
+        for t in reversed(self._chain(name)):
             out.update(t.features)
         return out
 
@@ -136,9 +144,18 @@ DEFAULT_TYPE_SYSTEM = TypeSystem([
 ])
 
 
+import re as _re
+
+_RESERVED_ATTRS = frozenset({"sofa", "begin", "end"})
+_XML_NAME = _re.compile(r"^[A-Za-z_][A-Za-z0-9_.\-]*$")
+
+
 def to_xmi(cas: CAS) -> str:
     """Serialize a CAS to XMI: xmi:XMI envelope, cas:Sofa with the
-    document text, one dl4j:<type> element per annotation."""
+    document text, one dl4j:<type> element per annotation. Feature names
+    must be valid XML attribute names and may not shadow the reserved
+    span attributes (sofa/begin/end) — violations raise rather than
+    silently corrupting the spans."""
     for prefix, uri in _NS.items():
         ET.register_namespace(prefix, uri)
     root = ET.Element(f"{{{_NS['xmi']}}}XMI",
@@ -162,6 +179,11 @@ def to_xmi(cas: CAS) -> str:
                 "end": str(ann.end),
             }
             for k, v in ann.features.items():
+                if k in _RESERVED_ATTRS or not _XML_NAME.match(k):
+                    raise ValueError(
+                        f"feature name {k!r} on {tname!r} cannot be "
+                        "serialized to XMI (reserved or not a valid XML"
+                        " attribute name)")
                 attrs[k] = str(v)
             ET.SubElement(root, f"{{{_NS['dl4j']}}}{tname}", attrs)
             next_id += 1

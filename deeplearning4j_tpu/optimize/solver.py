@@ -105,6 +105,47 @@ def make_train_step(loss_fn: LossFn, tx: optax.GradientTransformation,
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
+def make_scan_train_step(loss_fn: LossFn, tx: optax.GradientTransformation,
+                         donate: bool = True, constrain_fn=None):
+    """Multi-step variant of ``make_train_step``: one dispatch runs K
+    optimizer steps via ``lax.scan`` over pre-staged batches.
+
+    Why this exists: each host→device dispatch carries fixed overhead
+    (buffer-handle marshalling; tens of ms through tunneled PJRT
+    transports — measured in benchmarks/step_overhead.py), so per-step
+    dispatch caps small-step throughput. Scanning K steps device-side
+    amortizes it K× and lets XLA overlap the scan with host work — the
+    TPU analog of the reference keeping its fit loop inside one native
+    workspace iteration.
+
+    Returns ``steps(train_state, features, labels, fmask, lmask, rng) ->
+    (new_train_state, per-step losses)`` where features/labels (and
+    masks, if given) carry a leading K dim.
+    """
+
+    def one(ts: TrainState, xs):
+        features, labels, fmask, lmask, i = xs
+        def lf(params):
+            return loss_fn(params, ts.model_state, features, labels, fmask,
+                           lmask, i[0], ts.iteration)
+        (loss, new_ms), grads = jax.value_and_grad(lf, has_aux=True)(ts.params)
+        updates, new_opt = tx.update(grads, ts.opt_state, ts.params)
+        new_params = optax.apply_updates(ts.params, updates)
+        if constrain_fn is not None:
+            new_params = constrain_fn(new_params)
+        return TrainState(new_params, new_ms, new_opt,
+                          ts.iteration + 1), loss
+
+    def steps(ts: TrainState, features, labels, fmask, lmask, rng):
+        k = features[0].shape[0] if isinstance(features, tuple) \
+            else features.shape[0]
+        keys = jax.random.split(rng, k)[:, None]
+        return jax.lax.scan(one, ts,
+                            (features, labels, fmask, lmask, keys))
+
+    return jax.jit(steps, donate_argnums=(0,) if donate else ())
+
+
 def make_eval_step(forward_fn):
     """Jitted inference step: forward_fn(params, model_state, x, mask)."""
     return jax.jit(forward_fn)

@@ -25,12 +25,43 @@ body{font-family:sans-serif;margin:20px;background:#fafafa}
 h2{margin:8px 0} .card{background:#fff;border:1px solid #ddd;
 border-radius:6px;padding:12px;margin-bottom:14px}
 canvas{width:100%;height:220px} td,th{padding:2px 10px;text-align:left}
+nav a{margin-right:14px;text-decoration:none;color:#1668b8;
+font-weight:bold} nav a.on{color:#111;border-bottom:2px solid #111}
+.tab{display:none}.tab.on{display:block}
+svg text{font:11px sans-serif} .node rect{fill:#eef;stroke:#88a}
+img.act{image-rendering:pixelated;border:1px solid #ccc;margin:4px}
 </style></head><body>
+<nav id=nav>
+<a href=#overview class=on>Overview</a><a href=#model>Model</a>
+<a href=#system>System</a><a href=#activations>Activations</a>
+<a href=#tsne>t-SNE</a></nav>
+<div id=overview class="tab on">
 <h2>Training overview</h2>
 <div class=card><b>Score vs iteration</b><canvas id=score></canvas></div>
 <div class=card><b>Samples/sec</b><canvas id=tput></canvas></div>
 <div class=card><b>Per-layer mean |param|</b><canvas id=pm></canvas></div>
 <div class=card><b>Session</b><table id=info></table></div>
+</div>
+<div id=model class=tab>
+<h2>Model graph</h2>
+<div class=card><svg id=dag width="100%" height="500"></svg></div>
+<div class=card><b>Layer detail</b><table id=ldetail></table></div>
+</div>
+<div id=system class=tab>
+<h2>System</h2>
+<div class=card><b>Device memory (bytes in use)</b>
+<canvas id=mem></canvas></div>
+<div class=card><b>ETL ms / iteration</b><canvas id=etl></canvas></div>
+</div>
+<div id=activations class=tab>
+<h2>Layer activations</h2>
+<div class=card id=actimgs>no activation records yet — attach a
+ConvolutionalListener</div>
+</div>
+<div id=tsne class=tab>
+<h2>t-SNE</h2>
+<div class=card><canvas id=tsneplot style="height:480px"></canvas></div>
+</div>
 <script>
 function draw(cv, series, labels){
   const c = cv.getContext('2d');
@@ -53,23 +84,121 @@ function draw(cv, series, labels){
   c.fillStyle='#333';
   c.fillText(hi.toPrecision(4),2,12); c.fillText(lo.toPrecision(4),2,H-4);
 }
+function drawDag(nodes, stats){
+  const svg = document.getElementById('dag');
+  svg.replaceChildren();
+  const pos = {}; const W = svg.clientWidth||900;
+  const perRow = Math.max(2, Math.floor(W/170));
+  nodes.forEach((n,i)=>{
+    pos[n.name] = {x: 20+(i%perRow)*165, y: 20+Math.floor(i/perRow)*70};});
+  const NS='http://www.w3.org/2000/svg';
+  nodes.forEach(n=>{ (n.inputs||[]).forEach(src=>{
+    if(!pos[src]) return;
+    const l=document.createElementNS(NS,'line');
+    l.setAttribute('x1',pos[src].x+75); l.setAttribute('y1',pos[src].y+40);
+    l.setAttribute('x2',pos[n.name].x+75); l.setAttribute('y2',pos[n.name].y);
+    l.setAttribute('stroke','#99a'); svg.append(l);});});
+  nodes.forEach(n=>{
+    const g=document.createElementNS(NS,'g'); g.setAttribute('class','node');
+    const r=document.createElementNS(NS,'rect');
+    r.setAttribute('x',pos[n.name].x); r.setAttribute('y',pos[n.name].y);
+    r.setAttribute('width',150); r.setAttribute('height',40);
+    r.setAttribute('rx',5);
+    const t1=document.createElementNS(NS,'text');
+    t1.setAttribute('x',pos[n.name].x+6); t1.setAttribute('y',pos[n.name].y+15);
+    t1.textContent=n.name;
+    const t2=document.createElementNS(NS,'text');
+    t2.setAttribute('x',pos[n.name].x+6); t2.setAttribute('y',pos[n.name].y+31);
+    t2.textContent=n.type+' ('+n.n_params+')';
+    g.append(r,t1,t2);
+    g.onclick=()=>{
+      const st=(stats||{})[n.name]||{};
+      const rows=Object.entries({name:n.name,type:n.type,
+        params:n.n_params,...st}).map(([k,v])=>{
+        const tr=document.createElement('tr');
+        const th=document.createElement('th'); th.textContent=k;
+        const td=document.createElement('td');
+        td.textContent=JSON.stringify(v); tr.append(th,td); return tr;});
+      document.getElementById('ldetail').replaceChildren(...rows);};
+    svg.append(g);});
+  svg.setAttribute('height', 20+Math.ceil(nodes.length/perRow)*70);
+}
+function scatter(cv, pts, labels){
+  const c=cv.getContext('2d');
+  const W=cv.width=cv.clientWidth, H=cv.height=cv.clientHeight;
+  c.clearRect(0,0,W,H);
+  if(!pts.length) { c.fillText('POST /api/tsne or UIServer.upload_tsne()'
+    ,20,20); return; }
+  const xs=pts.map(p=>p[0]), ys=pts.map(p=>p[1]);
+  const lx=Math.min(...xs), hx=Math.max(...xs)||1;
+  const ly=Math.min(...ys), hy=Math.max(...ys)||1;
+  pts.forEach((p,i)=>{
+    const x=(p[0]-lx)/(hx-lx||1)*(W-60)+30;
+    const y=(p[1]-ly)/(hy-ly||1)*(H-40)+20;
+    c.fillStyle='#1668b8'; c.fillRect(x-1.5,y-1.5,3,3);
+    if(labels&&labels[i]) c.fillText(labels[i],x+4,y+3);});
+}
+function showTab(){
+  const h=(location.hash||'#overview').slice(1);
+  document.querySelectorAll('.tab').forEach(d=>
+    d.classList.toggle('on',d.id===h));
+  document.querySelectorAll('nav a').forEach(a=>
+    a.classList.toggle('on',a.hash==='#'+h));
+}
+window.onhashchange=()=>{showTab(); tick();};
+let dagSession=null, latestStats={}, lastActIter=null;
 async function tick(){
+  showTab();
+  const h=(location.hash||'#overview').slice(1);
   const sessions = await (await fetch('api/sessions')).json();
   if(!sessions.length) return;
   const s = sessions[sessions.length-1];
-  const d = await (await fetch('api/overview?session='+s)).json();
-  draw(document.getElementById('score'), [d.scores]);
-  draw(document.getElementById('tput'), [d.samples_per_sec]);
-  const names = Object.keys(d.param_mean_magnitude||{});
-  draw(document.getElementById('pm'),
-       names.map(n=>d.param_mean_magnitude[n]), names);
-  const info = d.static_info||{};
-  const tbl = document.getElementById('info');
-  tbl.replaceChildren(...Object.entries(info).map(([k,v])=>{
-    const tr=document.createElement('tr');
-    const th=document.createElement('th'); th.textContent=k;
-    const td=document.createElement('td'); td.textContent=JSON.stringify(v);
-    tr.append(th,td); return tr;}));
+  if(h==='overview'){
+    const d = await (await fetch('api/overview?session='+s)).json();
+    draw(document.getElementById('score'), [d.scores]);
+    draw(document.getElementById('tput'), [d.samples_per_sec]);
+    const names = Object.keys(d.param_mean_magnitude||{});
+    draw(document.getElementById('pm'),
+         names.map(n=>d.param_mean_magnitude[n]), names);
+    const info = d.static_info||{};
+    const tbl = document.getElementById('info');
+    tbl.replaceChildren(...Object.entries(info)
+      .filter(([k,v])=>k!=='model_graph').map(([k,v])=>{
+      const tr=document.createElement('tr');
+      const th=document.createElement('th'); th.textContent=k;
+      const td=document.createElement('td');
+      td.textContent=JSON.stringify(v);
+      tr.append(th,td); return tr;}));
+  } else if(h==='model'){
+    // the graph is static per session: build the SVG once (rebuilding
+    // every tick would wipe it mid-click); stats refresh via reference
+    const md = await (await fetch('api/model?session='+s)).json();
+    Object.assign(latestStats, md.latest_param_stats||{});
+    if(dagSession!==s){ drawDag(md.graph||[], latestStats);
+                        dagSession=s; }
+  } else if(h==='system'){
+    const sys = await (await fetch('api/system?session='+s)).json();
+    const d = await (await fetch('api/overview?session='+s)).json();
+    draw(document.getElementById('mem'), [sys.bytes_in_use||[]]);
+    draw(document.getElementById('etl'), [d.etl_ms||[]]);
+  } else if(h==='activations'){
+    const act = await (await fetch('api/activations?session='+s)).json();
+    const imgs = act.activations_png||{};
+    if(Object.keys(imgs).length && act.iteration!==lastActIter){
+      lastActIter = act.iteration;
+      const div=document.getElementById('actimgs');
+      div.replaceChildren(...Object.entries(imgs).map(([name,b64])=>{
+        const w=document.createElement('div');
+        const lbl=document.createElement('b'); lbl.textContent=name;
+        const img=document.createElement('img'); img.className='act';
+        img.src='data:image/png;base64,'+b64;
+        w.append(lbl,document.createElement('br'),img); return w;}));
+    }
+  } else if(h==='tsne'){
+    const ts = await (await fetch('api/tsne')).json();
+    scatter(document.getElementById('tsneplot'), ts.points||[],
+            ts.labels||[]);
+  }
 }
 tick(); setInterval(tick, 2000);
 </script></body></html>"""
@@ -116,11 +245,80 @@ class _Handler(BaseHTTPRequestHandler):
             sess = q.get("session", [None])[0]
             self._json(self.storage.get_all_updates(sess) if sess else [])
             return
+        if u.path == "/api/model":
+            sess = self._session(u)
+            static = (self.storage.get_static_info(sess) or {}) if sess \
+                else {}
+            latest = {}
+            for up in reversed(self.storage.get_all_updates(sess)
+                               if sess else []):
+                if up.get("param_stats"):
+                    latest = {k: {kk: vv for kk, vv in v.items()
+                                  if kk != "histogram"}
+                              for k, v in up["param_stats"].items()}
+                    break
+            self._json({"graph": static.get("model_graph", []),
+                        "latest_param_stats": latest})
+            return
+        if u.path == "/api/system":
+            sess = self._session(u)
+            ups = self.storage.get_all_updates(sess) if sess else []
+            self._json({
+                "bytes_in_use": [
+                    (up.get("memory") or {}).get("bytes_in_use") or 0
+                    for up in ups if "memory" in up],
+                "static_info": (self.storage.get_static_info(sess) or {})
+                if sess else {},
+            })
+            return
+        if u.path == "/api/activations":
+            sess = self._session(u)
+            for up in reversed(self.storage.get_all_updates(sess)
+                               if sess else []):
+                if up.get("type") == "activations":
+                    self._json({
+                        "iteration": up.get("iteration"),
+                        "activations_png": up.get("activations_png", {}),
+                    })
+                    return
+            self._json({"activations_png": {}})
+            return
+        if u.path == "/api/tsne":
+            self._json(getattr(self.server, "tsne_data", None)
+                       or {"points": [], "labels": []})
+            return
         self._json({"error": "not found"}, 404)
 
+    def _session(self, u) -> Optional[str]:
+        q = parse_qs(u.query)
+        sess = q.get("session", [None])[0]
+        if not sess:
+            ids = self.storage.list_session_ids()
+            sess = ids[-1] if ids else None
+        return sess
+
     def do_POST(self):
+        path = urlparse(self.path).path
+        if path == "/api/tsne":
+            # TsneModule analog: upload 2-D coordinates (+labels) to plot
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                pts = payload.get("points", [])
+                if not all(isinstance(p, (list, tuple)) and len(p) == 2
+                           for p in pts):
+                    raise ValueError("points must be [x, y] pairs")
+                self.server.tsne_data = {
+                    "points": [[float(a), float(b)] for a, b in pts],
+                    "labels": [str(l) for l in payload.get("labels", [])],
+                }
+            except (ValueError, TypeError, json.JSONDecodeError) as e:
+                self._json({"error": str(e)}, 400)
+                return
+            self._json({"ok": True})
+            return
         # RemoteReceiverModule analog: accept remote-routed records
-        if urlparse(self.path).path != "/remote":
+        if path != "/remote":
             self._json({"error": "not found"}, 404)
             return
         try:
@@ -198,6 +396,22 @@ class UIServer:
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
+        return self
+
+    def upload_tsne(self, points, labels=None):
+        """Populate the t-SNE tab (the reference UI's TsneModule accepts
+        coordinate uploads; manifold/tsne.py output plugs in directly)."""
+        import numpy as np
+        pts = np.asarray(points, np.float64)
+        if pts.ndim != 2 or pts.shape[1] != 2:
+            raise ValueError(f"expected (N, 2) coords, got {pts.shape}")
+        if self._httpd is None:
+            raise RuntimeError("start() the server first")
+        self._httpd.tsne_data = {
+            "points": pts.tolist(),
+            # `labels or []` would crash on numpy label arrays
+            "labels": [] if labels is None else [str(l) for l in labels],
+        }
         return self
 
     @property

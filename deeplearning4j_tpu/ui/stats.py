@@ -115,8 +115,46 @@ class StatsListener(TrainingListener):
             "num_params": int(model.num_params()),
             "layer_names": list(getattr(model, "layer_names", ())) or
             list(model.train_state.params.keys()),
+            "model_graph": self._model_graph(model),
         })
         self._static_sent = True
+
+    @staticmethod
+    def _model_graph(model) -> List[dict]:
+        """Network DAG for the dashboard's Model tab: one node per layer
+        or vertex with its inputs (MLN = a chain; CG = the real DAG)."""
+        def count(tree):
+            return int(sum(np.asarray(l).size
+                           for l in jax.tree_util.tree_leaves(tree)))
+
+        params = model.train_state.params
+        nodes: List[dict] = []
+        if hasattr(model, "layers"):           # MultiLayerNetwork
+            prev = "input"
+            for layer in model.layers:
+                nodes.append({
+                    "name": layer.name,
+                    "type": type(layer).__name__,
+                    "inputs": [prev],
+                    "n_params": count(params.get(layer.name, {})),
+                })
+                prev = layer.name
+        elif hasattr(model, "_nodes"):         # ComputationGraph
+            for name in model._topo:
+                node = model._nodes.get(name)
+                if node is None:               # a network input
+                    nodes.append({"name": name, "type": "Input",
+                                  "inputs": [], "n_params": 0})
+                    continue
+                kind = (type(node.layer).__name__ if node.layer is not None
+                        else type(node.vertex).__name__)
+                nodes.append({
+                    "name": name,
+                    "type": kind,
+                    "inputs": list(node.inputs),
+                    "n_params": count(params.get(name, {})),
+                })
+        return nodes
 
     def _layer_stats(self, params) -> Dict[str, dict]:
         out: Dict[str, dict] = {}

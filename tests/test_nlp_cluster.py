@@ -27,13 +27,13 @@ def test_sharded_vocab_matches_single_host():
     single = Word2Vec(min_word_frequency=2)
     single.build_vocab(CORPUS)
 
-    assert set(vocab.words()) == set(single.vocab.words())
+    # index-IDENTICAL, not just same set: frequency ties break in
+    # first-appearance order on both paths, so Huffman codes / syn1
+    # rows line up across sharded and single-host vocab builds
+    assert vocab.words() == single.vocab.words()
     for w in vocab.words():
         assert vocab.word_frequency(w) == single.vocab.word_frequency(w)
     assert vocab.total_word_count == single.vocab.total_word_count
-    # frequency-descending order holds in both
-    counts = [vocab.word_frequency(w) for w in vocab.words()]
-    assert counts == sorted(counts, reverse=True)
 
 
 def test_shard_partition_covers_corpus():

@@ -105,6 +105,31 @@ class TestXmi:
                [(t.begin, t.end, t.text) for t in toks2]
         assert toks2[0].features["pos"] == "VB"
 
+    def test_reserved_or_invalid_feature_names_rejected(self):
+        from deeplearning4j_tpu.nlp.language_packs import CAS, Annotation
+        cas = CAS("abc")
+        cas.add(Annotation("token", 0, 1, "a", begin="NN"))
+        with pytest.raises(ValueError, match="reserved"):
+            to_xmi(cas)
+        cas2 = CAS("abc")
+        cas2.add(Annotation("token", 0, 1, "a", **{"my pos": "NN"}))
+        with pytest.raises(ValueError, match="XML attribute"):
+            to_xmi(cas2)
+
+    def test_supertype_cycle_detected(self):
+        from deeplearning4j_tpu.nlp.uima import TypeDescription, TypeSystem
+        ts = TypeSystem([TypeDescription("a", supertype="b"),
+                         TypeDescription("b", supertype="a")])
+        with pytest.raises(ValueError, match="cycle"):
+            ts.features_of("a")
+        with pytest.raises(ValueError, match="cycle"):
+            ts.subsumes("x", "b")
+
+    def test_activation_grid_dense_row_not_black(self):
+        from deeplearning4j_tpu.ui.png import activation_grid
+        g = activation_grid(np.array([0.0, 1.0, 2.0, 3.0]))
+        assert g.max() > 0.0  # a row image, not per-pixel black tiles
+
     def test_from_xmi_validates(self):
         from deeplearning4j_tpu.nlp.language_packs import CAS, Annotation
         cas = CAS("abc")
