@@ -108,7 +108,9 @@ class TestXmi:
     def test_reserved_or_invalid_feature_names_rejected(self):
         from deeplearning4j_tpu.nlp.language_packs import CAS, Annotation
         cas = CAS("abc")
-        cas.add(Annotation("token", 0, 1, "a", begin="NN"))
+        ann = Annotation("token", 0, 1, "a")
+        ann.features["begin"] = "NN"   # constructor kwargs can't collide
+        cas.add(ann)
         with pytest.raises(ValueError, match="reserved"):
             to_xmi(cas)
         cas2 = CAS("abc")
