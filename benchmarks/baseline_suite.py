@@ -25,13 +25,11 @@ def vgg16():
     import jax.numpy as jnp
     import jax.random as jrandom
     from deeplearning4j_tpu.optimize.solver import make_scan_train_step
-    from deeplearning4j_tpu.optimize.updaters import Nesterovs
     from deeplearning4j_tpu.zoo.models import VGG16
 
     batch, k, n = 512, 12, 3
     model = VGG16(num_classes=200, height=64, width=64, channels=3,
-                  compute_dtype="bfloat16",
-                  updater=Nesterovs(1e-2, 0.9)).init()
+                  compute_dtype="bfloat16").init()
 
     def loss_fn(params, mstate, feats, labels, fmask, lmask, rng, it):
         return model._loss(params, mstate, (feats,), (labels,), fmask,
@@ -211,7 +209,7 @@ def word2vec():
     reference's native AggregateSkipGram targets — SkipGram.java:176)."""
     from deeplearning4j_tpu.nlp.word2vec import Word2Vec
 
-    v, n_tokens = 100_000, 1_500_000
+    v, n_tokens = 100_000, 3_000_000
     rng = np.random.default_rng(0)
     # zipf-ish draw over a 100k vocab, chunked into 40-token sentences
     freq = 1.0 / np.arange(1, v + 1) ** 1.05
@@ -221,9 +219,12 @@ def word2vec():
     seqs = [words[i:i + 40].tolist() for i in range(0, n_tokens, 40)]
 
     for hs in (False, True):
+        # 64k-pair device batches: at realistic corpus scale the number
+        # of dispatches, not device math, dominates (26 ms tunnel
+        # overhead each — PERF_ANALYSIS.md), so big chunks win
         model = Word2Vec(layer_size=128, window_size=5, negative=5,
                          use_hierarchic_softmax=hs, min_word_frequency=1,
-                         epochs=1, batch_size=8192, seed=3)
+                         epochs=1, batch_size=65536, seed=3)
         model.build_vocab(seqs)
         t0 = time.perf_counter()
         model.fit(seqs)

@@ -99,6 +99,14 @@ class BaseModel:
         MultiLayerNetwork.fit(DataSetIterator) hot loop."""
         if self.train_state is None:
             self.init()
+        else:
+            # scope-panic analog (utils/sanitizers.py): a donated/stale
+            # TrainState must fail HERE with a clear message, not at the
+            # next dispatch deep inside jit
+            from deeplearning4j_tpu.utils.sanitizers import (
+                check_not_donated)
+            check_not_donated(self.train_state.params,
+                              what="fit() train state")
         if self._train_step is None:
             self._train_step = self._build_train_step()
         if isinstance(data, DataSet):

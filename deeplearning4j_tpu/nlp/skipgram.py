@@ -9,9 +9,31 @@ reference batches (center, context) pairs into ``AggregateSkipGram`` /
 Here the same batching idea becomes ONE jitted step per batch: gather the
 center rows from syn0 and the target rows (negative samples or Huffman
 inner nodes) from syn1, compute the sigmoid-gradient for every pair at
-once on the MXU, and scatter-add the updates back. Duplicate indices in a
-batch are handled correctly by XLA's scatter-add. Buffers are donated so
+once on the MXU, and scatter the updates back. Buffers are donated so
 the embedding tables are updated in place on device.
+
+Duplicate rows in a batch scatter-add as usual but each row's TOTAL
+accumulated update is norm-clipped: word2vec's sequential (hogwild)
+updates are self-limiting — each saturating step sees the previous one's
+result — but a batched scatter-add applies k duplicate updates computed
+from the SAME pre-update row. For frequent words (or tiny vocabularies)
+k is large; once row norms grow, the summed step overshoots and the
+feedback loop diverges to overflow as batch size grows. Clipping the
+per-row accumulated update norm (at 1.0 — well above any healthy
+per-batch step, far below the runaway regime) bounds the feedback loop
+at any batch size — which the dispatch-overhead economics push toward
+64k+ (PERF_ANALYSIS.md). This is a deliberate, small semantic deviation
+from word2vec.c's sequential updates: sub-threshold batches differ from
+a sequential replay only by float summation order, and a frequent word
+whose legitimate accumulated update exceeds the threshold takes a
+direction-preserving, norm-1 step instead (word2vec.c, applying the
+same pairs one at a time through a saturating sigmoid, also never moves
+a row by more than O(1) per batch — the clip restores that property,
+it does not add a new one).
+
+The clip works on the B·K update rows directly (sort by index +
+segment sums), NOT by materializing a dense [V, D] accumulator — per
+step cost stays O(B·K·D + B·K log B·K) regardless of vocab size.
 
 The math (per pair, label y ∈ {0,1}, lr α):
     g = (y − σ(syn0[c]·syn1[t])) · α
@@ -45,10 +67,38 @@ def skipgram_step(syn0: jax.Array, syn1: jax.Array,
     dh = jnp.einsum("bk,bkd->bd", g, w)                # grad wrt syn0 rows
     dw = g[..., None] * h[:, None, :]                  # [B, K, D]
     d = syn0.shape[1]
-    syn1 = syn1.at[targets.reshape(-1)].add(
-        dw.reshape(-1, d).astype(syn1.dtype))
-    syn0 = syn0.at[centers].add(dh.astype(syn0.dtype))
+    syn1 = _clipped_scatter(syn1, targets.reshape(-1), dw.reshape(-1, d))
+    syn0 = _clipped_scatter(syn0, centers, dh)
     return syn0, syn1
+
+
+_MAX_ROW_UPDATE = 1.0
+
+
+def _clipped_scatter(table: jax.Array, idx: jax.Array,
+                     upd: jax.Array) -> jax.Array:
+    """table[idx] += updates, with each destination row's accumulated
+    update norm-clipped (see module docstring). Segment-sum over the
+    sorted update rows — no dense [V, D] temporaries, so cost scales
+    with the batch, not the vocabulary."""
+    b = idx.shape[0]
+    order = jnp.argsort(idx)
+    sid = jnp.take(idx, order)
+    supd = jnp.take(upd, order, axis=0).astype(jnp.float32)
+    first = jnp.concatenate([jnp.ones((1,), bool), sid[1:] != sid[:-1]])
+    seg = jnp.cumsum(first) - 1                       # per-element segment
+    pos = jnp.arange(b)
+    seg_end = jnp.zeros((b,), pos.dtype).at[seg].max(pos)
+    seg_start = jnp.full((b,), b - 1, pos.dtype).at[seg].min(pos)
+    cs = jnp.cumsum(supd, axis=0)
+    hi = jnp.take(cs, jnp.take(seg_end, seg), axis=0)
+    lo_idx = jnp.take(seg_start, seg)
+    lo = jnp.where((lo_idx > 0)[:, None],
+                   jnp.take(cs, jnp.maximum(lo_idx - 1, 0), axis=0), 0.0)
+    total = hi - lo                                   # segment sum, per row
+    norm = jnp.linalg.norm(total, axis=-1, keepdims=True)
+    scale = jnp.minimum(1.0, _MAX_ROW_UPDATE / jnp.maximum(norm, 1e-12))
+    return table.at[sid].add((supd * scale).astype(table.dtype))
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -71,10 +121,9 @@ def cbow_step(syn0: jax.Array, syn1: jax.Array,
     dh = jnp.einsum("bk,bkd->bd", g, w) / denom          # [B, D]
     dw = g[..., None] * h[:, None, :]
     d = syn0.shape[1]
-    syn1 = syn1.at[targets.reshape(-1)].add(
-        dw.reshape(-1, d).astype(syn1.dtype))
+    syn1 = _clipped_scatter(syn1, targets.reshape(-1), dw.reshape(-1, d))
     dctx = (dh[:, None, :] * context_mask[..., None]).reshape(-1, d)
-    syn0 = syn0.at[context.reshape(-1)].add(dctx.astype(syn0.dtype))
+    syn0 = _clipped_scatter(syn0, context.reshape(-1), dctx)
     return syn0, syn1
 
 
@@ -91,7 +140,13 @@ def infer_step(docvec: jax.Array,        # [D] the one trainable vector
     w = syn1[targets]                                   # [P, K, D]
     logits = jnp.einsum("d,pkd->pk", docvec, w)
     g = (labels - jax.nn.sigmoid(logits)) * mask * lr
-    return docvec + jnp.einsum("pk,pkd->d", g, w).astype(docvec.dtype)
+    upd = jnp.einsum("pk,pkd->d", g, w).astype(jnp.float32)
+    # the whole P*K pair sum lands on ONE row computed from the same
+    # pre-update docvec — the worst case of the duplicate-sum divergence
+    # _clipped_scatter guards against; clip it the same way
+    norm = jnp.maximum(jnp.linalg.norm(upd), 1e-12)
+    upd = upd * jnp.minimum(1.0, _MAX_ROW_UPDATE / norm)
+    return docvec + upd.astype(docvec.dtype)
 
 
 class PairBatcher:

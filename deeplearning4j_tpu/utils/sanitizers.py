@@ -1,0 +1,66 @@
+"""Runtime sanitizers — the TPU analog of the reference's workspace
+scope panics (SURVEY §5.2).
+
+The reference's ND4J workspaces crash loudly (``SCOPE_PANIC``) when a
+buffer is used outside its workspace scope or leaks across iterations.
+The JAX/XLA failure modes that correspond:
+
+- **silent host↔device transfers** — a stray ``np.asarray`` / implicit
+  convert inside a training loop stalls the device exactly like a
+  workspace spill. ``no_implicit_transfers()`` turns those into errors
+  via jax's transfer guard.
+- **donated-buffer reuse** — a donated ``TrainState`` (every train step
+  here donates) must never be touched again; reuse raises by default
+  but only at dispatch time. ``check_not_donated()`` asserts eagerly at
+  the API boundary for a clear error.
+
+Use in tests and tight loops:
+
+    with no_implicit_transfers():
+        ts, loss = step(ts, batch)          # device-resident or it raises
+
+    check_not_donated(model.train_state)    # SCOPE_PANIC-style assert
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Iterator
+
+import jax
+
+
+@contextlib.contextmanager
+def no_implicit_transfers(level: str = "disallow") -> Iterator[None]:
+    """Error on implicit host↔device transfers inside the scope.
+
+    ``level``: "disallow" (raise), "log" (warn), or "allow".
+    Explicit transfers (``jax.device_put`` / ``jax.device_get``) stay
+    legal — only *implicit* conversions are flagged, which is exactly
+    the workspace-scope-leak class of bug."""
+    with jax.transfer_guard(level):
+        yield
+
+
+def is_deleted(x: Any) -> bool:
+    """True if ``x`` is a jax array whose buffer was donated/deleted."""
+    try:
+        return hasattr(x, "is_deleted") and x.is_deleted()
+    except Exception:
+        return False
+
+
+def check_not_donated(tree: Any, what: str = "buffer") -> None:
+    """Raise immediately (not at next dispatch) if any leaf of ``tree``
+    was donated — the reference's scope panic, eagerly."""
+    bad = [
+        "/".join(str(getattr(p, "key", p)) for p in path)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]
+        if is_deleted(leaf)
+    ]
+    if bad:
+        raise RuntimeError(
+            f"SCOPE_PANIC: {what} uses {len(bad)} donated/deleted "
+            f"buffer(s), first: {bad[0]!r}. A train step donated this "
+            "pytree; use the returned TrainState instead of the stale "
+            "reference.")

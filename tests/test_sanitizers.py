@@ -1,0 +1,72 @@
+"""Scope/transfer sanitizers (SURVEY §5.2 — the reference's workspace
+SCOPE_PANIC / race detection analog, VERDICT partial #71)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.utils.sanitizers import (
+    check_not_donated,
+    is_deleted,
+    no_implicit_transfers,
+)
+
+
+def test_transfer_guard_catches_implicit_transfer():
+    x = np.arange(8.0)
+    with no_implicit_transfers():
+        with pytest.raises(Exception, match="[Tt]ransfer"):
+            jnp.sin(x) + x  # implicit host->device convert
+        # explicit transfers stay legal
+        d = jax.device_put(x)
+        float(jax.device_get(jnp.sum(d)))
+
+
+def test_check_not_donated_detects_stale_state():
+    @jax.jit
+    def bump(t):
+        return jax.tree_util.tree_map(lambda a: a + 1, t)
+
+    donating = jax.jit(lambda t: jax.tree_util.tree_map(
+        lambda a: a * 2, t), donate_argnums=(0,))
+
+    tree = {"w": jnp.ones((4,)), "b": jnp.zeros((2,))}
+    check_not_donated(tree)          # fresh: fine
+    out = donating(tree)
+    check_not_donated(out)           # result: fine
+    if not any(is_deleted(l) for l in jax.tree_util.tree_leaves(tree)):
+        pytest.skip("backend ignores buffer donation")
+    with pytest.raises(RuntimeError, match="SCOPE_PANIC"):
+        check_not_donated(tree, what="stale tree")
+
+
+def test_fit_rejects_donated_train_state():
+    """Using a model whose TrainState leaked through a donating step
+    fails eagerly in fit() with the scope-panic message."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models.multi_layer_network import (
+        MultiLayerNetwork)
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+
+    conf = (NeuralNetConfiguration.Builder().seed(1).updater(Sgd(0.1))
+            .list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=3))
+            .set_input_type(InputType.feed_forward(4)).build())
+    m = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[np.random.default_rng(1)
+                                    .integers(0, 3, 8)]
+    stale = m.train_state
+    m.fit(DataSet(x, y))     # donates `stale`'s buffers
+    m.train_state = stale    # simulate holding the old reference
+    if not any(is_deleted(l)
+               for l in jax.tree_util.tree_leaves(stale.params)):
+        pytest.skip("backend ignores buffer donation")
+    with pytest.raises(RuntimeError, match="SCOPE_PANIC"):
+        m.fit(DataSet(x, y))

@@ -1,0 +1,89 @@
+"""SkipGram device-kernel unit tests: duplicate-row clipping semantics
+(the batched-vs-sequential stability deviation documented in
+nlp/skipgram.py's module docstring)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.skipgram import (
+    _MAX_ROW_UPDATE,
+    _clipped_scatter,
+    infer_step,
+    skipgram_step,
+)
+
+
+def test_unique_rows_match_plain_scatter():
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(rng.normal(size=(10, 4)).astype(np.float32))
+    idx = jnp.asarray([1, 3, 7], np.int32)
+    upd = jnp.asarray(rng.normal(0, 0.01, (3, 4)).astype(np.float32))
+    got = _clipped_scatter(table, idx, upd)
+    ref = table.at[idx].add(upd)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_duplicate_rows_sum_below_threshold():
+    """Duplicates whose accumulated update stays under the clip sum
+    exactly (up to float reassociation)."""
+    table = jnp.zeros((4, 3))
+    idx = jnp.asarray([2, 2, 2, 1], np.int32)
+    upd = jnp.asarray([[0.1, 0, 0], [0.1, 0, 0], [0.1, 0, 0],
+                       [0, 0.2, 0]], np.float32)
+    got = np.asarray(_clipped_scatter(table, idx, upd))
+    np.testing.assert_allclose(got[2], [0.3, 0, 0], rtol=1e-6)
+    np.testing.assert_allclose(got[1], [0, 0.2, 0], rtol=1e-6)
+
+
+def test_duplicate_rows_clip_above_threshold():
+    """A row whose accumulated update exceeds the threshold moves by
+    exactly _MAX_ROW_UPDATE in the same direction."""
+    table = jnp.zeros((4, 3))
+    idx = jnp.asarray([0] * 8, np.int32)
+    upd = jnp.full((8, 3), 1.0, jnp.float32)   # sum norm = 8*sqrt(3)
+    got = np.asarray(_clipped_scatter(table, idx, upd))
+    np.testing.assert_allclose(np.linalg.norm(got[0]), _MAX_ROW_UPDATE,
+                               rtol=1e-5)
+    # direction preserved
+    np.testing.assert_allclose(got[0] / np.linalg.norm(got[0]),
+                               np.ones(3) / np.sqrt(3), rtol=1e-5)
+    # untouched rows stay put
+    assert np.all(got[1:] == 0)
+
+
+def test_skipgram_step_stable_on_degenerate_batch():
+    """All pairs hitting the same rows with big lr: norms stay bounded
+    over many steps instead of running away."""
+    syn0 = jnp.asarray(np.random.default_rng(0).normal(
+        0, 0.5, (4, 16)).astype(np.float32))
+    syn1 = jnp.zeros((4, 16), jnp.float32)
+    centers = jnp.zeros((256,), jnp.int32)
+    targets = jnp.ones((256, 3), jnp.int32)
+    labels = jnp.tile(jnp.asarray([1.0, 0.0, 0.0]), (256, 1))
+    mask = jnp.ones((256, 3), jnp.float32)
+    for _ in range(50):
+        syn0, syn1 = skipgram_step(syn0, syn1, centers, targets, labels,
+                                   mask, jnp.float32(0.5))
+    n0 = float(jnp.linalg.norm(syn0, axis=1).max())
+    n1 = float(jnp.linalg.norm(syn1, axis=1).max())
+    assert np.isfinite(n0) and np.isfinite(n1)
+    # ≤ init + steps * clip, with lots of slack
+    assert n0 < 60 and n1 < 60, (n0, n1)
+
+
+def test_infer_step_clipped():
+    """The single-docvec inference update (worst duplicate case: every
+    pair lands on one row) is norm-clipped too."""
+    rng = np.random.default_rng(1)
+    syn1 = jnp.asarray(rng.normal(0, 5.0, (32, 8)).astype(np.float32))
+    docvec = jnp.zeros((8,), jnp.float32)
+    targets = jnp.asarray(rng.integers(0, 32, (64, 4)), jnp.int32)
+    labels = jnp.zeros((64, 4), jnp.float32).at[:, 0].set(1.0)
+    mask = jnp.ones((64, 4), jnp.float32)
+    out = infer_step(docvec, syn1, targets, labels, mask,
+                     jnp.float32(1.0))
+    assert float(jnp.linalg.norm(out)) <= _MAX_ROW_UPDATE + 1e-5
+    assert np.isfinite(np.asarray(out)).all()
