@@ -32,7 +32,8 @@ def vgg16():
                   compute_dtype="bfloat16").init()
 
     def loss_fn(params, mstate, feats, labels, fmask, lmask, rng, it):
-        return model._loss(params, mstate, (feats,), (labels,), fmask,
+        # VGG16 is a MultiLayerNetwork: _loss takes raw arrays
+        return model._loss(params, mstate, feats, labels, fmask,
                            lmask, rng, it)
 
     steps_fn = make_scan_train_step(loss_fn, model._tx)

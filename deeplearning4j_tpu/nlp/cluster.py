@@ -163,6 +163,12 @@ class DistributedWord2Vec:
                 worker.vocab = vocab
                 worker._max_code_len = master._max_code_len
                 worker._table = master._table
+                if master.use_hs:
+                    # share the device-resident Huffman matrices
+                    # (read-only; the kernels never donate them)
+                    worker._hs_points = master._hs_points
+                    worker._hs_labels = master._hs_labels
+                    worker._hs_mask = master._hs_mask
                 worker.epochs = max(1, epochs // self.averaging_rounds)
                 # broadcast current globals (the Spark broadcast step) —
                 # as COPIES: the device hot loop donates its syn buffers,

@@ -85,6 +85,25 @@ class SequenceVectors:
         self.syn1 = jnp.zeros((rows1, d), jnp.float32)
         if not self.use_hs:
             self._table = self.vocab.unigram_table()
+        else:
+            self._ensure_hs_matrices()
+
+    def _ensure_hs_matrices(self):
+        """Device-resident Huffman-path matrices for the vectorized HS
+        step (host loop ships only index pairs). Built lazily so models
+        whose tables arrived WITHOUT _init_tables — deserialized models,
+        DistributedWord2Vec workers — still fast-path correctly."""
+        if getattr(self, "_hs_points", None) is not None:
+            return
+        if not self._max_code_len:
+            self._max_code_len = max(
+                (len(w.codes) for w in self.vocab.vocab_words()),
+                default=1)
+        pts, labs, hmask = sk.build_hs_matrices(
+            self.vocab.vocab_words(), max(self._max_code_len, 1))
+        self._hs_points = jnp.asarray(pts)
+        self._hs_labels = jnp.asarray(labs)
+        self._hs_mask = jnp.asarray(hmask)
 
     # ---- training --------------------------------------------------------
     def fit(self, sequences: Iterable[Sequence[str]]):
@@ -124,18 +143,19 @@ class SequenceVectors:
         ts = type(self)._train_sequence
         train_seq_ok = (ts is SequenceVectors._train_sequence
                         or getattr(ts, "_sgns_fast_path_safe", False))
-        return (not self.use_hs and not self.use_cbow
+        return (not self.use_cbow
                 and self.iterations == 1
                 and type(self)._add_pair is SequenceVectors._add_pair
                 and train_seq_ok)
 
     def _fit_fast_sgns(self, seqs, total_words: int):
-        """Whole-corpus vectorized skip-gram with negative sampling: pair
-        generation is numpy over an offsets grid, negatives are one table
-        gather per chunk, and each chunk is a single donated device step —
-        the TPU-shaped version of the reference's AggregateSkipGram
-        batching (SkipGram.java:176-186) with the Python-per-pair loop
-        removed."""
+        """Whole-corpus vectorized skip-gram (negative sampling OR
+        hierarchical softmax): pair generation is numpy over an offsets
+        grid; negatives are one table gather per chunk, Huffman paths are
+        gathered on device from precomputed matrices; each chunk is a
+        single donated device step — the TPU-shaped version of the
+        reference's AggregateSkipGram batching (SkipGram.java:176-186)
+        with the Python-per-pair loop removed."""
         rng = self._rng
         W = self.window_size
         offsets = np.concatenate([np.arange(-W, 0), np.arange(1, W + 1)])
@@ -148,23 +168,39 @@ class SequenceVectors:
         chunk = int(np.clip(est_pairs // 64, self.batch_size, 65536))
         k = 1 + self.negative
         cen_buf = np.zeros(chunk, np.int32)
-        tgt_buf = np.zeros((chunk, k), np.int32)
-        lab_np = np.zeros((chunk, k), np.float32)
-        lab_np[:, 0] = 1.0
-        # labels never change and the mask is all-ones except on the final
-        # partial chunk: keep both device-resident instead of re-uploading
-        # megabytes per step
-        lab_dev = jnp.asarray(lab_np)
-        ones_mask = jnp.ones((chunk, k), jnp.float32)
+        ctx_buf = np.zeros(chunk, np.int32)
+        if self.use_hs:
+            self._ensure_hs_matrices()
+            ones_row = jnp.ones((chunk,), jnp.float32)
+        else:
+            tgt_buf = np.zeros((chunk, k), np.int32)
+            lab_np = np.zeros((chunk, k), np.float32)
+            lab_np[:, 0] = 1.0
+            # labels never change and the mask is all-ones except on the
+            # final partial chunk: keep both device-resident instead of
+            # re-uploading megabytes per step
+            lab_dev = jnp.asarray(lab_np)
+            ones_mask = jnp.ones((chunk, k), jnp.float32)
         fill = 0
         seen = 0
         table = self._table
         n_words = self.vocab.num_words()
 
-        def flush(n_valid):
-            nonlocal fill
-            if n_valid == 0:
-                return
+        def flush_hs(n_valid):
+            if n_valid == chunk:
+                row_valid = ones_row
+            else:
+                r = np.zeros(chunk, np.float32)
+                r[:n_valid] = 1.0
+                row_valid = jnp.asarray(r)
+            lr = self._lr(seen, total_words)
+            self.syn0, self.syn1 = sk.skipgram_hs_step(
+                self.syn0, self.syn1, jnp.asarray(cen_buf),
+                jnp.asarray(ctx_buf), self._hs_points, self._hs_labels,
+                self._hs_mask, row_valid, jnp.float32(lr))
+
+        def flush_ns(n_valid):
+            tgt_buf[:n_valid, 0] = ctx_buf[:n_valid]
             negs = table[rng.integers(0, len(table), (n_valid, k - 1))]
             pos = tgt_buf[:n_valid, 0:1]
             bad = negs == pos
@@ -185,6 +221,15 @@ class SequenceVectors:
             self.syn0, self.syn1 = sk.skipgram_step(
                 self.syn0, self.syn1, jnp.asarray(cen_buf),
                 jnp.asarray(tgt_buf), lab_dev, mask, jnp.float32(lr))
+
+        def flush(n_valid):
+            nonlocal fill
+            if n_valid == 0:
+                return
+            if self.use_hs:
+                flush_hs(n_valid)
+            else:
+                flush_ns(n_valid)
             fill = 0
 
         for _epoch in range(self.epochs):
@@ -207,7 +252,7 @@ class SequenceVectors:
                 while p < len(centers):
                     take = min(chunk - fill, len(centers) - p)
                     cen_buf[fill:fill + take] = centers[p:p + take]
-                    tgt_buf[fill:fill + take, 0] = contexts[p:p + take]
+                    ctx_buf[fill:fill + take] = contexts[p:p + take]
                     fill += take
                     p += take
                     if fill == chunk:

@@ -102,6 +102,41 @@ def _clipped_scatter(table: jax.Array, idx: jax.Array,
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
+def skipgram_hs_step(syn0: jax.Array, syn1: jax.Array,
+                     centers: jax.Array,      # [B] int32
+                     contexts: jax.Array,     # [B] int32
+                     points_mat: jax.Array,   # [V, L] int32 Huffman nodes
+                     labels_mat: jax.Array,   # [V, L] float32 (1 - code)
+                     hs_mask: jax.Array,      # [V, L] float32 path length
+                     row_valid: jax.Array,    # [B] float32 batch padding
+                     lr: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Hierarchical-softmax SkipGram step with the Huffman-path gathers
+    done ON DEVICE: targets/labels/mask come from per-word matrices, so
+    the host loop ships only (center, context) index pairs — the same
+    batching economics as the negative-sampling path."""
+    targets = points_mat[contexts]                 # [B, L]
+    labels = labels_mat[contexts]
+    mask = hs_mask[contexts] * row_valid[:, None]
+    return skipgram_step(syn0, syn1, centers, targets, labels, mask, lr)
+
+
+def build_hs_matrices(vocab_words, max_len: int
+                      ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(points, labels=1-codes, mask) matrices padded to ``max_len`` for
+    the device-side HS gather (rows indexed by word index)."""
+    v = len(vocab_words)
+    points = np.zeros((v, max_len), np.int32)
+    labels = np.zeros((v, max_len), np.float32)
+    mask = np.zeros((v, max_len), np.float32)
+    for i, vw in enumerate(vocab_words):
+        n = min(len(vw.points), max_len)
+        points[i, :n] = vw.points[:n]
+        labels[i, :n] = 1.0 - np.asarray(vw.codes[:n], np.float32)
+        mask[i, :n] = 1.0
+    return points, labels, mask
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
 def cbow_step(syn0: jax.Array, syn1: jax.Array,
               context: jax.Array,       # [B, W] int32 context word rows
               context_mask: jax.Array,  # [B, W] float32

@@ -77,3 +77,31 @@ def test_distributed_matches_single_when_one_worker():
         np.testing.assert_allclose(
             np.asarray(dist.get_word_vector(w)),
             np.asarray(single.get_word_vector(w)), rtol=1e-4, atol=1e-5)
+
+
+def test_hs_resume_after_deserialize(tmp_path):
+    """A deserialized HS model (tables installed without _init_tables)
+    must keep training via the fast path — the HS matrices are built
+    lazily (regression: AttributeError _hs_points)."""
+    from deeplearning4j_tpu.nlp.serializer import (read_full_model,
+                                                   write_full_model)
+    m = Word2Vec(layer_size=12, window_size=2, epochs=2, seed=5,
+                 use_hierarchic_softmax=True)
+    m.fit(CORPUS)
+    p = str(tmp_path / "w2v_hs.npz")
+    write_full_model(m, p)
+    m2 = read_full_model(p)
+    assert m2.use_hs
+    m2.fit(CORPUS)          # crashed before the lazy-matrix fix
+    assert np.isfinite(np.asarray(m2.syn0)).all()
+
+
+def test_distributed_hs_workers_train():
+    dw = DistributedWord2Vec(num_workers=3, averaging_rounds=2,
+                             layer_size=12, window_size=2, epochs=8,
+                             use_hierarchic_softmax=True,
+                             min_word_frequency=1, seed=9)
+    model = dw.fit(CORPUS)
+    assert model.use_hs
+    assert np.isfinite(np.asarray(model.syn0)).all()
+    assert model.similarity("cat", "cat") > 0.99
