@@ -1,14 +1,25 @@
-"""HDF5 archive reader.
+"""HDF5 archive reader (legacy .h5 AND Keras-3 .keras zips).
 
 Analog of the reference's Hdf5Archive.java (deeplearning4j-modelimport,
 which binds libhdf5 via JavaCPP — SURVEY §2.5, §3.5): attribute JSON
 reads + dataset traversal over a Keras .h5 file. h5py provides the same
 C-library binding surface.
+
+Beyond the reference: the Keras 3 native ``.keras`` format (a zip of
+config.json + metadata.json + model.weights.h5) loads through the same
+class — the constructor sniffs the zip magic, reads the config from the
+zip, and rebuilds legacy-style weight names from the v3 layout
+(``layers/<auto_snake_name>/vars/<i>``, sublayer dirs for MHA/RNN
+cells), so every existing converter works unchanged on modern files the
+reference cannot read at all.
 """
 
 from __future__ import annotations
 
+import io
 import json
+import re
+import zipfile
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -26,13 +37,94 @@ def _as_str(v) -> str:
     return str(v)
 
 
+def _snake(name: str) -> str:
+    """Keras' to_snake_case (auto layer-path naming in .keras files)."""
+    name = re.sub(r"\W+", "", name)
+    name = re.sub(r"(.)([A-Z][a-z]+)", r"\1_\2", name)
+    return re.sub(r"([a-z])([A-Z])", r"\1_\2", name).lower()
+
+
+# .keras var index → legacy weight name, per layer class (flag-adjusted
+# in _v3_var_names). Order == keras layer.weights order.
+_V3_VAR_NAMES = {
+    "Dense": ["kernel", "bias"],
+    "Conv1D": ["kernel", "bias"],
+    "Conv2D": ["kernel", "bias"],
+    "Convolution2D": ["kernel", "bias"],
+    "Conv2DTranspose": ["kernel", "bias"],
+    "DepthwiseConv2D": ["depthwise_kernel", "bias"],
+    "SeparableConv2D": ["depthwise_kernel", "pointwise_kernel", "bias"],
+    "BatchNormalization": ["gamma", "beta", "moving_mean",
+                           "moving_variance"],
+    "LayerNormalization": ["gamma", "beta"],
+    "Embedding": ["embeddings"],
+    "PReLU": ["alpha"],
+}
+
+_V3_RNN = {"LSTM", "GRU", "SimpleRNN"}
+_V3_MHA_SUBS = (("query_dense", "query"), ("key_dense", "key"),
+                ("value_dense", "value"),
+                ("output_dense", "attention_output"))
+
+
+def _v3_var_names(cls: str, lcfg: dict) -> Optional[List[str]]:
+    names = _V3_VAR_NAMES.get(cls)
+    if names is None:
+        return None
+    names = list(names)
+    if not lcfg.get("use_bias", True) and "bias" in names:
+        names.remove("bias")
+    if cls == "BatchNormalization":
+        if not lcfg.get("scale", True):
+            names.remove("gamma")
+        if not lcfg.get("center", True):
+            names.remove("beta")
+    return names
+
+
 class Hdf5Archive:
-    """Read-only view of a Keras HDF5 file."""
+    """Read-only view of a Keras HDF5 file or Keras-3 .keras zip."""
 
     def __init__(self, path: str):
         if not _H5PY:
             raise RuntimeError("h5py is required for Keras import")
-        self._f = h5py.File(path, "r")
+        self._zip_cfg = None
+        self._zip_version = None
+        with open(path, "rb") as fh:
+            magic = fh.read(4)
+        if magic == b"PK\x03\x04":
+            with zipfile.ZipFile(path) as z:
+                self._zip_cfg = json.loads(z.read("config.json"))
+                try:
+                    meta = json.loads(z.read("metadata.json"))
+                    self._zip_version = int(
+                        str(meta.get("keras_version", "3")).split(".")[0])
+                except KeyError:
+                    self._zip_version = 3
+                self._f = h5py.File(io.BytesIO(z.read("model.weights.h5")),
+                                    "r")
+            self._v3_dirs = self._build_v3_dir_map(self._zip_cfg)
+        else:
+            self._f = h5py.File(path, "r")
+
+    @staticmethod
+    def _build_v3_dir_map(cfg: dict) -> Dict[str, dict]:
+        """config layer name → (weights dir name, layer dict). Keras
+        writes weight dirs under the AUTO path (snake_case class + per-
+        base counter, in config order), not the user-visible name."""
+        layers = cfg.get("config", {})
+        layers = layers.get("layers", []) if isinstance(layers, dict) \
+            else []
+        counts: Dict[str, int] = {}
+        out: Dict[str, dict] = {}
+        for ld in layers:
+            base = _snake(ld["class_name"])
+            n = counts.get(base, 0)
+            counts[base] = n + 1
+            dirname = base if n == 0 else f"{base}_{n}"
+            name = ld.get("config", {}).get("name", dirname)
+            out[name] = {"dir": dirname, "layer": ld}
+        return out
 
     def close(self):
         self._f.close()
@@ -87,10 +179,14 @@ class Hdf5Archive:
 
     # ---- Keras-specific helpers -----------------------------------------
     def model_config(self) -> dict:
+        if self._zip_cfg is not None:
+            return self._zip_cfg
         return self.read_attribute_as_json("model_config")
 
     def keras_version(self) -> int:
-        """Major Keras version (1 or 2) from the file's attrs."""
+        """Major Keras version (1, 2, or 3) from the file."""
+        if self._zip_version is not None:
+            return self._zip_version
         root = ("model_weights",) if self.has_group("model_weights") else ()
         try:
             v = self.read_attribute_as_string("keras_version", *root)
@@ -98,9 +194,71 @@ class Hdf5Archive:
         except KeyError:
             return 1
 
+    # ---- .keras (v3) weight translation ---------------------------------
+    def _v3_vars(self, *groups: str) -> List[np.ndarray]:
+        if not self.has_group(*groups, "vars"):
+            return []
+        node = self._node(*groups, "vars")
+        return [np.asarray(node[k]) for k in
+                sorted(node.keys(), key=lambda s: int(s))]
+
+    def _v3_layer_weights(self, layer_name: str) -> Dict[str, np.ndarray]:
+        entry = self._v3_dirs.get(layer_name)
+        if entry is None:
+            return {}
+        # 3.x writes "layers/"; some 3.0-era files used
+        # "_layer_checkpoint_dependencies/"
+        root = ("layers" if self.has_group("layers")
+                else "_layer_checkpoint_dependencies")
+        if not self.has_group(root, entry["dir"]):
+            return {}
+        cls = entry["layer"]["class_name"]
+        lcfg = entry["layer"].get("config", {})
+        base = (root, entry["dir"])
+        out: Dict[str, np.ndarray] = {}
+
+        def put(names, arrs, prefix=""):
+            if len(arrs) > len(names):
+                # more saved vars than the known layout (LoRA adapters,
+                # exotic trackables): importing a truncated subset would
+                # be silently WRONG weights — refuse loudly instead
+                raise ValueError(
+                    f".keras layer {layer_name!r} ({cls}) has "
+                    f"{len(arrs)} saved variables but only {len(names)} "
+                    f"are understood ({names}); unsupported layer state")
+            for n, a in zip(names, arrs):
+                out[prefix + n if not prefix else f"{prefix}/{n}"] = a
+                if prefix:
+                    out.setdefault(n, a)   # leaf alias (may collide; the
+                    # qualified key above stays authoritative)
+
+        if cls == "MultiHeadAttention":
+            for sub, alias in _V3_MHA_SUBS:
+                put(["kernel", "bias"], self._v3_vars(*base, sub),
+                    prefix=alias)
+        elif cls in _V3_RNN:
+            put(["kernel", "recurrent_kernel", "bias"],
+                self._v3_vars(*base, "cell"))
+        elif cls == "Bidirectional":
+            for sub in ("forward_layer", "backward_layer"):
+                put(["kernel", "recurrent_kernel", "bias"],
+                    self._v3_vars(*base, sub, "cell"), prefix=sub)
+        else:
+            arrs = self._v3_vars(*base)
+            names = _v3_var_names(cls, lcfg)
+            if names is None:
+                if len(arrs) == 2 and arrs[1].ndim == 1:
+                    names = ["kernel", "bias"]   # generic kernel+bias
+                else:
+                    names = [f"var_{i}" for i in range(len(arrs))]
+            put(names, arrs)
+        return out
+
     def layer_weights(self, layer_name: str) -> Dict[str, np.ndarray]:
         """All weights of one layer, keyed by the LAST path component of
         the Keras weight name ('dense_1/kernel:0' → 'kernel')."""
+        if self._zip_cfg is not None:
+            return self._v3_layer_weights(layer_name)
         root = ("model_weights",) if self.has_group("model_weights") else ()
         groups = root + (layer_name,)
         if not self.has_group(*groups):

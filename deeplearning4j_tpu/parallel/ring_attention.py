@@ -97,6 +97,27 @@ def _ring_attention_local(q, k, v, mask, axis_name: str, causal: bool):
     return out.astype(q.dtype)
 
 
+def _shard_attention(local_fn, q, k, v, mask, mesh: Mesh, axis: str,
+                     batch_axis: Optional[str]):
+    """Shared shard_map dispatch for sequence-parallel attention bodies:
+    q/k/v sharded (batch, time) over the mesh, mask optional (statically
+    absent → the body skips all mask work)."""
+    bspec = batch_axis if batch_axis else None
+    spec_qkv = P(bspec, axis, None, None)
+    spec_mask = P(bspec, axis)
+    if mask is None:
+        shard_fn = jax.shard_map(
+            lambda q_, k_, v_: local_fn(q_, k_, v_, None),
+            mesh=mesh, in_specs=(spec_qkv,) * 3, out_specs=spec_qkv,
+            check_vma=False)
+        return shard_fn(q, k, v)
+    shard_fn = jax.shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(spec_qkv, spec_qkv, spec_qkv, spec_mask),
+        out_specs=spec_qkv, check_vma=False)
+    return shard_fn(q, k, v, mask)
+
+
 def ring_self_attention(q, k, v, mesh: Mesh, *, axis: str = SEQ_AXIS,
                         mask: Optional[jax.Array] = None,
                         causal: bool = False,
@@ -107,19 +128,49 @@ def ring_self_attention(q, k, v, mesh: Mesh, *, axis: str = SEQ_AXIS,
     mask:    (N, T) key-validity mask (or None).
     Returns the (N, T, H, Dh) attention output, same sharding as q.
     """
-    bspec = batch_axis if batch_axis else None
-    spec_qkv = P(bspec, axis, None, None)
-    spec_mask = P(bspec, axis)
-
     fn = functools.partial(_ring_attention_local, axis_name=axis,
                            causal=causal)
-    if mask is None:
-        shard_fn = jax.shard_map(
-            lambda q_, k_, v_: fn(q_, k_, v_, None),
-            mesh=mesh, in_specs=(spec_qkv,) * 3, out_specs=spec_qkv,
-            check_vma=False)
-        return shard_fn(q, k, v)
-    shard_fn = jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec_qkv, spec_qkv, spec_qkv, spec_mask),
-        out_specs=spec_qkv, check_vma=False)
-    return shard_fn(q, k, v, mask)
+    return _shard_attention(fn, q, k, v, mask, mesh, axis, batch_axis)
+
+
+def _ulysses_local(q, k, v, mask, axis_name: str, causal: bool):
+    """Per-device body: all-to-all head-scatter/sequence-gather, full-
+    sequence attention on the local head shard, all-to-all back."""
+    a2a = functools.partial(lax.all_to_all, axis_name=axis_name,
+                            tiled=True)
+    qg = a2a(q, split_axis=2, concat_axis=1)   # (N, T, H/P, Dh)
+    kg = a2a(k, split_axis=2, concat_axis=1)
+    vg = a2a(v, split_axis=2, concat_axis=1)
+    mg = (None if mask is None
+          else lax.all_gather(mask, axis_name, axis=1, tiled=True))
+    from deeplearning4j_tpu.ops.pallas_kernels import attention
+    o = attention(qg, kg, vg, mask=mg, causal=causal)
+    return a2a(o, split_axis=1, concat_axis=2)  # (N, T/P, H, Dh)
+
+
+def ulysses_self_attention(q, k, v, mesh: Mesh, *, axis: str = SEQ_AXIS,
+                           mask: Optional[jax.Array] = None,
+                           causal: bool = False,
+                           batch_axis: Optional[str] = None):
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style): the
+    alternative SP strategy to the ring. Two all-to-alls swap the
+    sequence sharding for a HEAD sharding, each device runs full-
+    sequence attention (through the flash-kernel dispatch) on H/P heads,
+    and a third all-to-all restores the sequence sharding.
+
+    Trade-off vs the ring: Ulysses moves O(T·H·Dh/P) per device through
+    three all-to-alls and needs ``H % P == 0``, but runs the unmodified
+    single-device kernel (no online-softmax carry) and has no P-step
+    serial dependency; the ring streams K/V in P hops with compute
+    overlap and supports any H. Same math either way — both are asserted
+    equal to ``scaled_dot_product_attention`` in tests/test_attention.py.
+
+    q, k, v: (N, T, H, Dh) GLOBAL shapes; T and H must divide by the
+    axis size. mask: (N, T) key-validity mask (or None).
+    """
+    p = int(mesh.shape[axis])
+    if q.shape[2] % p:
+        raise ValueError(f"ulysses needs heads ({q.shape[2]}) divisible"
+                         f" by the {axis!r} axis ({p})")
+    fn = functools.partial(_ulysses_local, axis_name=axis, causal=causal)
+    return _shard_attention(fn, q, k, v, mask, mesh, axis, batch_axis)

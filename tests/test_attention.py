@@ -167,3 +167,59 @@ class TestAttentionLayers:
         y, _ = pe.apply(params, {}, x, LayerContext())
         assert y.shape == (2, 8, 4)
         assert not np.allclose(np.asarray(y), 0.0)
+
+
+class TestUlyssesAttention:
+    """All-to-all sequence parallelism (the alternative SP strategy to
+    the ring): same math as single-chip attention."""
+
+    def _mesh4(self):
+        return Mesh(np.array(jax.devices()[:4]), ("sp",))
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_single_chip(self, causal):
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            ulysses_self_attention)
+        q, k, v = _qkv()
+        want = scaled_dot_product_attention(q, k, v, causal=causal)
+        got = ulysses_self_attention(q, k, v, self._mesh4(), causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_masked_matches_single_chip(self):
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            ulysses_self_attention)
+        q, k, v = _qkv(seed=3)
+        mask = jnp.asarray((np.random.default_rng(4)
+                            .random((2, 16)) > 0.3).astype(np.float32))
+        want = scaled_dot_product_attention(q, k, v, mask=mask)
+        got = ulysses_self_attention(q, k, v, self._mesh4(), mask=mask)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_gradients_match_single_chip(self):
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            ulysses_self_attention)
+        q, k, v = _qkv(t=8, seed=5)
+        mesh = self._mesh4()
+
+        def loss_sp(q, k, v):
+            return jnp.sum(
+                ulysses_self_attention(q, k, v, mesh, causal=True) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(
+                scaled_dot_product_attention(q, k, v, causal=True) ** 2)
+
+        g_sp = jax.grad(loss_sp, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_sp, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_heads_divisibility_enforced(self):
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            ulysses_self_attention)
+        q, k, v = _qkv(h=3)
+        with pytest.raises(ValueError, match="divisible"):
+            ulysses_self_attention(q, k, v, self._mesh4())

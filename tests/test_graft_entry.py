@@ -49,6 +49,6 @@ def test_dryrun_multichip_driver_invocation():
         cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "ResNet50 train step OK" in proc.stdout
-    assert "ring-attention + MoE train step OK" in proc.stdout
+    assert "ring-attention + Ulysses a2a + MoE train step OK" in proc.stdout
     assert "circular pipeline" in proc.stdout
     assert "Megatron-paired transformer train step OK" in proc.stdout

@@ -70,3 +70,36 @@ def test_fit_rejects_donated_train_state():
         pytest.skip("backend ignores buffer donation")
     with pytest.raises(RuntimeError, match="SCOPE_PANIC"):
         m.fit(DataSet(x, y))
+
+
+def test_train_step_is_transfer_clean():
+    """The jitted train step with device-resident batches performs no
+    implicit host<->device transfers — the workspace-hygiene guarantee,
+    now enforced by the guard rather than assumed."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models.multi_layer_network import (
+        MultiLayerNetwork)
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+
+    conf = (NeuralNetConfiguration.Builder().seed(2).updater(Sgd(0.1))
+            .list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=3))
+            .set_input_type(InputType.feed_forward(4)).build())
+    m = MultiLayerNetwork(conf).init()
+    step = m._build_train_step()
+    rng = np.random.default_rng(3)
+    x = jax.device_put(jnp.asarray(
+        rng.normal(size=(8, 4)).astype(np.float32)))
+    y = jax.device_put(jnp.asarray(
+        np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]))
+    key = jax.random.PRNGKey(0)
+    ts = m.train_state
+    ts, loss = step(ts, x, y, None, None, key)  # compile outside guard
+    with no_implicit_transfers():
+        ts, loss = step(ts, x, y, None, None, key)
+    assert np.isfinite(float(loss))
