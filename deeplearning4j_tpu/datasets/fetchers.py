@@ -369,6 +369,23 @@ class CifarDataSetIterator(_ArrayBackedIterator):
         return np.concatenate(imgs), np.concatenate(lbls)
 
 
+def write_cifar_bin(images: np.ndarray, labels: np.ndarray,
+                    path: str) -> None:
+    """Write (N, 32, 32, 3) uint8 NHWC images + (N,) labels in the
+    canonical ``cifar-10-batches-bin`` record format (label byte + 3072
+    CHW bytes) — lets tests/users populate the cache so the real-file
+    path is exercised byte-for-byte (same contract as write_idx_gz)."""
+    images = np.asarray(images, np.uint8)
+    labels = np.asarray(labels, np.uint8)
+    n = images.shape[0]
+    chw = images.transpose(0, 3, 1, 2).reshape(n, 3072)
+    rec = np.concatenate([labels[:, None], chw], axis=1)
+    d = os.path.dirname(path)
+    if d:                      # bare filename → cwd, no mkdir needed
+        os.makedirs(d, exist_ok=True)
+    rec.tofile(path)
+
+
 
 class LFWDataSetIterator(_ArrayBackedIterator):
     """Labeled-faces-in-the-wild (reference: LFWDataSetIterator). The

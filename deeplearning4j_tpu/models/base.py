@@ -109,7 +109,13 @@ class BaseModel:
                               what="fit() train state")
         if self._train_step is None:
             self._train_step = self._build_train_step()
-        if isinstance(data, DataSet):
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        if isinstance(data, MultiDataSet) and not hasattr(
+                self, "_walk"):   # only ComputationGraph handles multi-IO
+            raise TypeError(
+                "MultiDataSet requires a ComputationGraph; wrap single-"
+                "input data in a DataSet for MultiLayerNetwork")
+        if isinstance(data, (DataSet, MultiDataSet)):
             self._fit_batch(data)
             return self
         iterator = data

@@ -75,3 +75,29 @@ def test_mnist_real_file_path_roundtrip(tmp_path, monkeypatch):
     model = LeNet(compute_dtype="float32").init()
     model.fit(it, epochs=1)
     assert np.isfinite(float(model._last_loss))
+
+
+def test_cifar_real_file_path_roundtrip(tmp_path, monkeypatch):
+    """write_cifar_bin -> CifarDataSetIterator reads the REAL canonical
+    bin layout, not the synthetic fallback."""
+    from deeplearning4j_tpu.datasets.fetchers import (
+        CifarDataSetIterator, write_cifar_bin)
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, (40, 32, 32, 3)).astype(np.uint8)
+    labels = rng.integers(0, 10, 40).astype(np.uint8)
+    base = tmp_path / "cifar-10-batches-bin"
+    for i in range(1, 6):
+        write_cifar_bin(imgs[(i - 1) * 8: i * 8],
+                        labels[(i - 1) * 8: i * 8],
+                        str(base / f"data_batch_{i}.bin"))
+    write_cifar_bin(imgs[:8], labels[:8], str(base / "test_batch.bin"))
+    monkeypatch.setattr(fetchers, "DATA_DIR", str(tmp_path))
+
+    it = CifarDataSetIterator(batch_size=8, train=True, seed=1)
+    got = np.concatenate([np.asarray(b.features) for b in it])
+    assert got.shape == (40, 32, 32, 3)
+    # content equality (order shuffled): compare sorted pixel sums
+    np.testing.assert_allclose(
+        np.sort(got.sum((1, 2, 3))),
+        np.sort(imgs.astype(np.float32).sum((1, 2, 3)) / 255.0),
+        rtol=1e-5)
