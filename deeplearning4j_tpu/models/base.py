@@ -110,11 +110,14 @@ class BaseModel:
         if self._train_step is None:
             self._train_step = self._build_train_step()
         from deeplearning4j_tpu.datasets.dataset import MultiDataSet
-        if isinstance(data, MultiDataSet) and not hasattr(
-                self, "_walk"):   # only ComputationGraph handles multi-IO
-            raise TypeError(
-                "MultiDataSet requires a ComputationGraph; wrap single-"
-                "input data in a DataSet for MultiLayerNetwork")
+        if isinstance(data, MultiDataSet):
+            from deeplearning4j_tpu.models.computation_graph import (
+                ComputationGraph)
+            if not isinstance(self, ComputationGraph):
+                raise TypeError(
+                    "MultiDataSet requires a ComputationGraph; wrap "
+                    "single-input data in a DataSet for "
+                    "MultiLayerNetwork")
         if isinstance(data, (DataSet, MultiDataSet)):
             self._fit_batch(data)
             return self

@@ -297,6 +297,42 @@ class ParallelWrapper:
                        rep(batch.features_mask),
                        np.concatenate([lmask, zeros], axis=0))
 
+    def _put_batch(self, a, sharding=None, batch_dim: int = 0):
+        """Stage one batch tensor onto the data-sharded layout.
+
+        Single process: device_put of the full array. Multi-process
+        (real multi-host): ``a`` is THIS process's shard of the global
+        batch (the standard jax data-loading contract — each host's
+        iterator yields its share), assembled into the global array via
+        make_array_from_process_local_data; XLA moves nothing between
+        hosts. Requires EQUAL local batches on every process
+        (checked once per fit — unequal shards would silently build
+        inconsistent global shapes and hang the first collective)."""
+        if a is None:
+            return None
+        sh = self._batch_sh if sharding is None else sharding
+        if jax.process_count() == 1:
+            return jax.device_put(jnp.asarray(a), sh)
+        a = np.asarray(a)
+        self._check_equal_local_batch(a.shape[batch_dim])
+        gshape = list(a.shape)
+        gshape[batch_dim] *= jax.process_count()
+        return jax.make_array_from_process_local_data(sh, a,
+                                                      tuple(gshape))
+
+    def _check_equal_local_batch(self, n: int):
+        if getattr(self, "_local_batch_checked", None) == n:
+            return
+        from jax.experimental import multihost_utils
+        sizes = np.asarray(
+            multihost_utils.process_allgather(np.asarray([n])))
+        if not (sizes == n).all():
+            raise ValueError(
+                f"multi-host fit needs equal per-process batches; got "
+                f"{sizes.ravel().tolist()}. Pad or trim each host's "
+                "data shard to a common batch size.")
+        self._local_batch_checked = n
+
     def _fit_sync(self, iterator, epochs):
         if self._step is None:
             self._step, self._batch_sh = self._build_sync_step()
@@ -310,12 +346,10 @@ class ParallelWrapper:
                 n_real = batch.num_examples()
                 batch = self._pad_batch(batch)
                 m._rng, key = jax.random.split(m._rng)
-                put = lambda a: (None if a is None else jax.device_put(
-                    jnp.asarray(a), self._batch_sh))
-                feats = put(batch.features)
-                labels = put(batch.labels)
-                fmask = put(batch.features_mask)
-                lmask = put(batch.labels_mask)
+                feats = self._put_batch(batch.features)
+                labels = self._put_batch(batch.labels)
+                fmask = self._put_batch(batch.features_mask)
+                lmask = self._put_batch(batch.labels_mask)
                 m.train_state, loss = self._step(m.train_state, feats,
                                                  labels, fmask, lmask, key)
                 it = int(m.train_state.iteration)
@@ -333,6 +367,10 @@ class ParallelWrapper:
     def _fit_averaging(self, iterator, epochs):
         if self._step is None:
             self._step, _ = self._build_averaging_step()
+        # (k, B, ...) rounds shard the batch dim over data; multi-host
+        # staging assembles each process's slice (see _put_batch)
+        self._avg_batch_sh = NamedSharding(self.mesh,
+                                           P(None, DATA_AXIS))
         m = self.model
         k = self.averaging_frequency
         for epoch in range(epochs):
@@ -383,7 +421,11 @@ class ParallelWrapper:
             vals = [get(b) for b in batches]
             if any(v is None for v in vals):
                 return None
-            return jnp.stack([jnp.asarray(v) for v in vals])
+            stacked = np.stack([np.asarray(v) for v in vals])
+            # multi-host: each process holds its slice of the (k, B)
+            # global batch along the batch dim (dim 1)
+            return self._put_batch(stacked, sharding=self._avg_batch_sh,
+                                   batch_dim=1)
         feats = stack(lambda b: b.features)
         labels = stack(lambda b: b.labels)
         fmask = stack(lambda b: b.features_mask)

@@ -1,0 +1,101 @@
+"""Worker process for the REAL multi-process distributed test
+(tests/test_multihost.py). Not collected by pytest — launched as
+``python multihost_worker.py <rank> <nprocs> <port> <outdir>``.
+
+Each process owns 2 virtual CPU devices; together they form one global
+4-device ``data`` mesh spanning 2 OS processes — the honest simulation
+of two TPU hosts (separate runtimes, gloo/TCP collectives, per-process
+data shards), not 8 devices faked inside one process.
+"""
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    rank, nprocs, port, outdir = (int(sys.argv[1]), int(sys.argv[2]),
+                                  sys.argv[3], sys.argv[4])
+    from deeplearning4j_tpu.parallel.mesh import initialize_distributed
+    initialize_distributed(f"127.0.0.1:{port}", num_processes=nprocs,
+                           process_id=rank)
+    assert jax.process_count() == nprocs
+    assert jax.device_count() == 2 * nprocs
+
+    import numpy as np
+    from deeplearning4j_tpu.datasets.dataset import (
+        ArrayDataSetIterator, DataSet)
+    from deeplearning4j_tpu.models.multi_layer_network import (
+        MultiLayerNetwork)
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+    from deeplearning4j_tpu.ops.activations import Activation
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+    from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, create_mesh
+    from deeplearning4j_tpu.parallel.wrapper import (
+        ParallelWrapper, TrainingMode)
+
+    conf = (NeuralNetConfiguration.Builder().seed(1).updater(Sgd(0.1))
+            .list()
+            .layer(DenseLayer(n_out=16, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=3))
+            .set_input_type(InputType.feed_forward(4)).build())
+    model = MultiLayerNetwork(conf).init()
+    mesh = create_mesh({DATA_AXIS: 2 * nprocs})
+
+    # fixed GLOBAL dataset; this process feeds its contiguous shard
+    rng = np.random.default_rng(0)
+    gx = rng.normal(size=(64, 4)).astype(np.float32)
+    gy = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+    per = 64 // nprocs
+    lx = gx[rank * per:(rank + 1) * per]
+    ly = gy[rank * per:(rank + 1) * per]
+
+    w = (ParallelWrapper.builder(model).mesh(mesh)
+         .training_mode(TrainingMode.SHARED_GRADIENTS).build())
+    w.fit(ArrayDataSetIterator(DataSet(lx, ly), batch_size=per,
+                               shuffle=False), epochs=5)
+
+    params = jax.tree_util.tree_map(np.asarray, model.params)
+    flat = np.concatenate([l.ravel() for l in
+                           jax.tree_util.tree_leaves(params)])
+    result = {"rank": rank, "loss": float(model._last_loss),
+              "param_sum": float(flat.sum()),
+              "param_head": flat[:5].tolist()}
+
+    # multihost-safe sharded checkpoint: every process writes ONLY its
+    # addressable shards; process 0 publishes the manifest
+    from deeplearning4j_tpu.parallel.checkpoint import save_sharded
+    ckpt = os.path.join(outdir, "ckpt")
+    save_sharded(model.train_state, ckpt)
+
+    # AVERAGING (local-SGD) mode across processes too: each process
+    # contributes its slice of every (k, B) averaging round
+    avg_model = MultiLayerNetwork(conf).init()
+    wa = (ParallelWrapper.builder(avg_model).mesh(mesh)
+          .training_mode(TrainingMode.AVERAGING)
+          .averaging_frequency(2).build())
+    wa.fit(ArrayDataSetIterator(DataSet(lx, ly), batch_size=per // 2,
+                                shuffle=False), epochs=2)
+    aflat = np.concatenate(
+        [np.asarray(l).ravel() for l in
+         jax.tree_util.tree_leaves(avg_model.params)])
+    result["avg_param_sum"] = float(aflat.sum())
+    assert np.isfinite(aflat).all()
+
+    with open(os.path.join(outdir, f"result_{rank}.json"), "w") as f:
+        json.dump(result, f)
+    print(f"rank {rank} done", flush=True)
+
+
+if __name__ == "__main__":
+    main()
