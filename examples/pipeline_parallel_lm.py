@@ -1,0 +1,54 @@
+"""Pipeline-parallel causal LM with the circular/interleaved schedule.
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    JAX_PLATFORMS=cpu python examples/pipeline_parallel_lm.py
+
+Four pipeline stages, each holding TWO interleaved transformer blocks
+(Megatron "virtual pipeline"): an 8-layer LM trains with embed/unembed
+outside the pipelined region and per-tick rematerialization.
+"""
+
+import jax
+
+if jax.default_backend() == "cpu" and jax.device_count() < 4:
+    raise SystemExit("set XLA_FLAGS=--xla_force_host_platform_device_count=8")
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.parallel.pipeline import (
+    PIPE_AXIS,
+    PipelinedTransformerLM,
+)
+
+S = 4                       # pipeline stages (devices)
+mesh = Mesh(np.array(jax.devices()[:S]), (PIPE_AXIS,))
+lm = PipelinedTransformerLM(vocab=32, width=16, n_heads=4,
+                            n_layers=2 * S, max_len=16, mesh=mesh,
+                            remat=True)
+params = lm.init(jax.random.PRNGKey(0))
+
+rng = np.random.default_rng(0)
+toks = jnp.asarray(rng.integers(0, 32, (16, 12)))
+tgts = (toks + 1) % 32      # learnable: next token = token + 1
+
+
+@jax.jit
+def step(p):
+    loss, g = jax.value_and_grad(lm.loss)(p, toks, tgts)
+    return jax.tree_util.tree_map(lambda a, b: a - 0.5 * b, p, g), loss
+
+
+for i in range(70):
+    params, loss = step(params)
+    if i % 10 == 0:
+        print(f"step {i:3d}  loss {float(loss):.4f}")
+print(f"final loss {float(loss):.4f}")
+assert float(loss) < 1.0
+
+# sanity: the pipelined loss equals the sequential stack bit-for-bit
+seq = float(lm.loss(params, toks, tgts, pipelined=False))
+pipe = float(lm.loss(params, toks, tgts))
+print(f"pipelined {pipe:.6f} == sequential {seq:.6f}")
+assert abs(pipe - seq) < 1e-5
