@@ -41,7 +41,8 @@ class SequenceVectors:
                  batch_size: int = 512,
                  seed: int = 42,
                  stop_words: Iterable[str] = (),
-                 use_cbow: bool = False):
+                 use_cbow: bool = False,
+                 device_pair_generation: bool = False):
         self.layer_size = layer_size
         self.window_size = window_size
         self.min_word_frequency = min_word_frequency
@@ -56,6 +57,13 @@ class SequenceVectors:
         self.seed = seed
         self.stop_words = stop_words
         self.use_cbow = use_cbow
+        # opt-in: generate (center, context) pairs ON DEVICE
+        # (skipgram_token_step). Removes the host pair pipeline entirely
+        # — the right trade when host CPU is contended — but the batched
+        # clip pass costs more device time per pair, so the tuned host
+        # pair path measures faster on a dedicated host (101-119k vs
+        # ~76k tokens/s at 100k vocab); hence not the default.
+        self.device_pair_generation = device_pair_generation
 
         self.vocab: Optional[VocabCache] = None
         self.syn0: Optional[jax.Array] = None
@@ -115,6 +123,15 @@ class SequenceVectors:
         total_words = max(
             1, sum(len(s) for s in seqs) * self.epochs * self.iterations)
         if self._fast_sgns_ok():
+            if self.device_pair_generation:
+                if (not self.use_hs and self.sampling == 0.0
+                        and self.negative > 0):
+                    return self._fit_tokens_sgns(seqs, total_words)
+                import warnings
+                warnings.warn(
+                    "device_pair_generation only covers plain SGNS "
+                    "(negative>0, sampling=0, no HS/CBOW); falling back "
+                    "to the host pair pipeline", stacklevel=2)
             return self._fit_fast_sgns(seqs, total_words)
         k = self._k()
         batcher = sk.PairBatcher(self.batch_size, k)
@@ -147,6 +164,73 @@ class SequenceVectors:
                 and self.iterations == 1
                 and type(self)._add_pair is SequenceVectors._add_pair
                 and train_seq_ok)
+
+    def _fit_tokens_sgns(self, seqs, total_words: int):
+        """Device-side pair generation (skipgram_token_step): the host
+        ships padded (S, L) token-id matrices; window expansion,
+        negative sampling, and the update all run in one jitted step.
+        Used for plain SGNS without subsampling — the host pair pipeline
+        caps at ~120k tokens/s, this path removes it entirely.
+
+        Sentences longer than the row width are chunked and windows do
+        not cross chunk boundaries — the same truncation word2vec.c
+        applies at MAX_SENTENCE_LENGTH (its sentences split at 1000
+        tokens); with L<=512 the lost boundary pairs are <=W(W+1) per
+        chunk."""
+        W = self.window_size
+        # row width: fit the longest sentence piece (cap 512) — padding
+        # slots still compute masked pairs, so loose rows burn device
+        # time (40-token sentences in 128-wide rows = 3x waste)
+        max_len = max((len(s) for s in seqs), default=2)
+        L = int(min(512, max(8, max_len)))
+        rows_per_epoch = sum((len(s) + L - 1) // L for s in seqs) or 1
+        est_rows = rows_per_epoch * self.epochs
+        # flush sizing: ~256k pair slots amortizes dispatch overhead
+        # without blowing up the clip's sort/cumsum working set; shrink
+        # for small corpora so they still get >=~64 optimizer steps
+        budget_rows = max(4, 262144 // (L * 2 * W))
+        S = int(np.clip(est_rows // 64, 4, budget_rows))
+        buf = np.zeros((S, L), np.int32)
+        lens = np.zeros(S, np.int32)
+        table_dev = jnp.asarray(np.asarray(self._table, np.int32))
+        key = jax.random.PRNGKey(self.seed ^ 0x5EED)
+        fill = 0
+        seen = 0
+        n_flush = 0
+
+        def flush(n):
+            nonlocal fill, n_flush
+            if n == 0:
+                return
+            if n < S:
+                lens[n:] = 0
+            lr = self._lr(seen, total_words)
+            self.syn0, self.syn1 = sk.skipgram_token_step(
+                # .copy(): the host loop mutates these buffers while
+                # the async transfer may still be reading them — shipping
+                # the live buffer races and corrupts batches
+                self.syn0, self.syn1, jnp.asarray(buf.copy()),
+                jnp.asarray(lens.copy()), table_dev,
+                jax.random.fold_in(key, n_flush), jnp.float32(lr),
+                window=W, n_neg=self.negative)
+            n_flush += 1
+            fill = 0
+
+        for _epoch in range(self.epochs):
+            for seq in seqs:
+                idxs = np.asarray(self._indices(seq), np.int32)
+                seen += len(idxs)
+                for lo in range(0, len(idxs), L):
+                    piece = idxs[lo:lo + L]
+                    if len(piece) < 2:
+                        continue
+                    buf[fill, :len(piece)] = piece
+                    lens[fill] = len(piece)
+                    fill += 1
+                    if fill == S:
+                        flush(S)
+        flush(fill)
+        return self
 
     def _fit_fast_sgns(self, seqs, total_words: int):
         """Whole-corpus vectorized skip-gram (negative sampling OR
@@ -195,8 +279,9 @@ class SequenceVectors:
                 row_valid = jnp.asarray(r)
             lr = self._lr(seen, total_words)
             self.syn0, self.syn1 = sk.skipgram_hs_step(
-                self.syn0, self.syn1, jnp.asarray(cen_buf),
-                jnp.asarray(ctx_buf), self._hs_points, self._hs_labels,
+                self.syn0, self.syn1, jnp.asarray(cen_buf.copy()),
+                jnp.asarray(ctx_buf.copy()), self._hs_points,
+                self._hs_labels,
                 self._hs_mask, row_valid, jnp.float32(lr))
 
         def flush_ns(n_valid):
@@ -219,8 +304,9 @@ class SequenceVectors:
                 mask = jnp.asarray(m)
             lr = self._lr(seen, total_words)
             self.syn0, self.syn1 = sk.skipgram_step(
-                self.syn0, self.syn1, jnp.asarray(cen_buf),
-                jnp.asarray(tgt_buf), lab_dev, mask, jnp.float32(lr))
+                self.syn0, self.syn1, jnp.asarray(cen_buf.copy()),
+                jnp.asarray(tgt_buf.copy()), lab_dev, mask,
+                jnp.float32(lr))
 
         def flush(n_valid):
             nonlocal fill

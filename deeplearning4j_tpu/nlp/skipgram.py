@@ -98,7 +98,16 @@ def _clipped_scatter(table: jax.Array, idx: jax.Array,
     total = hi - lo                                   # segment sum, per row
     norm = jnp.linalg.norm(total, axis=-1, keepdims=True)
     scale = jnp.minimum(1.0, _MAX_ROW_UPDATE / jnp.maximum(norm, 1e-12))
-    return table.at[sid].add((supd * scale).astype(table.dtype))
+    # scatter each segment's total exactly ONCE (at its last element);
+    # every other duplicate index contributes an exact 0.0. XLA's scatter
+    # applies duplicate-index float adds in nondeterministic order, which
+    # made training runs differ at the last bit and drift apart — with at
+    # most one nonzero add per destination row the result is bitwise
+    # deterministic.
+    is_last = jnp.concatenate([sid[1:] != sid[:-1],
+                               jnp.ones((1,), bool)])
+    contrib = jnp.where(is_last[:, None], total * scale, 0.0)
+    return table.at[sid].add(contrib.astype(table.dtype))
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -160,6 +169,58 @@ def cbow_step(syn0: jax.Array, syn1: jax.Array,
     dctx = (dh[:, None, :] * context_mask[..., None]).reshape(-1, d)
     syn0 = _clipped_scatter(syn0, context.reshape(-1), dctx)
     return syn0, syn1
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1),
+                   static_argnames=("window", "n_neg"))
+def skipgram_token_step(syn0: jax.Array, syn1: jax.Array,
+                        tokens: jax.Array,    # (S, L) int32, padded
+                        lengths: jax.Array,   # (S,) int32 valid lengths
+                        table: jax.Array,     # unigram^0.75 table, int32
+                        key: jax.Array, lr: jax.Array,
+                        *, window: int, n_neg: int
+                        ) -> Tuple[jax.Array, jax.Array]:
+    """SGNS over raw token-id sentences with pair generation ON DEVICE.
+
+    The host pipeline (word→id lookup aside) caps tokens/s at what numpy
+    window expansion + negative gathers can produce (~120k tokens/s
+    measured). Here the (center, context) grid, the per-center effective
+    window draw (word2vec.c's ``b``), the negative samples, and the
+    update all happen inside one jitted step: the host ships only padded
+    int32 sentence matrices. Same math as skipgram_step (shared tail,
+    incl. the clipped scatter); RNG is jax-side instead of host-side.
+    """
+    s, l = tokens.shape
+    kb, kn = jax.random.split(key)
+    pos = jnp.arange(l)
+    offs = jnp.concatenate([jnp.arange(-window, 0),
+                            jnp.arange(1, window + 1)])      # (2W,)
+    b = jax.random.randint(kb, (s, l), 1, window + 1)
+    grid = jnp.broadcast_to(pos[None, :, None] + offs[None, None, :],
+                            (s, l, 2 * window))
+    valid = ((jnp.abs(offs)[None, None, :] <= b[..., None])
+             & (grid >= 0) & (grid < lengths[:, None, None])
+             & (pos[None, :, None] < lengths[:, None, None]))
+    centers = jnp.broadcast_to(tokens[:, :, None],
+                               valid.shape).reshape(-1)
+    ctx_idx = jnp.clip(grid, 0, l - 1)          # (S, L, 2W) positions
+    contexts = jnp.take_along_axis(
+        tokens, ctx_idx.reshape(s, -1), axis=1).reshape(-1)
+    mask_row = valid.reshape(-1).astype(jnp.float32)
+
+    p = centers.shape[0]
+    negs = table[jax.random.randint(kn, (p, n_neg), 0, table.shape[0])]
+    # a negative colliding with the positive would train the same target
+    # toward both labels: cycle it (word2vec.c skips; same effect). The
+    # vocab bound is syn1's static row count — free at trace time.
+    vmax = max(syn1.shape[0], 2)
+    negs = jnp.where(negs == contexts[:, None],
+                     (negs + 1) % vmax, negs)
+    targets = jnp.concatenate([contexts[:, None], negs], axis=1)
+    labels = jnp.zeros((p, 1 + n_neg),
+                       jnp.float32).at[:, 0].set(1.0)
+    mask = jnp.broadcast_to(mask_row[:, None], (p, 1 + n_neg))
+    return skipgram_step(syn0, syn1, centers, targets, labels, mask, lr)
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))

@@ -87,3 +87,53 @@ def test_infer_step_clipped():
                      jnp.float32(1.0))
     assert float(jnp.linalg.norm(out)) <= _MAX_ROW_UPDATE + 1e-5
     assert np.isfinite(np.asarray(out)).all()
+
+
+class TestTokenStep:
+    """Device-side pair generation (skipgram_token_step)."""
+
+    def test_window1_updates_exactly_neighbor_targets(self):
+        """window=1 makes the pair set deterministic: with zero syn1 and
+        n_neg over a 1-entry table, exactly the neighbor/negative rows
+        move."""
+        from deeplearning4j_tpu.nlp.skipgram import skipgram_token_step
+        syn0_host = np.random.default_rng(0).normal(
+            0, 0.3, (6, 8)).astype(np.float32)
+        syn0 = jnp.asarray(syn0_host)   # donated by the step
+        syn1 = jnp.zeros((6, 8), jnp.float32)
+        tokens = jnp.asarray([[1, 2, 3, 0]], jnp.int32)
+        lengths = jnp.asarray([3], jnp.int32)
+        table = jnp.asarray([5], jnp.int32)   # all negatives hit row 5
+        out0, out1 = skipgram_token_step(
+            syn0, syn1, tokens, lengths, table,
+            jax.random.PRNGKey(0), jnp.float32(0.1), window=1, n_neg=1)
+        changed = np.where(np.abs(np.asarray(out1)).sum(1) > 0)[0]
+        # positives: contexts {1,2,3}; negatives: row 5 (or cycled 0 on
+        # collision — impossible here since contexts != 5)
+        assert set(changed.tolist()) <= {1, 2, 3, 5}
+        assert {1, 2, 3} <= set(changed.tolist())
+        # step 1 leaves syn0 untouched (zero syn1 → zero dh, as in
+        # word2vec.c); step 2 moves exactly the center rows {1,2,3}
+        np.testing.assert_array_equal(np.asarray(out0), syn0_host)
+        out0b, _ = skipgram_token_step(
+            out0, out1, tokens, lengths, table,
+            jax.random.PRNGKey(1), jnp.float32(0.1), window=1, n_neg=1)
+        d0 = np.abs(np.asarray(out0b) - syn0_host).sum(1)
+        assert (d0[[1, 2, 3]] > 0).all()
+        assert d0[[0, 4, 5]].sum() == 0.0
+
+    def test_word2vec_token_path_learns_structure(self):
+        """End-to-end through Word2Vec with the opt-in device pair
+        generation: learns topic structure on the toy corpus."""
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+        rng = np.random.default_rng(0)
+        pools = (["cat", "dog", "pet", "fur", "paw"],
+                 ["car", "truck", "road", "wheel", "engine"])
+        corpus = [" ".join(rng.choice(pools[rng.random() < 0.5], size=6))
+                  for _ in range(150)]
+        m = Word2Vec(layer_size=24, window_size=3, epochs=15, negative=4,
+                     learning_rate=0.05, seed=7,
+                     device_pair_generation=True)
+        m.fit(corpus)
+        assert m.similarity("cat", "dog") > m.similarity("cat", "truck")
+        assert np.isfinite(np.asarray(m.syn0)).all()
