@@ -122,6 +122,9 @@ class SequenceVectors:
             self._init_tables()
         total_words = max(
             1, sum(len(s) for s in seqs) * self.epochs * self.iterations)
+        if (self.use_cbow and self._fast_hooks_ok()
+                and hasattr(self, "_fit_fast_cbow")):
+            return self._fit_fast_cbow(seqs, total_words)
         if self._fast_sgns_ok():
             if self.device_pair_generation:
                 if (not self.use_hs and self.sampling == 0.0
@@ -157,11 +160,15 @@ class SequenceVectors:
         silently get generic SGNS behavior). A subclass whose override
         merely delegates (Word2Vec) can opt back in by setting
         ``_sgns_fast_path_safe = True`` on the override function."""
+        return (not self.use_cbow and self._fast_hooks_ok())
+
+    def _fast_hooks_ok(self) -> bool:
+        """True when no subclass customizes pair generation (the
+        condition for ANY vectorized path — SGNS, HS, or CBOW)."""
         ts = type(self)._train_sequence
         train_seq_ok = (ts is SequenceVectors._train_sequence
                         or getattr(ts, "_sgns_fast_path_safe", False))
-        return (not self.use_cbow
-                and self.iterations == 1
+        return (self.iterations == 1
                 and type(self)._add_pair is SequenceVectors._add_pair
                 and train_seq_ok)
 
@@ -286,16 +293,8 @@ class SequenceVectors:
 
         def flush_ns(n_valid):
             tgt_buf[:n_valid, 0] = ctx_buf[:n_valid]
-            negs = table[rng.integers(0, len(table), (n_valid, k - 1))]
-            pos = tgt_buf[:n_valid, 0:1]
-            bad = negs == pos
-            if bad.any():  # redraw collisions once, then cycle
-                negs[bad] = table[rng.integers(0, len(table),
-                                               int(bad.sum()))]
-                bad = negs == pos
-                negs[bad] = (np.broadcast_to(pos, negs.shape)[bad] + 1) \
-                    % max(n_words, 2)
-            tgt_buf[:n_valid, 1:] = negs
+            tgt_buf[:n_valid, 1:] = sk.draw_negatives(
+                rng, table, tgt_buf[:n_valid, 0:1], k - 1, n_words)
             if n_valid == chunk:
                 mask = ones_mask
             else:
@@ -326,11 +325,7 @@ class SequenceVectors:
                     seen += n
                     continue
                 # randomized effective window per center (word2vec.c's b)
-                eff = (rng.integers(1, W + 1, n) if W > 1
-                       else np.ones(n, np.int64))
-                grid = np.arange(n)[:, None] + offsets[None, :]
-                valid = (np.abs(offsets)[None, :] <= eff[:, None]) \
-                    & (grid >= 0) & (grid < n)
+                grid, valid = sk.window_grid(n, W, rng)
                 centers = np.repeat(idxs, valid.sum(axis=1))
                 contexts = idxs[grid[valid]]
                 seen += n

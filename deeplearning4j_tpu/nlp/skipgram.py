@@ -284,6 +284,39 @@ class PairBatcher:
         return out
 
 
+def draw_negatives(rng: np.random.Generator, table: np.ndarray,
+                   pos: np.ndarray, n_neg: int,
+                   n_words: int) -> np.ndarray:
+    """(n, n_neg) negatives from the unigram^0.75 table for positive
+    column ``pos`` (n, 1): collisions with the positive are redrawn
+    once, then cycled to (pos+1) mod vocab — the single home of the
+    collision policy shared by the SGNS and CBOW fast paths."""
+    n = pos.shape[0]
+    negs = table[rng.integers(0, len(table), (n, n_neg))]
+    bad = negs == pos
+    if bad.any():
+        negs[bad] = table[rng.integers(0, len(table), int(bad.sum()))]
+        bad = negs == pos
+        negs[bad] = (np.broadcast_to(pos, negs.shape)[bad] + 1) \
+            % max(n_words, 2)
+    return negs
+
+
+def window_grid(n: int, window: int, rng: np.random.Generator
+                ) -> Tuple[np.ndarray, np.ndarray]:
+    """Randomized-effective-window offsets grid (word2vec.c's ``b``):
+    returns (grid positions (n, 2W), validity mask (n, 2W)) shared by
+    the SGNS and CBOW fast paths."""
+    offsets = np.concatenate([np.arange(-window, 0),
+                              np.arange(1, window + 1)])
+    eff = (rng.integers(1, window + 1, n) if window > 1
+           else np.ones(n, np.int64))
+    grid = np.arange(n)[:, None] + offsets[None, :]
+    valid = ((np.abs(offsets)[None, :] <= eff[:, None])
+             & (grid >= 0) & (grid < n))
+    return grid, valid
+
+
 def negative_sample_targets(pos: int, table: np.ndarray, n_neg: int,
                             rng: np.random.Generator
                             ) -> Tuple[np.ndarray, np.ndarray]:

@@ -100,6 +100,90 @@ class Word2Vec(SequenceVectors):
     # SGNS fast path stays valid for Word2Vec (see _fast_sgns_ok)
     _train_sequence._sgns_fast_path_safe = True
 
+    def _fit_fast_cbow(self, seqs, total_words: int):
+        """Vectorized CBOW (NS and HS): context windows built with the
+        same numpy offsets grid the SGNS fast path uses, one donated
+        ``cbow_step`` per chunk — replaces the per-center Python loop
+        (reference: AggregateCBOW batching, CBOW.java)."""
+        rng = self._rng
+        if self.device_pair_generation:
+            import warnings
+            warnings.warn(
+                "device_pair_generation does not cover CBOW; using the "
+                "host context-window pipeline", stacklevel=2)
+        W = self.window_size
+        ctx_w = 2 * W
+        chunk = int(np.clip(total_words // 64, self.batch_size, 65536))
+        k = self._k()
+        ctx_buf = np.zeros((chunk, ctx_w), np.int32)
+        cmask_buf = np.zeros((chunk, ctx_w), np.float32)
+        cen_buf = np.zeros(chunk, np.int32)
+        hs = self.use_hs
+        if hs:
+            self._ensure_hs_matrices()
+            pts = np.asarray(self._hs_points)
+            labs = np.asarray(self._hs_labels)
+            hm = np.asarray(self._hs_mask)
+        table = self._table
+        n_words = self.vocab.num_words()
+        fill = 0
+        seen = 0
+
+        def flush(n):
+            nonlocal fill
+            if n == 0:
+                return
+            if hs:
+                targets = pts[cen_buf[:n]]
+                labels = labs[cen_buf[:n]]
+                mask = hm[cen_buf[:n]]
+            else:
+                targets = np.zeros((n, k), np.int32)
+                labels = np.zeros((n, k), np.float32)
+                labels[:, 0] = 1.0
+                targets[:, 0] = cen_buf[:n]
+                targets[:, 1:] = sk.draw_negatives(
+                    rng, table, cen_buf[:n, None], k - 1, n_words)
+                mask = np.ones((n, k), np.float32)
+            if n < chunk:   # static shapes: pad the tail chunk
+                pad = chunk - n
+                z = lambda a: np.concatenate(
+                    [a, np.zeros((pad,) + a.shape[1:], a.dtype)])
+                targets, labels, mask = z(targets), z(labels), z(mask)
+                cmask_buf[n:] = 0.0
+            lr = self._lr(seen, total_words)
+            # .copy(): the loop mutates these buffers while the async
+            # transfer may still read them (see _fit_fast_sgns)
+            self.syn0, self.syn1 = sk.cbow_step(
+                self.syn0, self.syn1, jnp.asarray(ctx_buf.copy()),
+                jnp.asarray(cmask_buf.copy()), jnp.asarray(targets),
+                jnp.asarray(labels), jnp.asarray(mask), jnp.float32(lr))
+            fill = 0
+
+        for _epoch in range(self.epochs):
+            for seq in seqs:
+                idxs = np.asarray(self._indices(seq), np.int32)
+                n = len(idxs)
+                if n < 2:
+                    seen += n
+                    continue
+                grid, valid = sk.window_grid(n, W, rng)
+                ctx = idxs[np.clip(grid, 0, n - 1)]
+                seen += n
+                p = 0
+                while p < n:
+                    take = min(chunk - fill, n - p)
+                    sl = slice(fill, fill + take)
+                    cen_buf[sl] = idxs[p:p + take]
+                    ctx_buf[sl] = ctx[p:p + take]
+                    cmask_buf[sl] = valid[p:p + take].astype(np.float32)
+                    fill += take
+                    p += take
+                    if fill == chunk:
+                        flush(chunk)
+        flush(fill)
+        return self
+
 
 class _CbowBatcher:
     def __init__(self, batch_size: int, ctx_w: int, k: int):
