@@ -61,6 +61,21 @@ class ParagraphVectors(Word2Vec):
         # (once as a label-pair add, once in the joint word pass)
         per_epoch = sum(len(t) for t, _ in tokenized)
         total = max(1, per_epoch * self.epochs * (1 if self.dm else 2))
+        # fast paths only when no subclass customizes the doc-level
+        # hooks either (same trap _fast_sgns_ok documents for
+        # _train_sequence overrides)
+        doc_hooks_ok = (
+            type(self)._train_dbow is ParagraphVectors._train_dbow
+            and type(self)._train_dm is ParagraphVectors._train_dm)
+        if self._fast_hooks_ok() and doc_hooks_ok:
+            if self.dm:
+                lidx_lists = [
+                    [i for i in (self.vocab.index_of(lb) for lb in lbs)
+                     if i >= 0] for _t, lbs in tokenized]
+                return self._fit_fast_cbow(
+                    [t for t, _ in tokenized], total,
+                    extra_per_seq=lidx_lists)
+            return self._fit_fast_dbow(tokenized, total)
         k = self._k()
         batcher = sk.PairBatcher(self.batch_size, k)
         seen = 0
@@ -78,6 +93,37 @@ class ParagraphVectors(Word2Vec):
                     seen = super(Word2Vec, self)._train_sequence(
                         idxs, batcher, seen, total)
         self._flush(batcher, self._lr(seen, total))
+        return self
+
+    def _fit_fast_dbow(self, tokenized, total: int):
+        """Vectorized DBOW: the (label, word) product plus the joint
+        word-window pairs stream through the shared chunked pair
+        consumer (one donated device step per chunk) instead of the
+        per-pair Python loop — NS and HS alike."""
+        from deeplearning4j_tpu.nlp.sequence_vectors import _PairStream
+        W = self.window_size
+        stream = _PairStream(
+            self, self._pair_chunk_size(total * (W + 2)), total)
+        for _ep in range(self.epochs):
+            for tokens, labels in tokenized:
+                idxs = np.asarray(self._indices(tokens), np.int32)
+                lidxs = np.asarray(
+                    [i for i in (self.vocab.index_of(lb)
+                                 for lb in labels) if i >= 0], np.int32)
+                n = len(idxs)
+                if n and len(lidxs):
+                    # every (label, word) pair — the doc vector predicts
+                    # each of its words (DBOW.java semantics)
+                    stream.push(np.repeat(lidxs, n),
+                                np.tile(idxs, len(lidxs)))
+                    stream.seen += len(lidxs) * n
+                # joint word pass (trainWordVectors=true semantics)
+                if n >= 2:
+                    grid, valid = sk.window_grid(n, W, self._rng)
+                    stream.push(np.repeat(idxs, valid.sum(axis=1)),
+                                idxs[grid[valid]])
+                stream.seen += n
+        stream.finish()
         return self
 
     def _train_dbow(self, idxs, lidxs, batcher, seen, total):

@@ -23,6 +23,83 @@ from deeplearning4j_tpu.nlp import skipgram as sk
 from deeplearning4j_tpu.nlp.vocab import Huffman, VocabCache, VocabConstructor
 
 
+class _PairStream:
+    """Chunked (center, context) consumer for the vectorized SGNS/HS
+    paths (used by SequenceVectors and ParagraphVectors' DBOW): buffers
+    pushed pair arrays and flushes one donated device step per chunk.
+    ``seen`` is advanced by the producer; the lr anneal reads it at each
+    flush (word2vec.c's linear decay)."""
+
+    def __init__(self, model, chunk: int, total_words: int):
+        self.m = model
+        self.chunk = chunk
+        self.total = total_words
+        self.seen = 0
+        self.cen = np.zeros(chunk, np.int32)
+        self.ctx = np.zeros(chunk, np.int32)
+        self.fill = 0
+        if model.use_hs:
+            model._ensure_hs_matrices()
+            self._ones_row = jnp.ones((chunk,), jnp.float32)
+        else:
+            k = 1 + model.negative
+            self.tgt = np.zeros((chunk, k), np.int32)
+            lab = np.zeros((chunk, k), np.float32)
+            lab[:, 0] = 1.0
+            # labels never change and the mask is all-ones except on the
+            # final partial chunk: keep both device-resident instead of
+            # re-uploading megabytes per step
+            self._lab_dev = jnp.asarray(lab)
+            self._ones_mask = jnp.ones((chunk, k), jnp.float32)
+
+    def push(self, centers: np.ndarray, contexts: np.ndarray):
+        p = 0
+        while p < len(centers):
+            take = min(self.chunk - self.fill, len(centers) - p)
+            self.cen[self.fill:self.fill + take] = centers[p:p + take]
+            self.ctx[self.fill:self.fill + take] = contexts[p:p + take]
+            self.fill += take
+            p += take
+            if self.fill == self.chunk:
+                self._flush(self.chunk)
+
+    def finish(self):
+        self._flush(self.fill)
+
+    def _flush(self, n_valid: int):
+        if n_valid == 0:
+            return
+        m = self.m
+        lr = jnp.float32(m._lr(self.seen, self.total))
+        if m.use_hs:
+            if n_valid == self.chunk:
+                row_valid = self._ones_row
+            else:
+                r = np.zeros(self.chunk, np.float32)
+                r[:n_valid] = 1.0
+                row_valid = jnp.asarray(r)
+            m.syn0, m.syn1 = sk.skipgram_hs_step(
+                m.syn0, m.syn1, jnp.asarray(self.cen.copy()),
+                jnp.asarray(self.ctx.copy()), m._hs_points,
+                m._hs_labels, m._hs_mask, row_valid, lr)
+        else:
+            k = 1 + m.negative
+            self.tgt[:n_valid, 0] = self.ctx[:n_valid]
+            self.tgt[:n_valid, 1:] = sk.draw_negatives(
+                m._rng, m._table, self.tgt[:n_valid, 0:1], k - 1,
+                m.vocab.num_words())
+            if n_valid == self.chunk:
+                mask = self._ones_mask
+            else:
+                mk = np.zeros((self.chunk, k), np.float32)
+                mk[:n_valid] = 1.0
+                mask = jnp.asarray(mk)
+            m.syn0, m.syn1 = sk.skipgram_step(
+                m.syn0, m.syn1, jnp.asarray(self.cen.copy()),
+                jnp.asarray(self.tgt.copy()), self._lab_dev, mask, lr)
+        self.fill = 0
+
+
 class SequenceVectors:
     """Builder-configured embedding trainer (reference:
     SequenceVectors.Builder)."""
@@ -239,6 +316,15 @@ class SequenceVectors:
         flush(fill)
         return self
 
+    def _pair_chunk_size(self, est_pairs: int) -> int:
+        """Chunk sizing shared by the vectorized pair paths: large chunks
+        amortize per-dispatch latency (~26 ms over tunneled transports —
+        PERF_ANALYSIS.md); update staleness within a chunk is the same
+        hogwild-style race the reference's multithreaded native loop
+        accepts (SURVEY §3.6). Scaled to the corpus so small corpora
+        still get ≥~64 sequential optimizer steps per fit."""
+        return int(np.clip(est_pairs // 64, self.batch_size, 65536))
+
     def _fit_fast_sgns(self, seqs, total_words: int):
         """Whole-corpus vectorized skip-gram (negative sampling OR
         hierarchical softmax): pair generation is numpy over an offsets
@@ -247,98 +333,24 @@ class SequenceVectors:
         single donated device step — the TPU-shaped version of the
         reference's AggregateSkipGram batching (SkipGram.java:176-186)
         with the Python-per-pair loop removed."""
-        rng = self._rng
         W = self.window_size
-        offsets = np.concatenate([np.arange(-W, 0), np.arange(1, W + 1)])
-        # large chunks amortize per-call dispatch latency; update staleness
-        # within a chunk is the same hogwild-style race the reference's
-        # multithreaded native loop accepts (SURVEY §3.6). Scale the chunk
-        # to the corpus so small corpora still get enough sequential
-        # updates to converge (≥~64 steps over the whole fit).
-        est_pairs = total_words * (W + 1)
-        chunk = int(np.clip(est_pairs // 64, self.batch_size, 65536))
-        k = 1 + self.negative
-        cen_buf = np.zeros(chunk, np.int32)
-        ctx_buf = np.zeros(chunk, np.int32)
-        if self.use_hs:
-            self._ensure_hs_matrices()
-            ones_row = jnp.ones((chunk,), jnp.float32)
-        else:
-            tgt_buf = np.zeros((chunk, k), np.int32)
-            lab_np = np.zeros((chunk, k), np.float32)
-            lab_np[:, 0] = 1.0
-            # labels never change and the mask is all-ones except on the
-            # final partial chunk: keep both device-resident instead of
-            # re-uploading megabytes per step
-            lab_dev = jnp.asarray(lab_np)
-            ones_mask = jnp.ones((chunk, k), jnp.float32)
-        fill = 0
-        seen = 0
-        table = self._table
-        n_words = self.vocab.num_words()
-
-        def flush_hs(n_valid):
-            if n_valid == chunk:
-                row_valid = ones_row
-            else:
-                r = np.zeros(chunk, np.float32)
-                r[:n_valid] = 1.0
-                row_valid = jnp.asarray(r)
-            lr = self._lr(seen, total_words)
-            self.syn0, self.syn1 = sk.skipgram_hs_step(
-                self.syn0, self.syn1, jnp.asarray(cen_buf.copy()),
-                jnp.asarray(ctx_buf.copy()), self._hs_points,
-                self._hs_labels,
-                self._hs_mask, row_valid, jnp.float32(lr))
-
-        def flush_ns(n_valid):
-            tgt_buf[:n_valid, 0] = ctx_buf[:n_valid]
-            tgt_buf[:n_valid, 1:] = sk.draw_negatives(
-                rng, table, tgt_buf[:n_valid, 0:1], k - 1, n_words)
-            if n_valid == chunk:
-                mask = ones_mask
-            else:
-                m = np.zeros((chunk, k), np.float32)
-                m[:n_valid] = 1.0
-                mask = jnp.asarray(m)
-            lr = self._lr(seen, total_words)
-            self.syn0, self.syn1 = sk.skipgram_step(
-                self.syn0, self.syn1, jnp.asarray(cen_buf.copy()),
-                jnp.asarray(tgt_buf.copy()), lab_dev, mask,
-                jnp.float32(lr))
-
-        def flush(n_valid):
-            nonlocal fill
-            if n_valid == 0:
-                return
-            if self.use_hs:
-                flush_hs(n_valid)
-            else:
-                flush_ns(n_valid)
-            fill = 0
-
+        stream = _PairStream(
+            self, self._pair_chunk_size(total_words * (W + 1)),
+            total_words)
         for _epoch in range(self.epochs):
             for seq in seqs:
                 idxs = np.asarray(self._indices(seq), np.int32)
                 n = len(idxs)
                 if n < 2:
-                    seen += n
+                    stream.seen += n
                     continue
                 # randomized effective window per center (word2vec.c's b)
-                grid, valid = sk.window_grid(n, W, rng)
+                grid, valid = sk.window_grid(n, W, self._rng)
                 centers = np.repeat(idxs, valid.sum(axis=1))
                 contexts = idxs[grid[valid]]
-                seen += n
-                p = 0
-                while p < len(centers):
-                    take = min(chunk - fill, len(centers) - p)
-                    cen_buf[fill:fill + take] = centers[p:p + take]
-                    ctx_buf[fill:fill + take] = contexts[p:p + take]
-                    fill += take
-                    p += take
-                    if fill == chunk:
-                        flush(chunk)
-        flush(fill)
+                stream.seen += n
+                stream.push(centers, contexts)
+        stream.finish()
         return self
 
     def _k(self) -> int:

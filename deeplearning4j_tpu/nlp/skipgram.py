@@ -171,6 +171,27 @@ def cbow_step(syn0: jax.Array, syn1: jax.Array,
     return syn0, syn1
 
 
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def cbow_hs_step(syn0: jax.Array, syn1: jax.Array,
+                 context: jax.Array,       # [B, W] int32
+                 context_mask: jax.Array,  # [B, W] float32
+                 centers: jax.Array,       # [B] int32 (Huffman lookup)
+                 points_mat: jax.Array,    # [V, L] int32
+                 labels_mat: jax.Array,    # [V, L] float32
+                 hs_mask: jax.Array,       # [V, L] float32
+                 row_valid: jax.Array,     # [B] float32
+                 lr: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Hierarchical-softmax CBOW with the Huffman-path gather ON DEVICE
+    (mirrors skipgram_hs_step): the host ships context ids + center ids
+    only, instead of re-uploading gathered (B, L) target/label/mask
+    arrays every chunk."""
+    targets = points_mat[centers]
+    labels = labels_mat[centers]
+    mask = hs_mask[centers] * row_valid[:, None]
+    return cbow_step(syn0, syn1, context, context_mask, targets, labels,
+                     mask, lr)
+
+
 @functools.partial(jax.jit, donate_argnums=(0, 1),
                    static_argnames=("window", "n_neg"))
 def skipgram_token_step(syn0: jax.Array, syn1: jax.Array,

@@ -100,11 +100,16 @@ class Word2Vec(SequenceVectors):
     # SGNS fast path stays valid for Word2Vec (see _fast_sgns_ok)
     _train_sequence._sgns_fast_path_safe = True
 
-    def _fit_fast_cbow(self, seqs, total_words: int):
+    def _fit_fast_cbow(self, seqs, total_words: int,
+                       extra_per_seq=None):
         """Vectorized CBOW (NS and HS): context windows built with the
         same numpy offsets grid the SGNS fast path uses, one donated
         ``cbow_step`` per chunk — replaces the per-center Python loop
-        (reference: AggregateCBOW batching, CBOW.java)."""
+        (reference: AggregateCBOW batching, CBOW.java).
+
+        ``extra_per_seq``: per-sequence id lists appended to every
+        center's context window — ParagraphVectors' DM mode (the doc
+        label vectors join each context)."""
         rng = self._rng
         if self.device_pair_generation:
             import warnings
@@ -112,8 +117,10 @@ class Word2Vec(SequenceVectors):
                 "device_pair_generation does not cover CBOW; using the "
                 "host context-window pipeline", stacklevel=2)
         W = self.window_size
-        ctx_w = 2 * W
-        chunk = int(np.clip(total_words // 64, self.batch_size, 65536))
+        max_extra = (max((len(e) for e in extra_per_seq), default=0)
+                     if extra_per_seq else 0)
+        ctx_w = 2 * W + max_extra
+        chunk = self._pair_chunk_size(total_words)  # one center per token
         k = self._k()
         ctx_buf = np.zeros((chunk, ctx_w), np.int32)
         cmask_buf = np.zeros((chunk, ctx_w), np.float32)
@@ -121,9 +128,14 @@ class Word2Vec(SequenceVectors):
         hs = self.use_hs
         if hs:
             self._ensure_hs_matrices()
-            pts = np.asarray(self._hs_points)
-            labs = np.asarray(self._hs_labels)
-            hm = np.asarray(self._hs_mask)
+            ones_row = jnp.ones((chunk,), jnp.float32)
+        else:
+            # constants stay device-resident (same reason as _PairStream)
+            lab_np = np.zeros((chunk, k), np.float32)
+            lab_np[:, 0] = 1.0
+            lab_dev = jnp.asarray(lab_np)
+            ones_mask = jnp.ones((chunk, k), jnp.float32)
+            tgt_buf = np.zeros((chunk, k), np.int32)
         table = self._table
         n_words = self.vocab.num_words()
         fill = 0
@@ -133,35 +145,41 @@ class Word2Vec(SequenceVectors):
             nonlocal fill
             if n == 0:
                 return
-            if hs:
-                targets = pts[cen_buf[:n]]
-                labels = labs[cen_buf[:n]]
-                mask = hm[cen_buf[:n]]
-            else:
-                targets = np.zeros((n, k), np.int32)
-                labels = np.zeros((n, k), np.float32)
-                labels[:, 0] = 1.0
-                targets[:, 0] = cen_buf[:n]
-                targets[:, 1:] = sk.draw_negatives(
-                    rng, table, cen_buf[:n, None], k - 1, n_words)
-                mask = np.ones((n, k), np.float32)
-            if n < chunk:   # static shapes: pad the tail chunk
-                pad = chunk - n
-                z = lambda a: np.concatenate(
-                    [a, np.zeros((pad,) + a.shape[1:], a.dtype)])
-                targets, labels, mask = z(targets), z(labels), z(mask)
+            if n < chunk:
                 cmask_buf[n:] = 0.0
-            lr = self._lr(seen, total_words)
+            lr = jnp.float32(self._lr(seen, total_words))
             # .copy(): the loop mutates these buffers while the async
             # transfer may still read them (see _fit_fast_sgns)
-            self.syn0, self.syn1 = sk.cbow_step(
-                self.syn0, self.syn1, jnp.asarray(ctx_buf.copy()),
-                jnp.asarray(cmask_buf.copy()), jnp.asarray(targets),
-                jnp.asarray(labels), jnp.asarray(mask), jnp.float32(lr))
+            ctx_d = jnp.asarray(ctx_buf.copy())
+            cm_d = jnp.asarray(cmask_buf.copy())
+            if hs:
+                if n == chunk:
+                    row_valid = ones_row
+                else:
+                    r = np.zeros(chunk, np.float32)
+                    r[:n] = 1.0
+                    row_valid = jnp.asarray(r)
+                self.syn0, self.syn1 = sk.cbow_hs_step(
+                    self.syn0, self.syn1, ctx_d, cm_d,
+                    jnp.asarray(cen_buf.copy()), self._hs_points,
+                    self._hs_labels, self._hs_mask, row_valid, lr)
+            else:
+                tgt_buf[:n, 0] = cen_buf[:n]
+                tgt_buf[:n, 1:] = sk.draw_negatives(
+                    rng, table, cen_buf[:n, None], k - 1, n_words)
+                if n == chunk:
+                    mask = ones_mask
+                else:
+                    mk = np.zeros((chunk, k), np.float32)
+                    mk[:n] = 1.0
+                    mask = jnp.asarray(mk)
+                self.syn0, self.syn1 = sk.cbow_step(
+                    self.syn0, self.syn1, ctx_d, cm_d,
+                    jnp.asarray(tgt_buf.copy()), lab_dev, mask, lr)
             fill = 0
 
         for _epoch in range(self.epochs):
-            for seq in seqs:
+            for si, seq in enumerate(seqs):
                 idxs = np.asarray(self._indices(seq), np.int32)
                 n = len(idxs)
                 if n < 2:
@@ -169,6 +187,17 @@ class Word2Vec(SequenceVectors):
                     continue
                 grid, valid = sk.window_grid(n, W, rng)
                 ctx = idxs[np.clip(grid, 0, n - 1)]
+                if max_extra:
+                    e = np.asarray(extra_per_seq[si], np.int32)
+                    pad = np.zeros(max_extra - len(e), np.int32)
+                    ctx = np.concatenate(
+                        [ctx, np.tile(np.concatenate([e, pad]), (n, 1))],
+                        axis=1)
+                    evalid = np.concatenate(
+                        [np.ones(len(e), bool),
+                         np.zeros(max_extra - len(e), bool)])
+                    valid = np.concatenate(
+                        [valid, np.tile(evalid, (n, 1))], axis=1)
                 seen += n
                 p = 0
                 while p < n:
