@@ -11,7 +11,6 @@ update for a batch is one fused device step.
 from __future__ import annotations
 
 import functools
-from collections import defaultdict
 from typing import Dict, Iterable, List, Sequence, Tuple
 
 import jax
@@ -58,22 +57,64 @@ class Glove(SequenceVectors):
 
     def _cooccurrences(self, seqs: List[List[int]]
                        ) -> Dict[Tuple[int, int], float]:
-        counts: Dict[Tuple[int, int], float] = defaultdict(float)
+        """Distance-weighted co-occurrence counts, vectorized: per
+        sequence the left-context pairs come from an offsets grid, then
+        one np.unique + bincount reduces all (wi, wj, 1/d) triples —
+        the per-pair Python loop collapsed to numpy (same counts)."""
+        v = max(self.vocab.num_words(), 1)
+        offs = np.arange(1, self.window_size + 1)
+        # periodic reduction bounds peak memory at O(unique pairs +
+        # reduce_every) instead of materializing every windowed pair of
+        # the corpus before one global unique
+        reduce_every = 2_000_000
+        acc_keys = np.empty(0, np.int64)
+        acc_wts = np.empty(0, np.float64)
+        pend_k: List[np.ndarray] = []
+        pend_w: List[np.ndarray] = []
+        pending = 0
+
+        def reduce_pending():
+            nonlocal acc_keys, acc_wts, pend_k, pend_w, pending
+            if not pend_k:
+                return
+            keys = np.concatenate([acc_keys] + pend_k)
+            wts = np.concatenate([acc_wts] + pend_w)
+            acc_keys, inv = np.unique(keys, return_inverse=True)
+            acc_wts = np.bincount(inv, weights=wts)
+            pend_k, pend_w, pending = [], [], 0
+
         for idxs in seqs:
-            for pos, wi in enumerate(idxs):
-                lo = max(0, pos - self.window_size)
-                for cpos in range(lo, pos):
-                    wj = idxs[cpos]
-                    inc = 1.0 / (pos - cpos)   # distance weighting
-                    counts[(wi, wj)] += inc
-                    if self.symmetric:
-                        counts[(wj, wi)] += inc
-        return counts
+            idxs = np.asarray(idxs, np.int64)
+            n = len(idxs)
+            if n < 2:
+                continue
+            grid = np.arange(n)[:, None] - offs[None, :]
+            valid = grid >= 0
+            wi = np.repeat(idxs, valid.sum(axis=1))
+            wj = idxs[grid[valid]]
+            inc = 1.0 / np.broadcast_to(
+                offs, valid.shape)[valid].astype(np.float64)
+            pend_k.append(wi * v + wj)
+            pend_w.append(inc)
+            pending += len(wi)
+            if self.symmetric:
+                pend_k.append(wj * v + wi)
+                pend_w.append(inc)
+                pending += len(wi)
+            if pending >= reduce_every:
+                reduce_pending()
+        reduce_pending()
+        return {(int(k // v), int(k % v)): float(s)
+                for k, s in zip(acc_keys, acc_wts)}
 
     def fit(self, sequences: Iterable[Sequence[str]]):
-        seqs = [list(s) for s in sequences]
+        # materialize BEFORE type-sniffing, without list()-ing strings —
+        # list("cat") is ['c','a','t'] and would build a character vocab
+        seqs = list(sequences)
         if seqs and isinstance(seqs[0], str):
             seqs = [s.split() for s in seqs]
+        else:
+            seqs = [list(s) for s in seqs]
         if self.vocab is None:
             self.build_vocab(seqs)
         idx_seqs = [self._indices(s) for s in seqs]

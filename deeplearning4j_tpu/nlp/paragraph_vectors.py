@@ -102,8 +102,10 @@ class ParagraphVectors(Word2Vec):
         per-pair Python loop — NS and HS alike."""
         from deeplearning4j_tpu.nlp.sequence_vectors import _PairStream
         W = self.window_size
+        # total already carries DBOW's x2 token factor; the pair count
+        # is ~tokens * (W + 2), so halve before scaling
         stream = _PairStream(
-            self, self._pair_chunk_size(total * (W + 2)), total)
+            self, self._pair_chunk_size((total // 2) * (W + 2)), total)
         for _ep in range(self.epochs):
             for tokens, labels in tokenized:
                 idxs = np.asarray(self._indices(tokens), np.int32)

@@ -72,12 +72,7 @@ class _PairStream:
         m = self.m
         lr = jnp.float32(m._lr(self.seen, self.total))
         if m.use_hs:
-            if n_valid == self.chunk:
-                row_valid = self._ones_row
-            else:
-                r = np.zeros(self.chunk, np.float32)
-                r[:n_valid] = 1.0
-                row_valid = jnp.asarray(r)
+            row_valid = sk.partial_mask(self._ones_row, n_valid)
             m.syn0, m.syn1 = sk.skipgram_hs_step(
                 m.syn0, m.syn1, jnp.asarray(self.cen.copy()),
                 jnp.asarray(self.ctx.copy()), m._hs_points,
@@ -88,12 +83,7 @@ class _PairStream:
             self.tgt[:n_valid, 1:] = sk.draw_negatives(
                 m._rng, m._table, self.tgt[:n_valid, 0:1], k - 1,
                 m.vocab.num_words())
-            if n_valid == self.chunk:
-                mask = self._ones_mask
-            else:
-                mk = np.zeros((self.chunk, k), np.float32)
-                mk[:n_valid] = 1.0
-                mask = jnp.asarray(mk)
+            mask = sk.partial_mask(self._ones_mask, n_valid)
             m.syn0, m.syn1 = sk.skipgram_step(
                 m.syn0, m.syn1, jnp.asarray(self.cen.copy()),
                 jnp.asarray(self.tgt.copy()), self._lab_dev, mask, lr)

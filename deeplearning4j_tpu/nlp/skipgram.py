@@ -129,6 +129,18 @@ def skipgram_hs_step(syn0: jax.Array, syn1: jax.Array,
     return skipgram_step(syn0, syn1, centers, targets, labels, mask, lr)
 
 
+def partial_mask(full_dev: jax.Array, n_valid: int) -> jax.Array:
+    """All-ones device mask when the chunk is full; else a zero-padded
+    host-built mask of the same shape — the one home for the padded-tail
+    logic shared by every vectorized flush path."""
+    shape = full_dev.shape
+    if n_valid == shape[0]:
+        return full_dev
+    m = np.zeros(shape, np.float32)
+    m[:n_valid] = 1.0
+    return jnp.asarray(m)
+
+
 def build_hs_matrices(vocab_words, max_len: int
                       ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """(points, labels=1-codes, mask) matrices padded to ``max_len`` for

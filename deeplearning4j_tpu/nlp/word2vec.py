@@ -153,12 +153,7 @@ class Word2Vec(SequenceVectors):
             ctx_d = jnp.asarray(ctx_buf.copy())
             cm_d = jnp.asarray(cmask_buf.copy())
             if hs:
-                if n == chunk:
-                    row_valid = ones_row
-                else:
-                    r = np.zeros(chunk, np.float32)
-                    r[:n] = 1.0
-                    row_valid = jnp.asarray(r)
+                row_valid = sk.partial_mask(ones_row, n)
                 self.syn0, self.syn1 = sk.cbow_hs_step(
                     self.syn0, self.syn1, ctx_d, cm_d,
                     jnp.asarray(cen_buf.copy()), self._hs_points,
@@ -167,12 +162,7 @@ class Word2Vec(SequenceVectors):
                 tgt_buf[:n, 0] = cen_buf[:n]
                 tgt_buf[:n, 1:] = sk.draw_negatives(
                     rng, table, cen_buf[:n, None], k - 1, n_words)
-                if n == chunk:
-                    mask = ones_mask
-                else:
-                    mk = np.zeros((chunk, k), np.float32)
-                    mk[:n] = 1.0
-                    mask = jnp.asarray(mk)
+                mask = sk.partial_mask(ones_mask, n)
                 self.syn0, self.syn1 = sk.cbow_step(
                     self.syn0, self.syn1, ctx_d, cm_d,
                     jnp.asarray(tgt_buf.copy()), lab_dev, mask, lr)
@@ -182,7 +172,9 @@ class Word2Vec(SequenceVectors):
             for si, seq in enumerate(seqs):
                 idxs = np.asarray(self._indices(seq), np.int32)
                 n = len(idxs)
-                if n < 2:
+                # with label columns (DM) even a 1-token doc trains its
+                # label vector (slow-path parity); without, need a window
+                if n < 1 or (n < 2 and not max_extra):
                     seen += n
                     continue
                 grid, valid = sk.window_grid(n, W, rng)

@@ -178,3 +178,14 @@ class TestVectorizers:
         assert mat.shape == (3, v.vocab.num_words())
         cat_col = v.vocab.index_of("cat")
         assert mat[0, cat_col] > 0 and mat[1, cat_col] == 0
+
+
+def test_glove_accepts_raw_strings():
+    """Regression: raw-string corpora must tokenize by whitespace, not
+    decompose into characters (list('cat') == ['c','a','t'])."""
+    from deeplearning4j_tpu.nlp.glove import Glove
+    g = Glove(layer_size=8, window_size=3, epochs=2,
+              min_word_frequency=1, seed=5)
+    g.fit(["the cat sat on the mat", "the dog sat on the rug"] * 4)
+    assert g.has_word("cat") and g.has_word("dog")
+    assert not g.has_word("c")
