@@ -62,6 +62,26 @@ _V3_VAR_NAMES = {
 }
 
 _V3_RNN = {"LSTM", "GRU", "SimpleRNN"}
+
+# Layer classes known to carry NO variables: an empty weight dict is
+# legitimate for these and only these. Anything else with a config entry
+# but no resolvable weights dir is a layout mismatch — importing random
+# init weights silently would violate the refuse-loudly policy.
+_V3_STATELESS = {
+    "InputLayer", "Dropout", "SpatialDropout1D", "SpatialDropout2D",
+    "SpatialDropout3D", "GaussianDropout", "GaussianNoise", "AlphaDropout",
+    "Flatten", "Reshape", "Permute", "RepeatVector", "Activation",
+    "LeakyReLU", "ELU", "ThresholdedReLU", "ReLU", "Softmax", "Lambda",
+    "Masking", "Add", "Subtract", "Multiply", "Average", "Maximum",
+    "Minimum", "Concatenate", "Dot", "MaxPooling1D", "MaxPooling2D",
+    "MaxPooling3D", "AveragePooling1D", "AveragePooling2D",
+    "AveragePooling3D", "GlobalMaxPooling1D", "GlobalMaxPooling2D",
+    "GlobalMaxPooling3D", "GlobalAveragePooling1D",
+    "GlobalAveragePooling2D", "GlobalAveragePooling3D", "ZeroPadding1D",
+    "ZeroPadding2D", "ZeroPadding3D", "Cropping1D", "Cropping2D",
+    "Cropping3D", "UpSampling1D", "UpSampling2D", "UpSampling3D",
+    "SpaceToDepth", "LRN", "LRN2D", "PoolHelper",
+}
 _V3_MHA_SUBS = (("query_dense", "query"), ("key_dense", "key"),
                 ("value_dense", "value"),
                 ("output_dense", "attention_output"))
@@ -206,14 +226,25 @@ class Hdf5Archive:
         entry = self._v3_dirs.get(layer_name)
         if entry is None:
             return {}
+        cls = entry["layer"]["class_name"]
+        lcfg = entry["layer"].get("config", {})
         # 3.x writes "layers/"; some 3.0-era files used
         # "_layer_checkpoint_dependencies/"
         root = ("layers" if self.has_group("layers")
                 else "_layer_checkpoint_dependencies")
         if not self.has_group(root, entry["dir"]):
-            return {}
-        cls = entry["layer"]["class_name"]
-        lcfg = entry["layer"].get("config", {})
+            if cls in _V3_STATELESS:
+                return {}
+            # a weighted layer whose dir can't be found is a layout
+            # mismatch (different Keras-3 naming, nested sub-model,
+            # shared layer) — importing random init weights silently
+            # would be wrong with no error
+            raise ValueError(
+                f".keras layer {layer_name!r} ({cls}) should carry "
+                f"weights but no '{root}/{entry['dir']}' group exists "
+                "in model.weights.h5; unsupported .keras layout "
+                "(nested sub-model / shared layer / different Keras-3 "
+                "naming?)")
         base = (root, entry["dir"])
         out: Dict[str, np.ndarray] = {}
 
@@ -227,10 +258,11 @@ class Hdf5Archive:
                     f"{len(arrs)} saved variables but only {len(names)} "
                     f"are understood ({names}); unsupported layer state")
             for n, a in zip(names, arrs):
+                # prefixed (multi-sublayer) classes emit ONLY qualified
+                # keys: a bare-leaf alias would resolve 'kernel' to the
+                # first sublayer's array (MHA query vs key) for any
+                # consumer keying by last path component
                 out[prefix + n if not prefix else f"{prefix}/{n}"] = a
-                if prefix:
-                    out.setdefault(n, a)   # leaf alias (may collide; the
-                    # qualified key above stays authoritative)
 
         if cls == "MultiHeadAttention":
             for sub, alias in _V3_MHA_SUBS:
@@ -252,6 +284,11 @@ class Hdf5Archive:
                 else:
                     names = [f"var_{i}" for i in range(len(arrs))]
             put(names, arrs)
+        if not out and cls not in _V3_STATELESS:
+            raise ValueError(
+                f".keras layer {layer_name!r} ({cls}) should carry "
+                "weights but none were found under "
+                f"'{root}/{entry['dir']}'; unsupported .keras layout")
         return out
 
     def layer_weights(self, layer_name: str) -> Dict[str, np.ndarray]:

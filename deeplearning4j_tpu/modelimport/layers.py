@@ -41,11 +41,17 @@ from deeplearning4j_tpu.nn.layers.convolution import (
     ZeroPadding1DLayer,
     ZeroPaddingLayer,
 )
+from deeplearning4j_tpu.nn.dropout import (
+    GaussianDropout as GaussianDropoutNoise,
+    GaussianNoise as AdditiveGaussianNoise,
+)
 from deeplearning4j_tpu.nn.layers.feedforward import (
     ActivationLayer,
     DenseLayer,
     DropoutLayer,
     EmbeddingSequenceLayer,
+    PermuteLayer,
+    ReshapeLayer,
 )
 from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
 from deeplearning4j_tpu.nn.layers.normalization import (
@@ -369,6 +375,43 @@ def flatten(cfg, _v):
     return Converted(skip=True)
 
 
+def reshape(cfg, _v):
+    """Keras Reshape honoring target_shape (reference: KerasReshape.java:40
+    materializes the target shape — never a silent skip)."""
+    target = cfg.get("target_shape")
+    if not target:
+        raise ValueError("Reshape layer missing target_shape")
+    return Converted(layer=ReshapeLayer(shape=tuple(int(d) for d in target)))
+
+
+def permute(cfg, _v):
+    """Keras Permute: real axis transpose of the non-batch dims
+    (1-indexed, reference: KerasPermute.java)."""
+    dims = cfg.get("dims")
+    if not dims:
+        raise ValueError("Permute layer missing dims")
+    return Converted(layer=PermuteLayer(dims=tuple(int(d) for d in dims)))
+
+
+def gaussian_noise(cfg, _v):
+    """Additive gaussian noise — NOT a dropout (the two regularize
+    differently at train time; reference: KerasGaussianNoise.java maps to
+    conf/dropout/GaussianNoise)."""
+    return Converted(layer=DropoutLayer(
+        dropout=AdditiveGaussianNoise(stddev=float(cfg.get("stddev",
+                                                           cfg.get("sigma",
+                                                                   0.1))))))
+
+
+def gaussian_dropout(cfg, _v):
+    """Multiplicative N(1, rate/(1-rate)) noise (reference:
+    KerasGaussianDropout.java → conf/dropout/GaussianDropout)."""
+    return Converted(layer=DropoutLayer(
+        dropout=GaussianDropoutNoise(rate=float(cfg.get("rate",
+                                                        cfg.get("p",
+                                                                0.5))))))
+
+
 def input_layer(cfg, _v):
     return Converted(skip=True)
 
@@ -627,12 +670,12 @@ CONVERTERS: Dict[str, Callable[[dict, int], Converted]] = {
     "Activation": activation,
     "LeakyReLU": leaky_relu,
     "Dropout": dropout, "SpatialDropout2D": dropout,
-    "GaussianDropout": dropout, "GaussianNoise": dropout,
+    "GaussianDropout": gaussian_dropout, "GaussianNoise": gaussian_noise,
     "Embedding": embedding,
     "LSTM": lstm,
     "SimpleRNN": simple_rnn,
     "Bidirectional": bidirectional,
-    "Flatten": flatten, "Reshape": flatten, "Permute": flatten,
+    "Flatten": flatten, "Reshape": reshape, "Permute": permute,
     "InputLayer": input_layer, "Input": input_layer,
     "ZeroPadding2D": zero_padding2d,
     "ZeroPadding1D": zero_padding1d,

@@ -67,16 +67,26 @@ def skipgram_step(syn0: jax.Array, syn1: jax.Array,
     dh = jnp.einsum("bk,bkd->bd", g, w)                # grad wrt syn0 rows
     dw = g[..., None] * h[:, None, :]                  # [B, K, D]
     d = syn0.shape[1]
-    syn1 = _clipped_scatter(syn1, targets.reshape(-1), dw.reshape(-1, d))
-    syn0 = _clipped_scatter(syn0, centers, dh)
+    mr = _max_row_norm(lr, d)
+    syn1 = _clipped_scatter(syn1, targets.reshape(-1), dw.reshape(-1, d),
+                            mr)
+    syn0 = _clipped_scatter(syn0, centers, dh, mr)
     return syn0, syn1
 
 
-_MAX_ROW_UPDATE = 1.0
+# Divergence-guard clip, scaled with lr and layer size: at word2vec.c
+# defaults (lr=0.025, D=100) this reproduces the old absolute threshold
+# of 1.0, but high-lr or large-D configs no longer have legitimate
+# per-chunk updates silently clipped (advisor r2).
+_CLIP_COEF = 4.0
+
+
+def _max_row_norm(lr: jax.Array, d: int) -> jax.Array:
+    return _CLIP_COEF * lr * jnp.sqrt(jnp.float32(d))
 
 
 def _clipped_scatter(table: jax.Array, idx: jax.Array,
-                     upd: jax.Array) -> jax.Array:
+                     upd: jax.Array, max_norm: jax.Array) -> jax.Array:
     """table[idx] += updates, with each destination row's accumulated
     update norm-clipped (see module docstring). Segment-sum over the
     sorted update rows — no dense [V, D] temporaries, so cost scales
@@ -97,7 +107,7 @@ def _clipped_scatter(table: jax.Array, idx: jax.Array,
                    jnp.take(cs, jnp.maximum(lo_idx - 1, 0), axis=0), 0.0)
     total = hi - lo                                   # segment sum, per row
     norm = jnp.linalg.norm(total, axis=-1, keepdims=True)
-    scale = jnp.minimum(1.0, _MAX_ROW_UPDATE / jnp.maximum(norm, 1e-12))
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
     # scatter each segment's total exactly ONCE (at its last element);
     # every other duplicate index contributes an exact 0.0. XLA's scatter
     # applies duplicate-index float adds in nondeterministic order, which
@@ -177,9 +187,11 @@ def cbow_step(syn0: jax.Array, syn1: jax.Array,
     dh = jnp.einsum("bk,bkd->bd", g, w) / denom          # [B, D]
     dw = g[..., None] * h[:, None, :]
     d = syn0.shape[1]
-    syn1 = _clipped_scatter(syn1, targets.reshape(-1), dw.reshape(-1, d))
+    mr = _max_row_norm(lr, d)
+    syn1 = _clipped_scatter(syn1, targets.reshape(-1), dw.reshape(-1, d),
+                            mr)
     dctx = (dh[:, None, :] * context_mask[..., None]).reshape(-1, d)
-    syn0 = _clipped_scatter(syn0, context.reshape(-1), dctx)
+    syn0 = _clipped_scatter(syn0, context.reshape(-1), dctx, mr)
     return syn0, syn1
 
 
@@ -274,7 +286,7 @@ def infer_step(docvec: jax.Array,        # [D] the one trainable vector
     # pre-update docvec — the worst case of the duplicate-sum divergence
     # _clipped_scatter guards against; clip it the same way
     norm = jnp.maximum(jnp.linalg.norm(upd), 1e-12)
-    upd = upd * jnp.minimum(1.0, _MAX_ROW_UPDATE / norm)
+    upd = upd * jnp.minimum(1.0, _max_row_norm(lr, docvec.shape[0]) / norm)
     return docvec + upd.astype(docvec.dtype)
 
 

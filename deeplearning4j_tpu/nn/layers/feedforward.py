@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.nn.inputs import (
+    ConvolutionalType,
     FeedForwardType,
     InputType,
     RecurrentType,
@@ -107,6 +108,102 @@ class EmbeddingSequenceLayer(FeedForwardLayer):
         if idx.ndim == 3 and idx.shape[-1] == 1:
             idx = idx[..., 0]
         return jnp.take(params["W"], idx, axis=0), state
+
+
+def _type_for_trailing(shape):
+    """Trailing (non-batch) dims → InputType, same family mapping as
+    ReshapeVertex (1 → FF, 2 → (T, F) recurrent, 3 → NHWC conv)."""
+    if len(shape) == 1:
+        return FeedForwardType(shape[0])
+    if len(shape) == 2:
+        return RecurrentType(shape[1], shape[0])
+    if len(shape) == 3:
+        return ConvolutionalType(shape[0], shape[1], shape[2])
+    raise ValueError(f"unsupported shape arity: {shape}")
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class ReshapeLayer(Layer):
+    """Reshape the trailing (non-batch) dims to ``shape``; one -1 allowed.
+
+    Row-major (C-order) element order, matching Keras ``Reshape`` — the
+    reference materializes that layer's ``target_shape`` via a dedicated
+    preprocessor (KerasReshape.java:40,67); here it is a first-class
+    shape-only layer."""
+    shape: tuple = ()
+
+    @property
+    def has_params(self):
+        return False
+
+    def resolved_shape(self, input_type: InputType):
+        total = 1
+        for d in input_type.shape():
+            if d < 0:
+                raise ValueError(
+                    "ReshapeLayer needs a fully-known input shape; got "
+                    f"{input_type.shape()} (unknown timesteps)")
+            total *= d
+        s = [int(v) for v in self.shape]
+        if s.count(-1) > 1:
+            raise ValueError(f"ReshapeLayer shape {s} has multiple -1s")
+        known = 1
+        for v in s:
+            if v != -1:
+                known *= v
+        if -1 in s:
+            if known == 0 or total % known:
+                raise ValueError(
+                    f"cannot infer -1 in reshape {s} from {total} elements")
+            s[s.index(-1)] = total // known
+        elif known != total:
+            raise ValueError(
+                f"reshape {tuple(s)} incompatible with input "
+                f"{input_type.shape()} ({total} elements)")
+        return tuple(s)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return _type_for_trailing(self.resolved_shape(input_type))
+
+    def apply(self, params, state, x, ctx):
+        s = [int(v) for v in self.shape]
+        return x.reshape((x.shape[0],) + tuple(s)), state
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
+class PermuteLayer(Layer):
+    """Transpose the trailing (non-batch) dims by 1-indexed ``dims``
+    (Keras ``Permute`` convention: dims=(2, 1) swaps the first two
+    non-batch axes). The reference silently lacks this — KerasReshape.java
+    is its closest relative; we implement the real transpose."""
+    dims: tuple = ()
+
+    @property
+    def has_params(self):
+        return False
+
+    def _perm(self, rank: int):
+        dims = tuple(int(d) for d in self.dims)
+        if sorted(dims) != list(range(1, rank + 1)):
+            raise ValueError(
+                f"PermuteLayer dims {dims} is not a permutation of "
+                f"1..{rank}")
+        return dims
+
+    def output_type(self, input_type: InputType) -> InputType:
+        shape = input_type.shape()
+        if any(d < 0 for d in shape):
+            raise ValueError(
+                "PermuteLayer needs a fully-known input shape; got "
+                f"{shape} (unknown timesteps)")
+        dims = self._perm(len(shape))
+        return _type_for_trailing(tuple(shape[d - 1] for d in dims))
+
+    def apply(self, params, state, x, ctx):
+        dims = self._perm(x.ndim - 1)
+        return x.transpose((0,) + dims), state
 
 
 @register_serializable

@@ -12,12 +12,18 @@ ThreadingHTTPServer + one self-contained HTML page drawing charts on a
 from __future__ import annotations
 
 import json
+import math
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 from urllib.parse import parse_qs, urlparse
 
 from deeplearning4j_tpu.ui.storage import StatsStorage
+
+# Upload cap for POST bodies (t-SNE coords / remote-routed records): the
+# dashboard binds localhost, but an unbounded Content-Length read could
+# still exhaust memory on a bad client.
+_MAX_UPLOAD_BYTES = 8 << 20
 
 _PAGE = """<!doctype html>
 <html><head><title>deeplearning4j_tpu training UI</title><style>
@@ -297,19 +303,35 @@ class _Handler(BaseHTTPRequestHandler):
             sess = ids[-1] if ids else None
         return sess
 
+    def _read_json_body(self):
+        """Parse the POST body, enforcing the upload cap (negative
+        Content-Length would make ``read(-1)`` slurp to EOF — reject it
+        with the oversize case). Returns None after sending a 413."""
+        n = int(self.headers.get("Content-Length", 0))
+        if n < 0 or n > _MAX_UPLOAD_BYTES:
+            self._json({"error": f"bad payload size ({n} bytes; "
+                        f"cap {_MAX_UPLOAD_BYTES})"}, 413)
+            return None
+        return json.loads(self.rfile.read(n) or b"{}")
+
     def do_POST(self):
         path = urlparse(self.path).path
         if path == "/api/tsne":
             # TsneModule analog: upload 2-D coordinates (+labels) to plot
             try:
-                n = int(self.headers.get("Content-Length", 0))
-                payload = json.loads(self.rfile.read(n) or b"{}")
+                payload = self._read_json_body()
+                if payload is None:
+                    return
                 pts = payload.get("points", [])
                 if not all(isinstance(p, (list, tuple)) and len(p) == 2
                            for p in pts):
                     raise ValueError("points must be [x, y] pairs")
+                coords = [[float(a), float(b)] for a, b in pts]
+                if not all(math.isfinite(a) and math.isfinite(b)
+                           for a, b in coords):
+                    raise ValueError("points must be finite numbers")
                 self.server.tsne_data = {
-                    "points": [[float(a), float(b)] for a, b in pts],
+                    "points": coords,
                     "labels": [str(l) for l in payload.get("labels", [])],
                 }
             except (ValueError, TypeError, json.JSONDecodeError) as e:
@@ -322,8 +344,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._json({"error": "not found"}, 404)
             return
         try:
-            n = int(self.headers.get("Content-Length", 0))
-            payload = json.loads(self.rfile.read(n) or b"{}")
+            payload = self._read_json_body()
+            if payload is None:
+                return
             record = payload.get("record", {})
             if "session_id" not in record:
                 raise ValueError("record missing session_id")

@@ -330,6 +330,46 @@ def k2_yolo_bits():
     save_io("k2_yolo_bits", x, softmax(dense(h, Wd, bd)))
 
 
+def k2_reshape_permute():
+    """Non-flat Reshape + (2,1) Permute + GaussianNoise: the layers round
+    2 imported silently-wrong (VERDICT r2 missing #1). GaussianNoise is
+    inference-inert; Reshape/Permute change every downstream value, so
+    the expected output catches a skip immediately."""
+    Wc = RNG.normal(0, 0.3, (3, 3, 2, 3))
+    bc = RNG.normal(0, 0.05, (3,))
+    Wd = RNG.normal(0, 0.2, (8, 3))
+    bd = RNG.normal(0, 0.05, (3,))
+    cfg = [
+        {"class_name": "Conv2D", "config": {
+            "name": "conv2d_1", "filters": 3, "kernel_size": [3, 3],
+            "strides": [1, 1], "padding": "valid", "activation": "relu",
+            "use_bias": True, "batch_input_shape": [None, 6, 6, 2]}},
+        {"class_name": "GaussianNoise", "config": {
+            "name": "gaussian_noise_1", "stddev": 0.3}},
+        {"class_name": "Reshape", "config": {
+            "name": "reshape_1", "target_shape": [8, 6]}},
+        {"class_name": "Permute", "config": {
+            "name": "permute_1", "dims": [2, 1]}},
+        {"class_name": "GlobalMaxPooling1D", "config": {
+            "name": "global_max_pooling1d_1"}},
+        {"class_name": "Dense", "config": {
+            "name": "dense_1", "units": 3, "activation": "softmax",
+            "use_bias": True}},
+    ]
+    weights = {"conv2d_1": {"kernel": Wc, "bias": bc},
+               "gaussian_noise_1": {}, "reshape_1": {}, "permute_1": {},
+               "global_max_pooling1d_1": {},
+               "dense_1": {"kernel": Wd, "bias": bd}}
+    write_k2(os.path.join(HERE, "k2_reshape_permute.h5"), cfg, weights,
+             {"loss": "categorical_crossentropy"})
+    x = RNG.normal(0, 1, (4, 6, 6, 2))
+    h = relu(conv2d_valid(x, Wc, bc))       # 4x4x3
+    h = h.reshape(h.shape[0], 8, 6)         # non-flat Reshape
+    h = h.transpose(0, 2, 1)                # Permute (2,1) -> (6, 8)
+    h = h.max(axis=1)                       # GlobalMaxPooling1D -> 8
+    save_io("k2_reshape_permute", x, softmax(dense(h, Wd, bd)))
+
+
 def k2_temporal():
     """ZeroPadding1D + dilated Conv1D + UpSampling1D."""
     F = 3
@@ -369,6 +409,6 @@ def k2_temporal():
 
 if __name__ == "__main__":
     for fn in (k1_mlp, k1_cnn_atrous, k1_lstm, k2_googlenet_bits,
-               k2_yolo_bits, k2_temporal):
+               k2_yolo_bits, k2_temporal, k2_reshape_permute):
         fn()
         print("wrote", fn.__name__)

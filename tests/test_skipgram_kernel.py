@@ -8,11 +8,13 @@ import numpy as np
 import pytest
 
 from deeplearning4j_tpu.nlp.skipgram import (
-    _MAX_ROW_UPDATE,
     _clipped_scatter,
+    _max_row_norm,
     infer_step,
     skipgram_step,
 )
+
+_CLIP = jnp.float32(1.0)
 
 
 def test_unique_rows_match_plain_scatter():
@@ -20,7 +22,7 @@ def test_unique_rows_match_plain_scatter():
     table = jnp.asarray(rng.normal(size=(10, 4)).astype(np.float32))
     idx = jnp.asarray([1, 3, 7], np.int32)
     upd = jnp.asarray(rng.normal(0, 0.01, (3, 4)).astype(np.float32))
-    got = _clipped_scatter(table, idx, upd)
+    got = _clipped_scatter(table, idx, upd, _CLIP)
     ref = table.at[idx].add(upd)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=1e-6, atol=1e-7)
@@ -33,19 +35,19 @@ def test_duplicate_rows_sum_below_threshold():
     idx = jnp.asarray([2, 2, 2, 1], np.int32)
     upd = jnp.asarray([[0.1, 0, 0], [0.1, 0, 0], [0.1, 0, 0],
                        [0, 0.2, 0]], np.float32)
-    got = np.asarray(_clipped_scatter(table, idx, upd))
+    got = np.asarray(_clipped_scatter(table, idx, upd, _CLIP))
     np.testing.assert_allclose(got[2], [0.3, 0, 0], rtol=1e-6)
     np.testing.assert_allclose(got[1], [0, 0.2, 0], rtol=1e-6)
 
 
 def test_duplicate_rows_clip_above_threshold():
     """A row whose accumulated update exceeds the threshold moves by
-    exactly _MAX_ROW_UPDATE in the same direction."""
+    exactly the clip norm in the same direction."""
     table = jnp.zeros((4, 3))
     idx = jnp.asarray([0] * 8, np.int32)
     upd = jnp.full((8, 3), 1.0, jnp.float32)   # sum norm = 8*sqrt(3)
-    got = np.asarray(_clipped_scatter(table, idx, upd))
-    np.testing.assert_allclose(np.linalg.norm(got[0]), _MAX_ROW_UPDATE,
+    got = np.asarray(_clipped_scatter(table, idx, upd, _CLIP))
+    np.testing.assert_allclose(np.linalg.norm(got[0]), float(_CLIP),
                                rtol=1e-5)
     # direction preserved
     np.testing.assert_allclose(got[0] / np.linalg.norm(got[0]),
@@ -85,7 +87,8 @@ def test_infer_step_clipped():
     mask = jnp.ones((64, 4), jnp.float32)
     out = infer_step(docvec, syn1, targets, labels, mask,
                      jnp.float32(1.0))
-    assert float(jnp.linalg.norm(out)) <= _MAX_ROW_UPDATE + 1e-5
+    clip = float(_max_row_norm(jnp.float32(1.0), 8))
+    assert float(jnp.linalg.norm(out)) <= clip + 1e-4
     assert np.isfinite(np.asarray(out)).all()
 
 
