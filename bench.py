@@ -34,8 +34,11 @@ def main():
         # batch 256 is the measured sweet spot on v5e at 64x64: per-layer
         # activations stay VMEM-resident, relieving the HBM-bandwidth
         # bound (benchmarks/flag_sweep.py: 256->39.2k, 512->35.0k,
-        # 1024->33k, 2048->28.5k img/s)
-        batch, k, dispatches, warmup = 256, 64, 3, 1
+        # 1024->33k, 2048->28.5k img/s). K=256 steps/dispatch shrinks the
+        # ~26-30 ms tunnel overhead to ~0.1 ms/step: the hardware profile
+        # (PERF_ANALYSIS.md r3) puts the device-side step at 6.06 ms —
+        # 42.2k img/s is this config's device ceiling.
+        batch, k, dispatches, warmup = 256, 256, 2, 1
         compute_dtype = "bfloat16"
     else:
         batch, k, dispatches, warmup = 16, 2, 2, 1

@@ -1,0 +1,79 @@
+"""Bound the ResNet50 win available from fusing BN batch-stat traffic.
+
+Three step variants at the bench config (batch 256, K steps/dispatch):
+  base    — real BatchNormalization (batch stats fwd, recomputed bwd)
+  frozen  — BN uses running stats (pure elementwise; XLA fuses it into
+            neighbors completely). Upper bound for ANY conv+BN fusion
+            kernel: no fusion can beat deleting the stats entirely.
+  nobn    — BN replaced by identity. Bounds the whole BN cost incl. the
+            scale/shift elementwise math.
+
+If frozen ≈ base, the Pallas conv+BN fusion lever is dead and the
+remaining gap is conv-intrinsic; if frozen >> base, the kernel is worth
+building (VERDICT r3 #1).
+"""
+
+import dataclasses as dc
+import json
+import time
+
+import numpy as np
+
+
+def build_step(mode: str, batch: int, k: int):
+    import jax.numpy as jnp
+    import jax.random as jrandom
+    from deeplearning4j_tpu.nn.layers import normalization as nz
+    from deeplearning4j_tpu.optimize.solver import make_scan_train_step
+    from deeplearning4j_tpu.optimize.updaters import Nesterovs
+    from deeplearning4j_tpu.zoo.models import ResNet50
+
+    orig_apply = nz.BatchNormalization.apply
+    if mode == "frozen":
+        def patched(self, params, state, x, ctx):
+            return orig_apply(dc.replace(self, use_global_stats_in_train=True),
+                              params, state, x, ctx)
+        nz.BatchNormalization.apply = patched
+    elif mode == "nobn":
+        def patched(self, params, state, x, ctx):
+            return x, state
+        nz.BatchNormalization.apply = patched
+    try:
+        model = ResNet50(num_classes=200, height=64, width=64, channels=3,
+                         compute_dtype="bfloat16",
+                         updater=Nesterovs(1e-2, 0.9)).init()
+
+        def loss_fn(params, mstate, feats, labels, fmask, lmask, rng, it):
+            return model._loss(params, mstate, (feats,), (labels,), fmask,
+                               lmask, rng, it)
+
+        steps_fn = make_scan_train_step(loss_fn, model._tx)
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(batch, 64, 64, 3))
+                        .astype(np.float32))
+        y = np.zeros((batch, 200), np.float32)
+        y[np.arange(batch), rng.integers(0, 200, batch)] = 1.0
+        xs = jnp.broadcast_to(x, (k,) + x.shape)
+        ys = jnp.broadcast_to(jnp.asarray(y), (k, batch, 200))
+        key = jrandom.PRNGKey(0)
+        ts = model.train_state
+        ts, losses = steps_fn(ts, xs, ys, None, None, key)
+        float(np.asarray(losses[-1]))
+        n = 3
+        t0 = time.perf_counter()
+        for i in range(n):
+            ts, losses = steps_fn(ts, xs, ys, None, None,
+                                  jrandom.fold_in(key, i))
+        float(np.asarray(losses[-1]))
+        dt = time.perf_counter() - t0
+        return n * k * batch / dt
+    finally:
+        nz.BatchNormalization.apply = orig_apply
+
+
+if __name__ == "__main__":
+    batch, k = 256, 64
+    for mode in ("base", "frozen", "nobn"):
+        ips = build_step(mode, batch, k)
+        print(json.dumps({"mode": mode, "batch": batch, "k": k,
+                          "img_per_sec": round(ips, 1)}))

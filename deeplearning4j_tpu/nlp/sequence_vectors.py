@@ -26,68 +26,83 @@ from deeplearning4j_tpu.nlp.vocab import Huffman, VocabCache, VocabConstructor
 class _PairStream:
     """Chunked (center, context) consumer for the vectorized SGNS/HS
     paths (used by SequenceVectors and ParagraphVectors' DBOW): buffers
-    pushed pair arrays and flushes one donated device step per chunk.
-    ``seen`` is advanced by the producer; the lr anneal reads it at each
-    flush (word2vec.c's linear decay)."""
+    ``depth`` chunks of pushed pair arrays and flushes them as ONE
+    scanned device dispatch (sk.skipgram_scan_step) — the scan applies
+    the chunks sequentially (same math as chunk-at-a-time) while
+    amortizing the per-dispatch transport overhead depth× and letting
+    the host build the next superchunk while the device drains this
+    one. ``seen`` is advanced by the producer; the lr anneal snapshots
+    it per chunk (word2vec.c's linear decay)."""
 
-    def __init__(self, model, chunk: int, total_words: int):
+    DEPTH = 8
+
+    def __init__(self, model, chunk: int, total_words: int,
+                 depth: int = DEPTH):
         self.m = model
         self.chunk = chunk
+        self.depth = depth
         self.total = total_words
         self.seen = 0
-        self.cen = np.zeros(chunk, np.int32)
-        self.ctx = np.zeros(chunk, np.int32)
-        self.fill = 0
+        self.cen = np.zeros((depth, chunk), np.int32)
+        self.ctx = np.zeros((depth, chunk), np.int32)
+        self.nv = np.zeros(depth, np.int32)
+        self.lrs = np.zeros(depth, np.float32)
+        self.d = 0          # chunks filled
+        self.fill = 0       # rows filled in the current chunk
         if model.use_hs:
             model._ensure_hs_matrices()
-            self._ones_row = jnp.ones((chunk,), jnp.float32)
-        else:
-            k = 1 + model.negative
-            self.tgt = np.zeros((chunk, k), np.int32)
-            lab = np.zeros((chunk, k), np.float32)
-            lab[:, 0] = 1.0
-            # labels never change and the mask is all-ones except on the
-            # final partial chunk: keep both device-resident instead of
-            # re-uploading megabytes per step
-            self._lab_dev = jnp.asarray(lab)
-            self._ones_mask = jnp.ones((chunk, k), jnp.float32)
 
     def push(self, centers: np.ndarray, contexts: np.ndarray):
         p = 0
         while p < len(centers):
             take = min(self.chunk - self.fill, len(centers) - p)
-            self.cen[self.fill:self.fill + take] = centers[p:p + take]
-            self.ctx[self.fill:self.fill + take] = contexts[p:p + take]
+            self.cen[self.d, self.fill:self.fill + take] = \
+                centers[p:p + take]
+            self.ctx[self.d, self.fill:self.fill + take] = \
+                contexts[p:p + take]
             self.fill += take
             p += take
             if self.fill == self.chunk:
-                self._flush(self.chunk)
+                self._seal_chunk()
+
+    def _seal_chunk(self):
+        self.nv[self.d] = self.fill
+        self.lrs[self.d] = self.m._lr(self.seen, self.total)
+        self.d += 1
+        self.fill = 0
+        if self.d == self.depth:
+            self._flush()
 
     def finish(self):
-        self._flush(self.fill)
+        if self.fill:
+            self._seal_chunk()
+        self._flush()
 
-    def _flush(self, n_valid: int):
-        if n_valid == 0:
+    def _flush(self):
+        if self.d == 0:
             return
         m = self.m
-        lr = jnp.float32(m._lr(self.seen, self.total))
+        self.nv[self.d:] = 0                 # unused chunks are inert
+        self.lrs[self.d:] = 0.0
         if m.use_hs:
-            row_valid = sk.partial_mask(self._ones_row, n_valid)
-            m.syn0, m.syn1 = sk.skipgram_hs_step(
+            m.syn0, m.syn1 = sk.skipgram_hs_scan_step(
                 m.syn0, m.syn1, jnp.asarray(self.cen.copy()),
                 jnp.asarray(self.ctx.copy()), m._hs_points,
-                m._hs_labels, m._hs_mask, row_valid, lr)
+                m._hs_labels, m._hs_mask, jnp.asarray(self.nv.copy()),
+                jnp.asarray(self.lrs.copy()))
         else:
             k = 1 + m.negative
-            self.tgt[:n_valid, 0] = self.ctx[:n_valid]
-            self.tgt[:n_valid, 1:] = sk.draw_negatives(
-                m._rng, m._table, self.tgt[:n_valid, 0:1], k - 1,
+            tgt = np.zeros((self.depth, self.chunk, k), np.int32)
+            tgt[..., 0] = self.ctx
+            flat = tgt.reshape(-1, k)
+            flat[:, 1:] = sk.draw_negatives(
+                m._rng, m._table, flat[:, 0:1], k - 1,
                 m.vocab.num_words())
-            mask = sk.partial_mask(self._ones_mask, n_valid)
-            m.syn0, m.syn1 = sk.skipgram_step(
+            m.syn0, m.syn1 = sk.skipgram_scan_step(
                 m.syn0, m.syn1, jnp.asarray(self.cen.copy()),
-                jnp.asarray(self.tgt.copy()), self._lab_dev, mask, lr)
-        self.fill = 0
+                jnp.asarray(tgt), jnp.asarray(self.nv.copy()),
+                jnp.asarray(self.lrs.copy()))
+        self.d = 0
 
 
 class SequenceVectors:

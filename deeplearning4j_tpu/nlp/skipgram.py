@@ -51,13 +51,12 @@ import jax.numpy as jnp
 import numpy as np
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
-def skipgram_step(syn0: jax.Array, syn1: jax.Array,
-                  centers: jax.Array,      # [B] int32
-                  targets: jax.Array,      # [B, K] int32
-                  labels: jax.Array,       # [B, K] float32 (1=pos, 0=neg)
-                  mask: jax.Array,         # [B, K] float32
-                  lr: jax.Array) -> Tuple[jax.Array, jax.Array]:
+def _sg_update(syn0: jax.Array, syn1: jax.Array,
+               centers: jax.Array,      # [B] int32
+               targets: jax.Array,      # [B, K] int32
+               labels: jax.Array,       # [B, K] float32 (1=pos, 0=neg)
+               mask: jax.Array,         # [B, K] float32
+               lr: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """One batched SkipGram update (negative sampling or hierarchical
     softmax — identical math, different targets/labels)."""
     h = syn0[centers]                                  # [B, D]
@@ -72,6 +71,10 @@ def skipgram_step(syn0: jax.Array, syn1: jax.Array,
                             mr)
     syn0 = _clipped_scatter(syn0, centers, dh, mr)
     return syn0, syn1
+
+
+skipgram_step = functools.partial(jax.jit, donate_argnums=(0, 1))(
+    _sg_update)
 
 
 # Divergence-guard clip, scaled with lr and layer size: at word2vec.c
@@ -90,34 +93,44 @@ def _clipped_scatter(table: jax.Array, idx: jax.Array,
     """table[idx] += updates, with each destination row's accumulated
     update norm-clipped (see module docstring). Segment-sum over the
     sorted update rows — no dense [V, D] temporaries, so cost scales
-    with the batch, not the vocabulary."""
+    with the batch, not the vocabulary.
+
+    Every step here is duplicate-free by construction: segment bounds
+    come from cummax/cummin over the sorted order (a scatter-max with
+    duplicate indices lowers to a SERIAL per-element loop on TPU —
+    profiled at ~48 ms per 64k-pair chunk, 50× the rest of the step),
+    and the final scatter-add lands each segment total on its unique
+    destination row while every other element targets its own slot in
+    a dump area past the table, so XLA vectorizes the scatter AND the
+    result stays bitwise deterministic (exactly one add per live row)."""
     b = idx.shape[0]
     order = jnp.argsort(idx)
     sid = jnp.take(idx, order)
     supd = jnp.take(upd, order, axis=0).astype(jnp.float32)
-    first = jnp.concatenate([jnp.ones((1,), bool), sid[1:] != sid[:-1]])
-    seg = jnp.cumsum(first) - 1                       # per-element segment
     pos = jnp.arange(b)
-    seg_end = jnp.zeros((b,), pos.dtype).at[seg].max(pos)
-    seg_start = jnp.full((b,), b - 1, pos.dtype).at[seg].min(pos)
-    cs = jnp.cumsum(supd, axis=0)
-    hi = jnp.take(cs, jnp.take(seg_end, seg), axis=0)
-    lo_idx = jnp.take(seg_start, seg)
-    lo = jnp.where((lo_idx > 0)[:, None],
-                   jnp.take(cs, jnp.maximum(lo_idx - 1, 0), axis=0), 0.0)
-    total = hi - lo                                   # segment sum, per row
-    norm = jnp.linalg.norm(total, axis=-1, keepdims=True)
-    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
-    # scatter each segment's total exactly ONCE (at its last element);
-    # every other duplicate index contributes an exact 0.0. XLA's scatter
-    # applies duplicate-index float adds in nondeterministic order, which
-    # made training runs differ at the last bit and drift apart — with at
-    # most one nonzero add per destination row the result is bitwise
-    # deterministic.
+    first = jnp.concatenate([jnp.ones((1,), bool), sid[1:] != sid[:-1]])
     is_last = jnp.concatenate([sid[1:] != sid[:-1],
                                jnp.ones((1,), bool)])
-    contrib = jnp.where(is_last[:, None], total * scale, 0.0)
-    return table.at[sid].add(contrib.astype(table.dtype))
+    # ``total`` only has to be right at each segment's LAST element (all
+    # other elements land in the dump area below), so the segment sum is
+    # cs - cs[segment start - 1] evaluated elementwise: one cummax for
+    # the start positions and ONE row gather — (b, D) gathers are the
+    # dominant cost of this kernel on TPU
+    seg_start = jax.lax.cummax(jnp.where(first, pos, -1))
+    cs = jnp.cumsum(supd, axis=0)
+    lo = jnp.where((seg_start > 0)[:, None],
+                   jnp.take(cs, jnp.maximum(seg_start - 1, 0), axis=0),
+                   0.0)
+    total = cs - lo          # segment sum, valid at segment-last rows
+    norm = jnp.linalg.norm(total, axis=-1, keepdims=True)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    nrows = table.shape[0]
+    scatter_idx = jnp.where(is_last, sid, nrows + pos)
+    padded = jnp.concatenate(
+        [table, jnp.zeros((b,) + table.shape[1:], table.dtype)], axis=0)
+    padded = padded.at[scatter_idx].add(
+        (total * scale).astype(table.dtype), unique_indices=True)
+    return padded[:nrows]
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -167,14 +180,13 @@ def build_hs_matrices(vocab_words, max_len: int
     return points, labels, mask
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
-def cbow_step(syn0: jax.Array, syn1: jax.Array,
-              context: jax.Array,       # [B, W] int32 context word rows
-              context_mask: jax.Array,  # [B, W] float32
-              targets: jax.Array,       # [B, K] int32
-              labels: jax.Array,        # [B, K] float32
-              mask: jax.Array,          # [B, K] float32
-              lr: jax.Array) -> Tuple[jax.Array, jax.Array]:
+def _cbow_update(syn0: jax.Array, syn1: jax.Array,
+                 context: jax.Array,       # [B, W] int32 context word rows
+                 context_mask: jax.Array,  # [B, W] float32
+                 targets: jax.Array,       # [B, K] int32
+                 labels: jax.Array,        # [B, K] float32
+                 mask: jax.Array,          # [B, K] float32
+                 lr: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """One batched CBOW update: h = mean(context rows); the syn0 gradient
     is broadcast back to every context word (reference: CBOW.java via
     AggregateCBOW)."""
@@ -192,6 +204,116 @@ def cbow_step(syn0: jax.Array, syn1: jax.Array,
                             mr)
     dctx = (dh[:, None, :] * context_mask[..., None]).reshape(-1, d)
     syn0 = _clipped_scatter(syn0, context.reshape(-1), dctx, mr)
+    return syn0, syn1
+
+
+cbow_step = functools.partial(jax.jit, donate_argnums=(0, 1))(
+    _cbow_update)
+
+
+# ---- scanned multi-chunk steps -------------------------------------------
+# One dispatch applies D sequential chunk updates via lax.scan: the
+# per-dispatch transport overhead (~26 ms through the tunneled PJRT —
+# PERF_ANALYSIS.md) is amortized D×, and the host builds the next
+# superchunk while the device drains this one (async dispatch — the
+# double-buffering the reference gets from its trainer threads feeding
+# one fat native op per batch, SkipGram.java:176).
+
+def _row_mask(b: int, k: int, nv: jax.Array) -> jax.Array:
+    """(B, K) float mask of rows below the chunk's valid count."""
+    return jnp.broadcast_to(
+        (jnp.arange(b)[:, None] < nv).astype(jnp.float32), (b, k))
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def skipgram_scan_step(syn0, syn1,
+                       centers,   # [D, B] int32
+                       targets,   # [D, B, K] int32 (col 0 = positive)
+                       n_valid,   # [D] int32
+                       lrs):      # [D] float32
+    b, k = targets.shape[1], targets.shape[2]
+    labels = jnp.zeros((b, k), jnp.float32).at[:, 0].set(1.0)
+
+    def body(carry, chunk):
+        s0, s1 = carry
+        cen, tgt, nv, lr = chunk
+        s0, s1 = _sg_update(s0, s1, cen, tgt, labels,
+                            _row_mask(b, k, nv), lr)
+        return (s0, s1), None
+
+    (syn0, syn1), _ = jax.lax.scan(
+        body, (syn0, syn1), (centers, targets, n_valid, lrs))
+    return syn0, syn1
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def skipgram_hs_scan_step(syn0, syn1,
+                          centers,     # [D, B] int32
+                          contexts,    # [D, B] int32
+                          points_mat, labels_mat, hs_mask,
+                          n_valid, lrs):
+    b = centers.shape[1]
+    k = points_mat.shape[1]
+
+    def body(carry, chunk):
+        s0, s1 = carry
+        cen, ctx, nv, lr = chunk
+        targets = points_mat[ctx]
+        labels = labels_mat[ctx]
+        mask = hs_mask[ctx] * _row_mask(b, k, nv)
+        s0, s1 = _sg_update(s0, s1, cen, targets, labels, mask, lr)
+        return (s0, s1), None
+
+    (syn0, syn1), _ = jax.lax.scan(
+        body, (syn0, syn1), (centers, contexts, n_valid, lrs))
+    return syn0, syn1
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def cbow_scan_step(syn0, syn1,
+                   context,       # [D, B, W] int32
+                   context_mask,  # [D, B, W] float32
+                   targets,       # [D, B, K] int32 (col 0 = positive)
+                   n_valid, lrs):
+    b, k = targets.shape[1], targets.shape[2]
+    labels = jnp.zeros((b, k), jnp.float32).at[:, 0].set(1.0)
+
+    def body(carry, chunk):
+        s0, s1 = carry
+        ctx, cm, tgt, nv, lr = chunk
+        s0, s1 = _cbow_update(s0, s1, ctx, cm, tgt, labels,
+                              _row_mask(b, k, nv), lr)
+        return (s0, s1), None
+
+    (syn0, syn1), _ = jax.lax.scan(
+        body, (syn0, syn1), (context, context_mask, targets, n_valid,
+                             lrs))
+    return syn0, syn1
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def cbow_hs_scan_step(syn0, syn1,
+                      context,       # [D, B, W] int32
+                      context_mask,  # [D, B, W] float32
+                      centers,       # [D, B] int32
+                      points_mat, labels_mat, hs_mask,
+                      n_valid, lrs):
+    b = centers.shape[1]
+    k = points_mat.shape[1]
+
+    def body(carry, chunk):
+        s0, s1 = carry
+        ctx, cm, cen, nv, lr = chunk
+        targets = points_mat[cen]
+        labels = labels_mat[cen]
+        mask = hs_mask[cen] * _row_mask(b, k, nv)
+        s0, s1 = _cbow_update(s0, s1, ctx, cm, targets, labels, mask,
+                              lr)
+        return (s0, s1), None
+
+    (syn0, syn1), _ = jax.lax.scan(
+        body, (syn0, syn1), (context, context_mask, centers, n_valid,
+                             lrs))
     return syn0, syn1
 
 

@@ -120,53 +120,62 @@ class Word2Vec(SequenceVectors):
         max_extra = (max((len(e) for e in extra_per_seq), default=0)
                      if extra_per_seq else 0)
         ctx_w = 2 * W + max_extra
+        from deeplearning4j_tpu.nlp.sequence_vectors import _PairStream
         chunk = self._pair_chunk_size(total_words)  # one center per token
+        depth = _PairStream.DEPTH   # chunks per scanned dispatch
         k = self._k()
-        ctx_buf = np.zeros((chunk, ctx_w), np.int32)
-        cmask_buf = np.zeros((chunk, ctx_w), np.float32)
-        cen_buf = np.zeros(chunk, np.int32)
+        ctx_buf = np.zeros((depth, chunk, ctx_w), np.int32)
+        cmask_buf = np.zeros((depth, chunk, ctx_w), np.float32)
+        cen_buf = np.zeros((depth, chunk), np.int32)
+        nv = np.zeros(depth, np.int32)
+        lrs = np.zeros(depth, np.float32)
         hs = self.use_hs
         if hs:
             self._ensure_hs_matrices()
-            ones_row = jnp.ones((chunk,), jnp.float32)
-        else:
-            # constants stay device-resident (same reason as _PairStream)
-            lab_np = np.zeros((chunk, k), np.float32)
-            lab_np[:, 0] = 1.0
-            lab_dev = jnp.asarray(lab_np)
-            ones_mask = jnp.ones((chunk, k), jnp.float32)
-            tgt_buf = np.zeros((chunk, k), np.int32)
         table = self._table
         n_words = self.vocab.num_words()
+        d = 0
         fill = 0
         seen = 0
 
-        def flush(n):
-            nonlocal fill
-            if n == 0:
+        def seal():
+            nonlocal d, fill
+            nv[d] = fill
+            lrs[d] = self._lr(seen, total_words)
+            if fill < chunk:
+                cmask_buf[d, fill:] = 0.0
+            d += 1
+            fill = 0
+            if d == depth:
+                flush()
+
+        def flush():
+            nonlocal d
+            if d == 0:
                 return
-            if n < chunk:
-                cmask_buf[n:] = 0.0
-            lr = jnp.float32(self._lr(seen, total_words))
+            nv[d:] = 0
+            lrs[d:] = 0.0
             # .copy(): the loop mutates these buffers while the async
             # transfer may still read them (see _fit_fast_sgns)
             ctx_d = jnp.asarray(ctx_buf.copy())
             cm_d = jnp.asarray(cmask_buf.copy())
+            nv_d = jnp.asarray(nv.copy())
+            lr_d = jnp.asarray(lrs.copy())
             if hs:
-                row_valid = sk.partial_mask(ones_row, n)
-                self.syn0, self.syn1 = sk.cbow_hs_step(
+                self.syn0, self.syn1 = sk.cbow_hs_scan_step(
                     self.syn0, self.syn1, ctx_d, cm_d,
                     jnp.asarray(cen_buf.copy()), self._hs_points,
-                    self._hs_labels, self._hs_mask, row_valid, lr)
+                    self._hs_labels, self._hs_mask, nv_d, lr_d)
             else:
-                tgt_buf[:n, 0] = cen_buf[:n]
-                tgt_buf[:n, 1:] = sk.draw_negatives(
-                    rng, table, cen_buf[:n, None], k - 1, n_words)
-                mask = sk.partial_mask(ones_mask, n)
-                self.syn0, self.syn1 = sk.cbow_step(
-                    self.syn0, self.syn1, ctx_d, cm_d,
-                    jnp.asarray(tgt_buf.copy()), lab_dev, mask, lr)
-            fill = 0
+                tgt = np.zeros((depth, chunk, k), np.int32)
+                tgt[..., 0] = cen_buf
+                flat = tgt.reshape(-1, k)
+                flat[:, 1:] = sk.draw_negatives(
+                    rng, table, flat[:, 0:1], k - 1, n_words)
+                self.syn0, self.syn1 = sk.cbow_scan_step(
+                    self.syn0, self.syn1, ctx_d, cm_d, jnp.asarray(tgt),
+                    nv_d, lr_d)
+            d = 0
 
         for _epoch in range(self.epochs):
             for si, seq in enumerate(seqs):
@@ -195,14 +204,17 @@ class Word2Vec(SequenceVectors):
                 while p < n:
                     take = min(chunk - fill, n - p)
                     sl = slice(fill, fill + take)
-                    cen_buf[sl] = idxs[p:p + take]
-                    ctx_buf[sl] = ctx[p:p + take]
-                    cmask_buf[sl] = valid[p:p + take].astype(np.float32)
+                    cen_buf[d, sl] = idxs[p:p + take]
+                    ctx_buf[d, sl] = ctx[p:p + take]
+                    cmask_buf[d, sl] = \
+                        valid[p:p + take].astype(np.float32)
                     fill += take
                     p += take
                     if fill == chunk:
-                        flush(chunk)
-        flush(fill)
+                        seal()
+        if fill:
+            seal()
+        flush()
         return self
 
 

@@ -194,6 +194,12 @@ class ResNet50(ZooModel):
     compute_dtype: str = "float32"
     updater: Updater = dataclasses.field(
         default_factory=lambda: Nesterovs(1e-2, 0.9))
+    # Build each bottleneck as one FusedBottleneckBlock (Pallas fused
+    # conv+BN+ReLU kernels — ops/fused_conv.py): same math, BN stats and
+    # normalize ride the conv HBM passes. The per-layer graph (default)
+    # keeps conv/BN as separate layers, which the TP planner and
+    # transfer-learning surgery operate on.
+    fused_blocks: bool = False
 
     def conf(self):
         g = (NeuralNetConfiguration.Builder()
@@ -219,6 +225,13 @@ class ResNet50(ZooModel):
             return f"{name}_act"
 
         def bottleneck(name, src, filters, stride, downsample):
+            if self.fused_blocks:
+                from deeplearning4j_tpu.nn.layers.fused import (
+                    FusedBottleneckBlock)
+                g.add_layer(name, FusedBottleneckBlock(
+                    filters=filters, stride=stride, downsample=downsample),
+                    src)
+                return name
             f1, f2, f3 = filters, filters, filters * 4
             x = conv_bn(f"{name}_a", src, f1, (1, 1), (stride, stride))
             x = conv_bn(f"{name}_b", x, f2, (3, 3), (1, 1))

@@ -1,0 +1,138 @@
+"""FusedBottleneckBlock == unfused conv/BN/ReLU composition, with the
+same weights (the accelerated-path-vs-reference-path equivalence tier,
+SURVEY §4)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import LayerContext
+from deeplearning4j_tpu.nn.layers.fused import FusedBottleneckBlock
+
+RNG = np.random.default_rng(11)
+
+
+def reference_block(params, state, x, block: FusedBottleneckBlock,
+                    train: bool):
+    """Plain jnp composition of the same math (conv → BN → ReLU ×3 +
+    shortcut), returning (out, new_state)."""
+    f32 = jnp.float32
+    eps, decay = block.eps, block.decay
+    new_state = dict(state)
+
+    def bn(name, y):
+        yf = y.astype(f32)
+        if train:
+            mean = jnp.mean(yf, axis=(0, 1, 2))
+            var = jnp.var(yf, axis=(0, 1, 2))
+            new_state[f"{name}_mean"] = (decay * state[f"{name}_mean"]
+                                         + (1 - decay) * mean)
+            new_state[f"{name}_var"] = (decay * state[f"{name}_var"]
+                                        + (1 - decay) * var)
+        else:
+            mean = state[f"{name}_mean"].astype(f32)
+            var = state[f"{name}_var"].astype(f32)
+        xhat = (yf - mean) * jax.lax.rsqrt(var + eps)
+        return xhat * params[f"{name}_gamma"].astype(f32) \
+            + params[f"{name}_beta"].astype(f32)
+
+    def conv1x1(y, w, stride=1):
+        if stride != 1:
+            y = y[:, ::stride, ::stride, :]
+        return jnp.einsum("nhwc,co->nhwo", y, w,
+                          preferred_element_type=f32).astype(y.dtype)
+
+    def conv3x3(y, w):
+        return jax.lax.conv_general_dilated(
+            y, w, window_strides=(1, 1), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=f32).astype(y.dtype)
+
+    z = jnp.maximum(bn("bn1", conv1x1(x, params["W1"], block.stride)),
+                    0.0).astype(x.dtype)
+    z = jnp.maximum(bn("bn2", conv3x3(z, params["W2"])), 0.0) \
+        .astype(x.dtype)
+    main = bn("bn3", conv1x1(z, params["W3"]))
+    if block.downsample:
+        shortcut = bn("bnds", conv1x1(x, params["Wds"], block.stride))
+    else:
+        shortcut = x.astype(f32)
+    return jnp.maximum(main + shortcut, 0.0).astype(x.dtype), new_state
+
+
+@pytest.mark.parametrize("stride,downsample", [(1, False), (2, True),
+                                               (1, True)])
+def test_block_matches_reference(stride, downsample):
+    cin = 32 if not downsample else 16
+    block = FusedBottleneckBlock(filters=8, stride=stride,
+                                 downsample=downsample)
+    it = InputType.convolutional(8, 8, cin)
+    params = block.initialize(jax.random.PRNGKey(0), it)
+    state = block.init_state(it)
+    x = jnp.asarray(RNG.normal(0, 1, (4, 8, 8, cin)).astype(np.float32))
+
+    for train in (True, False):
+        ctx = LayerContext(train=train, rng=jax.random.PRNGKey(1))
+        y, st = block.apply(params, state, x, ctx)
+        yr, str_ = reference_block(params, state, x, block, train)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                                   rtol=2e-4, atol=2e-4)
+        for k in st:
+            np.testing.assert_allclose(
+                np.asarray(st[k]), np.asarray(str_[k]), rtol=2e-4,
+                atol=2e-4, err_msg=f"state {k} (train={train})")
+
+
+def test_block_grads_match_reference():
+    block = FusedBottleneckBlock(filters=4, stride=2, downsample=True)
+    it = InputType.convolutional(4, 4, 8)
+    params = block.initialize(jax.random.PRNGKey(0), it)
+    state = block.init_state(it)
+    x = jnp.asarray(RNG.normal(0, 1, (4, 4, 4, 8)).astype(np.float32))
+    ctx = LayerContext(train=True)
+
+    def loss_fused(p):
+        y, _ = block.apply(p, state, x, ctx)
+        return jnp.sum(jnp.tanh(y.astype(jnp.float32)))
+
+    def loss_ref(p):
+        y, _ = reference_block(p, state, x, block, True)
+        return jnp.sum(jnp.tanh(y.astype(jnp.float32)))
+
+    gf = jax.grad(loss_fused)(params)
+    gr = jax.grad(loss_ref)(params)
+    for k in gr:
+        np.testing.assert_allclose(np.asarray(gf[k]), np.asarray(gr[k]),
+                                   rtol=5e-4, atol=5e-4, err_msg=k)
+
+
+def test_fused_resnet50_trains():
+    """ResNet50(fused_blocks=True) compiles and the loss moves."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.zoo.models import ResNet50
+    model = ResNet50(num_classes=5, height=32, width=32, channels=3,
+                     fused_blocks=True).init()
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (8, 32, 32, 3)).astype(np.float32)
+    y = np.eye(5, dtype=np.float32)[rng.integers(0, 5, 8)]
+    ds = DataSet(x, y)
+    model.fit(ds)
+    l0 = float(model.score(ds))
+    for _ in range(6):
+        model.fit(ds)
+    assert float(model.score(ds)) < l0
+
+
+def test_fused_resnet50_matches_unfused_geometry():
+    from deeplearning4j_tpu.zoo.models import ResNet50
+    m1 = ResNet50(num_classes=7, height=32, width=32, channels=3,
+                  fused_blocks=True).init()
+    m2 = ResNet50(num_classes=7, height=32, width=32, channels=3,
+                  fused_blocks=False).init()
+    x = RNG.normal(0, 1, (2, 32, 32, 3)).astype(np.float32)
+    assert np.asarray(m1.output(x)).shape == \
+        np.asarray(m2.output(x)).shape == (2, 7)

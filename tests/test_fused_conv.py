@@ -1,0 +1,102 @@
+"""Fused conv+BN+ReLU Pallas kernel equivalence vs the plain XLA math
+(the accelerated-helper validation tier — reference analog:
+deeplearning4j-cuda's ValidateCudnn* tests, SURVEY §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops.fused_conv import (
+    _conv_reference,
+    fused_conv_bn_act,
+    stats_to_scale_shift,
+)
+
+RNG = np.random.default_rng(7)
+
+
+def _mk(n, h, w, cin, cout, kernel):
+    x = jnp.asarray(RNG.normal(0, 1, (n, h, w, cin)).astype(np.float32))
+    if kernel == 1:
+        wt = jnp.asarray(RNG.normal(0, 0.1, (cin, cout))
+                         .astype(np.float32))
+    else:
+        wt = jnp.asarray(RNG.normal(0, 0.1, (3, 3, cin, cout))
+                         .astype(np.float32))
+    s = jnp.asarray(RNG.normal(1, 0.1, cin).astype(np.float32))
+    b = jnp.asarray(RNG.normal(0, 0.1, cin).astype(np.float32))
+    return x, wt, s, b
+
+
+@pytest.mark.parametrize("case", [
+    dict(n=4, h=8, w=8, cin=16, cout=32, kernel=1, stride=1),
+    dict(n=4, h=8, w=8, cin=16, cout=32, kernel=1, stride=2),
+    dict(n=2, h=33, w=5, cin=24, cout=16, kernel=1, stride=1),  # pad M
+    dict(n=4, h=6, w=6, cin=16, cout=24, kernel=3, stride=1),
+    dict(n=6, h=2, w=2, cin=32, cout=16, kernel=3, stride=1),   # multi-img
+])
+def test_forward_matches_reference(case):
+    x, wt, s, b = _mk(case["n"], case["h"], case["w"], case["cin"],
+                      case["cout"], case["kernel"])
+    y, st = fused_conv_bn_act(x, wt, s, b, True, True, case["stride"],
+                              True)
+    yr, str_ = _conv_reference(x, wt, s, b, True, True, case["stride"])
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(st), np.asarray(str_),
+                               rtol=1e-4, atol=1e-3)
+
+
+def test_no_norm_prologue():
+    x, wt, s, b = _mk(2, 4, 4, 8, 16, 1)
+    y, st = fused_conv_bn_act(x, wt, s, b, False, False, 1, True)
+    yr, _ = _conv_reference(x, wt, s, b, False, False, 1)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("kernel,stride", [(1, 1), (1, 2), (3, 1)])
+def test_grads_match_unfused_autodiff(kernel, stride):
+    """jax.grad through (y, stats) must equal jax.grad of the plain XLA
+    composition — including the batch-stat gradient path (the stats
+    outputs are differentiable)."""
+    x, wt, s, b = _mk(3, 4, 4, 8, 12, kernel)
+
+    def loss_fused(x, wt, s, b):
+        y, st = fused_conv_bn_act(x, wt, s, b, True, True, stride, True)
+        # consume y AND the stats the way a downstream BN would
+        inv, shift, mean, var = stats_to_scale_shift(
+            st, y.size // y.shape[-1], jnp.ones(y.shape[-1]),
+            jnp.zeros(y.shape[-1]), 1e-5)
+        z = y.astype(jnp.float32) * inv + shift
+        return jnp.sum(jnp.tanh(z)) + 0.1 * jnp.sum(mean * mean) \
+            + 0.1 * jnp.sum(var)
+
+    def loss_ref(x, wt, s, b):
+        y, st = _conv_reference(x, wt, s, b, True, True, stride)
+        inv, shift, mean, var = stats_to_scale_shift(
+            st, y.size // y.shape[-1], jnp.ones(y.shape[-1]),
+            jnp.zeros(y.shape[-1]), 1e-5)
+        z = y.astype(jnp.float32) * inv + shift
+        return jnp.sum(jnp.tanh(z)) + 0.1 * jnp.sum(mean * mean) \
+            + 0.1 * jnp.sum(var)
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2, 3))(x, wt, s, b)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(x, wt, s, b)
+    for a, r, name in zip(gf, gr, "x w scale shift".split()):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(r), rtol=2e-4, atol=2e-4,
+            err_msg=f"grad mismatch for {name}")
+
+
+def test_bf16_path():
+    x, wt, s, b = _mk(2, 4, 4, 16, 16, 1)
+    xb, wb = x.astype(jnp.bfloat16), wt.astype(jnp.bfloat16)
+    y, st = fused_conv_bn_act(xb, wb, s, b, True, True, 1, True)
+    assert y.dtype == jnp.bfloat16
+    assert st.dtype == jnp.float32
+    yr, _ = _conv_reference(xb, wb, s, b, True, True, 1)
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(yr, np.float32),
+                               rtol=0.05, atol=0.05)
