@@ -369,18 +369,24 @@ class SequenceVectors:
     def _indices(self, seq: Sequence[str]) -> List[int]:
         """Vocab lookup + frequent-word subsampling (word2vec.c style;
         reference applies sampling in SequenceVectors' transformer)."""
+        lookup = self.vocab._by_word
+        if self.sampling <= 0:
+            # host pair generation feeds a device that now sustains
+            # >500k tokens/s — this per-token loop IS the hot path, so
+            # one dict-hit comprehension, no per-token method calls
+            return [vw.index for vw in map(lookup.get, seq)
+                    if vw is not None]
         out = []
         total = max(1, self.vocab.total_word_count)
         for tok in seq:
-            idx = self.vocab.index_of(tok)
-            if idx < 0:
+            vw = lookup.get(tok)
+            if vw is None:
                 continue
-            if self.sampling > 0:
-                f = self.vocab.element_at_index(idx).count / total
-                keep = (np.sqrt(f / self.sampling) + 1) * self.sampling / f
-                if self._rng.random() > keep:
-                    continue
-            out.append(idx)
+            f = vw.count / total
+            keep = (np.sqrt(f / self.sampling) + 1) * self.sampling / f
+            if self._rng.random() > keep:
+                continue
+            out.append(vw.index)
         return out
 
     def _window_bounds(self, pos: int, n: int) -> Tuple[int, int]:

@@ -46,10 +46,18 @@ def _apply_mask_step(mask_t, new_val, old_val):
 class LSTM(FeedForwardLayer):
     """Standard LSTM (no peepholes). Gate order: [i, f, o, g] packed in one
     4H-wide projection. ``forget_gate_bias_init`` mirrors the reference's
-    forgetGateBiasInit (LSTMHelpers defaults to 1.0 for gradient flow)."""
+    forgetGateBiasInit (LSTMHelpers defaults to 1.0 for gradient flow).
+
+    ``gate_layout``: "gate_major" (default) packs the 4H columns as four
+    H-wide gate blocks; "hidden_major" interleaves them per hidden unit
+    (column h*4+g) so that a contiguous column tile holds ALL FOUR gates
+    of a hidden-unit slice — the layout tensor parallelism needs to
+    shard the recurrence over hidden units (the Wqkv head-major trick,
+    applied to gates; parallel/tensor_parallel.py)."""
     activation: Activation = Activation.TANH
     gate_activation: Activation = Activation.SIGMOID
     forget_gate_bias_init: float = 1.0
+    gate_layout: str = "gate_major"
 
     def output_type(self, input_type: InputType) -> InputType:
         t = input_type.timesteps if isinstance(input_type, RecurrentType) else None
@@ -61,21 +69,35 @@ class LSTM(FeedForwardLayer):
         kx, kh = jax.random.split(key)
         dt = self.param_dtype()
         b = jnp.zeros((4 * h,), dt)
-        b = b.at[h:2 * h].set(self.forget_gate_bias_init)
+        if self.gate_layout == "hidden_major":
+            b = b.reshape(h, 4).at[:, 1].set(
+                self.forget_gate_bias_init).reshape(4 * h)
+        else:
+            b = b.at[h:2 * h].set(self.forget_gate_bias_init)
         return {
             "Wx": self.weight_init.init(kx, (n_in, 4 * h), n_in, h, dt),
             "Wh": self.weight_init.init(kh, (h, 4 * h), h, h, dt),
             "b": b,
         }
 
+    def _gates(self, z):
+        """Split the packed 4H projection into (i, f, o, g) per the
+        configured column layout."""
+        nh = self.n_out
+        if self.gate_layout == "hidden_major":
+            z4 = z.reshape(z.shape[0], nh, 4)
+            return z4[..., 0], z4[..., 1], z4[..., 2], z4[..., 3]
+        return (z[:, :nh], z[:, nh:2 * nh], z[:, 2 * nh:3 * nh],
+                z[:, 3 * nh:])
+
     def _cell(self, params, carry, zx_t, mask_t):
         h_prev, c_prev = carry
-        nh = self.n_out
         z = zx_t + h_prev @ params["Wh"]
-        i = self.gate_activation.apply(z[:, :nh])
-        f = self.gate_activation.apply(z[:, nh:2 * nh])
-        o = self.gate_activation.apply(z[:, 2 * nh:3 * nh])
-        g = self.activation.apply(z[:, 3 * nh:])
+        zi, zf, zo, zg = self._gates(z)
+        i = self.gate_activation.apply(zi)
+        f = self.gate_activation.apply(zf)
+        o = self.gate_activation.apply(zo)
+        g = self.activation.apply(zg)
         c = f * c_prev + i * g
         hy = o * self.activation.apply(c)
         if mask_t is not None:
@@ -130,6 +152,12 @@ class GravesLSTM(LSTM):
     """LSTM with peephole connections (reference: GravesLSTM, the A. Graves
     2013 formulation — peepholes from the cell state into i/f/o gates)."""
 
+    def __post_init__(self):
+        # fail at config time, not deep inside the first fit trace
+        if self.gate_layout != "gate_major":
+            raise ValueError(
+                "GravesLSTM supports only gate_layout='gate_major'")
+
     def initialize(self, key, input_type):
         params = super().initialize(key, input_type)
         h = self.n_out
@@ -170,7 +198,8 @@ class GravesBidirectionalLSTM(FeedForwardLayer):
     def _wrapper(self) -> "Bidirectional":
         inner = GravesLSTM(
             **{f.name: getattr(self, f.name)
-               for f in dataclasses.fields(GravesLSTM)})
+               for f in dataclasses.fields(GravesLSTM)
+               if hasattr(self, f.name)})
         return Bidirectional(fwd=inner, mode="concat", name=self.name)
 
     def output_type(self, input_type: InputType) -> InputType:

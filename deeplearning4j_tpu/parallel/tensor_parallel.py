@@ -55,6 +55,9 @@ class TPPlan:
     mesh: Mesh
     model_axis: str = MODEL_AXIS
     data_axis: str = DATA_AXIS
+    # per-layer model_state shardings (BatchNorm running stats of a
+    # channel-sharded conv pair live sharded); None = all replicated
+    state_shardings: Optional[Dict[str, Any]] = None
 
     @property
     def model_parallelism(self) -> int:
@@ -122,13 +125,34 @@ def _transformer_specs(p, m, ax, n_heads):
     return spec
 
 
+def _lstm_specs(layer, p, m, ax):
+    """Hidden-unit-sharded LSTM: requires the opt-in "hidden_major" gate
+    packing (a contiguous 4H-column tile then holds all four gates of a
+    hidden slice, so the recurrence c/h math stays local per shard).
+    Wx/Wh column-parallel, bias sharded; GSPMD all-gathers h_prev into
+    each step's Wh contraction — the inherent LSTM-TP collective."""
+    from deeplearning4j_tpu.nn.layers.recurrent import LSTM
+    if (type(layer) is not LSTM
+            or getattr(layer, "gate_layout", "") != "hidden_major"
+            or layer.n_out % m):
+        return None
+    spec = _repl_specs(p)
+    spec["Wx"] = P(None, ax)
+    spec["Wh"] = P(None, ax)
+    if "b" in p:
+        spec["b"] = P(ax)
+    return spec
+
+
 def _fallback_specs(p, m, ax):
     """Round-1 column-only rules for layer types without a pairing rule
     (conv output channels, recurrent gate matrices, embeddings)."""
     def rule(path, leaf):
         key = getattr(path[-1], "key", "")
         shape = getattr(leaf, "shape", ())
-        if key == "dW" and len(shape) == 4 and shape[-1] % m == 0:
+        # HWIO conv kernels: shard output channels (key is "W" on
+        # ConvolutionLayer; "dW" kept for depthwise kernels)
+        if key in ("W", "dW") and len(shape) == 4 and shape[-1] % m == 0:
             return P(None, None, None, ax)
         if key in ("Wx", "Wh", "pW") and len(shape) == 2 and shape[-1] % m == 0:
             return P(None, ax)
@@ -235,7 +259,9 @@ def plan_tp(model, mesh: Mesh, *, model_axis: str = MODEL_AXIS,
             spec_tree[name] = _repl_specs(p)
             act_kinds[name] = state
         else:
-            spec_tree[name] = _fallback_specs(p, m, ax)
+            lstm = _lstm_specs(layer, p, m, ax)
+            spec_tree[name] = lstm if lstm is not None \
+                else _fallback_specs(p, m, ax)
             act_kinds[name] = _REPL
             state = _REPL
 
@@ -243,12 +269,93 @@ def plan_tp(model, mesh: Mesh, *, model_axis: str = MODEL_AXIS,
                   model_axis, data_axis)
 
 
+def _find_conv_chains(model, m: int):
+    """Bottleneck conv chains in a ComputationGraph, by structure (not
+    by name): a 1×1 conv whose single-consumer chain through
+    BatchNorm/Activation reaches a 3×3 conv, then another such chain to
+    a closing 1×1 conv + its BatchNorm. Returns a list of dicts naming
+    the chain's members. Only chains whose mid-width divides the model
+    axis are returned."""
+    from deeplearning4j_tpu.nn.layers.convolution import ConvolutionLayer
+    from deeplearning4j_tpu.nn.layers.feedforward import ActivationLayer
+    from deeplearning4j_tpu.nn.layers.normalization import (
+        BatchNormalization)
+
+    nodes = {n.name: n for n in model.conf.nodes}
+    consumers: Dict[str, list] = {}
+    for n in model.conf.nodes:
+        for src in n.inputs:
+            consumers.setdefault(src, []).append(n.name)
+
+    def kernel(layer):
+        k = layer.kernel_size
+        return (k, k) if isinstance(k, int) else tuple(k)
+
+    def is_conv(name, ksize):
+        n = nodes.get(name)
+        return (n is not None and isinstance(n.layer, ConvolutionLayer)
+                and type(n.layer) is ConvolutionLayer
+                and kernel(n.layer) == ksize)
+
+    def follow_bn_act(name):
+        """From a conv node, walk its single-consumer BN (+optional
+        Activation); returns (bn_name, act_name|None, next_name)."""
+        cons = consumers.get(name, [])
+        if len(cons) != 1:
+            return None
+        bn = nodes.get(cons[0])
+        if bn is None or not isinstance(bn.layer, BatchNormalization):
+            return None
+        cons2 = consumers.get(bn.name, [])
+        if len(cons2) != 1:
+            return None
+        nxt = nodes.get(cons2[0])
+        if nxt is not None and isinstance(nxt.layer, ActivationLayer):
+            cons3 = consumers.get(nxt.name, [])
+            if len(cons3) != 1:
+                return None
+            return bn.name, nxt.name, cons3[0]
+        return bn.name, None, cons2[0]
+
+    chains = []
+    for n in model.conf.nodes:
+        if n.layer is None or not is_conv(n.name, (1, 1)):
+            continue
+        if n.layer.n_out % m:
+            continue
+        step_a = follow_bn_act(n.name)
+        if step_a is None or not is_conv(step_a[2], (3, 3)):
+            continue
+        b_name = step_a[2]
+        if nodes[b_name].layer.n_out % m:
+            continue
+        step_b = follow_bn_act(b_name)
+        if step_b is None or not is_conv(step_b[2], (1, 1)):
+            continue
+        # the closing conv's own BatchNorm stays replicated by design
+        # (it normalizes the post-psum replicated activation)
+        chains.append({
+            "a": n.name, "a_bn": step_a[0], "a_act": step_a[1],
+            "b": b_name, "b_bn": step_b[0], "b_act": step_b[1],
+            "c": step_b[2],
+        })
+    return chains
+
+
 def _plan_tp_graph(model, mesh: Mesh, *, model_axis: str = MODEL_AXIS,
                    data_axis: str = DATA_AXIS) -> TPPlan:
-    """Per-node TP plan for a ComputationGraph: transformer blocks and
-    attention layers keep their internal Megatron pairing (input and
-    output replicated, so DAG fan-out is safe); everything else uses the
-    fallback column rules."""
+    """Per-node TP plan for a ComputationGraph.
+
+    Transformer blocks and attention layers keep their internal Megatron
+    pairing (input and output replicated, so DAG fan-out is safe).
+    Bottleneck conv chains (1×1 → BN → ReLU → 3×3 → BN → ReLU → 1×1 →
+    BN) get the paired conv tiling: the opening 1×1 and the 3×3 are
+    column-parallel over output channels (GSPMD all-gathers the sharded
+    activation into the 3×3's full-channel contraction), the closing
+    1×1 is row-parallel (one psum restores the replicated residual), and
+    the BatchNorms between them run fully sharded — per-channel stats
+    need no communication at all. Per block: 1 all-gather + 1 psum.
+    Everything else uses the fallback column rules."""
     from deeplearning4j_tpu.nn.layers.attention import (
         SelfAttentionLayer, TransformerEncoderBlock)
 
@@ -257,21 +364,82 @@ def _plan_tp_graph(model, mesh: Mesh, *, model_axis: str = MODEL_AXIS,
     ax = model_axis
     spec_tree: Dict[str, Any] = {}
     act_kinds: Dict[str, str] = {}
+    state_specs: Dict[str, Any] = {}
+
+    chain_rules: Dict[str, Any] = {}
+    if m > 1:
+        for ch in _find_conv_chains(model, m):
+            # column convs: HWIO output channels sharded
+            chain_rules[ch["a"]] = ("conv", P(None, None, None, ax),
+                                    _SHARDED)
+            chain_rules[ch["b"]] = ("conv", P(None, None, None, ax),
+                                    _SHARDED)
+            # row conv: input channels sharded → psum; output replicated
+            chain_rules[ch["c"]] = ("conv", P(None, None, ax, None),
+                                    _REPL)
+            for bn in (ch["a_bn"], ch["b_bn"]):
+                chain_rules[bn] = ("bn", P(ax), _SHARDED)
+            for act in (ch["a_act"], ch["b_act"]):
+                if act is not None:
+                    chain_rules[act] = ("pass", None, _SHARDED)
+
     for node in model._layer_nodes:
         name, layer = node.name, node.layer
         p = params.get(name, {})
+        rule = chain_rules.get(name)
         if m <= 1:
             spec_tree[name] = _repl_specs(p)
+            act_kinds[name] = _REPL
+        elif rule is not None:
+            kind, spec, act = rule
+            if kind == "conv":
+                s = _repl_specs(p)
+                s["W"] = spec
+                if "b" in p:
+                    s["b"] = P(ax) if act == _SHARDED else P()
+                spec_tree[name] = s
+            elif kind == "bn":
+                spec_tree[name] = {k: spec for k in p}
+                state_specs[name] = {"mean": spec, "var": spec}
+            else:
+                spec_tree[name] = _repl_specs(p)
+            act_kinds[name] = act
         elif isinstance(layer, TransformerEncoderBlock):
             spec_tree[name] = _transformer_specs(p, m, ax, layer.n_heads)
+            act_kinds[name] = _REPL
         elif isinstance(layer, SelfAttentionLayer) and "Wqkv" in p \
                 and layer.n_heads % m == 0:
             spec_tree[name] = _attention_specs(p, m, ax)
+            act_kinds[name] = _REPL
         else:
-            spec_tree[name] = _fallback_specs(p, m, ax)
-        act_kinds[name] = _REPL
+            lstm = _lstm_specs(layer, p, m, ax)
+            spec_tree[name] = lstm if lstm is not None \
+                else _fallback_specs(p, m, ax)
+            act_kinds[name] = _REPL
+    state_sh = None
+    if state_specs:
+        mstate = model.train_state.model_state
+        state_sh = {
+            lname: {k: NamedSharding(mesh, s)
+                    for k, s in specs.items() if k in mstate.get(lname, {})}
+            for lname, specs in state_specs.items()}
     return TPPlan(_named(mesh, spec_tree, params), act_kinds, mesh,
-                  model_axis, data_axis)
+                  model_axis, data_axis, state_shardings=state_sh)
+
+
+def count_collectives(compiled) -> Dict[str, int]:
+    """Collective-op census of a compiled executable (the per-block
+    communication count VERDICT r3 #4 asks the planner to report):
+    occurrences of each collective HLO in the optimized module."""
+    import re
+    txt = compiled.as_text()
+    out: Dict[str, int] = {}
+    for op in ("all-reduce", "all-gather", "reduce-scatter",
+               "collective-permute", "all-to-all"):
+        n = len(re.findall(rf" {op}(?:-start)?\(", txt))
+        if n:
+            out[op] = n
+    return out
 
 
 def shard_train_state(model, plan: TPPlan):
@@ -285,11 +453,25 @@ def shard_train_state(model, plan: TPPlan):
     repl = NamedSharding(plan.mesh, P())
     opt_sh = mirror_opt_shardings(ts.opt_state, ts.params,
                                   plan.param_shardings, repl)
+    # model_state: replicated except where the plan shards it (BN running
+    # stats of channel-sharded conv pairs). Per-LAYER prefix shardings,
+    # not per-leaf: layers may add state keys on the first step (LSTM's
+    # last_h/last_c), and a bare sharding prefix covers whatever appears.
+    plan_state = plan.state_shardings or {}
+    state_sh = {lname: plan_state.get(lname, repl)
+                for lname in ts.model_state}
+    # device_put needs per-leaf shardings for the CURRENT keys; the
+    # prefix form above stays in the returned sharding struct
+    state_sh_exact = {
+        lname: (sub_sh if isinstance(sub_sh, dict)
+                else jax.tree_util.tree_map(lambda _: sub_sh,
+                                            ts.model_state[lname]))
+        for lname, sub_sh in state_sh.items()}
     put = jax.tree_util.tree_map
     new = TrainState(
         put(jax.device_put, ts.params, plan.param_shardings),
-        jax.device_put(ts.model_state, repl),
+        put(jax.device_put, ts.model_state, state_sh_exact),
         put(jax.device_put, ts.opt_state, opt_sh),
         jax.device_put(ts.iteration, repl))
     model.train_state = new
-    return new, TrainState(plan.param_shardings, repl, opt_sh, repl)
+    return new, TrainState(plan.param_shardings, state_sh, opt_sh, repl)

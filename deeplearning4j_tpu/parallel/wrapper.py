@@ -333,6 +333,36 @@ class ParallelWrapper:
                 "data shard to a common batch size.")
         self._local_batch_checked = n
 
+    def _stage_batch(self, batch: DataSet):
+        """Pad to the worker multiple and stage the four batch arrays on
+        the mesh — the single home for sync-step argument staging."""
+        batch = self._pad_batch(batch)
+        return (self._put_batch(batch.features),
+                self._put_batch(batch.labels),
+                self._put_batch(batch.features_mask),
+                self._put_batch(batch.labels_mask))
+
+    def collective_census(self, batch: DataSet):
+        """Compile the sync step for this batch's shapes and count its
+        collective HLOs (the TP communication audit — e.g. the ResNet50
+        conv pairing should show ~1 all-gather + 1 all-reduce per
+        bottleneck plus the gradient all-reduce over the data axis).
+
+        Note: this AOT-compiles a separate audit executable — jax's jit
+        dispatch cache is not populated by ``lower().compile()``, so a
+        following ``fit`` still compiles its own step."""
+        from deeplearning4j_tpu.parallel.tensor_parallel import (
+            count_collectives)
+        if self.mode is not TrainingMode.SHARED_GRADIENTS:
+            raise ValueError("collective_census audits the sync step")
+        if self._step is None:
+            self._step, self._batch_sh = self._build_sync_step()
+        feats, labels, fmask, lmask = self._stage_batch(batch)
+        compiled = self._step.lower(self.model.train_state, feats, labels,
+                                    fmask, lmask,
+                                    jax.random.PRNGKey(0)).compile()
+        return count_collectives(compiled)
+
     def _fit_sync(self, iterator, epochs):
         if self._step is None:
             self._step, self._batch_sh = self._build_sync_step()
@@ -344,12 +374,8 @@ class ParallelWrapper:
             for batch in iterator:
                 etl_ms = (time.perf_counter() - t0) * 1000
                 n_real = batch.num_examples()
-                batch = self._pad_batch(batch)
                 m._rng, key = jax.random.split(m._rng)
-                feats = self._put_batch(batch.features)
-                labels = self._put_batch(batch.labels)
-                fmask = self._put_batch(batch.features_mask)
-                lmask = self._put_batch(batch.labels_mask)
+                feats, labels, fmask, lmask = self._stage_batch(batch)
                 m.train_state, loss = self._step(m.train_state, feats,
                                                  labels, fmask, lmask, key)
                 it = int(m.train_state.iteration)

@@ -1,0 +1,219 @@
+"""Round-3 TP extensions: bottleneck conv-chain pairing and hidden-major
+LSTM sharding — golden "TP grads == replicated grads" tests on the
+8-device virtual CPU mesh, plus the collective census."""
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deeplearning4j_tpu.datasets.dataset import ArrayDataSetIterator, DataSet
+from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers.recurrent import LSTM
+from deeplearning4j_tpu.nn.layers.output import RnnOutputLayer
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.optimize.updaters import Sgd
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, create_mesh
+from deeplearning4j_tpu.parallel.tensor_parallel import (
+    count_collectives,
+    plan_tp,
+)
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper, TrainingMode
+from deeplearning4j_tpu.zoo.models import ResNet50
+
+
+def _assert_trees_close(a, b, rtol=5e-4, atol=5e-5):
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=rtol, atol=atol)
+
+
+def small_resnet():
+    # 32x32 + tiny lr: at smaller geometry the BatchNorms over 1x1
+    # spatial planes make gradients chaotic (max |grad| ~300 at init),
+    # so cross-device float reassociation would swamp the comparison
+    return ResNet50(num_classes=4, height=32, width=32, channels=3,
+                    seed=5, updater=Sgd(1e-3))
+
+
+def test_conv_chain_plan_pairs_bottlenecks():
+    """Every bottleneck's a/b convs go column-parallel, c row-parallel,
+    and the in-chain BatchNorms (params AND running stats) shard."""
+    model = small_resnet().init()
+    mesh = create_mesh({DATA_AXIS: 4, MODEL_AXIS: 2})
+    plan = plan_tp(model, mesh)
+    sh = plan.param_shardings
+    assert sh["s0b0_a_conv"]["W"].spec == P(None, None, None, MODEL_AXIS)
+    assert sh["s0b0_b_conv"]["W"].spec == P(None, None, None, MODEL_AXIS)
+    assert sh["s0b0_c_conv"]["W"].spec == P(None, None, MODEL_AXIS, None)
+    assert sh["s0b0_a_bn"]["gamma"].spec == P(MODEL_AXIS)
+    assert plan.state_shardings["s0b0_a_bn"]["mean"].spec == P(MODEL_AXIS)
+    assert plan.act_kinds["s0b0_a_conv"] == "sharded"
+    assert plan.act_kinds["s0b0_c_conv"] == "replicated"
+    # downsample convs are NOT part of a chain: fallback column rules
+    assert sh["s0b0_ds_conv"]["W"].spec == P(None, None, None, MODEL_AXIS)
+
+
+def bottleneck_graph(filters=8, classes=4):
+    """One ResNet bottleneck (a/b/c convs + BNs + ds shortcut) + head —
+    shallow enough that BatchNorm statistics are well-conditioned, so
+    the TP-vs-replicated comparison is not swamped by the chaotic
+    1/σ³ amplification a 50-layer random-init stack exhibits."""
+    from deeplearning4j_tpu.nn.graph.vertices import ElementWiseVertex
+    from deeplearning4j_tpu.nn.layers.convolution import (
+        ConvolutionLayer, ConvolutionMode)
+    from deeplearning4j_tpu.nn.layers.feedforward import ActivationLayer
+    from deeplearning4j_tpu.nn.layers.normalization import (
+        BatchNormalization)
+    from deeplearning4j_tpu.nn.layers.output import (
+        GlobalPoolingLayer, OutputLayer)
+    from deeplearning4j_tpu.ops.losses import LossFunction
+
+    g = (NeuralNetConfiguration.Builder()
+         .seed(5).updater(Sgd(0.01)).graph_builder()
+         .add_inputs("in")
+         .set_input_types(InputType.convolutional(8, 8, 6)))
+
+    def conv_bn(name, src, n_out, k, act=True):
+        g.add_layer(f"{name}_conv", ConvolutionLayer(
+            n_out=n_out, kernel_size=k, stride=(1, 1),
+            convolution_mode=ConvolutionMode.SAME, has_bias=False,
+            activation=Activation.IDENTITY), src)
+        g.add_layer(f"{name}_bn", BatchNormalization(), f"{name}_conv")
+        if not act:
+            return f"{name}_bn"
+        g.add_layer(f"{name}_act",
+                    ActivationLayer(activation=Activation.RELU),
+                    f"{name}_bn")
+        return f"{name}_act"
+
+    x = conv_bn("a", "in", filters, (1, 1))
+    x = conv_bn("b", x, filters, (3, 3))
+    x = conv_bn("c", x, filters * 4, (1, 1), act=False)
+    sc = conv_bn("ds", "in", filters * 4, (1, 1), act=False)
+    g.add_vertex("add", ElementWiseVertex(op="add"), x, sc)
+    g.add_layer("out_act", ActivationLayer(activation=Activation.RELU),
+                "add")
+    g.add_layer("pool", GlobalPoolingLayer(), "out_act")
+    g.add_layer("out", OutputLayer(n_out=classes,
+                                   loss=LossFunction.MCXENT,
+                                   activation=Activation.SOFTMAX),
+                "pool")
+    g.set_outputs("out")
+    return g.build()
+
+
+def test_tp_conv_grads_match_replicated():
+    """One SGD step of the TP-paired bottleneck == replicated model."""
+    from deeplearning4j_tpu.models.computation_graph import (
+        ComputationGraph)
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (16, 8, 8, 6)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 16)]
+    it = ArrayDataSetIterator(DataSet(x, y), batch_size=16)
+
+    single = ComputationGraph(bottleneck_graph()).init()
+    single.fit(it, epochs=2)
+    it.reset()
+
+    tp_model = ComputationGraph(bottleneck_graph()).init()
+    mesh = create_mesh({DATA_AXIS: 4, MODEL_AXIS: 2})
+    plan = plan_tp(tp_model, mesh)
+    # the structural chain detector must have paired this block
+    assert plan.param_shardings["a_conv"]["W"].spec == \
+        P(None, None, None, MODEL_AXIS)
+    assert plan.param_shardings["c_conv"]["W"].spec == \
+        P(None, None, MODEL_AXIS, None)
+    w = (ParallelWrapper.builder(tp_model)
+         .mesh(mesh)
+         .training_mode(TrainingMode.SHARED_GRADIENTS)
+         .tensor_parallel()
+         .build())
+    w.fit(it, epochs=2)
+    _assert_trees_close(single.params, tp_model.params,
+                        rtol=2e-3, atol=2e-4)
+
+
+def lstm_conf(hidden=16, gate_layout="hidden_major"):
+    return (NeuralNetConfiguration.Builder()
+            .seed(9)
+            .updater(Sgd(0.05))
+            .list()
+            .layer(LSTM(n_out=hidden, gate_layout=gate_layout))
+            .layer(LSTM(n_out=hidden, gate_layout=gate_layout))
+            .layer(RnnOutputLayer(n_out=3))
+            .set_input_type(InputType.recurrent(6, 5))
+            .build())
+
+
+def test_hidden_major_lstm_matches_gate_major_math():
+    """The two packings are the same function of their own params: with
+    permuted-equivalent weights the outputs coincide."""
+    rng = np.random.default_rng(2)
+    x = rng.normal(0, 1, (4, 5, 6)).astype(np.float32)
+    gm = MultiLayerNetwork(lstm_conf(gate_layout="gate_major")).init()
+    hm = MultiLayerNetwork(lstm_conf(gate_layout="hidden_major")).init()
+    # copy gate-major params into hidden-major layout: col h*4+g <- g*H+h
+    h = 16
+    perm = np.arange(4 * h).reshape(4, h).T.reshape(-1)
+    new_p = dict(hm.params)
+    for lname in ("layer_0", "layer_1"):
+        src = gm.params[lname]
+        new_p[lname] = {"Wx": src["Wx"][:, perm], "Wh": src["Wh"][:, perm],
+                        "b": src["b"][perm]}
+    new_p["layer_2"] = gm.params["layer_2"]
+    hm.train_state = hm.train_state._replace(params=new_p)
+    np.testing.assert_allclose(np.asarray(hm.output(x)),
+                               np.asarray(gm.output(x)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_tp_lstm_grads_match_replicated():
+    """One SGD step of the hidden-sharded LSTM stack == replicated."""
+    rng = np.random.default_rng(3)
+    x = rng.normal(0, 1, (8, 5, 6)).astype(np.float32)
+    y = np.zeros((8, 5, 3), np.float32)
+    y[np.arange(8)[:, None], np.arange(5)[None, :],
+      rng.integers(0, 3, (8, 5))] = 1.0
+    it = ArrayDataSetIterator(DataSet(x, y), batch_size=8)
+
+    single = MultiLayerNetwork(lstm_conf()).init()
+    single.fit(it, epochs=1)
+    it.reset()
+
+    tp_model = MultiLayerNetwork(lstm_conf()).init()
+    mesh = create_mesh({DATA_AXIS: 4, MODEL_AXIS: 2})
+    plan = plan_tp(tp_model, mesh)
+    assert plan.param_shardings["layer_0"]["Wx"].spec == \
+        P(None, MODEL_AXIS)
+    assert plan.param_shardings["layer_0"]["Wh"].spec == \
+        P(None, MODEL_AXIS)
+    w = (ParallelWrapper.builder(tp_model)
+         .mesh(mesh)
+         .training_mode(TrainingMode.SHARED_GRADIENTS)
+         .tensor_parallel()
+         .build())
+    w.fit(it, epochs=1)
+    _assert_trees_close(single.params, tp_model.params,
+                        rtol=1e-3, atol=1e-4)
+
+
+def test_collective_census_counts_tp_comms():
+    """The conv-paired plan's compiled step contains collectives and the
+    wrapper's census reports them (per-block design: 1 all-gather +
+    1 psum, plus the gradient all-reduce over the data axis)."""
+    from deeplearning4j_tpu.models.computation_graph import (
+        ComputationGraph)
+    rng = np.random.default_rng(1)
+    x = rng.normal(0, 1, (16, 8, 8, 6)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 16)]
+    tp_model = ComputationGraph(bottleneck_graph()).init()
+    mesh = create_mesh({DATA_AXIS: 4, MODEL_AXIS: 2})
+    w = (ParallelWrapper.builder(tp_model).mesh(mesh)
+         .training_mode(TrainingMode.SHARED_GRADIENTS)
+         .tensor_parallel().build())
+    counts = w.collective_census(DataSet(x, y))
+    assert counts.get("all-reduce", 0) >= 1
+    assert sum(counts.values()) >= 2, counts
