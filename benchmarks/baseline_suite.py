@@ -205,6 +205,130 @@ def bert_train():
         "unit": "tokens/sec/chip"}))
 
 
+def inception_train():
+    """Imported-InceptionV3 FINE-TUNE throughput (BASELINE config 3's
+    training half): import the canonical Keras graph, swap the 1000-way
+    head for 200 classes via TransferLearning.GraphBuilder, and train the
+    WHOLE network (fwd+bwd+Adam) with K scanned steps per dispatch."""
+    import jax.numpy as jnp
+    import jax.random as jrandom
+    import keras
+    import os
+    import tempfile
+
+    from deeplearning4j_tpu.modelimport.keras import (
+        import_keras_model_and_weights)
+    from deeplearning4j_tpu.nn.transferlearning import (
+        FineTuneConfiguration, TransferLearning)
+    from deeplearning4j_tpu.optimize.solver import make_scan_train_step
+    from deeplearning4j_tpu.optimize.updaters import Adam
+
+    km = keras.applications.InceptionV3(weights=None,
+                                        input_shape=(299, 299, 3),
+                                        classes=1000)
+    fd, p = tempfile.mkstemp(suffix=".h5")
+    os.close(fd)
+    try:
+        km.save(p)
+        model = import_keras_model_and_weights(p)
+    finally:
+        os.unlink(p)
+
+    head = model.conf.network_outputs[0]
+    model = (TransferLearning.GraphBuilder(model)
+             .fine_tune_configuration(
+                 FineTuneConfiguration.Builder().updater(Adam(1e-4))
+                 .build())
+             .n_out_replace(head, 200)
+             .build())
+
+    batch, k, n = 64, 8, 3
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, 299, 299, 3))
+                    .astype(np.float32))
+    y = np.zeros((batch, 200), np.float32)
+    y[np.arange(batch), rng.integers(0, 200, batch)] = 1.0
+    xs = jnp.broadcast_to(x, (k,) + x.shape)
+    ys = jnp.broadcast_to(jnp.asarray(y), (k, batch, 200))
+
+    def loss_fn(params, mstate, feats, labels, fmask, lmask, rng_, it):
+        return model._loss(params, mstate, (feats,), (labels,), fmask,
+                           lmask, rng_, it)
+
+    steps_fn = make_scan_train_step(loss_fn, model._tx)
+    key = jrandom.PRNGKey(0)
+    ts = model.train_state
+    ts, losses = steps_fn(ts, xs, ys, None, None, key)
+    _sync(losses[-1])
+    t0 = time.perf_counter()
+    for i in range(n):
+        ts, losses = steps_fn(ts, xs, ys, None, None,
+                              jrandom.fold_in(key, i))
+    _sync(losses[-1])
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "imported_inception_v3_299x299_finetune_images_per_sec",
+        "value": round(n * k * batch / dt, 1),
+        "unit": "images/sec/chip"}))
+
+
+def bert_finetune():
+    """Imported-BERT-base FINE-TUNE tokens/s (flash attention on): graft
+    a mean-pool + 2-class head on the imported encoder and train the
+    whole graph — the reference's flagship Keras-import workflow
+    (KerasModelImport.java:41 → TransferLearning)."""
+    import jax.numpy as jnp
+    import jax.random as jrandom
+    from deeplearning4j_tpu.modelimport.bert import (
+        BERT_BASE, example_inputs, import_bert_base)
+    from deeplearning4j_tpu.nn.layers.output import (
+        GlobalPoolingLayer, OutputLayer, PoolingType)
+    from deeplearning4j_tpu.nn.transferlearning import (
+        FineTuneConfiguration, TransferLearning)
+    from deeplearning4j_tpu.optimize.solver import make_scan_train_step
+    from deeplearning4j_tpu.optimize.updaters import Adam
+
+    seq, batch, k, n = 128, 32, 8, 3
+    model, _km = import_bert_base(seq_len=seq)
+    enc_out = model.conf.network_outputs[0]
+    ft = (TransferLearning.GraphBuilder(model)
+          .fine_tune_configuration(
+              FineTuneConfiguration.Builder().updater(Adam(2e-5)).build())
+          .add_layer("pool",
+                     GlobalPoolingLayer(pooling_type=PoolingType.AVG),
+                     enc_out)
+          .add_layer("cls", OutputLayer(n_out=2), "pool")
+          .set_outputs("cls")
+          .build())
+
+    rng = np.random.default_rng(0)
+    ids, pos = example_inputs(batch, seq, BERT_BASE["vocab"])
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, batch)]
+    idss = jnp.broadcast_to(jnp.asarray(ids), (k,) + ids.shape)
+    poss = jnp.broadcast_to(jnp.asarray(pos), (k,) + pos.shape)
+    ys = jnp.broadcast_to(jnp.asarray(y), (k, batch, 2))
+
+    def loss_fn(params, mstate, feats, labels, fmask, lmask, rng_, it):
+        return ft._loss(params, mstate, feats, labels, fmask, lmask,
+                        rng_, it)
+
+    steps_fn = make_scan_train_step(loss_fn, ft._tx)
+    key = jrandom.PRNGKey(0)
+    ts = ft.train_state
+    ts, losses = steps_fn(ts, (idss, poss), (ys,), None, None, key)
+    _sync(losses[-1])
+    t0 = time.perf_counter()
+    for i in range(n):
+        ts, losses = steps_fn(ts, (idss, poss), (ys,), None, None,
+                              jrandom.fold_in(key, i))
+    _sync(losses[-1])
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "imported_bert_base_seq128_finetune_tokens_per_sec",
+        "value": round(n * k * batch * seq / dt, 1),
+        "unit": "tokens/sec/chip"}))
+
+
 def word2vec():
     """SGNS and HS at 100k vocab on a zipf-shaped corpus (the scale the
     reference's native AggregateSkipGram targets — SkipGram.java:176)."""

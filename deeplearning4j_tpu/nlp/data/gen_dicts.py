@@ -1,0 +1,70 @@
+"""Generate the bundled CJK dictionaries (run from the repo root).
+
+Chinese: the top entries of jieba's MIT-licensed frequency dictionary
+(jieba 0.42.1, https://github.com/fxsjy/jieba — dict.txt), filtered to
+multi-character words and written as "word<space>log_freq" (compact,
+gzipped). Attribution: jieba's dict.txt is MIT; see its LICENSE.
+
+Japanese: unique surface forms from the ipadic tokenization of Natsume
+Soseki's public-domain novel "Botchan" (the tokenizer-output fixture the
+reference's Kuromoji port ships for testing:
+deeplearning4j-nlp-japanese/src/test/resources/bocchan-ipadic-features
+.txt) — a real-text vocabulary for maximum-matching compound splits.
+"""
+
+import gzip
+import math
+import os
+import re
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def gen_chinese(top_n: int = 60000):
+    import jieba
+    src = os.path.join(os.path.dirname(jieba.__file__), "dict.txt")
+    rows = []
+    with open(src, encoding="utf-8") as fh:
+        for line in fh:
+            parts = line.split()
+            if len(parts) < 2:
+                continue
+            word, freq = parts[0], int(parts[1])
+            if len(word) < 2 or freq < 2:     # single chars are fallback
+                continue
+            rows.append((word, freq))
+    rows.sort(key=lambda t: -t[1])
+    rows = rows[:top_n]
+    # normalized log-probabilities: each token on a path then costs its
+    # information content, so the unigram DP does not prefer splitting a
+    # frequent compound into even-more-frequent pieces
+    total = sum(f for _, f in rows)
+    with gzip.open(os.path.join(HERE, "chinese_freq.txt.gz"), "wt",
+                   encoding="utf-8") as fh:
+        for w, f in rows:
+            fh.write(f"{w} {math.log(f) - math.log(total):.3f}\n")
+    print("chinese:", len(rows), "entries; log_total",
+          round(math.log(total), 2))
+
+
+def gen_japanese():
+    src = ("/root/reference/deeplearning4j-nlp-parent/"
+           "deeplearning4j-nlp-japanese/src/test/resources/"
+           "bocchan-ipadic-features.txt")
+    words = set()
+    jp = re.compile(r"^[぀-ヿ一-鿿ー]+$")
+    with open(src, encoding="utf-8") as fh:
+        for line in fh:
+            surface = line.split("\t", 1)[0].strip()
+            if len(surface) >= 2 and jp.match(surface):
+                words.add(surface)
+    with gzip.open(os.path.join(HERE, "japanese_words.txt.gz"), "wt",
+                   encoding="utf-8") as fh:
+        for w in sorted(words):
+            fh.write(w + "\n")
+    print("japanese:", len(words), "entries")
+
+
+if __name__ == "__main__":
+    gen_chinese()
+    gen_japanese()

@@ -59,8 +59,18 @@ def _flatten(tree) -> Dict[str, Any]:
 
 def _barrier(name: str) -> None:
     if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices(name)
+        # Uneven-device-count-safe barrier: the tiny device-sharded
+        # reduction forces every process to participate.
+        # (multihost_utils.sync_global_devices crashes when processes
+        # own unequal numbers of devices.)
+        import zlib
+        from deeplearning4j_tpu.parallel.mesh import (
+            global_device_value_range)
+        h = float(zlib.crc32(name.encode()) % (1 << 20))
+        mn, mx = global_device_value_range(h)
+        if mn != mx:             # pragma: no cover
+            raise RuntimeError(
+                f"barrier {name!r} mismatch across processes")
 
 
 def _shard_starts(index, shape) -> list:

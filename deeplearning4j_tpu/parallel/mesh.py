@@ -78,6 +78,36 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
                                    process_id)
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=4)
+def _device_range_fn(devs):
+    """Cached (jitted reduction, mesh) over one flat device tuple — a
+    fresh jit per call would re-trace/compile on every barrier."""
+    mesh = Mesh(np.array(devs), ("d",))
+    repl = NamedSharding(mesh, P())
+    fn = jax.jit(lambda a: (a.min(), a.max()),
+                 out_shardings=(repl, repl))
+    return fn, mesh
+
+
+def global_device_value_range(value: float) -> tuple:
+    """(min, max) of a per-process scalar across ALL devices of ALL
+    processes, via a tiny device-sharded reduction. Safe when processes
+    own UNEVEN device counts (multihost_utils.process_allgather stacks
+    per-process then tiles per-device and crashes on uneven layouts).
+    Every process must call this — it doubles as a barrier."""
+    devs = tuple(jax.devices())
+    fn, mesh = _device_range_fn(devs)
+    sh = NamedSharding(mesh, P("d"))
+    loc = jax.local_device_count()
+    arr = jax.make_array_from_process_local_data(
+        sh, np.full((loc,), value, np.float64), (len(devs),))
+    mn, mx = fn(arr)
+    return float(mn), float(mx)
+
+
 def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
     """Leading-dim (batch) sharding for input batches."""
     return NamedSharding(mesh, P(axis))

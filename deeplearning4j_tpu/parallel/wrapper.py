@@ -305,33 +305,55 @@ class ParallelWrapper:
         batch (the standard jax data-loading contract — each host's
         iterator yields its share), assembled into the global array via
         make_array_from_process_local_data; XLA moves nothing between
-        hosts. Requires EQUAL local batches on every process
-        (checked once per fit — unequal shards would silently build
-        inconsistent global shapes and hang the first collective)."""
+        hosts. Processes may own UNEVEN device counts (round 3): each
+        local batch must be proportional to this process's share of the
+        mesh devices (checked once per shape — a wrong split would
+        silently build inconsistent global shapes and hang the first
+        collective)."""
         if a is None:
             return None
         sh = self._batch_sh if sharding is None else sharding
         if jax.process_count() == 1:
             return jax.device_put(jnp.asarray(a), sh)
         a = np.asarray(a)
-        self._check_equal_local_batch(a.shape[batch_dim])
+        total = self._global_batch_size(a.shape[batch_dim])
         gshape = list(a.shape)
-        gshape[batch_dim] *= jax.process_count()
+        gshape[batch_dim] = total
         return jax.make_array_from_process_local_data(sh, a,
                                                       tuple(gshape))
 
-    def _check_equal_local_batch(self, n: int):
-        if getattr(self, "_local_batch_checked", None) == n:
-            return
-        from jax.experimental import multihost_utils
-        sizes = np.asarray(
-            multihost_utils.process_allgather(np.asarray([n])))
-        if not (sizes == n).all():
+    def _global_batch_size(self, n: int) -> int:
+        """Global batch rows for a local shard of ``n`` rows: every
+        device carries the same per-device batch, so the global size is
+        (n / local_devices) · global_devices — valid when processes own
+        UNEVEN device counts. Checked once per shard size with a tiny
+        device-sharded reduction: a per-device-batch mismatch across
+        processes would otherwise compile different programs per
+        process and hang the first collective."""
+        cache = getattr(self, "_global_batch_cache", None)
+        if cache is None:
+            cache = self._global_batch_cache = {}
+        if n in cache:
+            return cache[n]
+        loc = jax.local_device_count()
+        if n % loc:
             raise ValueError(
-                f"multi-host fit needs equal per-process batches; got "
-                f"{sizes.ravel().tolist()}. Pad or trim each host's "
-                "data shard to a common batch size.")
-        self._local_batch_checked = n
+                f"multi-host fit: this process's batch shard ({n} rows) "
+                f"must divide evenly over its {loc} local devices — "
+                "split each host's data by its device share.")
+        per = n // loc
+        from deeplearning4j_tpu.parallel.mesh import (
+            global_device_value_range)
+        mn, mx = global_device_value_range(float(per))
+        if mn != mx:
+            raise ValueError(
+                "multi-host fit needs the SAME per-device batch on every "
+                f"process; this process feeds {per} rows/device but the "
+                f"mesh sees between {int(mn)} and {int(mx)}. Split each "
+                "host's data shard by its device share.")
+        total = per * jax.device_count()
+        cache[n] = total
+        return total
 
     def _stage_batch(self, batch: DataSet):
         """Pad to the worker multiple and stage the four batch arrays on

@@ -1,0 +1,151 @@
+"""Worker for the multihost chaos / uneven-device tests
+(tests/test_multihost_chaos.py). Launched as
+
+  python multihost_chaos_worker.py <rank> <nprocs> <port> <outdir> \
+      <devices_csv> <die_rank> <die_step> <epochs>
+
+``devices_csv`` lists EVERY rank's device count (e.g. "2,1,1"), so each
+process can size its proportional slice of the global batch.
+
+Each process owns ``local_devices`` virtual CPU devices (UNEVEN counts
+across ranks are the point — a 2+1+1 layout is the honest simulation of
+heterogeneous hosts). Training runs through ElasticTrainer with
+frequent COMMITTED checkpoints; rank ``die_rank`` (if >= 0) dies
+abruptly (os._exit) at iteration ``die_step`` — mid-fit, after at least
+one checkpoint committed. Survivors detect the broken collective,
+record it, and exit cleanly; the relaunched (smaller) job resumes from
+the last COMMITTED checkpoint and reshards onto its new mesh —
+the reference's recovery semantics (Spark recompute + driver-held
+params, SURVEY §5.3) re-expressed as restore-and-reshard.
+"""
+
+import json
+import os
+import sys
+
+rank, nprocs, port, outdir, devices_csv, die_rank, die_step, epochs = (
+    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4],
+    sys.argv[5], int(sys.argv[6]), int(sys.argv[7]), int(sys.argv[8]))
+counts = [int(c) for c in devices_csv.split(",")]
+local_devices = counts[rank]
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + f" --xla_force_host_platform_device_count={local_devices}")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    from deeplearning4j_tpu.parallel.mesh import initialize_distributed
+    initialize_distributed(f"127.0.0.1:{port}", num_processes=nprocs,
+                           process_id=rank)
+    assert jax.local_device_count() == local_devices
+
+    import numpy as np
+    from deeplearning4j_tpu.datasets.dataset import (
+        ArrayDataSetIterator, DataSet)
+    from deeplearning4j_tpu.models.multi_layer_network import (
+        MultiLayerNetwork)
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+    from deeplearning4j_tpu.ops.activations import Activation
+    from deeplearning4j_tpu.optimize.listeners import TrainingListener
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+    from deeplearning4j_tpu.parallel.checkpoint import ElasticTrainer
+    from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, create_mesh
+    from deeplearning4j_tpu.parallel.wrapper import (
+        ParallelWrapper, TrainingMode)
+
+    n_dev = jax.device_count()
+    conf = (NeuralNetConfiguration.Builder().seed(1).updater(Sgd(0.05))
+            .list()
+            .layer(DenseLayer(n_out=16, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=3))
+            .set_input_type(InputType.feed_forward(4)).build())
+    model = MultiLayerNetwork(conf).init()
+    mesh = create_mesh({DATA_AXIS: n_dev})
+
+    # fixed GLOBAL batch of 64 rows; this process feeds the contiguous
+    # slice proportional to its device share (uneven across ranks)
+    rng = np.random.default_rng(0)
+    gx = rng.normal(size=(64, 4)).astype(np.float32)
+    gy = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+    per_row = 64 // n_dev
+    sizes = [per_row * counts[r] for r in range(nprocs)]
+    off = sum(sizes[:rank])
+    lx = gx[off:off + sizes[rank]]
+    ly = gy[off:off + sizes[rank]]
+
+    w = (ParallelWrapper.builder(model).mesh(mesh)
+         .training_mode(TrainingMode.SHARED_GRADIENTS).build())
+
+    ckpt_dir = os.path.join(outdir, "ckpt")
+    trainer = ElasticTrainer(model, ckpt_dir, checkpoint_every=2,
+                             mesh=mesh)
+    resumed = trainer.resume()
+    start_iter = int(model.train_state.iteration)
+
+    class _Killer(TrainingListener):
+        def iteration_done(self, m, iteration, epoch, loss, etl_ms, n):
+            if rank == die_rank and die_step >= 0 and \
+                    iteration >= die_step:
+                sys.stdout.flush()
+                os._exit(17)   # abrupt death mid-fit, no cleanup
+
+    if die_rank >= 0:
+        model.add_listeners(_Killer())
+
+    it = ArrayDataSetIterator(DataSet(lx, ly), batch_size=sizes[rank],
+                              shuffle=False)
+
+    # ElasticTrainer saves through the model fit loop; the wrapper owns
+    # the distributed step, so attach the trainer's saver semantics by
+    # checkpointing every N wrapper iterations via a listener
+    class _Saver(TrainingListener):
+        def __init__(self):
+            self.last = start_iter
+
+        def iteration_done(self, m, iteration, epoch, loss, etl_ms, n):
+            if iteration - self.last >= trainer.checkpoint_every:
+                from deeplearning4j_tpu.parallel.checkpoint import (
+                    save_sharded)
+                save_sharded(m.train_state, ckpt_dir)
+                trainer._prune()
+                self.last = int(iteration)
+
+    model.add_listeners(_Saver())
+
+    try:
+        w.fit(it, epochs=epochs)
+    except BaseException as e:     # a dead peer breaks the collective
+        with open(os.path.join(outdir, f"survivor_{rank}.json"),
+                  "w") as f:
+            json.dump({"rank": rank, "detected": True,
+                       "error": type(e).__name__,
+                       "message": str(e)[:500],
+                       "iteration": int(model.train_state.iteration)}, f)
+        print(f"rank {rank}: peer failure detected ({type(e).__name__}: "
+              f"{str(e)[:300]})", flush=True)
+        return
+
+    params = jax.tree_util.tree_map(np.asarray, model.params)
+    flat = np.concatenate([l.ravel() for l in
+                           jax.tree_util.tree_leaves(params)])
+    with open(os.path.join(outdir, f"result_{rank}.json"), "w") as f:
+        json.dump({"rank": rank, "loss": float(model._last_loss),
+                   "param_sum": float(flat.sum()),
+                   "resumed": bool(resumed),
+                   "start_iteration": start_iter,
+                   "final_iteration": int(model.train_state.iteration),
+                   "n_devices": n_dev,
+                   "local_batch": int(sizes[rank])}, f)
+    print(f"rank {rank} done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

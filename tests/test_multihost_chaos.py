@@ -1,0 +1,129 @@
+"""Multihost beyond the happy path (VERDICT r3 #6): a 3-process run
+with UNEVEN per-process device counts, and a chaos test that kills a
+live worker mid-fit and asserts the relaunched smaller job resumes from
+the last COMMITTED checkpoint with correct resharding."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "multihost_chaos_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f)
+    return env
+
+
+def _launch(rank, nprocs, port, outdir, devices_csv, die_rank=-1,
+            die_step=-1, epochs=3):
+    return subprocess.Popen(
+        [sys.executable, WORKER, str(rank), str(nprocs), str(port),
+         str(outdir), devices_csv, str(die_rank), str(die_step),
+         str(epochs)],
+        env=_env(), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+
+
+def _join(procs, timeout=600):
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    return outs
+
+
+@pytest.mark.slow
+def test_three_process_uneven_device_counts(tmp_path):
+    """3 OS processes owning 2+1+1 devices train one 4-device mesh; the
+    per-process batches are proportional (32/16/16 of a 64 batch) and
+    all ranks converge to identical replicated params."""
+    port = _free_port()
+    procs = [_launch(r, 3, port, tmp_path, "2,1,1") for r in range(3)]
+    outs = _join(procs)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-3000:]
+    results = []
+    for r in range(3):
+        with open(tmp_path / f"result_{r}.json") as f:
+            results.append(json.load(f))
+    assert [r["local_batch"] for r in results] == [32, 16, 16]
+    assert results[0]["n_devices"] == 4
+    for r in (1, 2):
+        assert results[r]["param_sum"] == pytest.approx(
+            results[0]["param_sum"], rel=1e-6)
+
+
+@pytest.mark.slow
+def test_kill_worker_midfit_then_resume_smaller_mesh(tmp_path):
+    """Phase 1: 3 even processes train with frequent COMMITTED
+    checkpoints; rank 2 dies abruptly mid-fit. Phase 2: a fresh
+    2-process job on the SAME checkpoint dir resumes from the last
+    COMMITTED step, reshards onto the smaller 2-device mesh, and
+    finishes training with identical params on both survivors."""
+    port = _free_port()
+    procs = [_launch(r, 3, port, tmp_path, "1,1,1",
+                     die_rank=2, die_step=6, epochs=60)
+             for r in range(3)]
+    outs = _join(procs)
+    # the victim died with the abrupt-exit code
+    assert procs[2].returncode == 17, outs[2][-2000:]
+    # at least one checkpoint was COMMITTED before the death
+    ckpt = tmp_path / "ckpt"
+    steps = sorted(d for d in os.listdir(ckpt) if d.startswith("step_")
+                   and (ckpt / d / "COMMITTED").exists())
+    assert steps, list(os.listdir(ckpt))
+    last_step = max(int(s.split("_")[1]) for s in steps)
+    assert last_step >= 2
+
+    # survivors either detected the broken collective and exited with a
+    # marker, or were reaped by the harness — both acceptable deaths;
+    # what matters is the durable checkpoint state
+    for r in (0, 1):
+        marker = tmp_path / f"survivor_{r}.json"
+        if marker.exists():
+            with open(marker) as f:
+                assert json.load(f)["detected"]
+
+    # ---- phase 2: relaunch smaller (2-process) job, same ckpt dir ----
+    port2 = _free_port()
+    procs2 = [_launch(r, 2, port2, tmp_path, "1,1", epochs=3)
+              for r in range(2)]
+    outs2 = _join(procs2)
+    for p, out in zip(procs2, outs2):
+        assert p.returncode == 0, out[-3000:]
+    results = []
+    for r in range(2):
+        with open(tmp_path / f"result_{r}.json") as f:
+            results.append(json.load(f))
+    for r in results:
+        assert r["resumed"] is True
+        # resumed exactly from the last COMMITTED checkpoint...
+        assert r["start_iteration"] == last_step
+        # ...on the smaller mesh, and made progress past it
+        assert r["n_devices"] == 2
+        assert r["final_iteration"] > r["start_iteration"]
+    assert results[0]["param_sum"] == pytest.approx(
+        results[1]["param_sum"], rel=1e-6)
