@@ -5,6 +5,8 @@ Subcommands (each prints one JSON line):
   inception  — imported InceptionV3 inference at the CANONICAL 299x299
   bert       — imported BERT-base inference tokens/s/chip (flash attn)
   bert_train — BERT-base-geometry native train step tokens/s/chip
+  bert_finetune   — imported-BERT fine-tune tokens/s (grafted head)
+  inception_train — imported-InceptionV3 fine-tune img/s (299x299)
   word2vec   — SGNS + HS tokens/s at 100k vocab (corpus-shaped workload)
 
 Run: python benchmarks/baseline_suite.py <subcommand>

@@ -326,15 +326,14 @@ class ParallelWrapper:
         """Global batch rows for a local shard of ``n`` rows: every
         device carries the same per-device batch, so the global size is
         (n / local_devices) · global_devices — valid when processes own
-        UNEVEN device counts. Checked once per shard size with a tiny
-        device-sharded reduction: a per-device-batch mismatch across
-        processes would otherwise compile different programs per
-        process and hang the first collective."""
-        cache = getattr(self, "_global_batch_cache", None)
-        if cache is None:
-            cache = self._global_batch_cache = {}
-        if n in cache:
-            return cache[n]
+        UNEVEN device counts.
+
+        The cross-process consistency check (a tiny device-sharded
+        reduction) runs exactly ONCE, on the very first staged array —
+        a point every process reaches together. It must NOT be repeated
+        per shard size: processes can see different size sequences, and
+        a check collective entered by only some of them would deadlock
+        against the train-step collective of the rest."""
         loc = jax.local_device_count()
         if n % loc:
             raise ValueError(
@@ -342,18 +341,19 @@ class ParallelWrapper:
                 f"must divide evenly over its {loc} local devices — "
                 "split each host's data by its device share.")
         per = n // loc
-        from deeplearning4j_tpu.parallel.mesh import (
-            global_device_value_range)
-        mn, mx = global_device_value_range(float(per))
-        if mn != mx:
-            raise ValueError(
-                "multi-host fit needs the SAME per-device batch on every "
-                f"process; this process feeds {per} rows/device but the "
-                f"mesh sees between {int(mn)} and {int(mx)}. Split each "
-                "host's data shard by its device share.")
-        total = per * jax.device_count()
-        cache[n] = total
-        return total
+        if not getattr(self, "_batch_check_done", False):
+            self._batch_check_done = True
+            from deeplearning4j_tpu.parallel.mesh import (
+                global_device_value_range)
+            mn, mx = global_device_value_range(float(per))
+            if mn != mx:
+                raise ValueError(
+                    "multi-host fit needs the SAME per-device batch on "
+                    f"every process; this process feeds {per} rows/"
+                    f"device but the mesh sees between {int(mn)} and "
+                    f"{int(mx)}. Split each host's data shard by its "
+                    "device share.")
+        return per * jax.device_count()
 
     def _stage_batch(self, batch: DataSet):
         """Pad to the worker multiple and stage the four batch arrays on

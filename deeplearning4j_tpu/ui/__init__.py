@@ -14,6 +14,7 @@ from deeplearning4j_tpu.ui.storage import (
     RemoteUIStatsStorageRouter,
 )
 from deeplearning4j_tpu.ui.server import UIServer
+from deeplearning4j_tpu.ui.tsne_listener import TsneListener
 
 __all__ = ["StatsListener", "InMemoryStatsStorage", "SqliteStatsStorage",
-           "RemoteUIStatsStorageRouter", "UIServer"]
+           "RemoteUIStatsStorageRouter", "UIServer", "TsneListener"]

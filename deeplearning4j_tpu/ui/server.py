@@ -51,7 +51,13 @@ img.act{image-rendering:pixelated;border:1px solid #ccc;margin:4px}
 <div id=model class=tab>
 <h2>Model graph</h2>
 <div class=card><svg id=dag width="100%" height="500"></svg></div>
-<div class=card><b>Layer detail</b><table id=ldetail></table></div>
+<div class=card><b>Layer detail</b> <span id=lname></span>
+<table id=ldetail></table>
+<b>mean |param| and mean |update| over iterations</b>
+<canvas id=lseries></canvas>
+<b>latest param / update histograms</b>
+<canvas id=lhist style="height:140px"></canvas>
+<canvas id=luhist style="height:140px"></canvas></div>
 </div>
 <div id=system class=tab>
 <h2>System</h2>
@@ -61,6 +67,10 @@ img.act{image-rendering:pixelated;border:1px solid #ccc;margin:4px}
 </div>
 <div id=activations class=tab>
 <h2>Layer activations</h2>
+<div class=card>iteration:
+<input type=range id=actslider min=0 max=0 step=1 value=0
+style="width:60%">
+<span id=actlabel>latest</span></div>
 <div class=card id=actimgs>no activation records yet — attach a
 ConvolutionalListener</div>
 </div>
@@ -117,17 +127,44 @@ function drawDag(nodes, stats){
     t2.setAttribute('x',pos[n.name].x+6); t2.setAttribute('y',pos[n.name].y+31);
     t2.textContent=n.type+' ('+n.n_params+')';
     g.append(r,t1,t2);
-    g.onclick=()=>{
-      const st=(stats||{})[n.name]||{};
-      const rows=Object.entries({name:n.name,type:n.type,
-        params:n.n_params,...st}).map(([k,v])=>{
-        const tr=document.createElement('tr');
-        const th=document.createElement('th'); th.textContent=k;
-        const td=document.createElement('td');
-        td.textContent=JSON.stringify(v); tr.append(th,td); return tr;});
-      document.getElementById('ldetail').replaceChildren(...rows);};
+    g.onclick=()=>{selectedLayer=n; drillDown(n);};
     svg.append(g);});
   svg.setAttribute('height', 20+Math.ceil(nodes.length/perRow)*70);
+}
+function drawBars(cv, hist, color){
+  const c=cv.getContext('2d');
+  const W=cv.width=cv.clientWidth, H=cv.height=cv.clientHeight;
+  c.clearRect(0,0,W,H);
+  if(!hist||!hist.counts||!hist.counts.length){
+    c.fillText('no histogram yet',20,20); return;}
+  const mx=Math.max(...hist.counts)||1, n=hist.counts.length;
+  hist.counts.forEach((v,i)=>{
+    c.fillStyle=color;
+    const bw=(W-60)/n;
+    c.fillRect(30+i*bw, H-18-(v/mx)*(H-34), bw-1, (v/mx)*(H-34));});
+  c.fillStyle='#333';
+  c.fillText(hist.min.toPrecision(3),30,H-4);
+  c.fillText(hist.max.toPrecision(3),W-70,H-4);
+}
+async function drillDown(n){
+  const st=latestStats[n.name]||{};
+  document.getElementById('lname').textContent=n.name;
+  const rows=Object.entries({name:n.name,type:n.type,
+    params:n.n_params,...st}).map(([k,v])=>{
+    const tr=document.createElement('tr');
+    const th=document.createElement('th'); th.textContent=k;
+    const td=document.createElement('td');
+    td.textContent=JSON.stringify(v); tr.append(th,td); return tr;});
+  document.getElementById('ldetail').replaceChildren(...rows);
+  const ld=await (await fetch('api/layer?session='+dagSession
+    +'&name='+encodeURIComponent(n.name))).json();
+  draw(document.getElementById('lseries'),
+       [ld.param_mean_magnitude||[], ld.update_mean_magnitude||[]],
+       ['mean |param|','mean |update|']);
+  drawBars(document.getElementById('lhist'), ld.param_histogram,
+           '#1668b8');
+  drawBars(document.getElementById('luhist'), ld.update_histogram,
+           '#c2410c');
 }
 function scatter(cv, pts, labels){
   const c=cv.getContext('2d');
@@ -152,7 +189,39 @@ function showTab(){
     a.classList.toggle('on',a.hash==='#'+h));
 }
 window.onhashchange=()=>{showTab(); tick();};
-let dagSession=null, latestStats={}, lastActIter=null;
+let dagSession=null, latestStats={}, lastActIter=null,
+    selectedLayer=null, actIters=[], actFollow=true;
+document.addEventListener('DOMContentLoaded',()=>{
+  const sl=document.getElementById('actslider');
+  sl.oninput=async ()=>{
+    actFollow = (+sl.value === actIters.length-1);
+    const it = actIters[+sl.value];
+    if(it===undefined) return;
+    const sessions = await (await fetch('api/sessions')).json();
+    const s = sessions[sessions.length-1];
+    renderActs(await (await fetch('api/activations?session='+s
+      +'&iteration='+it)).json(), false);
+  };
+});
+function renderActs(act, updateSlider){
+  const imgs = act.activations_png||{};
+  actIters = act.iterations||[];
+  const sl=document.getElementById('actslider');
+  sl.max = Math.max(0, actIters.length-1);
+  if(updateSlider && actFollow) sl.value = sl.max;
+  document.getElementById('actlabel').textContent =
+    'iteration '+(act.iteration??'—')+' ('+actIters.length+' recorded)';
+  if(!Object.keys(imgs).length) return;
+  if(act.iteration===lastActIter) return;
+  lastActIter = act.iteration;
+  const div=document.getElementById('actimgs');
+  div.replaceChildren(...Object.entries(imgs).map(([name,b64])=>{
+    const w=document.createElement('div');
+    const lbl=document.createElement('b'); lbl.textContent=name;
+    const img=document.createElement('img'); img.className='act';
+    img.src='data:image/png;base64,'+b64;
+    w.append(lbl,document.createElement('br'),img); return w;}));
+}
 async function tick(){
   showTab();
   const h=(location.hash||'#overview').slice(1);
@@ -182,23 +251,16 @@ async function tick(){
     Object.assign(latestStats, md.latest_param_stats||{});
     if(dagSession!==s){ drawDag(md.graph||[], latestStats);
                         dagSession=s; }
+    if(selectedLayer) drillDown(selectedLayer);
   } else if(h==='system'){
     const sys = await (await fetch('api/system?session='+s)).json();
     const d = await (await fetch('api/overview?session='+s)).json();
     draw(document.getElementById('mem'), [sys.bytes_in_use||[]]);
     draw(document.getElementById('etl'), [d.etl_ms||[]]);
   } else if(h==='activations'){
-    const act = await (await fetch('api/activations?session='+s)).json();
-    const imgs = act.activations_png||{};
-    if(Object.keys(imgs).length && act.iteration!==lastActIter){
-      lastActIter = act.iteration;
-      const div=document.getElementById('actimgs');
-      div.replaceChildren(...Object.entries(imgs).map(([name,b64])=>{
-        const w=document.createElement('div');
-        const lbl=document.createElement('b'); lbl.textContent=name;
-        const img=document.createElement('img'); img.className='act';
-        img.src='data:image/png;base64,'+b64;
-        w.append(lbl,document.createElement('br'),img); return w;}));
+    if(actFollow){
+      renderActs(await (await fetch('api/activations?session='+s))
+        .json(), true);
     }
   } else if(h==='tsne'){
     const ts = await (await fetch('api/tsne')).json();
@@ -279,15 +341,56 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if u.path == "/api/activations":
             sess = self._session(u)
-            for up in reversed(self.storage.get_all_updates(sess)
-                               if sess else []):
-                if up.get("type") == "activations":
-                    self._json({
-                        "iteration": up.get("iteration"),
-                        "activations_png": up.get("activations_png", {}),
-                    })
-                    return
-            self._json({"activations_png": {}})
+            q = parse_qs(u.query)
+            want = q.get("iteration", [None])[0]
+            ups = [up for up in (self.storage.get_all_updates(sess)
+                                 if sess else [])
+                   if up.get("type") == "activations"]
+            iters = [up.get("iteration") for up in ups]
+            chosen = None
+            if want is not None:
+                chosen = next((up for up in ups
+                               if str(up.get("iteration")) == want), None)
+            if chosen is None and ups:
+                chosen = ups[-1]
+            self._json({
+                "iterations": iters,
+                "iteration": chosen.get("iteration") if chosen else None,
+                "activations_png": (chosen.get("activations_png", {})
+                                    if chosen else {}),
+            })
+            return
+        if u.path == "/api/layer":
+            # per-layer drill-down: param/update stats over time + the
+            # latest histograms (the TrainModule per-layer charts)
+            sess = self._session(u)
+            q = parse_qs(u.query)
+            name = q.get("name", [None])[0]
+            its, pmag, pstd, umag, ratio = [], [], [], [], []
+            phist = uhist = None
+            for up in (self.storage.get_all_updates(sess)
+                       if sess else []):
+                ps = (up.get("param_stats") or {}).get(name)
+                if not ps:
+                    continue
+                its.append(up.get("iteration"))
+                pmag.append(ps.get("mean_magnitude"))
+                pstd.append(ps.get("stdev"))
+                us = (up.get("update_stats") or {}).get(name) or {}
+                um = us.get("mean_magnitude")
+                umag.append(um)
+                pm = ps.get("mean_magnitude")
+                # um may legitimately be 0.0 (frozen layer): keep it
+                ratio.append((um / pm) if um is not None and pm
+                             else None)
+                phist = ps.get("histogram") or phist
+                uhist = us.get("histogram") or uhist
+            self._json({
+                "name": name, "iterations": its,
+                "param_mean_magnitude": pmag, "param_stdev": pstd,
+                "update_mean_magnitude": umag, "update_ratio": ratio,
+                "param_histogram": phist, "update_histogram": uhist,
+            })
             return
         if u.path == "/api/tsne":
             self._json(getattr(self.server, "tsne_data", None)

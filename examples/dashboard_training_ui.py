@@ -1,21 +1,27 @@
-"""Training dashboard: overview/model/system/activations/t-SNE tabs.
+"""Training dashboard: overview/model/system/activations/t-SNE tabs,
+all self-populating (no manual uploads).
 
     JAX_PLATFORMS=cpu python examples/dashboard_training_ui.py
 
 Trains a small conv net on real handwritten digits while serving the
-dashboard; open the printed URL, then Ctrl-C to stop.
+dashboard; open the printed URL — the Model tab supports per-layer
+drill-down (click a node), the Activations tab has an iteration slider
+over the full recorded history, and the t-SNE tab refreshes itself from
+the live model's penultimate activations. Ctrl-C to stop.
 """
 
 import jax
 
 jax.config.update("jax_platforms", "cpu")
 
-import numpy as np
-
 from deeplearning4j_tpu.datasets.fetchers import DigitsDataSetIterator
-from deeplearning4j_tpu.manifold.tsne import Tsne
 from deeplearning4j_tpu.zoo.models import LeNet
-from deeplearning4j_tpu.ui import InMemoryStatsStorage, StatsListener, UIServer
+from deeplearning4j_tpu.ui import (
+    InMemoryStatsStorage,
+    StatsListener,
+    TsneListener,
+    UIServer,
+)
 from deeplearning4j_tpu.ui.convolutional import ConvolutionalListener
 
 storage = InMemoryStatsStorage()
@@ -25,24 +31,21 @@ print("dashboard:", server.url)
 model = LeNet(compute_dtype="float32").init()
 train_it = DigitsDataSetIterator(batch_size=64, train=True)
 example = next(iter(train_it)).features
+test_imgs, test_labels = DigitsDataSetIterator.fetch(train=False)
 model.set_listeners(
     StatsListener(storage, session_id="digits"),
     ConvolutionalListener(storage, session_id="digits",
-                          frequency=5).set_example(example))
+                          frequency=5).set_example(example),
+    # the t-SNE tab populates itself from the live model every 20 steps
+    TsneListener(server, frequency=20, n_iter=250).set_example(
+        test_imgs[:300], test_labels[:300]))
 train_it.reset()
 model.fit(train_it, epochs=10)
 
 acc = model.evaluate(DigitsDataSetIterator(batch_size=64, train=False,
                                            shuffle=False)).accuracy()
 print("test accuracy:", acc)
-
-# populate the t-SNE tab with the test set's penultimate activations
-imgs, labels = DigitsDataSetIterator.fetch(train=False)
-acts = np.asarray(model.feed_forward(imgs[:300])[-2])
-coords = Tsne(n_components=2, perplexity=20, n_iter=300).fit_transform(
-    acts.reshape(acts.shape[0], -1))
-server.upload_tsne(coords, labels[:300].tolist())
-print("t-SNE uploaded — press Ctrl-C to exit")
+print("dashboard live — press Ctrl-C to exit")
 try:
     import time
     time.sleep(3600)

@@ -205,6 +205,108 @@ class TestStatsPipeline:
         finally:
             server.stop()
 
+    def test_activation_history_by_iteration(self):
+        """Round 3: the Activations tab serves the FULL recorded history
+        — any iteration retrievable, not just the latest."""
+        st = InMemoryStatsStorage()
+        for it in (1, 2, 3):
+            st.put_update({"session_id": "h", "iteration": it,
+                           "timestamp": float(it),
+                           "type": "activations",
+                           "activations_png": {"layer_0": f"img{it}"}})
+        server = UIServer(port=0).attach(st)
+        server.start()
+        try:
+            with urllib.request.urlopen(
+                    server.url + "/api/activations?session=h") as r:
+                act = json.loads(r.read())
+            assert act["iterations"] == [1, 2, 3]
+            assert act["iteration"] == 3          # latest by default
+            with urllib.request.urlopen(
+                    server.url
+                    + "/api/activations?session=h&iteration=2") as r:
+                act2 = json.loads(r.read())
+            assert act2["iteration"] == 2
+            assert act2["activations_png"]["layer_0"] == "img2"
+        finally:
+            server.stop()
+
+    def test_layer_drilldown_endpoint(self):
+        """Round 3: /api/layer serves per-layer param/update stats over
+        time + latest histograms (the TrainModule drill-down)."""
+        st = InMemoryStatsStorage()
+        for it in (1, 2):
+            st.put_update({
+                "session_id": "d", "iteration": it, "timestamp": float(it),
+                "param_stats": {"layer_0": {
+                    "mean_magnitude": 0.1 * it, "stdev": 0.05,
+                    "histogram": {"counts": [1, 2], "min": 0.0,
+                                  "max": 1.0}}},
+                "update_stats": {"layer_0": {
+                    "mean_magnitude": 0.01 * it,
+                    "histogram": {"counts": [3, 4], "min": -1.0,
+                                  "max": 1.0}}},
+            })
+        server = UIServer(port=0).attach(st)
+        server.start()
+        try:
+            with urllib.request.urlopen(
+                    server.url + "/api/layer?session=d&name=layer_0") as r:
+                d = json.loads(r.read())
+            assert d["iterations"] == [1, 2]
+            assert d["param_mean_magnitude"] == [0.1, 0.2]
+            assert d["update_mean_magnitude"] == [0.01, 0.02]
+            assert d["update_ratio"][1] == pytest.approx(0.1)
+            assert d["param_histogram"]["counts"] == [1, 2]
+            assert d["update_histogram"]["counts"] == [3, 4]
+        finally:
+            server.stop()
+
+    def test_tsne_listener_auto_populates(self):
+        """Round 3: TsneListener embeds the live model's activations and
+        fills the t-SNE tab with no manual upload."""
+        import time
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.models.multi_layer_network import (
+            MultiLayerNetwork)
+        from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.inputs import InputType
+        from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+        from deeplearning4j_tpu.nn.layers.output import OutputLayer
+        from deeplearning4j_tpu.optimize.updaters import Adam
+        from deeplearning4j_tpu.ui import TsneListener
+
+        conf = (NeuralNetConfiguration.Builder().seed(3).updater(Adam(1e-2))
+                .list()
+                .layer(DenseLayer(n_out=8))
+                .layer(OutputLayer(n_out=3))
+                .set_input_type(InputType.feed_forward(5)).build())
+        m = MultiLayerNetwork(conf).init()
+        st = InMemoryStatsStorage()
+        server = UIServer(port=0).attach(st)
+        server.start()
+        try:
+            rng = np.random.default_rng(4)
+            x = rng.normal(size=(40, 5)).astype(np.float32)
+            y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 40)]
+            m.set_listeners(TsneListener(server, frequency=1, n_iter=30,
+                                         perplexity=5.0)
+                            .set_example(x, rng.integers(0, 3, 40)))
+            m.fit(DataSet(x, y))
+            for _ in range(100):       # background embedding thread
+                with urllib.request.urlopen(server.url
+                                            + "/api/tsne") as r:
+                    d = json.loads(r.read())
+                if d["points"]:
+                    break
+                time.sleep(0.2)
+            assert len(d["points"]) == 40
+            assert len(d["labels"]) == 40
+            assert all(np.isfinite(p).all() for p in
+                       np.asarray(d["points"]))
+        finally:
+            server.stop()
+
     def test_tsne_tab_upload_and_fetch(self):
         st = InMemoryStatsStorage()
         st.put_update({"session_id": "t", "iteration": 0, "score": 1.0,
