@@ -14,6 +14,7 @@ single XLA program. bfloat16 compute is a flag away
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Optional, Tuple
 
 from deeplearning4j_tpu.models.computation_graph import ComputationGraph
@@ -48,9 +49,17 @@ from deeplearning4j_tpu.optimize.updaters import Adam, Nesterovs, Updater
 
 class ZooModel:
     """Base zoo entry (reference: ZooModel.java:23). ``init()`` returns a
-    built, initialized model. Pretrained-weight loading hooks into the
-    checkpoint loader when a weights file is present locally (zero-egress
-    environment: no downloads; same cache contract as the fetchers)."""
+    built, initialized model.
+
+    ``init_pretrained`` implements the reference's download+checksum
+    contract (ZooModel.initPretrained:51): fetch the published weights
+    archive into the cache dir, verify its Adler32 checksum, restore.
+    Zero-egress environments point ``url`` at a ``file://`` mirror (the
+    path the tests exercise); a plain local ``path`` also works."""
+
+    # subclasses may publish {url, checksum} per pretrained flavor the
+    # way the reference's pretrainedUrl/pretrainedChecksum do
+    PRETRAINED: dict = {}
 
     def conf(self):
         raise NotImplementedError
@@ -58,15 +67,40 @@ class ZooModel:
     def init(self):
         raise NotImplementedError
 
-    def init_pretrained(self, path: Optional[str] = None):
+    def init_pretrained(self, path: Optional[str] = None,
+                        url: Optional[str] = None,
+                        checksum: Optional[int] = None,
+                        flavor: str = "default"):
+        from deeplearning4j_tpu.datasets.fetchers import (
+            DATA_DIR, fetch_with_mirror)
         from deeplearning4j_tpu.models.serialization import (
             restore_computation_graph, restore_multi_layer_network)
         if path is None:
-            raise FileNotFoundError(
-                "no local pretrained weights; this environment has no "
-                "network egress — place a checkpoint zip and pass its path")
-        model = self.init()
-        if isinstance(model, MultiLayerNetwork):
+            if url is None and flavor in self.PRETRAINED:
+                spec = self.PRETRAINED[flavor]
+                url = spec.get("url")
+                checksum = checksum if checksum is not None \
+                    else spec.get("checksum")
+            if url is None:
+                raise FileNotFoundError(
+                    "no pretrained weights source: pass path= to a local "
+                    "checkpoint zip, or url= (file:// mirrors work in "
+                    "zero-egress environments) + checksum=")
+            # cache key includes the url: without it, a later call with a
+            # different mirror would silently reuse the first download
+            import zlib
+            tag = f"{zlib.crc32(url.encode()):08x}"
+            dest = os.path.join(
+                DATA_DIR, "pretrained",
+                f"{type(self).__name__}_{flavor}_{tag}.zip")
+            path = fetch_with_mirror(url, dest,
+                                     expected_checksum=checksum)
+        # the checkpoint's stored configuration defines the restored
+        # architecture (reference semantics: initPretrained returns the
+        # published network as-is); dispatch by this zoo entry's config
+        # class without paying a throwaway random init
+        from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
+        if isinstance(self.conf(), MultiLayerConfiguration):
             return restore_multi_layer_network(path)
         return restore_computation_graph(path)
 
