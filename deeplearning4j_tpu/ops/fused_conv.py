@@ -133,13 +133,32 @@ def _c3_images_per_program(n: int, h: int, wd: int, cin: int) -> int:
         bi -= 1
     return bi
 
+
+def _c3_fits_vmem(h: int, wd: int, cin: int, cout: int) -> bool:
+    """Whether even a single-image 3×3 program fits the VMEM budget.
+
+    The 3×3 kernels keep the whole padded (h+2)×(w+2)×Cin input plane
+    plus the h×w×Cout f32 accumulator resident; at ImageNet-size planes
+    (e.g. 224×224×64) that exceeds the ~16 MB of VMEM and the Pallas
+    call fails at compile time. Beyond this budget the op falls back to
+    the XLA reference math (advisor r3 low finding)."""
+    plane = (h + 2) * (wd + 2) * cin * 2          # padded bf16 input
+    # accumulator is tiled over cout in bn=min(512,cout) blocks — mirror
+    # _c3_pallas, not the full cout (a 56×56×2048 layer tiles fine)
+    acc = h * wd * min(512, cout) * 4             # f32 matmul accumulator
+    return plane + acc <= 8e6
+
 def _c3_kernel(x_ref, w_ref, s_ref, b_ref, y_ref, st_ref, *,
-               relu_in: bool, want_stats: bool, h: int, wdt: int):
-    x = x_ref[...].astype(jnp.float32)                 # (bi, h, w, cin)
-    e = x * s_ref[0, 0, 0] + b_ref[0, 0, 0]
-    if relu_in:
-        e = jnp.maximum(e, 0.0)
-    e = e.astype(w_ref.dtype)
+               relu_in: bool, want_stats: bool, norm_in: bool, h: int,
+               wdt: int):
+    if norm_in:
+        x = x_ref[...].astype(jnp.float32)             # (bi, h, w, cin)
+        e = x * s_ref[0, 0, 0] + b_ref[0, 0, 0]
+        if relu_in:
+            e = jnp.maximum(e, 0.0)
+        e = e.astype(w_ref.dtype)
+    else:
+        e = x_ref[...].astype(w_ref.dtype)
     bi = e.shape[0]
     cin = e.shape[3]
     ep = jnp.pad(e, ((0, 0), (1, 1), (1, 1), (0, 0)))
@@ -156,14 +175,16 @@ def _c3_kernel(x_ref, w_ref, s_ref, b_ref, y_ref, st_ref, *,
 
 
 def _c3_pallas(x4d, w, scale, shift, relu_in: bool, want_stats: bool,
-               interpret: bool, out_dtype) -> Tuple[jax.Array, jax.Array]:
+               norm_in: bool, interpret: bool,
+               out_dtype) -> Tuple[jax.Array, jax.Array]:
     n, h, wd, cin = x4d.shape
     cout = w.shape[3]
     bi = _c3_images_per_program(n, h, wd, cin)
     bn = min(512, cout)
     ni, nn = n // bi, -(-cout // bn)
     kernel = functools.partial(_c3_kernel, relu_in=relu_in,
-                               want_stats=want_stats, h=h, wdt=wd)
+                               want_stats=want_stats, norm_in=norm_in,
+                               h=h, wdt=wd)
     y, st = pl.pallas_call(
         kernel,
         grid=(nn, ni),
@@ -561,8 +582,11 @@ def _fused_fwd_impl(x, w, scale, shift, relu_in, norm_in, stride,
         y2, st = _mm_pallas(x.reshape(-1, cin), w, scale, shift, relu_in,
                             True, norm_in, interpret, x.dtype)
         return y2.reshape(n, h, wd, -1), st
-    return _c3_pallas(x, w, scale, shift, relu_in, True, interpret,
-                      x.dtype)
+    n, h, wd, cin = x.shape
+    if not _c3_fits_vmem(h, wd, cin, w.shape[3]):
+        return _conv_reference(x, w, scale, shift, relu_in, norm_in, 1)
+    return _c3_pallas(x, w, scale, shift, relu_in, True, norm_in,
+                      interpret, x.dtype)
 
 
 def _fused_fwd_rule(x, w, scale, shift, relu_in, norm_in, stride,
@@ -593,6 +617,15 @@ def _fused_bwd_rule(relu_in, norm_in, stride, interpret, res, cots):
         else x
     cin = xs.shape[-1]
     cout = y.shape[-1]
+
+    if w.ndim == 4 and not _c3_fits_vmem(xs.shape[1], xs.shape[2], cin,
+                                         cout):
+        # oversized spatial plane: the whole op ran on the XLA reference
+        # path — differentiate that same math
+        def _ref(x_, w_, s_, b_):
+            return _conv_reference(x_, w_, s_, b_, relu_in, norm_in, 1)
+        _, vjp = jax.vjp(_ref, x, w, scale, shift)
+        return vjp((dy, dst))
 
     if w.ndim == 2:
         dy2 = dy.reshape(-1, cout)

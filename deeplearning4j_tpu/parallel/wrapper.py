@@ -247,6 +247,20 @@ class ParallelWrapper:
 
     # ---- fit ------------------------------------------------------------
     def fit(self, iterator: DataSetIterator, epochs: int = 1):
+        """Train over the iterator.
+
+        Multi-process contract: EVERY batch each host yields (not just
+        the first) must be proportional to that host's share of the mesh
+        devices — same rows-per-device everywhere. Hosts pad their tail
+        batches independently (``_pad_batch`` pads to the local worker
+        multiple), so an uneven final split that violates this builds
+        inconsistent global shapes and hangs the first collective rather
+        than raising; the cross-host equality check runs only once (see
+        ``_global_batch_size`` for why repeating it would itself
+        deadlock). A collective-free local monitor warns when a
+        *non-final* batch's per-device count drifts from the checked
+        value — the final batch legitimately may."""
+        self._pending_uneven_per = None     # fresh fit: prior tail is fine
         if self.mode is TrainingMode.SHARED_GRADIENTS:
             return self._fit_sync(iterator, epochs)
         if self.mode is TrainingMode.AVERAGING:
@@ -343,6 +357,7 @@ class ParallelWrapper:
         per = n // loc
         if not getattr(self, "_batch_check_done", False):
             self._batch_check_done = True
+            self._checked_per = per
             from deeplearning4j_tpu.parallel.mesh import (
                 global_device_value_range)
             mn, mx = global_device_value_range(float(per))
@@ -355,10 +370,38 @@ class ParallelWrapper:
                     "device share.")
         return per * jax.device_count()
 
+    def _monitor_uneven_batch(self, n: int):
+        """Collective-free drift monitor (advisor r3), batch-level: a
+        batch whose per-device count differs from the checked value is
+        legal only as the FINAL batch of a fit. When ANOTHER batch
+        follows an uneven one, the uneven one was mid-stream and the
+        global shapes it built were inconsistent across hosts — warn
+        loudly, once (we cannot raise retroactively, and a fresh
+        collective check would deadlock; see ``_global_batch_size``)."""
+        loc = jax.local_device_count()
+        per = n // loc if n % loc == 0 else n / loc
+        if (getattr(self, "_pending_uneven_per", None) is not None
+                and not getattr(self, "_uneven_warned", False)):
+            self._uneven_warned = True
+            import warnings
+            warnings.warn(
+                "multi-host fit: a NON-final batch fed "
+                f"{self._pending_uneven_per} rows/device where the "
+                f"checked value is {getattr(self, '_checked_per', '?')} "
+                "— each host must split every mid-stream batch "
+                "proportionally to its device share; the preceding "
+                "collective may have mixed inconsistent global shapes.",
+                stacklevel=3)
+        checked = getattr(self, "_checked_per", None)
+        self._pending_uneven_per = per if (checked is not None
+                                           and per != checked) else None
+
     def _stage_batch(self, batch: DataSet):
         """Pad to the worker multiple and stage the four batch arrays on
         the mesh — the single home for sync-step argument staging."""
         batch = self._pad_batch(batch)
+        if jax.process_count() > 1:
+            self._monitor_uneven_batch(batch.num_examples())
         return (self._put_batch(batch.features),
                 self._put_batch(batch.labels),
                 self._put_batch(batch.features_mask),
@@ -407,6 +450,9 @@ class ParallelWrapper:
                 m._last_loss = loss
                 t0 = time.perf_counter()
             iterator.reset()
+            # an epoch's final batch is "final" — a legal uneven tail
+            # must not trip the drift monitor on the next epoch
+            self._pending_uneven_per = None
             for lst in m.listeners:
                 lst.on_epoch_end(m, m.epoch_count)
             m.epoch_count += 1
@@ -436,6 +482,7 @@ class ParallelWrapper:
                     pending.append(pending[-1])
                 self._run_averaging_round(pending)
             iterator.reset()
+            self._pending_uneven_per = None     # legal uneven tail round
             for lst in m.listeners:
                 lst.on_epoch_end(m, m.epoch_count)
             m.epoch_count += 1
@@ -448,6 +495,10 @@ class ParallelWrapper:
         # equalize batch sizes (stacking needs it), padding w/ masked rows
         target = max(b.num_examples() for b in batches)
         batches = [self._pad_batch(b, target=target) for b in batches]
+        if jax.process_count() > 1:
+            # same drift contract as _stage_batch: every mid-stream
+            # round's per-host rows must match the checked value
+            self._monitor_uneven_batch(batches[0].num_examples())
 
         def ones_lmask(b: DataSet):
             lab = np.asarray(b.labels)

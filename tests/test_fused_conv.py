@@ -48,12 +48,67 @@ def test_forward_matches_reference(case):
                                rtol=1e-4, atol=1e-3)
 
 
-def test_no_norm_prologue():
-    x, wt, s, b = _mk(2, 4, 4, 8, 16, 1)
+@pytest.mark.parametrize("kernel", [1, 3])
+def test_no_norm_prologue(kernel):
+    """norm_in=False must skip the scale/shift on BOTH conv paths
+    (advisor r3 medium: the 3×3 kernel used to apply it
+    unconditionally)."""
+    x, wt, s, b = _mk(2, 4, 4, 8, 16, kernel)
     y, st = fused_conv_bn_act(x, wt, s, b, False, False, 1, True)
-    yr, _ = _conv_reference(x, wt, s, b, False, False, 1)
+    yr, str_ = _conv_reference(x, wt, s, b, False, False, 1)
     np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
                                rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(st), np.asarray(str_),
+                               rtol=1e-4, atol=1e-3)
+
+
+@pytest.mark.parametrize("kernel", [1, 3])
+def test_no_norm_grads(kernel):
+    """forward/backward consistency for norm_in=False (the advisor-found
+    combination: fwd applied the normalize, bwd skipped it)."""
+    x, wt, s, b = _mk(2, 4, 4, 8, 12, kernel)
+
+    def loss(f):
+        def inner(x, wt):
+            y, st = f(x, wt, s, b, False, False, 1)
+            return jnp.sum(jnp.tanh(y.astype(jnp.float32))) \
+                + 1e-3 * jnp.sum(st)
+        return inner
+
+    def fused(x, wt, s, b, r, n, st):
+        return fused_conv_bn_act(x, wt, s, b, r, n, st, True)
+
+    gf = jax.grad(loss(fused), argnums=(0, 1))(x, wt)
+    gr = jax.grad(loss(_conv_reference), argnums=(0, 1))(x, wt)
+    for a, r, name in zip(gf, gr, ["x", "w"]):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(r), rtol=2e-4, atol=2e-4,
+            err_msg=f"grad mismatch for {name}")
+
+
+def test_oversized_plane_falls_back_to_xla():
+    """ImageNet-size spatial planes exceed the single-image VMEM budget;
+    the op must route to the XLA reference path (fwd AND bwd) instead of
+    emitting an uncompilable Pallas call (advisor r3 low)."""
+    from deeplearning4j_tpu.ops.fused_conv import _c3_fits_vmem
+    assert not _c3_fits_vmem(224, 224, 64, 16)
+    assert _c3_fits_vmem(16, 16, 64, 64)
+    xb = jnp.asarray(RNG.normal(0, 1, (1, 224, 224, 64))
+                     .astype(np.float32))
+    wb = jnp.asarray(RNG.normal(0, 0.1, (3, 3, 64, 16))
+                     .astype(np.float32))
+    sb = jnp.ones(64, jnp.float32)
+    bb = jnp.zeros(64, jnp.float32)
+    y, st = fused_conv_bn_act(xb, wb, sb, bb, True, True, 1, True)
+    yr, str_ = _conv_reference(xb, wb, sb, bb, True, True, 1)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                               rtol=1e-4, atol=1e-4)
+    g = jax.grad(lambda a: jnp.sum(
+        fused_conv_bn_act(a, wb, sb, bb, True, True, 1, True)[0]))(xb)
+    gr = jax.grad(lambda a: jnp.sum(
+        _conv_reference(a, wb, sb, bb, True, True, 1)[0]))(xb)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
+                               rtol=1e-4, atol=1e-4)
 
 
 @pytest.mark.parametrize("kernel,stride", [(1, 1), (1, 2), (3, 1)])
