@@ -42,6 +42,7 @@ from deeplearning4j_tpu.nn.layers.convolution import (
     ZeroPaddingLayer,
 )
 from deeplearning4j_tpu.nn.dropout import (
+    AlphaDropout as SeluAlphaDropout,
     GaussianDropout as GaussianDropoutNoise,
     GaussianNoise as AdditiveGaussianNoise,
 )
@@ -412,6 +413,15 @@ def gaussian_dropout(cfg, _v):
                                                                 0.5))))))
 
 
+def alpha_dropout(cfg, _v):
+    """SELU-preserving dropout (reference: KerasAlphaDropout.java →
+    conf/dropout/AlphaDropout). Keras' rate is the drop probability,
+    same convention as our AlphaDropout.p."""
+    return Converted(layer=DropoutLayer(
+        dropout=SeluAlphaDropout(p=float(cfg.get("rate", cfg.get("p",
+                                                                 0.05))))))
+
+
 def input_layer(cfg, _v):
     return Converted(skip=True)
 
@@ -671,6 +681,7 @@ CONVERTERS: Dict[str, Callable[[dict, int], Converted]] = {
     "LeakyReLU": leaky_relu,
     "Dropout": dropout, "SpatialDropout2D": dropout,
     "GaussianDropout": gaussian_dropout, "GaussianNoise": gaussian_noise,
+    "AlphaDropout": alpha_dropout,
     "Embedding": embedding,
     "LSTM": lstm,
     "SimpleRNN": simple_rnn,

@@ -208,6 +208,52 @@ class PermuteLayer(Layer):
 
 @register_serializable
 @dataclasses.dataclass(frozen=True)
+class ElementWiseMultiplicationLayer(FeedForwardLayer):
+    """out = act(x ⊙ w + b) with a learnable per-feature weight vector
+    (reference: nn/conf/layers/misc/ElementWiseMultiplicationLayer.java +
+    nn/layers/feedforward/elementwise/ElementWiseMultiplicationLayer.java
+    — input and output sizes are equal; the configured weight init draws
+    the vector with the layer's fan-in/fan-out, matching
+    ElementWiseParamInitializer)."""
+
+    def __post_init__(self):
+        if self.n_in is not None and self.n_out and self.n_in != self.n_out:
+            raise ValueError(
+                "ElementWiseMultiplicationLayer must have the same input "
+                f"and output size. Got n_in={self.n_in}, n_out={self.n_out}")
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if isinstance(input_type, RecurrentType):
+            return RecurrentType(self.resolved_n_out(input_type),
+                                 input_type.timesteps)
+        return FeedForwardType(self.resolved_n_out(input_type))
+
+    def resolved_n_out(self, input_type):
+        return self.n_out or self.resolved_n_in(input_type)
+
+    def initialize(self, key, input_type):
+        n = self.resolved_n_in(input_type)
+        if self.n_out and self.n_out != n:
+            raise ValueError(
+                "ElementWiseMultiplicationLayer must have the same input "
+                f"and output size. Got n_in={n}, n_out={self.n_out}")
+        dt = self.param_dtype()
+        params = {"W": self.weight_init.init(key, (n,), n, n, dt)}
+        if self.has_bias:
+            params["b"] = jnp.zeros((n,), dt)
+        return params
+
+    def apply(self, params, state, x, ctx):
+        ctx, dk = ctx.split_rng()
+        x = self.maybe_dropout(x, ctx, dk)
+        y = x * params["W"]
+        if self.has_bias:
+            y = y + params["b"]
+        return self.activation.apply(y), state
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
 class ActivationLayer(Layer):
     """Standalone activation (reference: nn/conf/layers/ActivationLayer)."""
     activation: Activation = Activation.RELU

@@ -27,6 +27,53 @@ from deeplearning4j_tpu.utils.serde import register_serializable
 
 @register_serializable
 @dataclasses.dataclass(frozen=True)
+class MaskLayer(Layer):
+    """Applies the current mask array to the activations, passing them
+    through otherwise (reference: nn/conf/layers/util/MaskLayer.java +
+    nn/layers/util/MaskLayer.java — 2d, 3d time-series and 4d CNN
+    activations). Zeroing the forward activations also zeroes the
+    backward gradients at masked positions under ``jax.grad``, which is
+    exactly the reference's backpropGradient contract."""
+
+    @property
+    def has_params(self):
+        return False
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def apply(self, params, state, x, ctx):
+        m = ctx.mask
+        if m is None:
+            return x, state
+        m = jnp.asarray(m, x.dtype)
+        if x.ndim == 2:
+            # per-example mask: (N,) or (N, 1) — reject (N, T) sequence
+            # masks instead of silently using only timestep 0
+            m2 = m.reshape(m.shape[0], -1)
+            if m2.shape[1] != 1 or m.shape[0] != x.shape[0]:
+                raise ValueError(
+                    f"MaskLayer: 2d input {x.shape} needs a per-example "
+                    f"(minibatch, 1) mask, got {m.shape}")
+            m = m2
+        elif x.ndim == 3:
+            # (N, T) sequence mask over (N, T, F)
+            if m.ndim != 2 or m.shape[0] != x.shape[0] \
+                    or m.shape[1] != x.shape[1]:
+                raise ValueError(
+                    f"MaskLayer: 3d input {x.shape} needs a (minibatch, "
+                    f"sequenceLength) mask, got {m.shape}")
+            m = m[:, :, None]
+        elif x.ndim == 4:
+            # per-example mask over (N, H, W, C) maps
+            m = m.reshape(m.shape[0], *([1] * (x.ndim - 1)))
+        else:
+            raise ValueError(f"MaskLayer: unsupported rank {x.ndim}")
+        return x * m, state
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
 class FrozenLayer(Layer):
     """Wrap any layer so its parameters never update
     (misc/FrozenLayer.java). Equivalent to ``underlying.frozen=True``;

@@ -106,6 +106,23 @@ class LossLayer(Layer):
 
 @register_serializable
 @dataclasses.dataclass(frozen=True)
+class RnnLossLayer(LossLayer):
+    """Per-timestep loss WITHOUT a time-distributed dense projection
+    (reference: nn/conf/layers/RnnLossLayer.java — unlike RnnOutputLayer
+    there are no parameters; output activations size equals input size).
+    Input/labels (N, T, F); the (N, T) sequence mask weights the
+    per-timestep loss exactly as in RnnOutputLayer."""
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if not isinstance(input_type, RecurrentType):
+            raise ValueError(
+                "RnnLossLayer expects recurrent (N, T, F) input, got "
+                f"{input_type}")
+        return input_type
+
+
+@register_serializable
+@dataclasses.dataclass(frozen=True)
 class CnnLossLayer(LossLayer):
     """Per-pixel loss over NHWC maps (reference: CnnLossLayer). Labels have
     the same NHWC shape; mask broadcasting handles (N,H,W) masks."""

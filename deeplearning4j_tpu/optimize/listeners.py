@@ -19,6 +19,8 @@ import os
 import time
 from typing import List, Optional
 
+import numpy as np
+
 log = logging.getLogger(__name__)
 
 
@@ -168,3 +170,151 @@ class CheckpointListener(TrainingListener):
     def on_epoch_end(self, model, epoch):
         if self.every_n_epochs and (epoch + 1) % self.every_n_epochs == 0:
             self._save(model, f"epoch_{epoch}")
+
+
+class SleepyTrainingListener(TrainingListener):
+    """Sleeps for a configured time at training phases — a throttle for
+    debugging/profiling or resource-sharing runs (reference:
+    optimize/listeners/SleepyTrainingListener.java).
+
+    ``time_mode="additive"`` always sleeps the full timer;
+    ``"connected"`` subtracts the elapsed wall time since the phase last
+    fired, sleeping only up to the target period (the reference's
+    TimeMode.CONNECTED). The reference's SleepMode (park vs busy-spin) is
+    a JVM-scheduler concern with no Python analog — time.sleep is used.
+    """
+
+    def __init__(self, timer_epoch_start_ms: float = 0.0,
+                 timer_epoch_end_ms: float = 0.0,
+                 timer_iteration_ms: float = 0.0,
+                 time_mode: str = "additive"):
+        if time_mode not in ("additive", "connected"):
+            raise ValueError(f"unknown time_mode: {time_mode}")
+        self.timer_es = timer_epoch_start_ms
+        self.timer_ee = timer_epoch_end_ms
+        self.timer_it = timer_iteration_ms
+        self.time_mode = time_mode
+        self._last = {}
+
+    def _sleep(self, phase: str, timer_ms: float):
+        if timer_ms <= 0:
+            return
+        if self.time_mode == "connected":
+            last = self._last.get(phase)
+            if last is not None:
+                timer_ms -= (time.perf_counter() - last) * 1000.0
+        if timer_ms >= 1.0:
+            time.sleep(timer_ms / 1000.0)
+        # record AFTER sleeping: the next period starts when this phase
+        # releases, else elapsed would include our own sleep and the
+        # throttle would fire every other call at double rate
+        self._last[phase] = time.perf_counter()
+
+    def on_epoch_start(self, model, epoch):
+        self._sleep("es", self.timer_es)
+
+    def on_epoch_end(self, model, epoch):
+        self._sleep("ee", self.timer_ee)
+
+    def iteration_done(self, model, iteration, epoch, loss, etl_ms,
+                       batch_size):
+        self._sleep("it", self.timer_it)
+
+
+class ParamAndGradientIterationListener(TrainingListener):
+    """Text-format per-iteration parameter/update statistics — the UI
+    histogram information for SSH-only sessions (reference:
+    optimize/listeners/ParamAndGradientIterationListener.java: mean,
+    min/max and mean-absolute-value of each parameter and gradient,
+    tab-delimited to console and/or file).
+
+    "Gradient" here is the applied update (param delta between
+    iterations): the functional train step consumes raw gradients inside
+    jit, so the observable quantity is the update — same convention as
+    ui/stats.py's update statistics and strictly more informative for
+    tuning (it includes the updater's transform).
+    """
+
+    def __init__(self, iterations: int = 1, print_header: bool = True,
+                 print_mean: bool = True, print_min_max: bool = True,
+                 print_mean_abs_value: bool = True,
+                 output_to_console: bool = True, file: str = None,
+                 delimiter: str = "\t"):
+        self.iterations = max(1, iterations)
+        self.print_header = print_header
+        self.print_mean = print_mean
+        self.print_min_max = print_min_max
+        self.print_mean_abs = print_mean_abs_value
+        self.output_to_console = output_to_console
+        self.file = file
+        self.delimiter = delimiter
+        self._total = 0
+        self._prev = None
+        self._header_done = False
+        if file:
+            with open(file, "w"):
+                pass
+
+    @staticmethod
+    def _flat(params):
+        import jax
+        out = []
+        for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+            name = ".".join(str(getattr(p, "key", p)) for p in path)
+            out.append((name, np.asarray(leaf)))
+        return out
+
+    def _stats(self, arr):
+        vals = []
+        if self.print_mean:
+            vals.append(float(arr.mean()))
+        if self.print_min_max:
+            vals.extend([float(arr.min()), float(arr.max())])
+        if self.print_mean_abs:
+            vals.append(float(np.abs(arr).mean()))
+        return vals
+
+    def _emit(self, line: str):
+        if self.output_to_console:
+            print(line)
+        if self.file:
+            try:
+                with open(self.file, "a") as f:
+                    f.write(line + "\n")
+            except OSError:
+                log.warning("ParamAndGradientIterationListener: write to "
+                            "%s failed", self.file)
+
+    def iteration_done(self, model, iteration, epoch, loss, etl_ms,
+                       batch_size):
+        self._total += 1
+        report = self._total % self.iterations == 0
+        # snapshot right before a reporting iteration, so the update
+        # column is a single-step delta
+        snapshot = (self.iterations > 1
+                    and self._total % self.iterations
+                    == self.iterations - 1)
+        if not (report or snapshot):
+            return          # no device→host param transfer on idle steps
+        params = self._flat(model.train_state.params)
+        if snapshot:
+            self._prev = {n: a.copy() for n, a in params}
+            return
+        if self.print_header and not self._header_done:
+            self._header_done = True
+            cols = ["iteration", "score"]
+            stat_names = ((["mean"] if self.print_mean else [])
+                          + (["min", "max"] if self.print_min_max else [])
+                          + (["meanAbs"] if self.print_mean_abs else []))
+            for name, _ in params:
+                cols += [f"param_{name}_{s}" for s in stat_names]
+                cols += [f"update_{name}_{s}" for s in stat_names]
+            self._emit(self.delimiter.join(cols))
+        vals = [str(self._total), f"{float(loss):.6g}"]
+        prev = self._prev or {}
+        for name, arr in params:
+            vals += [f"{v:.6g}" for v in self._stats(arr)]
+            upd = arr - prev[name] if name in prev else np.zeros_like(arr)
+            vals += [f"{v:.6g}" for v in self._stats(upd)]
+        self._emit(self.delimiter.join(vals))
+        self._prev = {n: a.copy() for n, a in params}

