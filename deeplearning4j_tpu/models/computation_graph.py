@@ -41,6 +41,9 @@ class ComputationGraph(BaseModel):
         self.layer_names = tuple(n.name for n in self._layer_nodes)
         self._output_fn = None
         self._loss_eval_fn = None
+        self._tbptt_step = None
+        self._rnn_step_fn = None
+        self._rnn_carries = None   # stored state for rnn_time_step
         # tensor-parallel activation specs (parallel/tensor_parallel.py);
         # set by ParallelWrapper when TP is enabled
         self._tp_plan = None
@@ -83,10 +86,13 @@ class ComputationGraph(BaseModel):
     # ---- functional forward --------------------------------------------
     def _walk(self, params, model_state, inputs: Dict[str, jnp.ndarray],
               fmasks: Dict[str, Optional[jnp.ndarray]], train: bool, rng,
-              stop_before_loss: bool):
+              stop_before_loss: bool, carries: Optional[dict] = None):
         """Execute the DAG. Returns (activations dict, new_state).
         When ``stop_before_loss`` the output layers' pre-activations are
-        stored for the fused-loss path."""
+        stored for the fused-loss path. ``carries`` maps recurrent node
+        name → initial hidden state (TBPTT chunk chaining + stateful
+        rnn_time_step — reference: rnnActivateUsingStoredState,
+        ComputationGraph.java:2753)."""
         g = self.conf.global_config
         acts: Dict[str, jnp.ndarray] = {}
         for k, v in inputs.items():
@@ -114,7 +120,13 @@ class ComputationGraph(BaseModel):
                         node.layer, "compute_loss"):
                     acts[name] = (x, lp, ctx)  # defer to loss
                     continue
-                y, s = node.layer.apply(lp, model_state.get(name, {}), x, ctx)
+                if carries is not None and name in carries:
+                    y, s = node.layer.apply(lp, model_state.get(name, {}),
+                                            x, ctx,
+                                            initial_state=carries[name])
+                else:
+                    y, s = node.layer.apply(lp, model_state.get(name, {}),
+                                            x, ctx)
                 new_state[name] = s
                 if self._tp_plan is not None:
                     y = self._tp_plan.constrain(name, y)
@@ -132,13 +144,14 @@ class ComputationGraph(BaseModel):
         return acts, new_state
 
     def _loss(self, params, model_state, features, labels, fmasks, lmasks,
-              rng, iteration):
+              rng, iteration, carries: Optional[dict] = None):
         inputs = dict(zip(self.conf.network_inputs, features))
         fm = {"__default__": fmasks[0] if fmasks else None}
         for i, k in enumerate(self.conf.network_inputs):
             fm[k] = fmasks[i] if fmasks and i < len(fmasks) else None
         acts, new_state = self._walk(params, model_state, inputs, fm, True,
-                                     rng, stop_before_loss=True)
+                                     rng, stop_before_loss=True,
+                                     carries=carries)
         any_leaf = jax.tree_util.tree_leaves(params)
         acc = (jnp.promote_types(jnp.float32, any_leaf[0].dtype)
                if any_leaf else jnp.float32)
@@ -181,8 +194,8 @@ class ComputationGraph(BaseModel):
                 [l for l in self._constraint_layers()]))
 
     # ---- fit ------------------------------------------------------------
-    def _fit_batch(self, batch: Union[DataSet, MultiDataSet],
-                   etl_ms: float = 0.0):
+    def _fit_batch_standard(self, batch: Union[DataSet, MultiDataSet],
+                            etl_ms: float = 0.0):
         self._rng, step_key = jax.random.split(self._rng)
         if isinstance(batch, MultiDataSet):
             feats = tuple(jnp.asarray(f) for f in batch.features)
@@ -207,6 +220,232 @@ class ComputationGraph(BaseModel):
             lst.iteration_done(self, it, self.epoch_count, loss, etl_ms,
                                n_examples)
         self._last_loss = loss
+
+    # ---- truncated BPTT (reference: ComputationGraph.java:955,1184) -----
+    def _recurrent_carry_nodes(self):
+        """(node name, stateful core layer, is_lstm) for every node whose
+        hidden state crosses TBPTT chunks / rnn_time_step calls —
+        including LSTM/SimpleRnn wrapped in LastTimeStep/MaskZeroLayer
+        (the wrappers delegate state to the core)."""
+        from deeplearning4j_tpu.nn.layers.recurrent import (
+            LSTM, SimpleRnn, unwrap_recurrent)
+        out = []
+        for n in self._layer_nodes:
+            core = unwrap_recurrent(n.layer)
+            if isinstance(core, (LSTM, SimpleRnn)):
+                out.append((n.name, core, isinstance(core, LSTM)))
+        return out
+
+    def _zero_carries(self, batch_size: int):
+        dt = (jnp.bfloat16 if self.conf.global_config.compute_dtype ==
+              "bfloat16" else jnp.float32)
+        out = {}
+        for name, core, is_lstm in self._recurrent_carry_nodes():
+            h = jnp.zeros((batch_size, core.n_out), dt)
+            out[name] = (h, h) if is_lstm else h
+        return out
+
+    def _build_tbptt_step(self):
+        import optax
+        constrain_fn = make_constrain_fn(list(self._constraint_layers()))
+        carry_nodes = self._recurrent_carry_nodes()
+
+        def step(ts, features, labels, fmasks, lmasks, rng, carries):
+            def lf(params):
+                return self._loss(params, ts.model_state, features, labels,
+                                  fmasks, lmasks, rng, ts.iteration,
+                                  carries=carries)
+            (loss, new_ms), grads = jax.value_and_grad(
+                lf, has_aux=True)(ts.params)
+            updates, new_opt = self._tx.update(grads, ts.opt_state,
+                                               ts.params)
+            new_params = optax.apply_updates(ts.params, updates)
+            if constrain_fn is not None:
+                new_params = constrain_fn(new_params)
+            # carries cross the chunk boundary with gradients cut — this
+            # IS the truncation (same contract as the MLN TBPTT step)
+            new_carries = {}
+            for name, _, is_lstm in carry_nodes:
+                s = new_ms[name]
+                c = ((s["last_h"], s["last_c"]) if is_lstm
+                     else s["last_h"])
+                new_carries[name] = jax.lax.stop_gradient(c)
+            return (TrainState(new_params, new_ms, new_opt,
+                               ts.iteration + 1), loss, new_carries)
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    def _fit_batch_tbptt(self, batch, etl_ms: float = 0.0):
+        """Chunked-time fit over a DAG (reference: doTruncatedBPTT path of
+        ComputationGraph.fit, ComputationGraph.java:955). 3-D features and
+        sequence labels are sliced along time; 2-D (static) inputs repeat
+        whole into every chunk, exactly like the reference's handling of
+        non-sequence graph inputs."""
+        if self._tbptt_step is None:
+            self._tbptt_step = self._build_tbptt_step()
+        if isinstance(batch, MultiDataSet):
+            feats = [np.asarray(f) for f in batch.features]
+            labels = [np.asarray(l) for l in batch.labels]
+            fmasks = [None if m is None else np.asarray(m)
+                      for m in (batch.features_masks
+                                or [None] * len(feats))]
+            lmasks = [None if m is None else np.asarray(m)
+                      for m in (batch.labels_masks
+                                or [None] * len(labels))]
+        else:
+            feats = [np.asarray(batch.features)]
+            labels = [np.asarray(batch.labels)]
+            fmasks = [None if batch.features_mask is None
+                      else np.asarray(batch.features_mask)]
+            lmasks = [None if batch.labels_mask is None
+                      else np.asarray(batch.labels_mask)]
+        k = self.conf.tbptt_fwd_length
+        T = max(f.shape[1] for f in feats if f.ndim == 3)
+        n = feats[0].shape[0]
+        carries = self._zero_carries(n)
+        loss = None
+        for lo in range(0, T, k):
+            hi = min(lo + k, T)
+            cf, cl, cfm, clm = [], [], [], []
+            for f, fm in zip(feats, fmasks):
+                if f.ndim == 3:
+                    cf.append(f[:, lo:hi])
+                    cfm.append(None if fm is None else fm[:, lo:hi])
+                else:
+                    cf.append(f)
+                    cfm.append(fm)
+            for l, lm in zip(labels, lmasks):
+                if l.ndim == 3:
+                    cl.append(l[:, lo:hi])
+                    clm.append(None if lm is None else lm[:, lo:hi])
+                else:
+                    cl.append(l)
+                    clm.append(lm)
+            if hi - lo < k:
+                # Ragged tail: pad every 3-D stream to length k, masking
+                # padded steps out of the recurrent math and the loss
+                # (same contract as the MLN _pad_tbptt_tail)
+                pad = k - (hi - lo)
+
+                def padt(a, fill=0.0):
+                    return np.concatenate(
+                        [a, np.full((a.shape[0], pad) + a.shape[2:],
+                                    fill, a.dtype)], axis=1)
+
+                for i in range(len(cf)):
+                    if cf[i].ndim != 3:
+                        continue
+                    base = (cfm[i] if cfm[i] is not None
+                            else np.ones((n, hi - lo), np.float32))
+                    cf[i] = padt(cf[i])
+                    cfm[i] = padt(base)
+                # the loss falls back to the DEFAULT features mask (the
+                # first input's) when an output has no labels mask; the
+                # synthesized tail mask must inherit it, or the padding
+                # would unmask fmask-excluded real steps (MLN contract)
+                default_fm = next(
+                    (m for f, m in zip(cf, cfm)
+                     if f.ndim == 3 and m is not None and m.ndim == 2),
+                    None)
+                for i in range(len(cl)):
+                    if cl[i].ndim != 3:
+                        continue
+                    if clm[i] is None:
+                        clm[i] = (default_fm if default_fm is not None
+                                  else padt(np.ones((n, hi - lo),
+                                            np.float32)))
+                    else:
+                        clm[i] = padt(clm[i])
+                    cl[i] = padt(cl[i])
+            self._rng, step_key = jax.random.split(self._rng)
+            tj = lambda seq: tuple(None if a is None else jnp.asarray(a)
+                                   for a in seq)
+            self.train_state, loss, carries = self._tbptt_step(
+                self.train_state, tj(cf), tj(cl), tj(cfm), tj(clm),
+                step_key, carries)
+        it = int(self.train_state.iteration)
+        for lst in self.listeners:
+            lst.iteration_done(self, it, self.epoch_count, loss, etl_ms,
+                               n)
+        self._last_loss = loss
+
+    def _fit_batch(self, batch: Union[DataSet, MultiDataSet],
+                   etl_ms: float = 0.0):
+        if (self.conf.backprop_type == "tbptt"
+                and self._recurrent_carry_nodes()
+                and any(np.ndim(f) == 3 for f in
+                        (batch.features if isinstance(batch, MultiDataSet)
+                         else [batch.features]))):
+            return self._fit_batch_tbptt(batch, etl_ms=etl_ms)
+        return self._fit_batch_standard(batch, etl_ms=etl_ms)
+
+    # ---- stateful rnn inference (reference: CG.rnnTimeStep:2720) --------
+    def rnn_time_step(self, *features, mask=None):
+        """Streaming inference with internally stored recurrent state —
+        reference: ComputationGraph.rnnTimeStep (ComputationGraph.java:
+        2720). 2-D inputs are treated as one timestep and the time axis
+        is squeezed from the outputs; 3-D inputs run multiple steps.
+        State persists across calls until ``rnn_clear_previous_state``;
+        batch-size changes reset it (same contract as the reference)."""
+        from deeplearning4j_tpu.nn.layers.recurrent import (
+            Bidirectional, GravesBidirectionalLSTM)
+        for node in self._layer_nodes:
+            if isinstance(node.layer, (Bidirectional,
+                                       GravesBidirectionalLSTM)):
+                raise ValueError(
+                    "rnn_time_step is not supported on graphs with "
+                    f"bidirectional layers ('{node.name}'): the backward "
+                    "pass needs future timesteps")
+        if self.train_state is None:
+            self.init()
+        if len(features) == 1 and isinstance(features[0], (list, tuple)):
+            features = tuple(features[0])
+        squeeze = all(np.ndim(f) == 2 for f in features)
+        feats = tuple(jnp.asarray(f)[:, None, :]
+                      if np.ndim(f) == 2 else jnp.asarray(f)
+                      for f in features)
+        n = feats[0].shape[0]
+        leaves = (None if self._rnn_carries is None
+                  else jax.tree_util.tree_leaves(self._rnn_carries))
+        if self._rnn_carries is None or (leaves
+                                         and leaves[0].shape[0] != n):
+            self._rnn_carries = self._zero_carries(n)
+        if self._rnn_step_fn is None:
+            carry_nodes = self._recurrent_carry_nodes()
+
+            def stepf(params, model_state, feats, default_mask, carries):
+                inputs = dict(zip(self.conf.network_inputs, feats))
+                fm = {"__default__": default_mask}
+                acts, new_state = self._walk(
+                    params, model_state, inputs, fm, False, None,
+                    stop_before_loss=False, carries=carries)
+                new_carries = {}
+                for name, _, is_lstm in carry_nodes:
+                    s = new_state[name]
+                    new_carries[name] = ((s["last_h"], s["last_c"])
+                                         if is_lstm else s["last_h"])
+                return ([acts[o] for o in self.conf.network_outputs],
+                        new_carries)
+            self._rnn_step_fn = jax.jit(stepf)
+        outs, self._rnn_carries = self._rnn_step_fn(
+            self.train_state.params, self.train_state.model_state, feats,
+            None if mask is None else jnp.asarray(mask),
+            self._rnn_carries)
+        if squeeze:
+            outs = [o[:, 0] if o.ndim >= 3 else o for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+    def rnn_clear_previous_state(self):
+        """Reference: ComputationGraph.rnnClearPreviousState():2828."""
+        self._rnn_carries = None
+
+    def rnn_get_previous_state(self) -> Optional[dict]:
+        """node name → stored hidden state ((h, c) for LSTM, h for
+        SimpleRnn) — reference: rnnGetPreviousState(layer)."""
+        return self._rnn_carries
+
+    def rnn_set_previous_state(self, carries: dict):
+        self._rnn_carries = None if carries is None else dict(carries)
 
     # ---- inference ------------------------------------------------------
     def output(self, *features, train: bool = False, mask=None):

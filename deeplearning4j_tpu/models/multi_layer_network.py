@@ -204,16 +204,24 @@ class MultiLayerNetwork(BaseModel):
 
     # ---- truncated BPTT (reference: doTruncatedBPTT:1521, SURVEY §5.7) --
     def _recurrent_carry_layers(self):
-        from deeplearning4j_tpu.nn.layers.recurrent import LSTM, SimpleRnn
-        return [(l, isinstance(l, LSTM)) for l in self.layers
-                if isinstance(l, (LSTM, SimpleRnn))]
+        """(layer, is_lstm) for every layer whose hidden state crosses
+        TBPTT chunks — including cores wrapped in LastTimeStep /
+        MaskZeroLayer (the wrappers delegate state + initial_state)."""
+        from deeplearning4j_tpu.nn.layers.recurrent import (
+            LSTM, SimpleRnn, unwrap_recurrent)
+        out = []
+        for l in self.layers:
+            core = unwrap_recurrent(l)
+            if isinstance(core, (LSTM, SimpleRnn)):
+                out.append((l, core, isinstance(core, LSTM)))
+        return out
 
     def _zero_carries(self, batch_size: int):
         dt = (jnp.bfloat16 if self.conf.global_config.compute_dtype ==
               "bfloat16" else jnp.float32)
         out = {}
-        for layer, is_lstm in self._recurrent_carry_layers():
-            h = jnp.zeros((batch_size, layer.n_out), dt)
+        for layer, core, is_lstm in self._recurrent_carry_layers():
+            h = jnp.zeros((batch_size, core.n_out), dt)
             out[layer.name] = (h, h) if is_lstm else h
         return out
 
@@ -237,7 +245,7 @@ class MultiLayerNetwork(BaseModel):
             # carries cross the chunk boundary with gradients cut — this IS
             # the truncation (reference: tbpttBackLength; here back==fwd)
             new_carries = {}
-            for layer, is_lstm in carry_layers:
+            for layer, _core, is_lstm in carry_layers:
                 s = new_ms[layer.name]
                 c = ((s["last_h"], s["last_c"]) if is_lstm else s["last_h"])
                 new_carries[layer.name] = jax.lax.stop_gradient(c)
@@ -348,7 +356,8 @@ class MultiLayerNetwork(BaseModel):
         reference: rnnTimeStep (MultiLayerNetwork.java:2806). ``carries``
         maps layer name → (h, c); returns (output, new_carries).
         Functional: the caller threads the state."""
-        from deeplearning4j_tpu.nn.layers.recurrent import LSTM, SimpleRnn
+        from deeplearning4j_tpu.nn.layers.recurrent import (
+            LSTM, SimpleRnn, unwrap_recurrent)
         if self.train_state is None:
             self.init()
         x = jnp.asarray(features)
@@ -364,10 +373,11 @@ class MultiLayerNetwork(BaseModel):
             ctx = LayerContext(train=False)
             lp = params.get(layer.name, {})
             st = self.train_state.model_state.get(layer.name, {})
-            if isinstance(layer, (LSTM, SimpleRnn)):
+            core = unwrap_recurrent(layer)
+            if isinstance(core, (LSTM, SimpleRnn)):
                 init = carries.get(layer.name)
                 x, s = layer.apply(lp, st, x, ctx, initial_state=init)
-                if isinstance(layer, LSTM):
+                if isinstance(core, LSTM):
                     carries[layer.name] = (s["last_h"], s["last_c"])
                 else:
                     carries[layer.name] = s["last_h"]

@@ -77,13 +77,43 @@ class GraphBuilder:
         self._outputs = list(names)
         return self
 
+    def backprop_type(self, kind: str) -> "GraphBuilder":
+        """'standard' or 'tbptt' — same alias set as
+        ListBuilder.backprop_type (reference: GraphBuilder.backpropType —
+        ComputationGraph TBPTT fit, ComputationGraph.java:955)."""
+        kind = kind.lower()
+        if kind not in ("standard", "tbptt", "truncated_bptt"):
+            raise ValueError(f"unknown backprop type {kind!r}")
+        self._backprop_type = "tbptt" if kind != "standard" else "standard"
+        return self
+
+    def tbptt_fwd_length(self, k: int) -> "GraphBuilder":
+        self._tbptt_fwd_length = int(k)
+        return self
+
+    def tbptt_back_length(self, k: int) -> "GraphBuilder":
+        """Accepted for API parity; gradients truncate at chunk
+        boundaries, so back length == fwd length here (same contract as
+        ListBuilder.tbptt_back_length; checked at build())."""
+        self._tbptt_back_length = int(k)
+        return self
+
     def build(self) -> "ComputationGraphConfiguration":
+        fwd = getattr(self, "_tbptt_fwd_length", 20)
+        back = getattr(self, "_tbptt_back_length", fwd)
+        if back != fwd:
+            import warnings
+            warnings.warn("tbptt_back_length != tbptt_fwd_length: "
+                          "gradients truncate at the fwd chunk boundary "
+                          f"({fwd}), not at {back}")
         conf = ComputationGraphConfiguration(
             global_config=self._cfg,
             network_inputs=tuple(self._inputs),
             network_input_types=tuple(self._input_types),
             nodes=tuple(self._nodes),
             network_outputs=tuple(self._outputs),
+            backprop_type=getattr(self, "_backprop_type", "standard"),
+            tbptt_fwd_length=fwd,
         )
         conf.resolve()
         return conf
@@ -97,6 +127,8 @@ class ComputationGraphConfiguration:
     network_input_types: Tuple[InputType, ...]
     nodes: Tuple[NodeDef, ...]
     network_outputs: Tuple[str, ...]
+    backprop_type: str = "standard"
+    tbptt_fwd_length: int = 20
 
     # ---- validation + shape inference -----------------------------------
     def resolve(self):

@@ -338,8 +338,12 @@ class LastTimeStep(Layer):
     def init_state(self, input_type):
         return self.inner.init_state(input_type)
 
-    def apply(self, params, state, x, ctx):
-        y, new_state = self.inner.apply(params, state, x, ctx)
+    def apply(self, params, state, x, ctx, initial_state=None):
+        if initial_state is not None:
+            y, new_state = self.inner.apply(params, state, x, ctx,
+                                            initial_state=initial_state)
+        else:
+            y, new_state = self.inner.apply(params, state, x, ctx)
         if ctx.mask is not None:
             # last unmasked index per example
             idx = jnp.sum(ctx.mask.astype(jnp.int32), axis=1) - 1
@@ -368,7 +372,21 @@ class MaskZeroLayer(Layer):
     def init_state(self, input_type):
         return self.inner.init_state(input_type)
 
-    def apply(self, params, state, x, ctx):
+    def apply(self, params, state, x, ctx, initial_state=None):
         mask = jnp.any(x != self.mask_value, axis=-1).astype(jnp.float32)
-        return self.inner.apply(params, state, x,
-                                dataclasses.replace(ctx, mask=mask))
+        ctx = dataclasses.replace(ctx, mask=mask)
+        if initial_state is not None:
+            return self.inner.apply(params, state, x, ctx,
+                                    initial_state=initial_state)
+        return self.inner.apply(params, state, x, ctx)
+
+
+def unwrap_recurrent(layer):
+    """The stateful core of a layer: LastTimeStep/MaskZeroLayer delegate
+    params, state and (since round 4) ``initial_state`` to their inner
+    layer, so TBPTT carries and rnn_time_step must look through them."""
+    inner = getattr(layer, "inner", None)
+    if isinstance(layer, (LastTimeStep, MaskZeroLayer)) \
+            and inner is not None:
+        return unwrap_recurrent(inner)
+    return layer

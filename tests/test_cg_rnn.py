@@ -1,0 +1,252 @@
+"""ComputationGraph RNN parity: stateful rnn_time_step + TBPTT fit on
+graph models (VERDICT r3 missing #1 — reference:
+ComputationGraph.java:2720 rnnTimeStep, :955 TBPTT fit,
+:2828 rnnClearPreviousState)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.models.computation_graph import ComputationGraph
+from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+from deeplearning4j_tpu.nn.layers.output import RnnOutputLayer
+from deeplearning4j_tpu.nn.layers.recurrent import LSTM, Bidirectional, SimpleRnn
+from deeplearning4j_tpu.nn.graph.vertices import MergeVertex
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.ops.losses import LossFunction
+from deeplearning4j_tpu.optimize.updaters import Adam, Sgd
+
+RNG = np.random.default_rng(2720)
+F, H, C = 3, 5, 2
+
+
+def _graph(seed=1, tbptt=False, k=4):
+    g = (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(5e-3))
+         .graph_builder()
+         .add_inputs("in")
+         .set_input_types(InputType.recurrent(F)))
+    g.add_layer("lstm", LSTM(n_out=H, activation=Activation.TANH), "in")
+    g.add_layer("rnn", SimpleRnn(n_out=H, activation=Activation.TANH),
+                "lstm")
+    g.add_layer("out", RnnOutputLayer(n_out=C, loss=LossFunction.MCXENT,
+                                      activation=Activation.SOFTMAX),
+                "rnn")
+    g.set_outputs("out")
+    if tbptt:
+        g.backprop_type("tbptt").tbptt_fwd_length(k)
+    return ComputationGraph(g.build()).init()
+
+
+def _mln(seed=1, tbptt=False, k=4):
+    b = (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(5e-3))
+         .list()
+         .layer(LSTM(n_out=H, activation=Activation.TANH))
+         .layer(SimpleRnn(n_out=H, activation=Activation.TANH))
+         .layer(RnnOutputLayer(n_out=C, loss=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX)))
+    if tbptt:
+        b = b.backprop_type("tbptt").tbptt_fwd_length(k)
+    return MultiLayerNetwork(
+        b.set_input_type(InputType.recurrent(F)).build()).init()
+
+
+def _copy_params_from_mln(cg, mln):
+    """Same architecture ⇒ transplant MLN params into the graph (layer
+    order matches node order)."""
+    import jax.numpy as jnp
+    mp = mln.train_state.params
+    names_mln = [l.name for l in mln.layers]
+    names_cg = ["lstm", "rnn", "out"]
+    new = dict(cg.train_state.params)
+    for a, b in zip(names_cg, names_mln):
+        # real copies: the MLN train step donates its buffers, so views
+        # would die at mln.fit()
+        new[a] = {k: jnp.array(v, copy=True) for k, v in mp[b].items()}
+    cg.train_state = cg.train_state._replace(params=new)
+    return cg
+
+
+def seq_labels(n, t):
+    y = np.zeros((n, t, C), np.float32)
+    y[np.arange(n)[:, None], np.arange(t)[None, :],
+      RNG.integers(0, C, (n, t))] = 1.0
+    return y
+
+
+def test_rnn_time_step_matches_full_sequence_forward():
+    cg = _graph()
+    n, t = 4, 6
+    x = RNG.normal(size=(n, t, F)).astype(np.float32)
+    full = np.asarray(cg.output(x))
+    cg.rnn_clear_previous_state()
+    step_outs = []
+    for ti in range(t):
+        step_outs.append(np.asarray(cg.rnn_time_step(x[:, ti])))
+    streamed = np.stack(step_outs, axis=1)
+    np.testing.assert_allclose(streamed, full, rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_time_step_chunked_multi_step():
+    cg = _graph()
+    n, t = 3, 8
+    x = RNG.normal(size=(n, t, F)).astype(np.float32)
+    full = np.asarray(cg.output(x))
+    cg.rnn_clear_previous_state()
+    a = np.asarray(cg.rnn_time_step(x[:, :5]))
+    b = np.asarray(cg.rnn_time_step(x[:, 5:]))
+    np.testing.assert_allclose(np.concatenate([a, b], axis=1), full,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_time_step_state_is_stored_and_clearable():
+    cg = _graph()
+    x = RNG.normal(size=(2, F)).astype(np.float32)
+    o1 = np.asarray(cg.rnn_time_step(x))
+    assert cg.rnn_get_previous_state() is not None
+    o2 = np.asarray(cg.rnn_time_step(x))
+    assert not np.allclose(o1, o2)          # state advanced
+    cg.rnn_clear_previous_state()
+    o3 = np.asarray(cg.rnn_time_step(x))
+    np.testing.assert_allclose(o1, o3, rtol=1e-5)
+    # get/set round-trip
+    st = cg.rnn_get_previous_state()
+    o4 = np.asarray(cg.rnn_time_step(x))
+    cg.rnn_set_previous_state(st)
+    o5 = np.asarray(cg.rnn_time_step(x))
+    np.testing.assert_allclose(o4, o5, rtol=1e-5)
+
+
+def test_rnn_time_step_matches_mln():
+    mln = _mln(seed=7)
+    cg = _copy_params_from_mln(_graph(seed=7), mln)
+    n, t = 3, 5
+    x = RNG.normal(size=(n, t, F)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(cg.output(x)),
+                               np.asarray(mln.output(x)),
+                               rtol=1e-4, atol=1e-5)
+    out, _ = mln.rnn_time_step(x)
+    cg.rnn_clear_previous_state()
+    np.testing.assert_allclose(np.asarray(cg.rnn_time_step(x)),
+                               np.asarray(out), rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_time_step_rejects_bidirectional():
+    g = (NeuralNetConfiguration.Builder().seed(3).updater(Sgd(0.1))
+         .graph_builder().add_inputs("in")
+         .set_input_types(InputType.recurrent(F)))
+    g.add_layer("bi", Bidirectional(fwd=LSTM(n_out=H)), "in")
+    g.add_layer("out", RnnOutputLayer(n_out=C), "bi")
+    g.set_outputs("out")
+    cg = ComputationGraph(g.build()).init()
+    with pytest.raises(ValueError, match="bidirectional"):
+        cg.rnn_time_step(RNG.normal(size=(2, F)).astype(np.float32))
+
+
+def test_tbptt_fit_trains_graph():
+    cg = _graph(tbptt=True, k=4)
+    n, t = 8, 12
+    x = RNG.normal(size=(n, t, F)).astype(np.float32)
+    y = seq_labels(n, t)
+    ds = DataSet(x, y)
+    s0 = float(cg.score(ds))
+    for _ in range(15):
+        cg.fit(ds)
+    assert float(cg.score(ds)) < s0
+    # 12 timesteps / k=4 → 3 chunks per fit call
+    assert int(cg.train_state.iteration) == 45
+
+
+def test_tbptt_ragged_tail_and_masking():
+    cg = _graph(tbptt=True, k=5)
+    n, t = 4, 7                              # 5 + ragged 2
+    x = RNG.normal(size=(n, t, F)).astype(np.float32)
+    y = seq_labels(n, t)
+    mask = np.ones((n, t), np.float32)
+    mask[:, 6:] = 0.0
+    ds = DataSet(x, y, features_mask=mask, labels_mask=mask)
+    s0 = float(cg.score(ds))
+    for _ in range(12):
+        cg.fit(ds)
+    assert np.isfinite(float(cg.score(ds)))
+    assert float(cg.score(ds)) < s0
+
+
+def test_tbptt_matches_mln_losses():
+    """Same params, same data: the CG TBPTT chunk losses must equal the
+    MLN TBPTT chunk losses step for step."""
+    mln = _mln(seed=11, tbptt=True, k=3)
+    cg = _copy_params_from_mln(_graph(seed=11, tbptt=True, k=3), mln)
+    n, t = 4, 9
+    x = RNG.normal(size=(n, t, F)).astype(np.float32)
+    y = seq_labels(n, t)
+    ds = DataSet(x, y)
+    mln.fit(ds)
+    cg.fit(ds)
+    np.testing.assert_allclose(float(cg._last_loss),
+                               float(mln._last_loss), rtol=1e-4)
+    # and after a few more steps they stay in lockstep
+    for _ in range(3):
+        mln.fit(ds)
+        cg.fit(ds)
+    np.testing.assert_allclose(float(cg._last_loss),
+                               float(mln._last_loss), rtol=1e-3)
+
+
+def test_wrapped_recurrent_carries_state():
+    """A MaskZeroLayer-wrapped LSTM must carry hidden state across
+    rnn_time_step calls and TBPTT chunks — the wrapper delegates state to
+    its core (code-review r4 finding: wrappers used to run stateless)."""
+    from deeplearning4j_tpu.nn.layers.recurrent import MaskZeroLayer
+    g = (NeuralNetConfiguration.Builder().seed(9).updater(Adam(5e-3))
+         .graph_builder().add_inputs("in")
+         .set_input_types(InputType.recurrent(F)))
+    g.add_layer("mz", MaskZeroLayer(
+        inner=LSTM(n_out=H, activation=Activation.TANH),
+        mask_value=-999.0), "in")
+    g.add_layer("out", RnnOutputLayer(n_out=C, loss=LossFunction.MCXENT,
+                                      activation=Activation.SOFTMAX),
+                "mz")
+    g.set_outputs("out")
+    cg = ComputationGraph(g.build()).init()
+    assert [nm for nm, _, _ in cg._recurrent_carry_nodes()] == ["mz"]
+    n, t = 3, 6
+    x = RNG.normal(size=(n, t, F)).astype(np.float32)
+    full = np.asarray(cg.output(x))
+    cg.rnn_clear_previous_state()
+    streamed = np.stack([np.asarray(cg.rnn_time_step(x[:, ti]))
+                         for ti in range(t)], axis=1)
+    np.testing.assert_allclose(streamed, full, rtol=1e-4, atol=1e-5)
+
+
+def test_tbptt_multi_input_static_side_input():
+    """A 2-D (static) side input must repeat whole into every chunk."""
+    g = (NeuralNetConfiguration.Builder().seed(5).updater(Adam(5e-3))
+         .graph_builder()
+         .add_inputs("seq", "static")
+         .set_input_types(InputType.recurrent(F),
+                          InputType.feed_forward(2)))
+    g.add_layer("lstm", LSTM(n_out=H, activation=Activation.TANH), "seq")
+    g.add_layer("emb", DenseLayer(n_out=H, activation=Activation.TANH),
+                "static")
+    from deeplearning4j_tpu.nn.graph.vertices import (
+        DuplicateToTimeSeriesVertex)
+    g.add_vertex("rep", DuplicateToTimeSeriesVertex(), "emb", "seq")
+    g.add_vertex("merge", MergeVertex(), "lstm", "rep")
+    g.add_layer("out", RnnOutputLayer(n_out=C, loss=LossFunction.MCXENT,
+                                      activation=Activation.SOFTMAX),
+                "merge")
+    g.set_outputs("out")
+    g.backprop_type("tbptt").tbptt_fwd_length(4)
+    cg = ComputationGraph(g.build()).init()
+    n, t = 4, 8
+    xs = RNG.normal(size=(n, t, F)).astype(np.float32)
+    xst = RNG.normal(size=(n, 2)).astype(np.float32)
+    y = seq_labels(n, t)
+    mds = MultiDataSet([xs, xst], [y])
+    s0 = float(cg.score(mds))
+    for _ in range(10):
+        cg.fit(mds)
+    assert float(cg.score(mds)) < s0
