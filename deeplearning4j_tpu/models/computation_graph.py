@@ -300,7 +300,14 @@ class ComputationGraph(BaseModel):
             lmasks = [None if batch.labels_mask is None
                       else np.asarray(batch.labels_mask)]
         k = self.conf.tbptt_fwd_length
-        T = max(f.shape[1] for f in feats if f.ndim == 3)
+        seq_lens = {f.shape[1] for f in feats if f.ndim == 3}
+        if len(seq_lens) > 1:
+            raise ValueError(
+                "TBPTT fit needs equal sequence lengths across all 3-D "
+                f"inputs (got {sorted(seq_lens)}): chunking slices every "
+                "sequence with the same time window. Pad the shorter "
+                "streams (with a features mask) to a common length.")
+        T = seq_lens.pop()
         n = feats[0].shape[0]
         carries = self._zero_carries(n)
         loss = None
@@ -323,14 +330,15 @@ class ComputationGraph(BaseModel):
                     clm.append(lm)
             if hi - lo < k:
                 # Ragged tail: pad every 3-D stream to length k, masking
-                # padded steps out of the recurrent math and the loss
-                # (same contract as the MLN _pad_tbptt_tail)
+                # padded steps out of the recurrent math and the loss —
+                # the multi-stream generalization of _pad_tbptt_tail
+                # (multi_layer_network.py), sharing its _pad_time
+                from deeplearning4j_tpu.models.multi_layer_network import (
+                    _pad_time)
                 pad = k - (hi - lo)
 
-                def padt(a, fill=0.0):
-                    return np.concatenate(
-                        [a, np.full((a.shape[0], pad) + a.shape[2:],
-                                    fill, a.dtype)], axis=1)
+                def padt(a):
+                    return _pad_time(a, pad)
 
                 for i in range(len(cf)):
                     if cf[i].ndim != 3:
@@ -388,10 +396,11 @@ class ComputationGraph(BaseModel):
         State persists across calls until ``rnn_clear_previous_state``;
         batch-size changes reset it (same contract as the reference)."""
         from deeplearning4j_tpu.nn.layers.recurrent import (
-            Bidirectional, GravesBidirectionalLSTM)
+            Bidirectional, GravesBidirectionalLSTM, unwrap_recurrent)
         for node in self._layer_nodes:
-            if isinstance(node.layer, (Bidirectional,
-                                       GravesBidirectionalLSTM)):
+            # unwrap: a wrapped bidirectional core must not slip past
+            if isinstance(unwrap_recurrent(node.layer),
+                          (Bidirectional, GravesBidirectionalLSTM)):
                 raise ValueError(
                     "rnn_time_step is not supported on graphs with "
                     f"bidirectional layers ('{node.name}'): the backward "

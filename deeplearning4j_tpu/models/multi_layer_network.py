@@ -38,21 +38,25 @@ from deeplearning4j_tpu.optimize.solver import (
 
 
 
+def _pad_time(a, pad):
+    """Zero-pad ``pad`` steps onto the time axis (shared by the MLN and
+    ComputationGraph TBPTT ragged-tail paths)."""
+    return np.concatenate(
+        [a, np.zeros((a.shape[0], pad) + a.shape[2:], a.dtype)], axis=1)
+
+
 def _pad_tbptt_tail(f, l, fm, lm, k, seq_labels):
     """Pad a ragged final TBPTT chunk to length k along time, masking the
     padded steps out of both the recurrent math and the loss."""
     n, t = f.shape[0], f.shape[1]
     pad = k - t
-    f = np.concatenate(
-        [f, np.zeros((n, pad) + f.shape[2:], f.dtype)], axis=1)
+    f = _pad_time(f, pad)
     base_fm = fm if fm is not None else np.ones((n, t), np.float32)
-    fm = np.concatenate([base_fm, np.zeros((n, pad), np.float32)], axis=1)
+    fm = _pad_time(base_fm, pad)
     if seq_labels:
-        l = np.concatenate(
-            [l, np.zeros((n, pad) + l.shape[2:], l.dtype)], axis=1)
+        l = _pad_time(l, pad)
         if lm is not None:
-            lm = np.concatenate(
-                [lm, np.zeros((n, pad), np.float32)], axis=1)
+            lm = _pad_time(lm, pad)
         else:
             # _loss falls back to fmask when lmask is None; the padded fm
             # already carries per-example valid steps + zeroed padding, so
