@@ -31,22 +31,25 @@ def main():
     from deeplearning4j_tpu.optimize.updaters import Nesterovs
 
     if on_accel:
-        # batch 256 is the measured sweet spot on v5e at 64x64: per-layer
-        # activations stay VMEM-resident, relieving the HBM-bandwidth
-        # bound (benchmarks/flag_sweep.py: 256->39.2k, 512->35.0k,
-        # 1024->33k, 2048->28.5k img/s). K=256 steps/dispatch shrinks the
-        # ~26-30 ms tunnel overhead to ~0.1 ms/step: the hardware profile
-        # (PERF_ANALYSIS.md r3) puts the device-side step at 6.06 ms —
-        # 42.2k img/s is this config's device ceiling.
-        batch, k, dispatches, warmup = 256, 256, 2, 1
+        # Round 4: fused blocks WIN — FusedBottleneckBlock(impl="xla")
+        # with Gram-matrix BN statistics for the expanding projections
+        # (Σy = colsum(e)@W, Σy² = diag(WᵀGW); ops/fused_conv.py
+        # conv_bn_stats_xla) removes the 4f-activation stat reads. The
+        # batch sweet spot moved with the new balance: 384 → 45.2k,
+        # 256 → 43.5k, 512 → 41.4k (unfused: 256 → 40.6k, 384 → 38.1k).
+        # K steps/dispatch shrinks the ~26-30 ms tunnel overhead to
+        # ~0.1 ms/step.
+        batch, k, dispatches, warmup = 384, 170, 2, 1
         compute_dtype = "bfloat16"
+        fused = dict(fused_blocks=True, fused_impl="xla")
     else:
         batch, k, dispatches, warmup = 16, 2, 2, 1
         compute_dtype = "float32"
+        fused = {}
 
     model = ResNet50(num_classes=200, height=64, width=64, channels=3,
                      compute_dtype=compute_dtype,
-                     updater=Nesterovs(1e-2, 0.9)).init()
+                     updater=Nesterovs(1e-2, 0.9), **fused).init()
 
     # K optimizer steps per dispatch (lax.scan in optimize/solver.py:
     # make_scan_train_step): per-dispatch fixed overhead (buffer-handle

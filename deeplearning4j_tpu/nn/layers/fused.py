@@ -46,6 +46,17 @@ class FusedBottleneckBlock(Layer):
     downsample: bool = False
     eps: float = 1e-5
     decay: float = 0.9
+    # "pallas": the custom-kernel tier; "xla": plain-XLA convs with
+    # Gram-matrix BN statistics for the expanding projections
+    # (ops/fused_conv.py conv_bn_stats_xla) — no custom calls, no
+    # layout copies, stats still never re-read the 4f activations
+    impl: str = "pallas"
+
+    def __post_init__(self):
+        if self.impl not in ("pallas", "xla"):
+            raise ValueError(
+                f"FusedBottleneckBlock impl must be 'pallas' or 'xla', "
+                f"got {self.impl!r}")
 
     # ---- shape ----------------------------------------------------------
     def _out_hw(self, it: ConvolutionalType) -> Tuple[int, int]:
@@ -119,36 +130,42 @@ class FusedBottleneckBlock(Layer):
 
         ones = jnp.ones((x.shape[-1],), f32)
         zeros = jnp.zeros((x.shape[-1],), f32)
+        if self.impl == "xla":
+            from deeplearning4j_tpu.ops.fused_conv import conv_bn_stats_xla
+            conv = conv_bn_stats_xla
+        else:
+            conv = fused_conv_bn_act
 
-        y1, st1 = fused_conv_bn_act(x, params["W1"], ones, zeros,
-                                    False, False, self.stride)
+        y1, st1 = conv(x, params["W1"], ones, zeros,
+                       False, False, self.stride)
         m1 = y1.size // y1.shape[-1]
         s1, b1 = bn_form("bn1", st1, m1)
 
-        y2, st2 = fused_conv_bn_act(y1, params["W2"], s1, b1, True, True,
-                                    1)
+        y2, st2 = conv(y1, params["W2"], s1, b1, True, True, 1)
         m2 = y2.size // y2.shape[-1]
         s2, b2 = bn_form("bn2", st2, m2)
 
-        y3, st3 = fused_conv_bn_act(y2, params["W3"], s2, b2, True, True,
-                                    1)
+        y3, st3 = conv(y2, params["W3"], s2, b2, True, True, 1)
         m3 = y3.size // y3.shape[-1]
         s3, b3 = bn_form("bn3", st3, m3)
 
-        # Tail normalize+add+ReLU on 2-D (M, C) views in the compute
-        # dtype: 4-D/f32 tails made XLA pick the convolution activation
-        # layout and relayout-copy + upcast around every Pallas kernel.
+        # Tail normalize+add+ReLU. Pallas impl: on 2-D (M, C) views in
+        # the compute dtype — 4-D/f32 tails made XLA pick the conv
+        # activation layout and relayout-copy + upcast around every
+        # Pallas kernel. XLA impl: stay 4-D — there the reshape itself
+        # is the relayout.
         f4 = y3.shape[-1]
         out_shape = y3.shape
-        main = y3.reshape(-1, f4) * s3.astype(y3.dtype) \
-            + b3.astype(y3.dtype)
+        flat = self.impl != "xla"
+        v = (lambda a: a.reshape(-1, f4)) if flat else (lambda a: a)
+        main = v(y3) * s3.astype(y3.dtype) + b3.astype(y3.dtype)
         if self.downsample:
-            yds, stds = fused_conv_bn_act(x, params["Wds"], ones, zeros,
-                                          False, False, self.stride)
+            yds, stds = conv(x, params["Wds"], ones, zeros,
+                             False, False, self.stride)
             sds, bds = bn_form("bnds", stds, yds.size // yds.shape[-1])
-            shortcut = yds.reshape(-1, f4) * sds.astype(y3.dtype) \
+            shortcut = v(yds) * sds.astype(y3.dtype) \
                 + bds.astype(y3.dtype)
         else:
-            shortcut = x.reshape(-1, f4)
+            shortcut = v(x)
         out = jnp.maximum(main + shortcut, 0.0).astype(x.dtype)
         return out.reshape(out_shape), new_state

@@ -369,7 +369,9 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, res, do):
     """Flash backward from saved (O, logsumexp) — dq/dk/dv Pallas kernels
     (``_flash_backward_pallas``); P is recomputed from the normalizer
     instead of being saved. ``DL4J_FLASH_BWD=xla`` selects the jnp/scan
-    reference implementation (also used by equivalence tests)."""
+    reference implementation (also used by equivalence tests). The env
+    var is read at TRACE time — a jitted train step freezes the choice;
+    call ``jax.clear_caches()`` after changing it."""
     import os
     q, k, v, mask, out, lse = res
     if os.environ.get("DL4J_FLASH_BWD", "pallas") != "xla":

@@ -234,6 +234,10 @@ class ResNet50(ZooModel):
     # keeps conv/BN as separate layers, which the TP planner and
     # transfer-learning surgery operate on.
     fused_blocks: bool = False
+    # implementation for fused blocks: "pallas" (custom kernels) or
+    # "xla" (plain-XLA convs + Gram-matrix BN stats — see
+    # ops/fused_conv.py conv_bn_stats_xla)
+    fused_impl: str = "pallas"
 
     def conf(self):
         g = (NeuralNetConfiguration.Builder()
@@ -263,7 +267,8 @@ class ResNet50(ZooModel):
                 from deeplearning4j_tpu.nn.layers.fused import (
                     FusedBottleneckBlock)
                 g.add_layer(name, FusedBottleneckBlock(
-                    filters=filters, stride=stride, downsample=downsample),
+                    filters=filters, stride=stride, downsample=downsample,
+                    impl=self.fused_impl),
                     src)
                 return name
             f1, f2, f3 = filters, filters, filters * 4

@@ -2,6 +2,8 @@
 (the accelerated-helper validation tier — reference analog:
 deeplearning4j-cuda's ValidateCudnn* tests, SURVEY §4)."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -155,3 +157,78 @@ def test_bf16_path():
     np.testing.assert_allclose(np.asarray(y, np.float32),
                                np.asarray(yr, np.float32),
                                rtol=0.05, atol=0.05)
+
+
+class TestXlaGramImpl:
+    """conv_bn_stats_xla — the XLA-native sibling: same (y, stats)
+    contract, Gram-matrix statistics for expanding 1×1 convs
+    (Σy = colsum(e)@W, Σy² = diag(WᵀGW) with G=eᵀe — exact algebra,
+    differentiable by plain autodiff)."""
+
+    @pytest.mark.parametrize("case", [
+        dict(cin=8, cout=32, kernel=1, stride=1),    # expand → Gram
+        dict(cin=8, cout=32, kernel=1, stride=2),
+        dict(cin=32, cout=8, kernel=1, stride=1),    # reduce → direct
+        dict(cin=8, cout=16, kernel=3, stride=1),
+    ])
+    def test_matches_reference(self, case):
+        from deeplearning4j_tpu.ops.fused_conv import conv_bn_stats_xla
+        x, wt, s, b = _mk(3, 6, 6, case["cin"], case["cout"],
+                          case["kernel"])
+        y, st = conv_bn_stats_xla(x, wt, s, b, True, True,
+                                  case["stride"])
+        yr, str_ = _conv_reference(x, wt, s, b, True, True,
+                                   case["stride"])
+        np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(st), np.asarray(str_),
+                                   rtol=1e-3, atol=1e-2)
+
+    def test_grads_match_reference(self):
+        from deeplearning4j_tpu.ops.fused_conv import conv_bn_stats_xla
+        x, wt, s, b = _mk(3, 4, 4, 8, 24, 1)     # expand → Gram path
+
+        def loss(f):
+            def inner(x, wt, s, b):
+                y, st = f(x, wt, s, b, True, True, 1)
+                inv, shift, mean, var = stats_to_scale_shift(
+                    st, y.size // y.shape[-1], jnp.ones(y.shape[-1]),
+                    jnp.zeros(y.shape[-1]), 1e-5)
+                z = y.astype(jnp.float32) * inv + shift
+                return jnp.sum(jnp.tanh(z)) + 0.1 * jnp.sum(var)
+            return inner
+
+        gf = jax.grad(loss(conv_bn_stats_xla),
+                      argnums=(0, 1, 2, 3))(x, wt, s, b)
+        gr = jax.grad(loss(_conv_reference),
+                      argnums=(0, 1, 2, 3))(x, wt, s, b)
+        for a, r, name in zip(gf, gr, "x w scale shift".split()):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(r), rtol=2e-3, atol=2e-3,
+                err_msg=f"grad mismatch for {name}")
+
+    def test_fused_block_xla_impl_matches_pallas(self):
+        from deeplearning4j_tpu.nn.layers.fused import (
+            FusedBottleneckBlock)
+        from deeplearning4j_tpu.nn.layers.base import LayerContext
+        from deeplearning4j_tpu.nn.inputs import InputType
+        it = InputType.convolutional(8, 8, 16)
+        import jax as _jax
+        key = _jax.random.PRNGKey(0)
+        bp = FusedBottleneckBlock(filters=8, stride=2, downsample=True,
+                                  impl="pallas")
+        bx = dataclasses.replace(bp, impl="xla")
+        params = bp.initialize(key, it)
+        state = bp.init_state(it)
+        x = jnp.asarray(RNG.normal(0, 1, (4, 8, 8, 16))
+                        .astype(np.float32))
+        ctx = LayerContext(train=True)
+        yp, sp = bp.apply(params, state, x, ctx)
+        yx, sx = bx.apply(params, state, x, ctx)
+        np.testing.assert_allclose(np.asarray(yp), np.asarray(yx),
+                                   rtol=2e-3, atol=2e-3)
+        for k in sp:
+            np.testing.assert_allclose(np.asarray(sp[k]),
+                                       np.asarray(sx[k]),
+                                       rtol=2e-3, atol=2e-3,
+                                       err_msg=k)
