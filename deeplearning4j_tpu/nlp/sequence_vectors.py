@@ -52,10 +52,22 @@ class _PairStream:
         if model.use_hs:
             model._ensure_hs_matrices()
 
-    def push(self, centers: np.ndarray, contexts: np.ndarray):
+    def push(self, centers: np.ndarray, contexts: np.ndarray,
+             tokens: float = 0.0):
+        """``tokens`` spreads that many corpus tokens' worth of
+        lr-anneal progress evenly over these pairs, so producers that
+        batch many sequences per push (the round-4 slab path) keep the
+        same smooth decay the per-sequence producer had — advancing
+        ``seen`` up front would snap small corpora straight to
+        min_learning_rate (code-review r4)."""
+        if len(centers) == 0:
+            self.seen += tokens
+            return
+        per = tokens / len(centers)
         p = 0
         while p < len(centers):
             take = min(self.chunk - self.fill, len(centers) - p)
+            self.seen += per * take
             self.cen[self.d, self.fill:self.fill + take] = \
                 centers[p:p + take]
             self.ctx[self.d, self.fill:self.fill + take] = \
@@ -89,6 +101,17 @@ class _PairStream:
                 m.syn0, m.syn1, jnp.asarray(self.cen.copy()),
                 jnp.asarray(self.ctx.copy()), m._hs_points,
                 m._hs_labels, m._hs_mask, jnp.asarray(self.nv.copy()),
+                jnp.asarray(self.lrs.copy()))
+        elif getattr(m, "shared_negatives", False) and m.negative > 0 \
+                and self.chunk % sk.SHARED_NEG_GROUP == 0:
+            g = self.chunk // sk.SHARED_NEG_GROUP
+            draws = m._rng.integers(0, len(m._table),
+                                    (self.depth, g, m.negative))
+            negs = m._table[draws].astype(np.int32)
+            m.syn0, m.syn1 = sk.skipgram_scan_step_shared(
+                m.syn0, m.syn1, jnp.asarray(self.cen.copy()),
+                jnp.asarray(self.ctx.copy()), jnp.asarray(negs),
+                jnp.asarray(self.nv.copy()),
                 jnp.asarray(self.lrs.copy()))
         else:
             k = 1 + m.negative
@@ -124,7 +147,8 @@ class SequenceVectors:
                  seed: int = 42,
                  stop_words: Iterable[str] = (),
                  use_cbow: bool = False,
-                 device_pair_generation: bool = False):
+                 device_pair_generation: bool = False,
+                 shared_negatives: bool = True):
         self.layer_size = layer_size
         self.window_size = window_size
         self.min_word_frequency = min_word_frequency
@@ -146,6 +170,12 @@ class SequenceVectors:
         # pair path measures faster on a dedicated host (101-119k vs
         # ~76k tokens/s at 100k vocab); hence not the default.
         self.device_pair_generation = device_pair_generation
+        # Negative samples shared per 512-pair group (skipgram.py
+        # _sg_update_shared): the exact per-pair draw is gather-latency
+        # bound on TPU; sharing turns negative work into MXU matmuls
+        # (measured ~3× SGNS throughput). Same negative DISTRIBUTION,
+        # different per-pair draws; False restores per-pair negatives.
+        self.shared_negatives = shared_negatives
 
         self.vocab: Optional[VocabCache] = None
         self.syn0: Optional[jax.Array] = None
@@ -327,34 +357,96 @@ class SequenceVectors:
         PERF_ANALYSIS.md); update staleness within a chunk is the same
         hogwild-style race the reference's multithreaded native loop
         accepts (SURVEY §3.6). Scaled to the corpus so small corpora
-        still get ≥~64 sequential optimizer steps per fit."""
-        return int(np.clip(est_pairs // 64, self.batch_size, 65536))
+        still get ≥~64 sequential optimizer steps per fit. Rounded up
+        to the shared-negative group size so the grouped kernel's
+        [G, group] reshape always divides."""
+        c = int(np.clip(est_pairs // 64, self.batch_size, 65536))
+        g = sk.SHARED_NEG_GROUP
+        return -(-c // g) * g
+
+    def _encode_corpus_flat(self, seqs):
+        """One host pass over the corpus: vocab lookup into a flat int32
+        id array plus the sequence id of every surviving token. Round 4:
+        the per-sequence ``_indices`` loop was the measured host bound
+        of the SGNS path (75k tiny numpy calls at the 100k-vocab
+        bench); everything downstream is corpus-level numpy."""
+        lookup = self.vocab._by_word
+        flat = [t for s in seqs for t in s]
+        lens = np.fromiter((len(s) for s in seqs), np.int64, len(seqs))
+        idx = np.fromiter(
+            (vw.index if vw is not None else -1
+             for vw in map(lookup.get, flat)), np.int32, len(flat))
+        keep = idx >= 0
+        seq_id = np.repeat(np.arange(len(seqs)), lens)[keep]
+        return idx[keep], seq_id
+
+    def _subsample_mask(self, ids: np.ndarray) -> np.ndarray:
+        """Vectorized frequent-word subsampling (word2vec.c's keep
+        probability), redrawn per epoch like the sequential path. The
+        per-index counts array is cached — vocab counts are fixed for
+        the whole fit (code-review r4)."""
+        counts = getattr(self, "_counts_arr", None)
+        if counts is None or len(counts) != self.vocab.num_words():
+            counts = np.zeros(self.vocab.num_words(), np.float64)
+            for vw in self.vocab.vocab_words():
+                counts[vw.index] = vw.count
+            self._counts_arr = counts
+        total = max(1, self.vocab.total_word_count)
+        f = counts[ids] / total
+        keep_p = (np.sqrt(f / self.sampling) + 1) * self.sampling \
+            / np.maximum(f, 1e-300)
+        return self._rng.random(len(ids)) < keep_p
 
     def _fit_fast_sgns(self, seqs, total_words: int):
         """Whole-corpus vectorized skip-gram (negative sampling OR
-        hierarchical softmax): pair generation is numpy over an offsets
-        grid; negatives are one table gather per chunk, Huffman paths are
-        gathered on device from precomputed matrices; each chunk is a
-        single donated device step — the TPU-shaped version of the
-        reference's AggregateSkipGram batching (SkipGram.java:176-186)
-        with the Python-per-pair loop removed."""
+        hierarchical softmax): ONE vocab-lookup pass flattens the corpus
+        (``_encode_corpus_flat``), then pair generation runs as
+        corpus-level numpy over an offsets grid in ~1M-token slabs —
+        no per-sequence Python. Negatives are one table gather per
+        chunk, Huffman paths are gathered on device from precomputed
+        matrices; each superchunk is a single donated scanned device
+        step — the TPU-shaped version of the reference's
+        AggregateSkipGram batching (SkipGram.java:176-186)."""
         W = self.window_size
         stream = _PairStream(
             self, self._pair_chunk_size(total_words * (W + 1)),
             total_words)
+        ids_all, seq_all = self._encode_corpus_flat(seqs)
+        offsets = np.concatenate([np.arange(-W, 0), np.arange(1, W + 1)])
         for _epoch in range(self.epochs):
-            for seq in seqs:
-                idxs = np.asarray(self._indices(seq), np.int32)
-                n = len(idxs)
-                if n < 2:
-                    stream.seen += n
-                    continue
-                # randomized effective window per center (word2vec.c's b)
-                grid, valid = sk.window_grid(n, W, self._rng)
-                centers = np.repeat(idxs, valid.sum(axis=1))
-                contexts = idxs[grid[valid]]
+            if self.sampling > 0:
+                m = self._subsample_mask(ids_all)
+                ids, seq_id = ids_all[m], seq_all[m]
+            else:
+                ids, seq_id = ids_all, seq_all
+            n = len(ids)
+            if n < 2:
                 stream.seen += n
-                stream.push(centers, contexts)
+                continue
+            # per-token position/length within its (post-subsample)
+            # sequence, computed without any per-sequence loop
+            change = np.empty(n, bool)
+            change[0] = True
+            np.not_equal(seq_id[1:], seq_id[:-1], out=change[1:])
+            starts = np.flatnonzero(change)
+            seg = np.cumsum(change) - 1
+            pos = np.arange(n) - starts[seg]
+            lens = np.diff(np.append(starts, n))
+            length = lens[seg]
+            # randomized effective window per center (word2vec.c's b)
+            w_eff = (self._rng.integers(1, W + 1, size=n)
+                     if W > 1 else np.ones(n, np.int64))
+            slab = 1 << 20
+            for lo in range(0, n, slab):
+                hi = min(n, lo + slab)
+                o = offsets[None, :]
+                p = pos[lo:hi, None]
+                valid = ((np.abs(o) <= w_eff[lo:hi, None])
+                         & (p + o >= 0)
+                         & (p + o < length[lo:hi, None]))
+                centers = np.repeat(ids[lo:hi], valid.sum(axis=1))
+                gpos = (np.arange(lo, hi)[:, None] + o)[valid]
+                stream.push(centers, ids[gpos], tokens=hi - lo)
         stream.finish()
         return self
 

@@ -515,3 +515,82 @@ def hs_targets(vw, max_len: Optional[int] = None
     if max_len is not None:
         points, labels = points[:max_len], labels[:max_len]
     return points, labels
+
+
+# ---------------------------------------------------------------------------
+# shared-negative-sample SkipGram (round 4)
+# ---------------------------------------------------------------------------
+
+SHARED_NEG_GROUP = 512
+
+
+def _sg_update_shared(syn0, syn1,
+                      centers,     # [B] int32
+                      contexts,    # [B] int32
+                      negs,        # [G, NEG] int32, B % G == 0
+                      nv,          # scalar int32 valid rows
+                      lr):
+    """SkipGram update with PER-GROUP shared negative samples.
+
+    Per-pair negative rows are the gather/scatter bound of the exact
+    batched step (K+1 random ~512-byte row ops each way per pair —
+    latency-, not bandwidth-, limited on TPU). Sharing one negative set
+    across a group of ``B/G`` consecutive pairs turns the negative
+    work into three batched MXU matmuls (logits, dh, dW) over [G,
+    group, D] blocks, leaving only the positive context + center rows
+    to gather/scatter. This is the published shared-negative-sampling
+    batching (e.g. Ji et al., "Parallelizing Word2Vec in Shared and
+    Distributed Memory", whose negative sharing this mirrors) — the
+    negatives are i.i.d. draws either way; sharing them within a group
+    changes which random negatives each pair sees, not their
+    distribution. The reference's exact per-pair semantics remain
+    available via shared_negatives=False.
+
+    Negatives are drawn WITHOUT excluding each pair's positive (a
+    collision demotes one true context draw to ~uniform noise at
+    unigram-table probability — word2vec.c itself merely skips such
+    draws). Row updates still go through the clipped deduplicating
+    scatter, so determinism and the divergence guard are unchanged."""
+    b = centers.shape[0]
+    d = syn0.shape[1]
+    g, n_neg = negs.shape
+    group = b // g
+    valid = (jnp.arange(b) < nv).astype(jnp.float32)
+    h = syn0[centers]                                  # [B, D]
+    wt = syn1[contexts]                                # [B, D]
+    # positive pair
+    lp = jnp.sum(h * wt, axis=-1)
+    gp = (1.0 - jax.nn.sigmoid(lp)) * valid * lr       # [B]
+    dh = gp[:, None] * wt
+    dwt = gp[:, None] * h
+    # shared negatives: batched matmuls over [G, group, D]
+    wn = syn1[negs.reshape(-1)].reshape(g, n_neg, d)   # [G, NEG, D]
+    hg = h.reshape(g, group, d)
+    ln = jnp.einsum("gbd,gnd->gbn", hg, wn)
+    gn = (-jax.nn.sigmoid(ln)) * valid.reshape(g, group, 1) * lr
+    dh = dh + jnp.einsum("gbn,gnd->gbd", gn, wn).reshape(b, d)
+    dwn = jnp.einsum("gbn,gbd->gnd", gn, hg)           # [G, NEG, D]
+    mr = _max_row_norm(lr, d)
+    syn1 = _clipped_scatter(syn1, contexts, dwt, mr)
+    syn1 = _clipped_scatter(syn1, negs.reshape(-1),
+                            dwn.reshape(-1, d), mr)
+    syn0 = _clipped_scatter(syn0, centers, dh, mr)
+    return syn0, syn1
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def skipgram_scan_step_shared(syn0, syn1,
+                              centers,   # [D, B] int32
+                              contexts,  # [D, B] int32
+                              negs,      # [D, G, NEG] int32
+                              n_valid,   # [D] int32
+                              lrs):      # [D] float32
+    def body(carry, chunk):
+        s0, s1 = carry
+        cen, ctx, ng, nv, lr = chunk
+        s0, s1 = _sg_update_shared(s0, s1, cen, ctx, ng, nv, lr)
+        return (s0, s1), None
+
+    (syn0, syn1), _ = jax.lax.scan(
+        body, (syn0, syn1), (centers, contexts, negs, n_valid, lrs))
+    return syn0, syn1
