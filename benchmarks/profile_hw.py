@@ -40,21 +40,34 @@ def shape_bytes(txt: str) -> int:
     return tot
 
 
-def capture(fused: bool, batch: int, k: int, outdir: str):
+def capture(mode: str, batch: int, k: int, outdir: str):
     import jax
     import jax.numpy as jnp
     import jax.random as jrandom
     from deeplearning4j_tpu.optimize.solver import make_scan_train_step
     from deeplearning4j_tpu.optimize.updaters import Nesterovs
-    from deeplearning4j_tpu.zoo.models import ResNet50
+    from deeplearning4j_tpu.zoo.models import ResNet50, VGG16
 
-    model = ResNet50(num_classes=200, height=64, width=64, channels=3,
-                     compute_dtype="bfloat16", fused_blocks=fused,
-                     updater=Nesterovs(1e-2, 0.9)).init()
+    if mode == "vgg":
+        model = VGG16(num_classes=200, height=64, width=64, channels=3,
+                      compute_dtype="bfloat16").init()
 
-    def loss_fn(params, mstate, feats, labels, fmask, lmask, rng, it):
-        return model._loss(params, mstate, (feats,), (labels,), fmask,
-                           lmask, rng, it)
+        def loss_fn(params, mstate, feats, labels, fmask, lmask, rng,
+                    it):
+            # MultiLayerNetwork _loss takes raw arrays
+            return model._loss(params, mstate, feats, labels, fmask,
+                               lmask, rng, it)
+    else:
+        model = ResNet50(
+            num_classes=200, height=64, width=64, channels=3,
+            compute_dtype="bfloat16", fused_blocks=mode != "unfused",
+            fused_impl="xla" if mode == "gram" else "pallas",
+            updater=Nesterovs(1e-2, 0.9)).init()
+
+        def loss_fn(params, mstate, feats, labels, fmask, lmask, rng,
+                    it):
+            return model._loss(params, mstate, (feats,), (labels,),
+                               fmask, lmask, rng, it)
 
     steps_fn = make_scan_train_step(loss_fn, model._tx)
     rng = np.random.default_rng(0)
@@ -120,10 +133,16 @@ def analyze(outdir: str, n_steps: int):
 
 
 if __name__ == "__main__":
-    fused = "fused" in sys.argv[1:]
-    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    # modes: unfused (default) | fused (pallas blocks) | gram (xla
+    # blocks + Gram stats) | vgg
+    mode = sys.argv[1] if len(sys.argv) > 1 else "unfused"
+    if mode not in ("unfused", "fused", "gram", "vgg"):
+        sys.exit(f"unknown mode {mode!r}: expected unfused|fused|gram|vgg"
+                 " [batch]")
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else (
+        512 if mode == "vgg" else 256)
     k = 64
     outdir = tempfile.mkdtemp(prefix="dl4j_hwprof_")
-    capture(fused, batch, k, outdir)
+    capture(mode, batch, k, outdir)
     print(f"trace: {outdir}")
     analyze(outdir, k)

@@ -32,6 +32,11 @@ from deeplearning4j_tpu.nlp.word2vec import Word2Vec
 class ParagraphVectors(Word2Vec):
     def __init__(self, dm: bool = False, **kwargs):
         kwargs.setdefault("use_cbow", dm)
+        # DBOW rides the shared _PairStream; keep the exact per-pair
+        # negative draws here (the round-4 grouped shared-negative
+        # kernel is validated for Word2Vec SGNS, not for PV) — opt in
+        # explicitly with shared_negatives=True
+        kwargs.setdefault("shared_negatives", False)
         super().__init__(**kwargs)
         self.dm = dm
         self._label_set = set()

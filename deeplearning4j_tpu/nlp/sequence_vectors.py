@@ -385,12 +385,15 @@ class SequenceVectors:
         probability), redrawn per epoch like the sequential path. The
         per-index counts array is cached — vocab counts are fixed for
         the whole fit (code-review r4)."""
-        counts = getattr(self, "_counts_arr", None)
-        if counts is None or len(counts) != self.vocab.num_words():
+        cached = getattr(self, "_counts_arr", None)
+        # keyed on vocab object identity: a rebuilt vocab of equal SIZE
+        # must not reuse stale frequencies (code-review r4)
+        if cached is None or cached[0] is not self.vocab:
             counts = np.zeros(self.vocab.num_words(), np.float64)
             for vw in self.vocab.vocab_words():
                 counts[vw.index] = vw.count
-            self._counts_arr = counts
+            self._counts_arr = cached = (self.vocab, counts)
+        counts = cached[1]
         total = max(1, self.vocab.total_word_count)
         f = counts[ids] / total
         keep_p = (np.sqrt(f / self.sampling) + 1) * self.sampling \

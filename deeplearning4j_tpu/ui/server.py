@@ -415,7 +415,13 @@ class _Handler(BaseHTTPRequestHandler):
             self._json({"error": f"bad payload size ({n} bytes; "
                         f"cap {_MAX_UPLOAD_BYTES})"}, 413)
             return None
-        return json.loads(self.rfile.read(n) or b"{}")
+        body = self.rfile.read(n) or b"{}"
+        # binary stats codec (the router's wire format) or JSON
+        from deeplearning4j_tpu.ui.codec import (
+            decode_stats_record, is_stats_record)
+        if is_stats_record(body):
+            return decode_stats_record(body)
+        return json.loads(body)
 
     def do_POST(self):
         path = urlparse(self.path).path

@@ -111,7 +111,10 @@ class InMemoryStatsStorage(StatsStorage):
 
 class SqliteStatsStorage(StatsStorage):
     """File-backed storage (reference: J7FileStatsStorage over MapDB /
-    sqlite, §2.12). One table of JSON blobs; safe across processes."""
+    sqlite, §2.12). One table of records; safe across processes.
+    Round 4: records persist in the compact binary stats codec
+    (ui/codec.py — the SBE-codec role), cutting blob size ~2-4× on
+    histogram-bearing updates; pre-existing JSON rows still read."""
 
     def __init__(self, path: str):
         super().__init__()
@@ -141,11 +144,25 @@ class SqliteStatsStorage(StatsStorage):
         self._put(record, "update")
 
     def _put(self, record: dict, kind: str):
+        from deeplearning4j_tpu.ui.codec import encode_stats_record
         with self._lock, self._conn() as c:
             c.execute("INSERT INTO records VALUES (?,?,?,?)",
                       (record["session_id"], kind,
-                       record.get("timestamp", 0.0), json.dumps(record)))
+                       record.get("timestamp", 0.0),
+                       encode_stats_record(record)))
         self._notify(record)
+
+    @staticmethod
+    def _load(blob) -> dict:
+        """Binary codec rows (current) or JSON rows (pre-round-4)."""
+        from deeplearning4j_tpu.ui.codec import (
+            decode_stats_record, is_stats_record)
+        if isinstance(blob, (bytes, bytearray)) and is_stats_record(
+                bytes(blob)):
+            return decode_stats_record(bytes(blob))
+        if isinstance(blob, (bytes, bytearray)):
+            blob = blob.decode("utf-8")
+        return json.loads(blob)
 
     def list_session_ids(self) -> List[str]:
         with self._lock, self._conn() as c:
@@ -163,7 +180,7 @@ class SqliteStatsStorage(StatsStorage):
             rows = c.execute(
                 "SELECT blob FROM records WHERE session_id=? AND kind="
                 "'update' ORDER BY ts, rowid", (session_id,)).fetchall()
-        ups = [json.loads(r[0]) for r in rows]
+        ups = [self._load(r[0]) for r in rows]
         if worker_id is not None:
             ups = [u for u in ups if u.get("worker_id") == worker_id]
         return ups
@@ -174,7 +191,7 @@ class SqliteStatsStorage(StatsStorage):
                 "SELECT blob FROM records WHERE session_id=? AND kind="
                 "'static' ORDER BY ts DESC LIMIT 1",
                 (session_id,)).fetchall()
-        return json.loads(rows[0][0]) if rows else None
+        return self._load(rows[0][0]) if rows else None
 
 
 class RemoteUIStatsStorageRouter(StatsStorageRouter):
@@ -242,10 +259,13 @@ class RemoteUIStatsStorageRouter(StatsStorageRouter):
             time.sleep(0.05)
 
     def _post(self, payload: dict):
-        data = json.dumps(payload).encode()
+        # binary stats codec on the wire (ui/codec.py — the SBE role);
+        # the receiver also accepts JSON from third-party posters
+        from deeplearning4j_tpu.ui.codec import encode_stats_record
+        data = encode_stats_record(payload)
         req = urllib.request.Request(
             self.url, data=data,
-            headers={"Content-Type": "application/json"})
+            headers={"Content-Type": "application/octet-stream"})
         last = None
         for _ in range(self.retry_count):
             try:

@@ -216,6 +216,9 @@ class TestSharedNegatives:
         s0r, s1r = self._numpy_ref(syn0, syn1, cen, ctx, negs, B, 0.01)
         np.testing.assert_allclose(np.asarray(s1), s1r, rtol=1e-5,
                                    atol=1e-6)
+        # the dh side must pair with its OWN group's negatives too
+        np.testing.assert_allclose(np.asarray(s0), s0r, rtol=1e-5,
+                                   atol=1e-6)
 
     def test_invalid_rows_inert(self):
         rng = np.random.default_rng(7)

@@ -1,0 +1,124 @@
+"""Binary stats wire codec (ui/codec.py — the reference SBE codecs'
+role, .../stats/sbe/UpdateEncoder): round-trip, size vs JSON,
+end-to-end through sqlite storage and the remote router → server path
+(VERDICT r3 #8)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ui.codec import (
+    decode_stats_record,
+    encode_stats_record,
+    is_stats_record,
+)
+
+
+def _record():
+    rng = np.random.default_rng(0)
+    return {
+        "session_id": "sess1", "worker_id": "w0", "timestamp": 12.5,
+        "iteration": 42, "score": 0.0314, "is_final": False,
+        "note": None, "tags": ["a", "b"],
+        "param_stats": {
+            f"layer_{i}": {
+                "mean": float(i), "std": 0.1 * i,
+                "histogram": rng.normal(0, 1, 64).tolist(),
+                "bins": np.linspace(-3, 3, 65).tolist(),
+            } for i in range(6)
+        },
+    }
+
+
+def test_round_trip_exact():
+    rec = _record()
+    data = encode_stats_record(rec)
+    assert is_stats_record(data)
+    back = decode_stats_record(data)
+    assert back["session_id"] == rec["session_id"]
+    assert back["iteration"] == 42 and back["is_final"] is False
+    assert back["note"] is None and back["tags"] == ["a", "b"]
+    for k, v in rec["param_stats"].items():
+        np.testing.assert_allclose(back["param_stats"][k]["histogram"],
+                                   v["histogram"], rtol=1e-6)
+        assert back["param_stats"][k]["mean"] == v["mean"]
+
+
+def test_smaller_than_json():
+    rec = _record()
+    binary = len(encode_stats_record(rec))
+    as_json = len(json.dumps(rec).encode())
+    assert binary < 0.6 * as_json, (binary, as_json)
+
+
+def test_rejects_corrupt_and_truncated():
+    rec = encode_stats_record({"session_id": "x", "v": [1.0] * 32})
+    with pytest.raises(ValueError):
+        decode_stats_record(b"NOTMAGIC" + rec[8:])
+    with pytest.raises(ValueError):
+        decode_stats_record(rec[:len(rec) // 2])
+    with pytest.raises(TypeError):
+        encode_stats_record({"bad": object()})
+
+
+def test_sqlite_storage_binary_round_trip(tmp_path):
+    from deeplearning4j_tpu.ui.storage import SqliteStatsStorage
+    st = SqliteStatsStorage(str(tmp_path / "s.db"))
+    rec = _record()
+    st.put_static_info({"session_id": "sess1", "model": "m"})
+    st.put_update(rec)
+    ups = st.get_all_updates("sess1")
+    assert len(ups) == 1
+    np.testing.assert_allclose(
+        ups[0]["param_stats"]["layer_0"]["histogram"],
+        rec["param_stats"]["layer_0"]["histogram"], rtol=1e-6)
+    assert st.get_static_info("sess1")["model"] == "m"
+    # stored blob IS binary
+    import sqlite3
+    rows = sqlite3.connect(str(tmp_path / "s.db")).execute(
+        "SELECT blob FROM records").fetchall()
+    assert all(is_stats_record(bytes(r[0])) for r in rows)
+
+
+def test_sqlite_reads_legacy_json_rows(tmp_path):
+    import sqlite3
+    from deeplearning4j_tpu.ui.storage import SqliteStatsStorage
+    st = SqliteStatsStorage(str(tmp_path / "s.db"))
+    legacy = {"session_id": "old", "iteration": 7, "score": 1.5}
+    with sqlite3.connect(str(tmp_path / "s.db")) as c:
+        c.execute("INSERT INTO records VALUES (?,?,?,?)",
+                  ("old", "update", 1.0, json.dumps(legacy)))
+    assert st.get_all_updates("old")[0]["iteration"] == 7
+
+
+def test_remote_router_to_server_binary(tmp_path):
+    """listener → router → HTTP /remote → storage, binary on the wire."""
+    import urllib.request
+    from deeplearning4j_tpu.ui.server import UIServer
+    from deeplearning4j_tpu.ui.storage import (
+        InMemoryStatsStorage, RemoteUIStatsStorageRouter)
+    storage = InMemoryStatsStorage()
+    srv = UIServer(port=0).attach(storage)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        url = base + "/remote"
+        router = RemoteUIStatsStorageRouter(base, async_mode=False)
+        rec = _record()
+        router.put_update(rec)
+        ups = storage.get_all_updates("sess1")
+        assert len(ups) == 1
+        np.testing.assert_allclose(
+            ups[0]["param_stats"]["layer_2"]["histogram"],
+            rec["param_stats"]["layer_2"]["histogram"], rtol=1e-6)
+        # JSON posters still accepted (third-party integrations)
+        body = json.dumps({"kind": "update", "record": {
+            "session_id": "sess1", "iteration": 1}}).encode()
+        req = urllib.request.Request(url, data=body, headers={
+            "Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=5):
+            pass
+        assert len(storage.get_all_updates("sess1")) == 2
+    finally:
+        srv.stop()
