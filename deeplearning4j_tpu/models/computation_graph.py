@@ -281,6 +281,12 @@ class ComputationGraph(BaseModel):
         sequence labels are sliced along time; 2-D (static) inputs repeat
         whole into every chunk, exactly like the reference's handling of
         non-sequence graph inputs."""
+        from deeplearning4j_tpu.nn.layers.recurrent import (
+            first_bidirectional_name, warn_tbptt_bidirectional)
+        bidi = first_bidirectional_name(
+            (n.name, n.layer) for n in self._layer_nodes)
+        if bidi is not None:
+            warn_tbptt_bidirectional(bidi)
         if self._tbptt_step is None:
             self._tbptt_step = self._build_tbptt_step()
         if isinstance(batch, MultiDataSet):
@@ -396,15 +402,15 @@ class ComputationGraph(BaseModel):
         State persists across calls until ``rnn_clear_previous_state``;
         batch-size changes reset it (same contract as the reference)."""
         from deeplearning4j_tpu.nn.layers.recurrent import (
-            Bidirectional, GravesBidirectionalLSTM, unwrap_recurrent)
-        for node in self._layer_nodes:
-            # unwrap: a wrapped bidirectional core must not slip past
-            if isinstance(unwrap_recurrent(node.layer),
-                          (Bidirectional, GravesBidirectionalLSTM)):
-                raise ValueError(
-                    "rnn_time_step is not supported on graphs with "
-                    f"bidirectional layers ('{node.name}'): the backward "
-                    "pass needs future timesteps")
+            first_bidirectional_name)
+        # unwrap inside the helper: a wrapped core must not slip past
+        bidi = first_bidirectional_name(
+            (n.name, n.layer) for n in self._layer_nodes)
+        if bidi is not None:
+            raise ValueError(
+                "rnn_time_step is not supported on graphs with "
+                f"bidirectional layers ('{bidi}'): the backward "
+                "pass needs future timesteps")
         if self.train_state is None:
             self.init()
         if len(features) == 1 and isinstance(features[0], (list, tuple)):

@@ -264,6 +264,12 @@ class MultiLayerNetwork(BaseModel):
         if (conf.backprop_type != "tbptt" or feats.ndim != 3
                 or not self._recurrent_carry_layers()):
             return super()._fit_batch(batch, etl_ms=etl_ms)
+        from deeplearning4j_tpu.nn.layers.recurrent import (
+            first_bidirectional_name, warn_tbptt_bidirectional)
+        bidi = first_bidirectional_name(
+            (l.name, l) for l in self.layers)
+        if bidi is not None:
+            warn_tbptt_bidirectional(bidi)
         if self._tbptt_step is None:
             self._tbptt_step = self._build_tbptt_step()
         k = conf.tbptt_fwd_length

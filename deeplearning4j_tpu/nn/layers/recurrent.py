@@ -390,3 +390,28 @@ def unwrap_recurrent(layer):
             and inner is not None:
         return unwrap_recurrent(inner)
     return layer
+
+
+def first_bidirectional_name(named_layers):
+    """Name of the first layer whose (unwrapped) core is bidirectional,
+    or None. Shared by rnn_time_step's hard check and TBPTT's warning on
+    both model types, so the wrapper list stays in lockstep (advisor
+    r4). ``named_layers`` yields (name, layer) pairs."""
+    for name, layer in named_layers:
+        if isinstance(unwrap_recurrent(layer),
+                      (Bidirectional, GravesBidirectionalLSTM)):
+            return name
+    return None
+
+
+def warn_tbptt_bidirectional(name: str, stacklevel: int = 4):
+    """TBPTT chunks a bidirectional layer with no carried state: each
+    chunk's backward pass is truncated at the chunk boundary, which
+    silently differs from full-sequence BPTT (advisor r4)."""
+    import warnings
+    warnings.warn(
+        f"TBPTT fit with bidirectional layer '{name}': bidirectional "
+        "cores carry no state across chunks, so the backward pass is "
+        "truncated at each chunk boundary (differs from full-sequence "
+        "BPTT). Use backprop_type='standard' for exact bidirectional "
+        "gradients.", UserWarning, stacklevel=stacklevel)

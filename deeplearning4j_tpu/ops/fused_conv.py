@@ -123,26 +123,31 @@ def _mm_pallas(x2d, w, scale, shift, relu_in: bool, want_stats: bool,
 # fused 3×3 SAME conv: y = conv3x3(relu?(x·s + b)) + stats, per-image planes
 # ---------------------------------------------------------------------------
 
-def _c3_images_per_program(n: int, h: int, wd: int, cin: int) -> int:
+def _c3_images_per_program(n: int, h: int, wd: int, cin: int,
+                           itemsize: int = 2) -> int:
     """Images per grid program: enough for ~2k matmul rows (small planes
-    would leave the MXU pipeline empty), capped so the padded bf16 plane
-    stays ≈1.5 MB of VMEM, and dividing the batch."""
-    cap = max(1, int(1.5e6 / ((h + 2) * (wd + 2) * cin * 2)))
+    would leave the MXU pipeline empty), capped so the padded input
+    plane (``itemsize`` bytes/element — f32 planes cost 2× bf16, advisor
+    r4) stays ≈1.5 MB of VMEM, and dividing the batch."""
+    cap = max(1, int(1.5e6 / ((h + 2) * (wd + 2) * cin * itemsize)))
     bi = max(1, min(n, 2048 // max(1, h * wd), cap))
     while n % bi:
         bi -= 1
     return bi
 
 
-def _c3_fits_vmem(h: int, wd: int, cin: int, cout: int) -> bool:
+def _c3_fits_vmem(h: int, wd: int, cin: int, cout: int,
+                  itemsize: int = 2) -> bool:
     """Whether even a single-image 3×3 program fits the VMEM budget.
 
     The 3×3 kernels keep the whole padded (h+2)×(w+2)×Cin input plane
     plus the h×w×Cout f32 accumulator resident; at ImageNet-size planes
     (e.g. 224×224×64) that exceeds the ~16 MB of VMEM and the Pallas
     call fails at compile time. Beyond this budget the op falls back to
-    the XLA reference math (advisor r3 low finding)."""
-    plane = (h + 2) * (wd + 2) * cin * 2          # padded bf16 input
+    the XLA reference math (advisor r3 low finding). `itemsize` is the
+    compute dtype's bytes/element — f32 planes cost 2× bf16 (advisor r4
+    low finding)."""
+    plane = (h + 2) * (wd + 2) * cin * itemsize   # padded input plane
     # accumulator is tiled over cout in bn=min(512,cout) blocks — mirror
     # _c3_pallas, not the full cout (a 56×56×2048 layer tiles fine)
     acc = h * wd * min(512, cout) * 4             # f32 matmul accumulator
@@ -179,7 +184,8 @@ def _c3_pallas(x4d, w, scale, shift, relu_in: bool, want_stats: bool,
                out_dtype) -> Tuple[jax.Array, jax.Array]:
     n, h, wd, cin = x4d.shape
     cout = w.shape[3]
-    bi = _c3_images_per_program(n, h, wd, cin)
+    bi = _c3_images_per_program(n, h, wd, cin,
+                                max(x4d.dtype.itemsize, w.dtype.itemsize))
     bn = min(512, cout)
     ni, nn = n // bi, -(-cout // bn)
     kernel = functools.partial(_c3_kernel, relu_in=relu_in,
@@ -420,7 +426,8 @@ def _c3_bwd_merged_pallas(x, dy, y, w, dst, scale, shift, relu_in,
                           interpret, out_dtype):
     n, h, wd, cin = x.shape
     cout = dy.shape[3]
-    bi = _c3_images_per_program(n, h, wd, cin)
+    bi = _c3_images_per_program(n, h, wd, cin,
+                                max(x.dtype.itemsize, w.dtype.itemsize))
     ni = n // bi
     wt = w[::-1, ::-1].transpose(0, 1, 3, 2)
     a4 = dst[0][None, None, None, :]
@@ -462,7 +469,8 @@ def _c3_bwd_pallas(x, dy, y, w, dst, scale, shift, relu_in, norm_in,
                    interpret, out_dtype):
     n, h, wd, cin = x.shape
     cout = dy.shape[3]
-    bi = _c3_images_per_program(n, h, wd, cin)
+    bi = _c3_images_per_program(n, h, wd, cin,
+                                max(x.dtype.itemsize, w.dtype.itemsize))
     ni = n // bi
     bci = min(512, cin)
     wt = w[::-1, ::-1].transpose(0, 1, 3, 2)       # flip + IO swap
@@ -675,7 +683,8 @@ def _fused_fwd_impl(x, w, scale, shift, relu_in, norm_in, stride,
                             True, norm_in, interpret, x.dtype)
         return y2.reshape(n, h, wd, -1), st
     n, h, wd, cin = x.shape
-    if not _c3_fits_vmem(h, wd, cin, w.shape[3]):
+    if not _c3_fits_vmem(h, wd, cin, w.shape[3],
+                         max(x.dtype.itemsize, w.dtype.itemsize)):
         return _conv_reference(x, w, scale, shift, relu_in, norm_in, 1)
     return _c3_pallas(x, w, scale, shift, relu_in, True, norm_in,
                       interpret, x.dtype)
@@ -710,8 +719,9 @@ def _fused_bwd_rule(relu_in, norm_in, stride, interpret, res, cots):
     cin = xs.shape[-1]
     cout = y.shape[-1]
 
-    if w.ndim == 4 and not _c3_fits_vmem(xs.shape[1], xs.shape[2], cin,
-                                         cout):
+    if w.ndim == 4 and not _c3_fits_vmem(
+            xs.shape[1], xs.shape[2], cin, cout,
+            max(x.dtype.itemsize, w.dtype.itemsize)):
         # oversized spatial plane: the whole op ran on the XLA reference
         # path — differentiate that same math
         def _ref(x_, w_, s_, b_):

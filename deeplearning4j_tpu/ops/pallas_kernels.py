@@ -157,14 +157,16 @@ def _flash_forward(q, k, v, mask, causal: bool, block_q: int, block_k: int,
     return out, lse[..., 0]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash_attention(q, k, v, mask, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_attention(q, k, v, mask, causal, block_q, block_k, interpret,
+                     bwd_impl):
     out, _ = _flash_forward(q, k, v, mask, causal, block_q, block_k,
                             interpret)
     return out
 
 
-def _flash_fwd_rule(q, k, v, mask, causal, block_q, block_k, interpret):
+def _flash_fwd_rule(q, k, v, mask, causal, block_q, block_k, interpret,
+                    bwd_impl):
     out, lse = _flash_forward(q, k, v, mask, causal, block_q, block_k,
                               interpret)
     return out, (q, k, v, mask, out, lse)
@@ -365,16 +367,22 @@ def _flash_backward_pallas(q, k, v, mask, out, lse, do, causal: bool,
     return dq, dk, dv
 
 
-def _flash_bwd_rule(causal, block_q, block_k, interpret, res, do):
+def _flash_bwd_rule(causal, block_q, block_k, interpret, bwd_impl, res,
+                    do):
     """Flash backward from saved (O, logsumexp) — dq/dk/dv Pallas kernels
     (``_flash_backward_pallas``); P is recomputed from the normalizer
-    instead of being saved. ``DL4J_FLASH_BWD=xla`` selects the jnp/scan
-    reference implementation (also used by equivalence tests). The env
-    var is read at TRACE time — a jitted train step freezes the choice;
-    call ``jax.clear_caches()`` after changing it."""
+    instead of being saved. ``bwd_impl`` ("pallas"/"xla", the explicit
+    flash_attention parameter) takes precedence; when None the
+    ``DL4J_FLASH_BWD=xla`` env override selects the jnp/scan reference
+    implementation (also used by equivalence tests). The env var is read
+    at TRACE time — a jitted train step freezes the choice; call
+    ``jax.clear_caches()`` after changing it (advisor r4: pass bwd_impl
+    for programmatic control instead)."""
     import os
     q, k, v, mask, out, lse = res
-    if os.environ.get("DL4J_FLASH_BWD", "pallas") != "xla":
+    if bwd_impl is None:
+        bwd_impl = os.environ.get("DL4J_FLASH_BWD", "pallas")
+    if bwd_impl != "xla":
         dq, dk, dv = _flash_backward_pallas(
             q, k, v, mask, out, lse, do, causal, block_q, block_k,
             interpret)
@@ -441,15 +449,22 @@ def _pad_len(t: int, block: int) -> int:
 def flash_attention(q, k, v, mask=None, causal: bool = False,
                     block_q: int = _DEF_BLOCK_Q,
                     block_k: int = _DEF_BLOCK_K,
-                    interpret: Optional[bool] = None):
+                    interpret: Optional[bool] = None,
+                    bwd_impl: Optional[str] = None):
     """Blockwise (flash) attention on (N, T, H, Dh) tensors.
 
     Drop-in for nn.layers.attention.scaled_dot_product_attention. ``mask``
     is the (N, T_k) key-validity mask. Sequences are padded to the block
     size internally (padding is masked out, query padding sliced off).
     ``interpret`` defaults to True off-TPU so tests exercise the same
-    kernel on the CPU mesh.
+    kernel on the CPU mesh. ``bwd_impl`` selects the backward
+    implementation explicitly ("pallas" kernels or the "xla" jnp/scan
+    reference); None defers to the ``DL4J_FLASH_BWD`` env override
+    (default pallas).
     """
+    if bwd_impl not in (None, "pallas", "xla"):
+        raise ValueError(f"bwd_impl must be 'pallas'/'xla'/None, "
+                         f"got {bwd_impl!r}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     n, tq, h, dh = q.shape
@@ -481,7 +496,7 @@ def flash_attention(q, k, v, mask=None, causal: bool = False,
         mask = jnp.pad(mask, ((0, 0), (0, pk)))
 
     out = _flash_attention(qt, kt, vt, mask, causal, block_q, block_k,
-                           interpret)
+                           interpret, bwd_impl)
     if pq:
         out = out[:, :, :tq, :]
     return jnp.swapaxes(out, 1, 2)                          # NHTD -> NTHD

@@ -61,7 +61,11 @@ def _enc(value: Any, out: list):
     elif isinstance(value, np.ndarray):
         # stats payloads travel at f32 width, like the reference's SBE
         # UpdateEncoder (histogram/summary floats are 32-bit on its
-        # wire too); integer arrays keep their exact dtype
+        # wire too); integer arrays keep their exact dtype. NOTE
+        # (advisor r4): f64 arrays — and numeric lists of >=8 items via
+        # the fast path below — are quantized to f32 on this wire;
+        # tuples decode as lists. Callers needing exact f64 round-trips
+        # should keep values as scalars or short (<8) lists.
         if value.dtype == np.float64:
             value = value.astype(np.float32)
         frame = serialize_ndarray(value)

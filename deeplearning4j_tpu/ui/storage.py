@@ -114,7 +114,10 @@ class SqliteStatsStorage(StatsStorage):
     sqlite, §2.12). One table of records; safe across processes.
     Round 4: records persist in the compact binary stats codec
     (ui/codec.py — the SBE-codec role), cutting blob size ~2-4× on
-    histogram-bearing updates; pre-existing JSON rows still read."""
+    histogram-bearing updates; pre-existing JSON rows still read.
+    The codec carries float arrays (and numeric lists of >=8 items) at
+    f32 width, matching the reference's 32-bit SBE floats — f64 stats
+    values lose precision on round-trip (advisor r4, documented)."""
 
     def __init__(self, path: str):
         super().__init__()
@@ -204,6 +207,10 @@ class RemoteUIStatsStorageRouter(StatsStorageRouter):
     slows nothing and, after retries, records are logged-and-dropped
     rather than crashing the training loop. ``async_mode=False`` posts
     synchronously and raises — for tests and one-shot scripts.
+
+    The binary wire format (ui/codec.py) carries float arrays and
+    numeric lists of >=8 items at f32 width (like the reference's SBE
+    encoders) — f64 values in posted records are quantized in transit.
     """
 
     def __init__(self, url: str, timeout: float = 5.0,

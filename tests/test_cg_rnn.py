@@ -145,6 +145,36 @@ def test_rnn_time_step_rejects_bidirectional():
         cg.rnn_time_step(RNG.normal(size=(2, F)).astype(np.float32))
 
 
+def test_tbptt_bidirectional_warns_on_both_model_types():
+    """TBPTT chunking silently truncates a bidirectional backward at
+    chunk boundaries — both model types must warn (advisor r4)."""
+    n, t = 2, 8
+    x = RNG.normal(size=(n, t, F)).astype(np.float32)
+    y = seq_labels(n, t)
+    g = (NeuralNetConfiguration.Builder().seed(3).updater(Sgd(0.1))
+         .graph_builder().add_inputs("in")
+         .set_input_types(InputType.recurrent(F)))
+    g.add_layer("lstm", LSTM(n_out=H), "in")
+    g.add_layer("bi", Bidirectional(fwd=LSTM(n_out=H)), "lstm")
+    g.add_layer("out", RnnOutputLayer(n_out=C), "bi")
+    g.set_outputs("out")
+    g.backprop_type("tbptt").tbptt_fwd_length(4)
+    cg = ComputationGraph(g.build()).init()
+    with pytest.warns(UserWarning, match="bidirectional layer 'bi'"):
+        cg.fit(DataSet(x, y))
+
+    mln = MultiLayerNetwork(
+        NeuralNetConfiguration.Builder().seed(3).updater(Sgd(0.1))
+        .list()
+        .layer(LSTM(n_out=H))
+        .layer(Bidirectional(fwd=LSTM(n_out=H)))
+        .layer(RnnOutputLayer(n_out=C))
+        .backprop_type("tbptt").tbptt_fwd_length(4)
+        .set_input_type(InputType.recurrent(F)).build()).init()
+    with pytest.warns(UserWarning, match="bidirectional layer"):
+        mln.fit(DataSet(x, y))
+
+
 def test_tbptt_fit_trains_graph():
     cg = _graph(tbptt=True, k=4)
     n, t = 8, 12

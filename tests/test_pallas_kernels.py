@@ -212,6 +212,43 @@ class TestFlashPallasBackward:
                 np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
                 err_msg=f"d{name} mismatch vs scan reference")
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_bwd_impl_parameter(self, rng, causal, monkeypatch):
+        """Explicit bwd_impl selects the backward programmatically and
+        overrides the env var (advisor r4: no ambient-state dependence).
+        The pallas/xla backwards agree numerically, so the override is
+        made OBSERVABLE by instrumenting the pallas entry point."""
+        from deeplearning4j_tpu.ops import pallas_kernels as pk
+        q, k, v = _qkv(rng, n=2, t=32, h=2, dh=16)
+        do = jnp.asarray(rng.normal(size=(2, 32, 2, 16))
+                         .astype(np.float32))
+        calls = []
+        real = pk._flash_backward_pallas
+        monkeypatch.setattr(
+            pk, "_flash_backward_pallas",
+            lambda *a, **kw: calls.append(1) or real(*a, **kw))
+        # env says xla; the explicit param must still control the choice
+        monkeypatch.setenv("DL4J_FLASH_BWD", "xla")
+
+        def run(impl):
+            def f(q, k, v):
+                o = flash_attention(q, k, v, causal=causal, block_q=16,
+                                    block_k=16, interpret=True,
+                                    bwd_impl=impl)
+                return jnp.sum(o * do)
+            return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+        gx = run("xla")
+        assert not calls, "bwd_impl='xla' must not touch the pallas bwd"
+        gp = run("pallas")
+        assert calls, "bwd_impl='pallas' must override DL4J_FLASH_BWD=xla"
+        for a, b, name in zip(gp, gx, "q k v".split()):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
+                err_msg=f"d{name} mismatch pallas vs xla bwd_impl")
+        with pytest.raises(ValueError, match="bwd_impl"):
+            flash_attention(q, k, v, bwd_impl="cuda")
+
     def test_unaligned_causal_masked_grads(self, rng):
         """Padding path + causal + key mask through the Pallas bwd."""
         q, k, v = _qkv(rng, n=1, t=37, h=2, dh=8)
