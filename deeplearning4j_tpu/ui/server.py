@@ -40,7 +40,7 @@ img.act{image-rendering:pixelated;border:1px solid #ccc;margin:4px}
 <nav id=nav>
 <a href=#overview class=on>Overview</a><a href=#model>Model</a>
 <a href=#system>System</a><a href=#activations>Activations</a>
-<a href=#tsne>t-SNE</a></nav>
+<a href=#tsne>t-SNE</a><a href=#evaluation>Evaluation</a></nav>
 <div id=overview class="tab on">
 <h2>Training overview</h2>
 <div class=card><b>Score vs iteration</b><canvas id=score></canvas></div>
@@ -77,6 +77,17 @@ ConvolutionalListener</div>
 <div id=tsne class=tab>
 <h2>t-SNE</h2>
 <div class=card><canvas id=tsneplot style="height:480px"></canvas></div>
+</div>
+<div id=evaluation class=tab>
+<h2>Evaluation</h2>
+<div class=card><b id=roctitle>ROC curve</b>
+<canvas id=rocplot style="height:260px"></canvas></div>
+<div class=card><b id=prtitle>Precision-recall curve</b>
+<canvas id=prplot style="height:260px"></canvas></div>
+<div class=card><b>Reliability diagram</b>
+<canvas id=relplot style="height:260px"></canvas></div>
+<div class=card><b id=phisttitle>Predicted probabilities</b>
+<canvas id=probhist style="height:160px"></canvas></div>
 </div>
 <script>
 function draw(cv, series, labels){
@@ -181,6 +192,33 @@ function scatter(cv, pts, labels){
     c.fillStyle='#1668b8'; c.fillRect(x-1.5,y-1.5,3,3);
     if(labels&&labels[i]) c.fillText(labels[i],x+4,y+3);});
 }
+function xyplot(cv, curves, labels, diag){
+  // x-y curves on a [0,1]x[0,1] frame (ROC / PR / reliability)
+  const c=cv.getContext('2d');
+  const W=cv.width=cv.clientWidth, H=cv.height=cv.clientHeight;
+  c.clearRect(0,0,W,H);
+  const L=35,R=10,T=10,B=20;
+  const px=x=>L+x*(W-L-R), py=y=>H-B-y*(H-T-B);
+  c.strokeStyle='#ccc'; c.strokeRect(L,T,W-L-R,H-T-B);
+  c.fillStyle='#333';
+  c.fillText('0',L-8,H-B+12); c.fillText('1',W-R-6,H-B+12);
+  c.fillText('1',L-12,T+8);
+  if(diag){ c.strokeStyle='#ddd'; c.beginPath();
+    c.moveTo(px(0),py(0)); c.lineTo(px(1),py(1)); c.stroke(); }
+  const colors=['#1668b8','#c2410c','#15803d'];
+  let any=false;
+  curves.forEach((cur,si)=>{
+    if(!cur||!cur.x||!cur.x.length) return; any=true;
+    c.strokeStyle=colors[si%colors.length]; c.beginPath();
+    cur.x.forEach((x,i)=>{const X=px(x),Y=py(cur.y[i]);
+      i?c.lineTo(X,Y):c.moveTo(X,Y)});
+    c.stroke();
+    if(labels&&labels[si]){c.fillStyle=colors[si%colors.length];
+      c.fillText(labels[si],L+8,T+14+12*si)}});
+  if(!any){c.fillStyle='#333';
+    c.fillText('UIServer.upload_evaluation(roc=..., calibration=...)',
+               L+10,H/2);}
+}
 function showTab(){
   const h=(location.hash||'#overview').slice(1);
   document.querySelectorAll('.tab').forEach(d=>
@@ -266,6 +304,23 @@ async function tick(){
     const ts = await (await fetch('api/tsne')).json();
     scatter(document.getElementById('tsneplot'), ts.points||[],
             ts.labels||[]);
+  } else if(h==='evaluation'){
+    const ev = await (await fetch('api/evaluation')).json();
+    const roc = ev.roc, pr = ev.pr, rel = ev.reliability;
+    if(roc) document.getElementById('roctitle').textContent =
+      'ROC curve (AUC='+(ev.auc??0).toFixed(4)+')';
+    xyplot(document.getElementById('rocplot'),
+           [roc?{x:roc.fpr,y:roc.tpr}:null], ['ROC'], true);
+    if(pr) document.getElementById('prtitle').textContent =
+      'Precision-recall curve (AUPRC='+(ev.auprc??0).toFixed(4)+')';
+    xyplot(document.getElementById('prplot'),
+           [pr?{x:pr.recall,y:pr.precision}:null], ['PR'], false);
+    xyplot(document.getElementById('relplot'),
+           [rel?{x:rel.meanPredictedValueX,y:rel.fractionPositivesY}
+               :null], ['reliability'], true);
+    const ph = ev.probability_histogram;
+    if(ph) drawBars(document.getElementById('probhist'),
+      {counts:ph.binCounts,min:ph.lower,max:ph.upper}, '#1668b8');
   }
 }
 tick(); setInterval(tick, 2000);
@@ -396,6 +451,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(getattr(self.server, "tsne_data", None)
                        or {"points": [], "labels": []})
             return
+        if u.path == "/api/evaluation":
+            self._json(getattr(self.server, "evaluation_data", None)
+                       or {})
+            return
         self._json({"error": "not found"}, 404)
 
     def _session(self, u) -> Optional[str]:
@@ -443,6 +502,21 @@ class _Handler(BaseHTTPRequestHandler):
                     "points": coords,
                     "labels": [str(l) for l in payload.get("labels", [])],
                 }
+            except (ValueError, TypeError, json.JSONDecodeError) as e:
+                self._json({"error": str(e)}, 400)
+                return
+            self._json({"ok": True})
+            return
+        if path == "/api/evaluation":
+            # curve-object upload (the reference UI charts RocCurve etc.
+            # produced by eval; curves arrive as their to_dict forms)
+            try:
+                payload = self._read_json_body()
+                if payload is None:
+                    return
+                if not isinstance(payload, dict):
+                    raise ValueError("expected a JSON object of curves")
+                self.server.evaluation_data = payload
             except (ValueError, TypeError, json.JSONDecodeError) as e:
                 self._json({"error": str(e)}, 400)
                 return
@@ -544,6 +618,33 @@ class UIServer:
             # `labels or []` would crash on numpy label arrays
             "labels": [] if labels is None else [str(l) for l in labels],
         }
+        return self
+
+    def upload_evaluation(self, roc=None, calibration=None):
+        """Populate the Evaluation tab from a ``ROC`` and/or an
+        ``EvaluationCalibration`` accumulator — their eval/curves
+        exports (RocCurve, PrecisionRecallCurve, ReliabilityDiagram,
+        probability Histogram) drive the charts, the analog of the
+        reference UI consuming eval/curves objects."""
+        if self._httpd is None:
+            raise RuntimeError("start() the server first")
+        data = {}
+        if roc is not None:
+            rc = roc.get_roc_curve()
+            pr = roc.get_precision_recall_curve()
+            data.update(roc=rc.to_dict(), pr=pr.to_dict(),
+                        auc=rc.calculate_auc(),
+                        auprc=pr.calculate_auprc())
+        if calibration is not None:
+            data.update(
+                reliability=calibration.get_reliability_diagram()
+                .to_dict(),
+                probability_histogram=calibration
+                .get_probability_histogram().to_dict(),
+                residual_histogram=calibration
+                .get_residual_histogram().to_dict(),
+                ece=calibration.expected_calibration_error())
+        self._httpd.evaluation_data = data
         return self
 
     @property

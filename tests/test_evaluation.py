@@ -1,0 +1,289 @@
+"""Evaluation metric breadth + curve exports (VERDICT r4 missing #1/#2
+— reference: Evaluation.java:96,1093,1119,1225,1287,1306 and
+eval/curves/*.java). All goldens hand-computed, no sklearn."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.evaluation.curves import (
+    Histogram, PrecisionRecallCurve, ReliabilityDiagram, RocCurve,
+    from_json)
+from deeplearning4j_tpu.evaluation.evaluation import (
+    ROC, Evaluation, EvaluationCalibration, ROCBinary, ROCMultiClass)
+
+
+def _eval_from_confusion(c, **kw):
+    """Build an Evaluation whose confusion matrix equals ``c`` by
+    feeding index labels/one-hot predictions pair by pair."""
+    c = np.asarray(c)
+    n = c.shape[0]
+    ev = Evaluation(num_classes=n, **kw)
+    labels, preds = [], []
+    for a in range(n):
+        for p in range(n):
+            for _ in range(int(c[a, p])):
+                labels.append(a)
+                one = np.full(n, 0.01)
+                one[p] = 0.9
+                preds.append(one)
+    ev.eval(np.array(labels), np.array(preds))
+    return ev
+
+
+class TestEvaluationMetrics:
+    # confusion: rows=actual, cols=predicted
+    #   [[2,1,0],
+    #    [0,3,1],
+    #    [1,0,2]]   → tp=[2,3,2] fp=[1,1,1] fn=[1,1,1] tn=[6,5,6]
+    C = [[2, 1, 0], [0, 3, 1], [1, 0, 2]]
+
+    def test_per_class_counts(self):
+        ev = _eval_from_confusion(self.C)
+        assert ev.true_positives() == {0: 2, 1: 3, 2: 2}
+        assert ev.false_positives() == {0: 1, 1: 1, 2: 1}
+        assert ev.false_negatives() == {0: 1, 1: 1, 2: 1}
+        assert ev.true_negatives() == {0: 6, 1: 5, 2: 6}
+
+    def test_precision_recall_macro_micro(self):
+        ev = _eval_from_confusion(self.C)
+        assert ev.accuracy() == pytest.approx(0.7)
+        assert ev.precision(0) == pytest.approx(2 / 3)
+        assert ev.precision(1) == pytest.approx(3 / 4)
+        assert ev.recall(2) == pytest.approx(2 / 3)
+        macro_p = (2 / 3 + 3 / 4 + 2 / 3) / 3
+        assert ev.precision() == pytest.approx(macro_p)
+        # micro-averaged P == R == accuracy for all-inclusive multiclass
+        assert ev.precision(averaging="micro") == pytest.approx(0.7)
+        assert ev.recall(averaging="micro") == pytest.approx(0.7)
+
+    def test_fbeta_gmeasure(self):
+        ev = _eval_from_confusion(self.C)
+        # class 1: p == r == 0.75 → every F_beta == 0.75, G == 0.75
+        assert ev.f_beta(2.0, 1) == pytest.approx(0.75)
+        assert ev.f_beta(0.5, 1) == pytest.approx(0.75)
+        assert ev.g_measure(1) == pytest.approx(0.75)
+        # class 0: p == r == 2/3
+        assert ev.f1(0) == pytest.approx(2 / 3)
+        assert ev.g_measure() == pytest.approx((2 / 3 + 3 / 4 + 2 / 3) / 3)
+
+    def test_matthews_correlation(self):
+        ev = _eval_from_confusion(self.C)
+        # class 0: (2*6 - 1*1)/sqrt(3*3*7*7) = 11/21
+        assert ev.matthews_correlation(0) == pytest.approx(11 / 21)
+        # class 1: (3*5 - 1*1)/sqrt(4*4*6*6) = 14/24
+        assert ev.matthews_correlation(1) == pytest.approx(14 / 24)
+        macro = (11 / 21 + 14 / 24 + 11 / 21) / 3
+        assert ev.matthews_correlation() == pytest.approx(macro)
+
+    def test_false_rates(self):
+        ev = _eval_from_confusion(self.C)
+        assert ev.false_positive_rate(0) == pytest.approx(1 / 7)
+        assert ev.false_negative_rate(0) == pytest.approx(1 / 3)
+        fpr = (1 / 7 + 1 / 6 + 1 / 7) / 3
+        fnr = (1 / 3 + 1 / 4 + 1 / 3) / 3
+        assert ev.false_alarm_rate() == pytest.approx((fpr + fnr) / 2)
+
+    def test_binary_positive_class_mode(self):
+        # 2-class: no-arg P/R/F1 report the positive class only
+        # (reference's binaryPositiveClass=1 default)
+        c = [[8, 2], [1, 9]]        # tp1=9 fp1=2 fn1=1
+        ev = _eval_from_confusion(c)
+        assert ev.precision() == pytest.approx(9 / 11)
+        assert ev.recall() == pytest.approx(9 / 10)
+        p, r = 9 / 11, 9 / 10
+        assert ev.f1() == pytest.approx(2 * p * r / (p + r))
+        # opting out macro-averages instead
+        ev2 = _eval_from_confusion(c, binary_positive_class=None)
+        assert ev2.precision() == pytest.approx((8 / 9 + 9 / 11) / 2)
+        # an explicit averaging request overrides binary mode (the
+        # reference's EvaluationAveraging overloads)
+        assert ev.precision(averaging="micro") == pytest.approx(17 / 20)
+        assert ev.precision(averaging="macro") == pytest.approx(
+            (8 / 9 + 9 / 11) / 2)
+
+    def test_top_n_accuracy(self):
+        ev = Evaluation(top_n=2)
+        labels = np.array([0, 1, 2, 2])
+        preds = np.array([
+            [0.6, 0.3, 0.1],     # top-1 correct
+            [0.5, 0.4, 0.1],     # wrong, but class 1 is 2nd → top-2 ok
+            [0.4, 0.35, 0.25],   # class 2 is 3rd → top-2 wrong
+            [0.1, 0.2, 0.7],     # top-1 correct
+        ])
+        ev.eval(labels, preds)
+        assert ev.accuracy() == pytest.approx(0.5)
+        assert ev.top_n_accuracy() == pytest.approx(0.75)
+        assert " Top 2 Accuracy:  0.7500" in ev.stats()
+
+    def test_stats_table(self):
+        ev = _eval_from_confusion(self.C, label_names=["a", "b", "c"])
+        s = ev.stats()
+        assert "Predictions labeled as a classified by model as b: 1 times" in s
+        assert "Per-class Statistics" in s
+        assert "macro-averaged" in s
+        # class b row carries its per-class numbers
+        assert "0.7500" in s
+
+    def test_stats_never_predicted_warning(self):
+        # class 1 is never predicted (tp=0, fp=0) → excluded from the
+        # macro precision average, and stats() warns about it
+        ev = _eval_from_confusion([[3, 0, 0], [2, 0, 0], [1, 0, 1]])
+        assert ev.precision() == pytest.approx((3 / 6 + 1 / 1) / 2)
+        assert "never predicted" in ev.stats()
+        assert "never predicted" not in ev.stats(suppress_warnings=True)
+
+    def test_empty_roc_curves(self):
+        r = ROC()
+        c = r.get_roc_curve()
+        assert c.calculate_auc() == 0.0
+        pr = r.get_precision_recall_curve()
+        assert pr.total_count == 0
+
+
+class TestRocCurves:
+    # y=[1,0,1,0] scores=[0.9,0.8,0.7,0.6]
+    Y = np.array([1.0, 0.0, 1.0, 0.0])
+    S = np.array([0.9, 0.8, 0.7, 0.6])
+
+    def _roc(self):
+        r = ROC()
+        r.eval(self.Y, self.S)
+        return r
+
+    def test_roc_curve_points(self):
+        c = self._roc().get_roc_curve()
+        np.testing.assert_allclose(c.threshold, [1.0, 0.9, 0.8, 0.7, 0.6])
+        np.testing.assert_allclose(c.fpr, [0, 0, 0.5, 0.5, 1.0])
+        np.testing.assert_allclose(c.tpr, [0, 0.5, 0.5, 1.0, 1.0])
+        assert c.calculate_auc() == pytest.approx(0.75)
+        # matches the accumulator's own AUC
+        assert self._roc().calculate_auc() == pytest.approx(0.75)
+        assert c.num_points() == 5
+        assert c.get_threshold(1) == pytest.approx(0.9)
+        assert c.get_true_positive_rate(3) == pytest.approx(1.0)
+        assert "Area=0.75" in c.title
+
+    def test_roc_curve_ties_collapse(self):
+        r = ROC()
+        r.eval(np.array([1, 0, 1, 0.0]), np.array([0.8, 0.8, 0.8, 0.2]))
+        c = r.get_roc_curve()
+        # one point for the tied 0.8 group + one for 0.2 + origin
+        np.testing.assert_allclose(c.threshold, [1.0, 0.8, 0.2])
+        np.testing.assert_allclose(c.tpr, [0, 1.0, 1.0])
+        np.testing.assert_allclose(c.fpr, [0, 0.5, 1.0])
+
+    def test_precision_recall_curve(self):
+        c = self._roc().get_precision_recall_curve()
+        np.testing.assert_allclose(c.threshold, [0.6, 0.7, 0.8, 0.9, 1.0])
+        np.testing.assert_allclose(c.precision, [0.5, 2 / 3, 0.5, 1, 1])
+        np.testing.assert_allclose(c.recall, [1, 1, 0.5, 0.5, 0])
+        np.testing.assert_array_equal(c.tp_count, [2, 2, 1, 1, 0])
+        np.testing.assert_array_equal(c.fp_count, [2, 1, 1, 0, 0])
+        np.testing.assert_array_equal(c.fn_count, [0, 0, 1, 1, 2])
+        assert c.total_count == 4
+        t, p, r = c.get_point_at_threshold(0.65)
+        assert (t, p, r) == (0.7, pytest.approx(2 / 3), 1.0)
+        t, p, r = c.get_point_at_precision(0.6)
+        assert (t, r) == (0.7, 1.0)
+        t, p, r = c.get_point_at_recall(1.0)
+        assert p == pytest.approx(2 / 3)
+
+    def test_curve_json_roundtrip(self):
+        roc = self._roc()
+        for curve in (roc.get_roc_curve(),
+                      roc.get_precision_recall_curve()):
+            back = from_json(curve.to_json())
+            assert type(back) is type(curve)
+            np.testing.assert_allclose(back.threshold, curve.threshold)
+            np.testing.assert_allclose(back.get_x(), curve.get_x())
+            np.testing.assert_allclose(back.get_y(), curve.get_y())
+
+    def test_multiclass_and_binary_wrappers(self):
+        labels = np.eye(3)[np.array([0, 1, 2, 1, 0])]
+        preds = np.array([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1],
+                          [0.2, 0.2, 0.6], [0.3, 0.5, 0.2],
+                          [0.6, 0.3, 0.1]])
+        m = ROCMultiClass()
+        m.eval(labels, preds)
+        c = m.get_roc_curve(0)
+        assert isinstance(c, RocCurve)
+        assert c.calculate_auc() == pytest.approx(m.calculate_auc(0))
+        b = ROCBinary()
+        b.eval(labels, preds)
+        assert isinstance(b.get_precision_recall_curve(1),
+                          PrecisionRecallCurve)
+
+
+class TestCalibrationExports:
+    def _cal(self):
+        cal = EvaluationCalibration(reliability_bins=4,
+                                    histogram_bins=4)
+        rng = np.random.default_rng(7)
+        p1 = rng.uniform(0, 1, 200)
+        labels = np.stack([1 - (p1 > 0.5), (p1 > 0.5)], axis=1)
+        preds = np.stack([1 - p1, p1], axis=1)
+        cal.eval(labels, preds)
+        return cal
+
+    def test_reliability_diagram_export(self):
+        d = self._cal().get_reliability_diagram()
+        assert isinstance(d, ReliabilityDiagram)
+        assert d.num_points() > 0
+        assert len(d.get_x()) == len(d.get_y())
+        back = ReliabilityDiagram.from_json(d.to_json())
+        np.testing.assert_allclose(back.mean_predicted_value,
+                                   d.mean_predicted_value)
+
+    def test_histogram_exports(self):
+        cal = self._cal()
+        h = cal.get_probability_histogram()
+        assert isinstance(h, Histogram)
+        assert h.n_bins == 4
+        assert h.bin_counts.sum() == 400      # both columns of 200 rows
+        np.testing.assert_allclose(h.get_bin_lower_bounds(),
+                                   [0, 0.25, 0.5, 0.75])
+        np.testing.assert_allclose(h.get_bin_mid_values(),
+                                   [0.125, 0.375, 0.625, 0.875])
+        hr = cal.get_residual_histogram()
+        assert hr.bin_counts.sum() == 400
+        back = from_json(h.to_json())
+        np.testing.assert_array_equal(back.bin_counts, h.bin_counts)
+
+
+class TestEvaluationTabE2E:
+    def test_upload_and_fetch(self):
+        import urllib.request
+        from deeplearning4j_tpu.ui.server import UIServer
+        from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+        roc = ROC()
+        roc.eval(TestRocCurves.Y, TestRocCurves.S)
+        cal = TestCalibrationExports()._cal()
+        srv = UIServer(port=0).attach(InMemoryStatsStorage()).start()
+        try:
+            srv.upload_evaluation(roc=roc, calibration=cal)
+            with urllib.request.urlopen(srv.url + "/api/evaluation") as r:
+                data = json.loads(r.read())
+            assert data["auc"] == pytest.approx(0.75)
+            assert data["roc"]["tpr"] == [0, 0.5, 0.5, 1.0, 1.0]
+            assert data["pr"]["@type"] == "PrecisionRecallCurve"
+            assert len(data["reliability"]["meanPredictedValueX"]) > 0
+            assert sum(data["probability_histogram"]["binCounts"]) == 400
+            # POST path (remote client uploading pre-built curves)
+            body = json.dumps({"roc": roc.get_roc_curve().to_dict(),
+                               "auc": 0.75}).encode()
+            req = urllib.request.Request(
+                srv.url + "/api/evaluation", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as r:
+                assert json.loads(r.read())["ok"]
+            with urllib.request.urlopen(srv.url + "/api/evaluation") as r:
+                data = json.loads(r.read())
+            assert data["roc"]["threshold"][0] == 1.0
+            # the dashboard page itself carries the Evaluation tab
+            with urllib.request.urlopen(srv.url + "/") as r:
+                page = r.read().decode()
+            assert "evaluation" in page and "rocplot" in page
+        finally:
+            srv.stop()
