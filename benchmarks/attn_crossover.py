@@ -1,0 +1,63 @@
+"""Measure the flash-vs-XLA attention crossover on the real chip.
+
+The helper-SPI dispatcher (ops/pallas_kernels.attention) should pick the
+plain XLA lowering at short sequence lengths — the full score matrix is
+cheap there and XLA fuses it into large batched MXU matmuls — and the
+streaming Pallas kernel at long lengths where the O(T^2) score tensor
+would blow HBM. This prints fwd+bwd ms for both paths across T so the
+threshold is a measured number, not a guess.
+
+Methodology matches benchmarks/flash_bwd_bench.py: K grad steps scanned
+inside ONE jit (the carry chains iterations so nothing is elided or
+overlapped), one device sync at the end.
+
+Run: python -m benchmarks.attn_crossover
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.layers.attention import (
+    scaled_dot_product_attention)
+from deeplearning4j_tpu.ops.pallas_kernels import flash_attention
+
+
+def bench(fn, q, k, v, steps=20, reps=3):
+    grad = jax.grad(lambda q, k, v: jnp.sum(
+        fn(q, k, v).astype(jnp.float32)), argnums=(0, 1, 2))
+
+    def body(carry, _):
+        q, k, v = carry
+        dq, dk, dv = grad(q, k, v)
+        # chain the carry so scan iterations are sequential
+        return (q + 0.0 * dq, k + 0.0 * dk, v + 0.0 * dv), None
+
+    @jax.jit
+    def run(q, k, v):
+        (q, k, v), _ = jax.lax.scan(body, (q, k, v), None, length=steps)
+        return jnp.float32(jnp.sum(q))
+
+    float(run(q, k, v))  # compile
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        float(run(q, k, v))
+        best = min(best, (time.perf_counter() - t0) / steps * 1e3)
+    return best
+
+
+if __name__ == "__main__":
+    h, dh = 12, 64
+    for t, batch in ((128, 32), (128, 128), (256, 64), (512, 32),
+                     (1024, 16), (2048, 8), (4096, 4)):
+        rng = np.random.default_rng(0)
+        q, k, v = (jnp.asarray(rng.normal(size=(batch, t, h, dh)),
+                               jnp.bfloat16) for _ in range(3))
+        ms_x = bench(scaled_dot_product_attention, q, k, v)
+        ms_f = bench(flash_attention, q, k, v)
+        print(f"T={t:5d} batch={batch:3d}  xla {ms_x:8.3f} ms   "
+              f"flash {ms_f:8.3f} ms   winner: "
+              f"{'xla' if ms_x < ms_f else 'flash'}", flush=True)

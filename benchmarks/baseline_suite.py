@@ -274,13 +274,23 @@ def inception_train():
         "unit": "images/sec/chip"}))
 
 
-def bert_finetune():
-    """Imported-BERT-base FINE-TUNE tokens/s (flash attention on): graft
-    a mean-pool + 2-class head on the imported encoder and train the
-    whole graph — the reference's flagship Keras-import workflow
-    (KerasModelImport.java:41 → TransferLearning)."""
+def build_bert_finetune(seq: int = 128, batch: int = 128, k: int = 16,
+                        dtype: str = "bf16"):
+    """The canonical imported-BERT fine-tune setup (BASELINE config 3
+    training half): graft a mean-pool + 2-class head on the imported
+    encoder via TransferLearning.GraphBuilder — the reference's flagship
+    Keras-import workflow (KerasModelImport.java:41 → TransferLearning).
+
+    Shared by ``bert_finetune`` and ``profile_hw.py bert`` so the
+    profiler measures the EXACT graph the benchmark ships. Returns
+    ``(ft, steps_fn, (idss, poss), ys)``.
+
+    bf16 compute via FineTuneConfiguration (round 5): imported params
+    stay f32, activations/matmuls run at MXU rate. Batch 128 (vs 32)
+    keeps every matmul MXU-shaped; attention dispatches to the plain
+    XLA path at seq 128 (measured crossover, benchmarks/attn_crossover).
+    """
     import jax.numpy as jnp
-    import jax.random as jrandom
     from deeplearning4j_tpu.modelimport.bert import (
         BERT_BASE, example_inputs, import_bert_base)
     from deeplearning4j_tpu.nn.layers.output import (
@@ -290,12 +300,13 @@ def bert_finetune():
     from deeplearning4j_tpu.optimize.solver import make_scan_train_step
     from deeplearning4j_tpu.optimize.updaters import Adam
 
-    seq, batch, k, n = 128, 32, 8, 3
     model, _km = import_bert_base(seq_len=seq)
     enc_out = model.conf.network_outputs[0]
+    ftc = FineTuneConfiguration.Builder().updater(Adam(2e-5))
+    if dtype == "bf16":
+        ftc = ftc.compute_dtype("bfloat16")
     ft = (TransferLearning.GraphBuilder(model)
-          .fine_tune_configuration(
-              FineTuneConfiguration.Builder().updater(Adam(2e-5)).build())
+          .fine_tune_configuration(ftc.build())
           .add_layer("pool",
                      GlobalPoolingLayer(pooling_type=PoolingType.AVG),
                      enc_out)
@@ -315,13 +326,22 @@ def bert_finetune():
                         rng_, it)
 
     steps_fn = make_scan_train_step(loss_fn, ft._tx)
+    return ft, steps_fn, (idss, poss), ys
+
+
+def bert_finetune():
+    """Imported-BERT-base FINE-TUNE tokens/s — see build_bert_finetune."""
+    import jax.random as jrandom
+
+    seq, batch, k, n = 128, 128, 16, 3
+    ft, steps_fn, feats, ys = build_bert_finetune(seq, batch, k)
     key = jrandom.PRNGKey(0)
     ts = ft.train_state
-    ts, losses = steps_fn(ts, (idss, poss), (ys,), None, None, key)
+    ts, losses = steps_fn(ts, feats, (ys,), None, None, key)
     _sync(losses[-1])
     t0 = time.perf_counter()
     for i in range(n):
-        ts, losses = steps_fn(ts, (idss, poss), (ys,), None, None,
+        ts, losses = steps_fn(ts, feats, (ys,), None, None,
                               jrandom.fold_in(key, i))
     _sync(losses[-1])
     dt = time.perf_counter() - t0

@@ -40,6 +40,27 @@ def shape_bytes(txt: str) -> int:
     return tot
 
 
+def capture_bert(batch: int, k: int, outdir: str, dtype: str):
+    """Imported-BERT fine-tune step (BASELINE config 3 training half):
+    the exact baseline_suite.bert_finetune graph — built by the SAME
+    builder (baseline_suite.build_bert_finetune) — profiled with a
+    device trace."""
+    import jax
+    import jax.random as jrandom
+    from benchmarks.baseline_suite import build_bert_finetune
+
+    ft, steps_fn, feats, ys = build_bert_finetune(
+        seq=128, batch=batch, k=k, dtype=dtype)
+    key = jrandom.PRNGKey(0)
+    ts = ft.train_state
+    ts, losses = steps_fn(ts, feats, (ys,), None, None, key)
+    float(np.asarray(losses[-1]))
+    with jax.profiler.trace(outdir):
+        ts, losses = steps_fn(ts, feats, (ys,), None, None,
+                              jrandom.fold_in(key, 1))
+        float(np.asarray(losses[-1]))
+
+
 def capture(mode: str, batch: int, k: int, outdir: str):
     import jax
     import jax.numpy as jnp
@@ -134,11 +155,20 @@ def analyze(outdir: str, n_steps: int):
 
 if __name__ == "__main__":
     # modes: unfused (default) | fused (pallas blocks) | gram (xla
-    # blocks + Gram stats) | vgg
+    # blocks + Gram stats) | vgg | bert [batch] [f32|bf16]
     mode = sys.argv[1] if len(sys.argv) > 1 else "unfused"
-    if mode not in ("unfused", "fused", "gram", "vgg"):
-        sys.exit(f"unknown mode {mode!r}: expected unfused|fused|gram|vgg"
-                 " [batch]")
+    if mode not in ("unfused", "fused", "gram", "vgg", "bert"):
+        sys.exit(f"unknown mode {mode!r}: expected "
+                 "unfused|fused|gram|vgg|bert [batch] [f32|bf16]")
+    if mode == "bert":
+        batch = int(sys.argv[2]) if len(sys.argv) > 2 else 32
+        dtype = sys.argv[3] if len(sys.argv) > 3 else "f32"
+        k = 8
+        outdir = tempfile.mkdtemp(prefix="dl4j_hwprof_")
+        capture_bert(batch, k, outdir, dtype)
+        print(f"trace: {outdir}")
+        analyze(outdir, k)
+        sys.exit(0)
     batch = int(sys.argv[2]) if len(sys.argv) > 2 else (
         512 if mode == "vgg" else 256)
     k = 64

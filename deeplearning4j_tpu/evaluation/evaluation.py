@@ -194,9 +194,15 @@ class Evaluation:
             if averaging is None and self._is_binary_mode():
                 cls = self.binary_positive_class
             elif averaging != "micro":
-                n = self.num_classes or 0
-                vals = [self.f_beta(beta, i) for i in range(n)]
-                return float(np.mean(vals)) if vals else 0.0
+                # macro: per-class P/R arrays computed ONCE, vectorized
+                # (per-class f_beta calls would redo the O(n²) confusion
+                # reductions n times over)
+                p = self._per_class_precision()
+                r = self._per_class_recall()
+                denom = beta * beta * p + r
+                f = np.divide((1 + beta * beta) * p * r, denom,
+                              out=np.zeros_like(p), where=denom > 0)
+                return float(f.mean()) if len(f) else 0.0
         p = self.precision(cls, averaging)
         r = self.recall(cls, averaging)
         denom = beta * beta * p + r
@@ -211,9 +217,9 @@ class Evaluation:
             if averaging is None and self._is_binary_mode():
                 cls = self.binary_positive_class
             elif averaging != "micro":
-                n = self.num_classes or 0
-                vals = [self.g_measure(i) for i in range(n)]
-                return float(np.mean(vals)) if vals else 0.0
+                g = np.sqrt(self._per_class_precision()
+                            * self._per_class_recall())
+                return float(g.mean()) if len(g) else 0.0
         p = self.precision(cls, averaging)
         r = self.recall(cls, averaging)
         return float(np.sqrt(p * r))
@@ -453,25 +459,27 @@ class ROC:
         self._scores.append(predictions)
 
     def calculate_auc(self) -> float:
-        y = np.concatenate(self._labels)
-        s = np.concatenate(self._scores)
-        order = np.argsort(-s, kind="mergesort")
-        y = y[order]
-        tps = np.cumsum(y)
-        fps = np.cumsum(1 - y)
-        tpr = tps / max(tps[-1], 1)
-        fpr = fps / max(fps[-1], 1)
-        return float(np.trapezoid(tpr, fpr))
+        """AUC over the tie-collapsed threshold points (so the scalar
+        agrees with get_roc_curve().calculate_auc(): a cut inside a
+        tie group is not a realizable threshold, and per-sample cumsums
+        would make the result depend on eval() insertion order)."""
+        _, tp, fp, pos, neg, _ = self._threshold_counts()
+        tpr = tp / pos if pos > 0 else np.zeros_like(tp)
+        fpr = fp / neg if neg > 0 else np.zeros_like(fp)
+        return float(np.trapezoid(np.concatenate([[0.0], tpr]),
+                                  np.concatenate([[0.0], fpr])))
 
     def calculate_auprc(self) -> float:
-        y = np.concatenate(self._labels)
-        s = np.concatenate(self._scores)
-        order = np.argsort(-s, kind="mergesort")
-        y = y[order]
-        tps = np.cumsum(y)
-        precision = tps / np.arange(1, len(y) + 1)
-        recall = tps / max(tps[-1], 1)
-        return float(np.trapezoid(precision, recall))
+        """AUPRC over the same tie-collapsed points, with the
+        (recall=0, precision=1) anchor (reference: ROC.java exact
+        mode)."""
+        _, tp, fp, pos, neg, _ = self._threshold_counts()
+        pred_pos = tp + fp
+        prec = np.divide(tp, pred_pos, out=np.ones_like(tp),
+                         where=pred_pos > 0)
+        rec = tp / pos if pos > 0 else np.zeros_like(tp)
+        return float(np.trapezoid(np.concatenate([[1.0], prec]),
+                                  np.concatenate([[0.0], rec])))
 
     # ---- curve exports (reference: ROC.getRocCurve /
     # getPrecisionRecallCurve over eval/curves/*.java) -------------------
@@ -667,6 +675,9 @@ class EvaluationCalibration:
         self._probs.append(preds)
 
     def _flat(self):
+        if not self._labels:          # nothing eval'd yet: empty curves,
+            z = np.zeros(0)           # not a concatenate ValueError
+            return z, z
         y = np.concatenate(self._labels).reshape(-1)
         p = np.concatenate(self._probs).reshape(-1)
         return y, p

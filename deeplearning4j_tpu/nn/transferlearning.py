@@ -34,7 +34,7 @@ class FineTuneConfiguration:
     (reference: transferlearning/FineTuneConfiguration.java)."""
 
     def __init__(self, **overrides):
-        # recognized keys: updater, seed, l1, l2, dropout
+        # recognized keys: updater, seed, l1, l2, dropout, compute_dtype
         self.overrides = overrides
 
     class Builder:
@@ -61,12 +61,22 @@ class FineTuneConfiguration:
             self._o["dropout"] = float(v)
             return self
 
+        def compute_dtype(self, dt: str):
+            """Activation/compute dtype for the fine-tuned model
+            ("bfloat16" for MXU-rate matmuls). Keras-imported models
+            arrive float32 (import fidelity); fine-tuning them at bf16
+            is the standard TPU recipe — params stay f32, activations
+            and matmuls run bf16 (the cast happens at trace time in
+            ComputationGraph._walk / MultiLayerNetwork._forward)."""
+            self._o["compute_dtype"] = str(dt)
+            return self
+
         def build(self) -> "FineTuneConfiguration":
             return FineTuneConfiguration(**self._o)
 
     def apply_to_global(self, g: GlobalConfig) -> GlobalConfig:
         kw = {k: v for k, v in self.overrides.items()
-              if k in ("updater", "seed", "l1", "l2")}
+              if k in ("updater", "seed", "l1", "l2", "compute_dtype")}
         return dataclasses.replace(g, **kw) if kw else g
 
     def apply_to_layer(self, layer: Layer) -> Layer:

@@ -502,14 +502,27 @@ def flash_attention(q, k, v, mask=None, causal: bool = False,
     return jnp.swapaxes(out, 1, 2)                          # NHTD -> NTHD
 
 
+# Measured on v5e (benchmarks/attn_crossover.py, bf16 fwd+bwd, 12 heads
+# Dh=64): plain XLA wins at T<=512 (the full score matrix is small and
+# XLA fuses it into large batched MXU matmuls; the flash grid degenerates
+# to tiny single-block programs), the streaming kernel wins from T=1024
+# on (1024: 8.9 vs 11.2 ms; 2048: 12.8 vs 20.8; 4096: 22.4 vs 33.6, and
+# plain XLA eventually OOMs on the O(T^2) scores).
+_FLASH_MIN_SEQ = 1024
+
+
 def attention(q, k, v, mask=None, causal: bool = False,
               prefer_flash: Optional[bool] = None):
     """Helper-SPI dispatch (the reflective cuDNN-hook analog): use the
-    Pallas kernel when it applies, else the plain XLA lowering."""
+    Pallas kernel when it applies AND the sequence is long enough to pay
+    for streaming, else the plain XLA lowering (the same dual-tier
+    policy as the reference's cuDNN helper + helperCountFail fallback,
+    ConvolutionLayer.java:173)."""
     from deeplearning4j_tpu.nn.layers.attention import (
         scaled_dot_product_attention)
     if prefer_flash is None:
-        prefer_flash = jax.default_backend() == "tpu"
+        prefer_flash = (jax.default_backend() == "tpu"
+                        and max(q.shape[1], k.shape[1]) >= _FLASH_MIN_SEQ)
     if not prefer_flash:
         return scaled_dot_product_attention(q, k, v, mask=mask,
                                             causal=causal)

@@ -188,6 +188,25 @@ class TestTransferLearning:
         assert len(new.conf.layers) == 4
         assert new.output(x[:2]).shape == (2, 3)
 
+    def test_compute_dtype_override(self):
+        """FineTuneConfiguration.compute_dtype flips the whole fine-tuned
+        model to bf16 compute (the standard recipe for f32 Keras imports
+        on TPU — round 5); params stay f32 and training still works."""
+        x, y = _toy_data()
+        orig = MultiLayerNetwork(_mlp()).init()
+        new = (TransferLearning.Builder(orig)
+               .fine_tune_configuration(
+                   FineTuneConfiguration.Builder().updater(Sgd(1e-3))
+                   .compute_dtype("bfloat16").build())
+               .build())
+        assert new.conf.global_config.compute_dtype == "bfloat16"
+        # param dtype untouched
+        assert np.asarray(
+            new.train_state.params["layer_0"]["W"]).dtype == np.float32
+        new.fit(DataSet(x, y))
+        out = np.asarray(new.output(x[:4]), np.float32)
+        assert np.isfinite(out).all()
+
     def test_helper_featurize(self):
         x, y = _toy_data()
         orig = MultiLayerNetwork(_mlp()).init()

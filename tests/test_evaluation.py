@@ -174,6 +174,18 @@ class TestRocCurves:
         np.testing.assert_allclose(c.tpr, [0, 1.0, 1.0])
         np.testing.assert_allclose(c.fpr, [0, 0.5, 1.0])
 
+    def test_tied_scores_auc_order_independent(self):
+        """Accumulator AUC runs on the tie-collapsed threshold points:
+        tied scores must give the same (correct) AUC regardless of
+        eval() insertion order, and agree with the curve export."""
+        a = ROC(); a.eval(np.array([1, 0.0]), np.array([0.8, 0.8]))
+        b = ROC(); b.eval(np.array([0, 1.0]), np.array([0.8, 0.8]))
+        assert a.calculate_auc() == pytest.approx(0.5)
+        assert b.calculate_auc() == pytest.approx(0.5)
+        assert a.calculate_auc() == pytest.approx(
+            a.get_roc_curve().calculate_auc())
+        assert a.calculate_auprc() == pytest.approx(b.calculate_auprc())
+
     def test_precision_recall_curve(self):
         c = self._roc().get_precision_recall_curve()
         np.testing.assert_allclose(c.threshold, [0.6, 0.7, 0.8, 0.9, 1.0])
@@ -226,6 +238,15 @@ class TestCalibrationExports:
         preds = np.stack([1 - p1, p1], axis=1)
         cal.eval(labels, preds)
         return cal
+
+    def test_empty_calibration_curves(self):
+        """Curve exports on an un-eval'd accumulator return empty
+        curves, mirroring the empty-ROC contract."""
+        cal = EvaluationCalibration()
+        assert len(cal.get_reliability_diagram().mean_predicted_value) == 0
+        assert cal.get_residual_histogram().bin_counts.sum() == 0
+        assert cal.get_probability_histogram().bin_counts.sum() == 0
+        assert cal.expected_calibration_error() == 0.0
 
     def test_reliability_diagram_export(self):
         d = self._cal().get_reliability_diagram()
