@@ -163,6 +163,8 @@ if __name__ == "__main__":
     if mode == "bert":
         batch = int(sys.argv[2]) if len(sys.argv) > 2 else 32
         dtype = sys.argv[3] if len(sys.argv) > 3 else "f32"
+        if dtype not in ("f32", "bf16"):
+            sys.exit(f"unknown dtype {dtype!r}: expected f32|bf16")
         k = 8
         outdir = tempfile.mkdtemp(prefix="dl4j_hwprof_")
         capture_bert(batch, k, outdir, dtype)

@@ -109,28 +109,36 @@ class ParagraphVectors(Word2Vec):
         W = self.window_size
         # total already carries DBOW's x2 token factor; the pair count
         # is ~tokens * (W + 2), so halve before scaling
-        stream = _PairStream(
-            self, self._pair_chunk_size((total // 2) * (W + 2)), total)
-        for _ep in range(self.epochs):
-            for tokens, labels in tokenized:
-                idxs = np.asarray(self._indices(tokens), np.int32)
-                lidxs = np.asarray(
-                    [i for i in (self.vocab.index_of(lb)
-                                 for lb in labels) if i >= 0], np.int32)
-                n = len(idxs)
-                if n and len(lidxs):
-                    # every (label, word) pair — the doc vector predicts
-                    # each of its words (DBOW.java semantics)
-                    stream.push(np.repeat(lidxs, n),
-                                np.tile(idxs, len(lidxs)))
-                    stream.seen += len(lidxs) * n
-                # joint word pass (trainWordVectors=true semantics)
-                if n >= 2:
-                    grid, valid = sk.window_grid(n, W, self._rng)
-                    stream.push(np.repeat(idxs, valid.sum(axis=1)),
-                                idxs[grid[valid]])
-                stream.seen += n
-        stream.finish()
+        chunk = self._pair_chunk_size((total // 2) * (W + 2))
+
+        def produce(sink):
+            stream = _PairStream(self, chunk, total, sink=sink)
+            for _ep in range(self.epochs):
+                for tokens, labels in tokenized:
+                    idxs = np.asarray(self._indices(tokens), np.int32)
+                    lidxs = np.asarray(
+                        [i for i in (self.vocab.index_of(lb)
+                                     for lb in labels) if i >= 0],
+                        np.int32)
+                    n = len(idxs)
+                    if n and len(lidxs):
+                        # every (label, word) pair — the doc vector
+                        # predicts each of its words (DBOW.java)
+                        stream.push(np.repeat(lidxs, n),
+                                    np.tile(idxs, len(lidxs)))
+                        stream.seen += len(lidxs) * n
+                    # joint word pass (trainWordVectors=true semantics)
+                    if n >= 2:
+                        grid, valid = sk.window_grid(n, W, self._rng)
+                        stream.push(np.repeat(idxs, valid.sum(axis=1)),
+                                    idxs[grid[valid]])
+                    stream.seen += n
+            stream.finish()
+
+        if self.overlap_pairgen:
+            self._run_overlapped(produce)
+        else:
+            produce(None)
         return self
 
     def _train_dbow(self, idxs, lidxs, batcher, seen, total):

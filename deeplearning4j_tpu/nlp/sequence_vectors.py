@@ -37,7 +37,7 @@ class _PairStream:
     DEPTH = 8
 
     def __init__(self, model, chunk: int, total_words: int,
-                 depth: int = DEPTH):
+                 depth: int = DEPTH, sink=None):
         self.m = model
         self.chunk = chunk
         self.depth = depth
@@ -49,6 +49,12 @@ class _PairStream:
         self.lrs = np.zeros(depth, np.float32)
         self.d = 0          # chunks filled
         self.fill = 0       # rows filled in the current chunk
+        # ``sink``: where sealed superchunks go. Default = dispatch the
+        # device step inline (serial). The overlapped fit loop passes a
+        # queue.put so a producer thread can run ALL host work (pair
+        # gen + negative draws, everything rng-ordered) while the main
+        # thread drains device dispatches (VERDICT r4 #2).
+        self.sink = sink if sink is not None else self.m._dispatch_chunks
         if model.use_hs:
             model._ensure_hs_matrices()
 
@@ -91,28 +97,26 @@ class _PairStream:
         self._flush()
 
     def _flush(self):
+        """Seal the superchunk: finish ALL host-side work (including the
+        rng-ordered negative draws, so producer-thread and serial modes
+        make identical rng calls in identical order → bitwise-equal
+        training) and hand the prepared arrays to the sink."""
         if self.d == 0:
             return
         m = self.m
         self.nv[self.d:] = 0                 # unused chunks are inert
         self.lrs[self.d:] = 0.0
         if m.use_hs:
-            m.syn0, m.syn1 = sk.skipgram_hs_scan_step(
-                m.syn0, m.syn1, jnp.asarray(self.cen.copy()),
-                jnp.asarray(self.ctx.copy()), m._hs_points,
-                m._hs_labels, m._hs_mask, jnp.asarray(self.nv.copy()),
-                jnp.asarray(self.lrs.copy()))
+            prep = ("hs", self.cen.copy(), self.ctx.copy(),
+                    self.nv.copy(), self.lrs.copy())
         elif getattr(m, "shared_negatives", False) and m.negative > 0 \
                 and self.chunk % sk.SHARED_NEG_GROUP == 0:
             g = self.chunk // sk.SHARED_NEG_GROUP
             draws = m._rng.integers(0, len(m._table),
                                     (self.depth, g, m.negative))
             negs = m._table[draws].astype(np.int32)
-            m.syn0, m.syn1 = sk.skipgram_scan_step_shared(
-                m.syn0, m.syn1, jnp.asarray(self.cen.copy()),
-                jnp.asarray(self.ctx.copy()), jnp.asarray(negs),
-                jnp.asarray(self.nv.copy()),
-                jnp.asarray(self.lrs.copy()))
+            prep = ("shared", self.cen.copy(), self.ctx.copy(),
+                    self.nv.copy(), self.lrs.copy(), negs)
         else:
             k = 1 + m.negative
             tgt = np.zeros((self.depth, self.chunk, k), np.int32)
@@ -121,11 +125,10 @@ class _PairStream:
             flat[:, 1:] = sk.draw_negatives(
                 m._rng, m._table, flat[:, 0:1], k - 1,
                 m.vocab.num_words())
-            m.syn0, m.syn1 = sk.skipgram_scan_step(
-                m.syn0, m.syn1, jnp.asarray(self.cen.copy()),
-                jnp.asarray(tgt), jnp.asarray(self.nv.copy()),
-                jnp.asarray(self.lrs.copy()))
+            prep = ("perpair", self.cen.copy(), tgt,
+                    self.nv.copy(), self.lrs.copy())
         self.d = 0
+        self.sink(prep)
 
 
 class SequenceVectors:
@@ -148,7 +151,8 @@ class SequenceVectors:
                  stop_words: Iterable[str] = (),
                  use_cbow: bool = False,
                  device_pair_generation: bool = False,
-                 shared_negatives: bool = True):
+                 shared_negatives: bool = True,
+                 overlap_pairgen: bool = True):
         self.layer_size = layer_size
         self.window_size = window_size
         self.min_word_frequency = min_word_frequency
@@ -176,6 +180,11 @@ class SequenceVectors:
         # (measured ~3× SGNS throughput). Same negative DISTRIBUTION,
         # different per-pair draws; False restores per-pair negatives.
         self.shared_negatives = shared_negatives
+        # Double-buffer host pair generation against device compute
+        # (VERDICT r4 #2): a producer thread prepares superchunk N+1
+        # while the device trains on N. Identical math (same rng call
+        # order); False restores the strictly serial loop.
+        self.overlap_pairgen = overlap_pairgen
 
         self.vocab: Optional[VocabCache] = None
         self.syn0: Optional[jax.Array] = None
@@ -227,7 +236,11 @@ class SequenceVectors:
 
     # ---- training --------------------------------------------------------
     def fit(self, sequences: Iterable[Sequence[str]]):
-        seqs = [list(s) for s in sequences]
+        if isinstance(sequences, list) and all(
+                isinstance(s, list) for s in sequences):
+            seqs = sequences   # host pairgen is the SGNS bound: don't
+        else:                  # re-copy an already-materialized corpus
+            seqs = [list(s) for s in sequences]
         if self.vocab is None:
             self.build_vocab(seqs)
         if self.syn0 is None:
@@ -351,6 +364,74 @@ class SequenceVectors:
         flush(fill)
         return self
 
+    def _dispatch_chunks(self, prep):
+        """Run one prepared superchunk as a scanned device step. Pure
+        consumer: all host randomness already happened in _PairStream.
+        JAX dispatch is async, so successive calls pipeline on device."""
+        kind = prep[0]
+        if kind == "hs":
+            _, cen, ctx, nv, lrs = prep
+            self.syn0, self.syn1 = sk.skipgram_hs_scan_step(
+                self.syn0, self.syn1, jnp.asarray(cen), jnp.asarray(ctx),
+                self._hs_points, self._hs_labels, self._hs_mask,
+                jnp.asarray(nv), jnp.asarray(lrs))
+        elif kind == "shared":
+            _, cen, ctx, nv, lrs, negs = prep
+            self.syn0, self.syn1 = sk.skipgram_scan_step_shared(
+                self.syn0, self.syn1, jnp.asarray(cen), jnp.asarray(ctx),
+                jnp.asarray(negs), jnp.asarray(nv), jnp.asarray(lrs))
+        else:
+            _, cen, tgt, nv, lrs = prep
+            self.syn0, self.syn1 = sk.skipgram_scan_step(
+                self.syn0, self.syn1, jnp.asarray(cen), jnp.asarray(tgt),
+                jnp.asarray(nv), jnp.asarray(lrs))
+
+    def _run_overlapped(self, produce, queue_depth: int = 2):
+        """Double-buffered fit loop (VERDICT r4 #2 — the reference
+        overlaps via trainer threads, SequenceVectors.java:193): a
+        producer thread runs ``produce(sink)`` — all host pair
+        generation, numpy slab ops release the GIL — pushing prepared
+        superchunks into a bounded queue while this thread drains
+        device dispatches. Bitwise-identical to the serial path: the
+        producer makes the same rng calls in the same order, and
+        dispatch order is FIFO."""
+        import queue as _queue
+        import threading
+
+        q: "_queue.Queue" = _queue.Queue(maxsize=queue_depth)
+        done = object()
+
+        def producer():
+            try:
+                produce(q.put)
+                q.put(done)
+            except BaseException as e:          # surface in consumer
+                q.put(e)
+
+        t = threading.Thread(target=producer, daemon=True,
+                             name="dl4j-pairgen")
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is done:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                self._dispatch_chunks(item)
+        finally:
+            # consumer died mid-stream: the producer may be blocked in
+            # q.put against the full bounded queue — drain until its
+            # terminal done/exception token so join() can't deadlock
+            while t.is_alive():
+                try:
+                    item = q.get(timeout=0.1)
+                except _queue.Empty:
+                    continue
+                if item is done or isinstance(item, BaseException):
+                    break
+            t.join()
+
     def _pair_chunk_size(self, est_pairs: int) -> int:
         """Chunk sizing shared by the vectorized pair paths: large chunks
         amortize per-dispatch latency (~26 ms over tunneled transports —
@@ -370,12 +451,16 @@ class SequenceVectors:
         the per-sequence ``_indices`` loop was the measured host bound
         of the SGNS path (75k tiny numpy calls at the 100k-vocab
         bench); everything downstream is corpus-level numpy."""
+        import itertools
         lookup = self.vocab._by_word
-        flat = [t for s in seqs for t in s]
         lens = np.fromiter((len(s) for s in seqs), np.int64, len(seqs))
+        total = int(lens.sum())
+        # stream the corpus through map(dict.get) without materializing
+        # a flat 3M-element Python list first
         idx = np.fromiter(
             (vw.index if vw is not None else -1
-             for vw in map(lookup.get, flat)), np.int32, len(flat))
+             for vw in map(lookup.get, itertools.chain.from_iterable(
+                 seqs))), np.int32, total)
         keep = idx >= 0
         seq_id = np.repeat(np.arange(len(seqs)), lens)[keep]
         return idx[keep], seq_id
@@ -411,46 +496,52 @@ class SequenceVectors:
         step — the TPU-shaped version of the reference's
         AggregateSkipGram batching (SkipGram.java:176-186)."""
         W = self.window_size
-        stream = _PairStream(
-            self, self._pair_chunk_size(total_words * (W + 1)),
-            total_words)
+        chunk = self._pair_chunk_size(total_words * (W + 1))
         ids_all, seq_all = self._encode_corpus_flat(seqs)
         offsets = np.concatenate([np.arange(-W, 0), np.arange(1, W + 1)])
-        for _epoch in range(self.epochs):
-            if self.sampling > 0:
-                m = self._subsample_mask(ids_all)
-                ids, seq_id = ids_all[m], seq_all[m]
-            else:
-                ids, seq_id = ids_all, seq_all
-            n = len(ids)
-            if n < 2:
-                stream.seen += n
-                continue
-            # per-token position/length within its (post-subsample)
-            # sequence, computed without any per-sequence loop
-            change = np.empty(n, bool)
-            change[0] = True
-            np.not_equal(seq_id[1:], seq_id[:-1], out=change[1:])
-            starts = np.flatnonzero(change)
-            seg = np.cumsum(change) - 1
-            pos = np.arange(n) - starts[seg]
-            lens = np.diff(np.append(starts, n))
-            length = lens[seg]
-            # randomized effective window per center (word2vec.c's b)
-            w_eff = (self._rng.integers(1, W + 1, size=n)
-                     if W > 1 else np.ones(n, np.int64))
-            slab = 1 << 20
-            for lo in range(0, n, slab):
-                hi = min(n, lo + slab)
-                o = offsets[None, :]
-                p = pos[lo:hi, None]
-                valid = ((np.abs(o) <= w_eff[lo:hi, None])
-                         & (p + o >= 0)
-                         & (p + o < length[lo:hi, None]))
-                centers = np.repeat(ids[lo:hi], valid.sum(axis=1))
-                gpos = (np.arange(lo, hi)[:, None] + o)[valid]
-                stream.push(centers, ids[gpos], tokens=hi - lo)
-        stream.finish()
+
+        def produce(sink):
+            stream = _PairStream(self, chunk, total_words, sink=sink)
+            for _epoch in range(self.epochs):
+                if self.sampling > 0:
+                    m = self._subsample_mask(ids_all)
+                    ids, seq_id = ids_all[m], seq_all[m]
+                else:
+                    ids, seq_id = ids_all, seq_all
+                n = len(ids)
+                if n < 2:
+                    stream.seen += n
+                    continue
+                # per-token position/length within its (post-subsample)
+                # sequence, computed without any per-sequence loop
+                change = np.empty(n, bool)
+                change[0] = True
+                np.not_equal(seq_id[1:], seq_id[:-1], out=change[1:])
+                starts = np.flatnonzero(change)
+                seg = np.cumsum(change) - 1
+                pos = np.arange(n) - starts[seg]
+                lens = np.diff(np.append(starts, n))
+                length = lens[seg]
+                # randomized effective window per center (word2vec.c's b)
+                w_eff = (self._rng.integers(1, W + 1, size=n)
+                         if W > 1 else np.ones(n, np.int64))
+                slab = 1 << 20
+                for lo in range(0, n, slab):
+                    hi = min(n, lo + slab)
+                    o = offsets[None, :]
+                    p = pos[lo:hi, None]
+                    valid = ((np.abs(o) <= w_eff[lo:hi, None])
+                             & (p + o >= 0)
+                             & (p + o < length[lo:hi, None]))
+                    centers = np.repeat(ids[lo:hi], valid.sum(axis=1))
+                    gpos = (np.arange(lo, hi)[:, None] + o)[valid]
+                    stream.push(centers, ids[gpos], tokens=hi - lo)
+            stream.finish()
+
+        if self.overlap_pairgen:
+            self._run_overlapped(produce)
+        else:
+            produce(None)      # _PairStream defaults to inline dispatch
         return self
 
     def _k(self) -> int:

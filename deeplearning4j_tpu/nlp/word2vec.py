@@ -36,10 +36,12 @@ class Word2Vec(SequenceVectors):
     # ---- text front-end --------------------------------------------------
     def _tokenize(self, corpus) -> List[List[str]]:
         # materialize first so type-sniffing can't consume a generator
-        items = list(corpus)
+        items = corpus if isinstance(corpus, list) else list(corpus)
         if items and isinstance(items[0], str):
             return [self.tokenizer_factory.create(s).get_tokens()
                     for s in items]
+        if items and all(isinstance(s, list) for s in items):
+            return items       # already token lists: no 3M-token copy
         return [list(s) for s in items]
 
     def fit(self, corpus: Union[SentenceIterator, Iterable[str],
@@ -100,6 +102,26 @@ class Word2Vec(SequenceVectors):
     # SGNS fast path stays valid for Word2Vec (see _fast_sgns_ok)
     _train_sequence._sgns_fast_path_safe = True
 
+    def _dispatch_chunks(self, prep):
+        """Adds the CBOW superchunk kinds to the base consumer (same
+        prepare/dispatch split — see SequenceVectors._dispatch_chunks)."""
+        kind = prep[0]
+        if kind == "cbow_hs":
+            _, ctx, cmask, cen, nv, lrs = prep
+            self.syn0, self.syn1 = sk.cbow_hs_scan_step(
+                self.syn0, self.syn1, jnp.asarray(ctx),
+                jnp.asarray(cmask), jnp.asarray(cen), self._hs_points,
+                self._hs_labels, self._hs_mask, jnp.asarray(nv),
+                jnp.asarray(lrs))
+        elif kind == "cbow_ns":
+            _, ctx, cmask, tgt, nv, lrs = prep
+            self.syn0, self.syn1 = sk.cbow_scan_step(
+                self.syn0, self.syn1, jnp.asarray(ctx),
+                jnp.asarray(cmask), jnp.asarray(tgt), jnp.asarray(nv),
+                jnp.asarray(lrs))
+        else:
+            super()._dispatch_chunks(prep)
+
     def _fit_fast_cbow(self, seqs, total_words: int,
                        extra_per_seq=None):
         """Vectorized CBOW (NS and HS): context windows built with the
@@ -138,83 +160,88 @@ class Word2Vec(SequenceVectors):
         fill = 0
         seen = 0
 
-        def seal():
-            nonlocal d, fill
-            nv[d] = fill
-            lrs[d] = self._lr(seen, total_words)
-            if fill < chunk:
-                cmask_buf[d, fill:] = 0.0
-            d += 1
-            fill = 0
-            if d == depth:
-                flush()
+        def produce(sink):
+            nonlocal d, fill, seen
 
-        def flush():
-            nonlocal d
-            if d == 0:
-                return
-            nv[d:] = 0
-            lrs[d:] = 0.0
-            # .copy(): the loop mutates these buffers while the async
-            # transfer may still read them (see _fit_fast_sgns)
-            ctx_d = jnp.asarray(ctx_buf.copy())
-            cm_d = jnp.asarray(cmask_buf.copy())
-            nv_d = jnp.asarray(nv.copy())
-            lr_d = jnp.asarray(lrs.copy())
-            if hs:
-                self.syn0, self.syn1 = sk.cbow_hs_scan_step(
-                    self.syn0, self.syn1, ctx_d, cm_d,
-                    jnp.asarray(cen_buf.copy()), self._hs_points,
-                    self._hs_labels, self._hs_mask, nv_d, lr_d)
-            else:
-                tgt = np.zeros((depth, chunk, k), np.int32)
-                tgt[..., 0] = cen_buf
-                flat = tgt.reshape(-1, k)
-                flat[:, 1:] = sk.draw_negatives(
-                    rng, table, flat[:, 0:1], k - 1, n_words)
-                self.syn0, self.syn1 = sk.cbow_scan_step(
-                    self.syn0, self.syn1, ctx_d, cm_d, jnp.asarray(tgt),
-                    nv_d, lr_d)
-            d = 0
+            def flush():
+                nonlocal d
+                if d == 0:
+                    return
+                nv[d:] = 0
+                lrs[d:] = 0.0
+                # .copy(): the loop keeps mutating these buffers
+                # (see _fit_fast_sgns)
+                if hs:
+                    prep = ("cbow_hs", ctx_buf.copy(), cmask_buf.copy(),
+                            cen_buf.copy(), nv.copy(), lrs.copy())
+                else:
+                    tgt = np.zeros((depth, chunk, k), np.int32)
+                    tgt[..., 0] = cen_buf
+                    flat = tgt.reshape(-1, k)
+                    flat[:, 1:] = sk.draw_negatives(
+                        rng, table, flat[:, 0:1], k - 1, n_words)
+                    prep = ("cbow_ns", ctx_buf.copy(),
+                            cmask_buf.copy(), tgt, nv.copy(),
+                            lrs.copy())
+                d = 0
+                sink(prep)
 
-        for _epoch in range(self.epochs):
-            for si, seq in enumerate(seqs):
-                idxs = np.asarray(self._indices(seq), np.int32)
-                n = len(idxs)
-                # with label columns (DM) even a 1-token doc trains its
-                # label vector (slow-path parity); without, need a window
-                if n < 1 or (n < 2 and not max_extra):
+            def seal():
+                nonlocal d, fill
+                nv[d] = fill
+                lrs[d] = self._lr(seen, total_words)
+                if fill < chunk:
+                    cmask_buf[d, fill:] = 0.0
+                d += 1
+                fill = 0
+                if d == depth:
+                    flush()
+
+            for _epoch in range(self.epochs):
+                for si, seq in enumerate(seqs):
+                    idxs = np.asarray(self._indices(seq), np.int32)
+                    n = len(idxs)
+                    # with label columns (DM) even a 1-token doc trains
+                    # its label vector (slow-path parity); without,
+                    # need a window
+                    if n < 1 or (n < 2 and not max_extra):
+                        seen += n
+                        continue
+                    grid, valid = sk.window_grid(n, W, rng)
+                    ctx = idxs[np.clip(grid, 0, n - 1)]
+                    if max_extra:
+                        e = np.asarray(extra_per_seq[si], np.int32)
+                        pad = np.zeros(max_extra - len(e), np.int32)
+                        ctx = np.concatenate(
+                            [ctx,
+                             np.tile(np.concatenate([e, pad]), (n, 1))],
+                            axis=1)
+                        evalid = np.concatenate(
+                            [np.ones(len(e), bool),
+                             np.zeros(max_extra - len(e), bool)])
+                        valid = np.concatenate(
+                            [valid, np.tile(evalid, (n, 1))], axis=1)
                     seen += n
-                    continue
-                grid, valid = sk.window_grid(n, W, rng)
-                ctx = idxs[np.clip(grid, 0, n - 1)]
-                if max_extra:
-                    e = np.asarray(extra_per_seq[si], np.int32)
-                    pad = np.zeros(max_extra - len(e), np.int32)
-                    ctx = np.concatenate(
-                        [ctx, np.tile(np.concatenate([e, pad]), (n, 1))],
-                        axis=1)
-                    evalid = np.concatenate(
-                        [np.ones(len(e), bool),
-                         np.zeros(max_extra - len(e), bool)])
-                    valid = np.concatenate(
-                        [valid, np.tile(evalid, (n, 1))], axis=1)
-                seen += n
-                p = 0
-                while p < n:
-                    take = min(chunk - fill, n - p)
-                    sl = slice(fill, fill + take)
-                    cen_buf[d, sl] = idxs[p:p + take]
-                    ctx_buf[d, sl] = ctx[p:p + take]
-                    cmask_buf[d, sl] = \
-                        valid[p:p + take].astype(np.float32)
-                    fill += take
-                    p += take
-                    if fill == chunk:
-                        seal()
-        if fill:
-            seal()
-        flush()
+                    p = 0
+                    while p < n:
+                        take = min(chunk - fill, n - p)
+                        sl = slice(fill, fill + take)
+                        cen_buf[d, sl] = idxs[p:p + take]
+                        ctx_buf[d, sl] = ctx[p:p + take]
+                        cmask_buf[d, sl] = \
+                            valid[p:p + take].astype(np.float32)
+                        fill += take
+                        p += take
+                        if fill == chunk:
+                            seal()
+            if fill:
+                seal()
+            flush()
+
+        if self.overlap_pairgen:
+            self._run_overlapped(produce)
+        else:
+            produce(self._dispatch_chunks)
         return self
 
 

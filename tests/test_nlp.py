@@ -122,6 +122,47 @@ class TestWord2Vec:
                                    w2v.get_word_vector("cat"), atol=1e-6)
         loaded.fit(_toy_corpus(10))  # resumable
 
+    @pytest.mark.parametrize("mode", ["ns", "hs", "cbow"])
+    def test_overlap_pairgen_bitwise_equal(self, mode):
+        """The double-buffered producer-thread fit (overlap_pairgen,
+        round 5) makes the same rng calls in the same order as the
+        serial loop — syn0 must come out bitwise identical."""
+        def run(overlap):
+            w2v = Word2Vec(layer_size=16, window_size=3,
+                           min_word_frequency=1, epochs=3, negative=4,
+                           use_hierarchic_softmax=(mode == "hs"),
+                           use_cbow=(mode == "cbow"),
+                           learning_rate=0.05, batch_size=256, seed=11,
+                           overlap_pairgen=overlap)
+            w2v.fit(_toy_corpus(60))
+            return np.asarray(w2v.syn0)
+        np.testing.assert_array_equal(run(True), run(False))
+
+    def test_overlap_consumer_error_propagates(self):
+        """A device-side dispatch failure during an overlapped fit must
+        surface promptly (not deadlock against the full bounded queue
+        — code-review r5)."""
+        w2v = Word2Vec(layer_size=8, epochs=2, negative=2, seed=1)
+
+        def boom(prep):
+            raise RuntimeError("device dispatch failed")
+
+        w2v._dispatch_chunks = boom
+        with pytest.raises(RuntimeError, match="device dispatch failed"):
+            w2v.fit(_toy_corpus(40))
+
+    def test_mixed_iterator_corpus_materialized(self):
+        """A corpus whose first element is a list but that hides
+        single-use iterators must still be materialized (the no-copy
+        fast path requires ALL elements to be lists)."""
+        corpus = _toy_corpus(20)
+        seqs = [s.split() for s in corpus]
+        seqs[5] = iter(corpus[5].split())
+        w2v = Word2Vec(layer_size=8, epochs=2, negative=2, seed=1)
+        w2v.fit(seqs)
+        for tok in corpus[5].split():
+            assert w2v.has_word(tok)
+
     def test_static_copy(self):
         w2v = Word2Vec(layer_size=8, epochs=1, negative=2, seed=1)
         w2v.fit(_toy_corpus(20))
