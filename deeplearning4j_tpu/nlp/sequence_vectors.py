@@ -400,10 +400,21 @@ class SequenceVectors:
 
         q: "_queue.Queue" = _queue.Queue(maxsize=queue_depth)
         done = object()
+        stop = threading.Event()
+
+        class _Stop(BaseException):
+            pass
+
+        def sink(prep):
+            if stop.is_set():       # consumer died: end pairgen NOW,
+                raise _Stop()       # not after the remaining corpus
+            q.put(prep)
 
         def producer():
             try:
-                produce(q.put)
+                produce(sink)
+                q.put(done)
+            except _Stop:
                 q.put(done)
             except BaseException as e:          # surface in consumer
                 q.put(e)
@@ -420,9 +431,10 @@ class SequenceVectors:
                     raise item
                 self._dispatch_chunks(item)
         finally:
-            # consumer died mid-stream: the producer may be blocked in
-            # q.put against the full bounded queue — drain until its
-            # terminal done/exception token so join() can't deadlock
+            # consumer died mid-stream: signal the producer (it aborts
+            # at its next sealed superchunk) and drain until its
+            # terminal token so a q.put can't deadlock against join()
+            stop.set()
             while t.is_alive():
                 try:
                     item = q.get(timeout=0.1)

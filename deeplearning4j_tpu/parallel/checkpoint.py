@@ -271,11 +271,16 @@ def mirror_opt_shardings(opt_state, params, param_shardings, replicated):
     return jax.tree_util.tree_unflatten(otree, out)
 
 
-def restore_sharded(model, path: str, mesh: Optional[Mesh] = None
-                    ) -> TrainState:
+def restore_sharded(model, path: str, mesh: Optional[Mesh] = None,
+                    param_shardings=None) -> TrainState:
     """Restore a sharded checkpoint into ``model`` (already init()ed so
     the pytree structure exists), placing params for ``mesh`` — which may
-    have a different device count than the mesh that saved it."""
+    have a different device count OR a different layout (e.g. a 3D
+    dp×tp×pp mesh resharded to a different dp/tp/pp split) than the mesh
+    that saved it. ``param_shardings`` overrides the inferred target
+    shardings with an explicit tree (matching ``params``' structure) —
+    how the 3D pipelined-TP layouts restore (the DP-default inference
+    knows nothing about Megatron column/row splits)."""
     with open(os.path.join(path, "manifest.json")) as f:
         manifest = json.load(f)
     dtypes = manifest.get("dtypes", {})
@@ -285,7 +290,24 @@ def restore_sharded(model, path: str, mesh: Optional[Mesh] = None
     # any data is read) so each leaf can be constructed directly with its
     # final placement — a process on a sharded mesh reads only the shard
     # regions it will hold, never the whole array.
-    if mesh is not None:
+    if param_shardings is not None:
+        t_sh = jax.tree_util.tree_structure(param_shardings)
+        t_p = jax.tree_util.tree_structure(ts.params)
+        if t_sh != t_p:
+            raise ValueError(
+                "param_shardings tree structure does not match the "
+                f"model's params: {t_sh} vs {t_p} — a silent zip "
+                "misalignment would restore arrays with the wrong "
+                "layouts")
+        if mesh is None:
+            some = jax.tree_util.tree_leaves(param_shardings)[0]
+            mesh = some.mesh
+        param_sh = param_shardings
+        repl = NamedSharding(mesh, P())
+        opt_sh = mirror_opt_shardings(ts.opt_state, ts.params, param_sh,
+                                      repl)
+        mstate_sh = jax.tree_util.tree_map(lambda _: repl, ts.model_state)
+    elif mesh is not None:
         param_sh = infer_param_shardings(ts.params, mesh)
         repl = NamedSharding(mesh, P())
         opt_sh = mirror_opt_shardings(ts.opt_state, ts.params, param_sh,

@@ -50,6 +50,19 @@ from jax.sharding import Mesh, PartitionSpec as P
 PIPE_AXIS = "pipe"
 
 
+def _device_major_order(n: int, num_devices: int) -> list:
+    """The circular layout's stage storage order: position p holds
+    global stage ``order[p]``, where device d's contiguous R-block
+    carries global stages d, S+d, 2S+d, … (R = n // num_devices). The
+    ONE definition both stacking and checkpoint restacking use."""
+    if n % num_devices:
+        raise ValueError(f"{n} stages not divisible over"
+                         f" {num_devices} devices")
+    r = n // num_devices
+    return [rep * num_devices + d
+            for d in range(num_devices) for rep in range(r)]
+
+
 def stack_stage_params(params_per_stage: Sequence[Any],
                        num_devices: Optional[int] = None) -> Any:
     """Stack per-stage parameter pytrees (identical structure) into one
@@ -61,17 +74,29 @@ def stack_stage_params(params_per_stage: Sequence[Any],
     circular schedule: device d's contiguous block holds global stages
     ``d, S+d, 2S+d, …`` (its R interleaved stages)."""
     n = len(params_per_stage)
-    order = list(range(n))
-    if num_devices and n > num_devices:
-        if n % num_devices:
-            raise ValueError(f"{n} stages not divisible over"
-                             f" {num_devices} devices")
-        r = n // num_devices
-        order = [rep * num_devices + d
-                 for d in range(num_devices) for rep in range(r)]
+    order = (_device_major_order(n, num_devices)
+             if num_devices and n > num_devices else list(range(n)))
     return jax.tree_util.tree_map(
         lambda *leaves: jnp.stack([leaves[i] for i in order], 0),
         *params_per_stage)
+
+
+def restack_stages(stacked_params: Any, from_devices: int,
+                   to_devices: int) -> Any:
+    """Permute the leading stage dim of a stacked-params pytree from one
+    circular layout's device-major order to another's — the fix-up when
+    a sharded checkpoint saved at pipeline size S1 restores onto S2
+    (e.g. a 2-stage×2-repeat layout resharded to 4 straight stages).
+    Positions follow ``stack_stage_params``: device d's block holds
+    global stages d, S+d, 2S+d, …"""
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    n = leaves[0].shape[0]
+    src = _device_major_order(n, from_devices)  # src[p] = stage at pos p
+    dst = _device_major_order(n, to_devices)
+    pos_of = {g: p for p, g in enumerate(src)}
+    perm = jnp.asarray([pos_of[g] for g in dst])
+    return jax.tree_util.tree_map(lambda a: jnp.take(a, perm, axis=0),
+                                  stacked_params)
 
 
 def _pipeline_local(stacked_params, x_mb, consts_mb, stage_fn,
@@ -186,11 +211,17 @@ def pipeline_apply(stage_fn: Callable,
         return stage_fn(p, xm, cst) if takes_consts else stage_fn(p, xm)
 
     pspec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    # Manual ONLY over the pipe axis: any other mesh axes (data, model)
+    # stay GSPMD-automatic, so dp batch sharding and Megatron TP inside
+    # the stage compose with the pipeline schedule in ONE mesh — the
+    # standard 3D dp×tp×pp deployment (partial-auto shard_map).
+    manual = (frozenset({axis}) if len(mesh.axis_names) > 1
+              else frozenset())
     fn = jax.shard_map(
         lambda p, xm, cm: _pipeline_local(p, xm, cm, fn3, axis, m,
                                           repeats, remat),
         mesh=mesh, in_specs=(pspec, P(), P()), out_specs=P(),
-        check_vma=False)
+        check_vma=False, axis_names=manual)
     out_mb = fn(stacked_params, x_mb, consts_mb)
     return out_mb.reshape((x.shape[0],) + out_mb.shape[2:])
 
@@ -254,6 +285,46 @@ class PipelinedTransformerLM:
             y, _ = block.apply(p, {}, h, LayerContext(train=False))
             return y
         return fn
+
+    def param_shardings(self, params, model_axis: str = "model"):
+        """NamedShardings composing the pipeline stage dim with Megatron
+        tensor parallelism over ``model_axis`` — the 3D dp×tp×pp layout
+        (params are replicated over the data axis; the batch shards
+        there). Column-parallel: Wqkv (head-major columns = whole
+        heads) and FFN W1; row-parallel: Wo and W2 (GSPMD inserts the
+        allreduce after the row-parallel contraction). When the mesh
+        has no ``model_axis``, this degrades to stage-only sharding."""
+        from jax.sharding import NamedSharding
+        mesh = self.mesh
+        has_tp = model_axis in mesh.axis_names
+        ax = self.axis
+
+        def ns(*spec):
+            return NamedSharding(mesh, P(*spec))
+
+        col3 = ns(ax, None, model_axis) if has_tp else ns(ax)
+        row3 = ns(ax, model_axis, None) if has_tp else ns(ax)
+        col2 = ns(ax, model_axis) if has_tp else ns(ax)
+        by_name = {"Wqkv": col3, "W1": col3, "bqkv": col2, "b1": col2,
+                   "Wo": row3, "W2": row3}
+
+        def block_leaf(path, leaf):
+            name = getattr(path[-1], "key", None) or str(path[-1])
+            return by_name.get(name, ns(ax))
+
+        return {
+            "embed": ns(), "pos": ns(),
+            "blocks": jax.tree_util.tree_map_with_path(
+                block_leaf, params["blocks"]),
+            "ln_f": jax.tree_util.tree_map(lambda _: ns(),
+                                           params["ln_f"]),
+            "head": ns(None, model_axis) if has_tp else ns(),
+        }
+
+    def shard_params(self, params, model_axis: str = "model"):
+        """device_put ``params`` onto the composed 3D layout."""
+        return jax.device_put(params,
+                              self.param_shardings(params, model_axis))
 
     def _trunk(self, params, tokens, pipelined: bool):
         x = jnp.take(params["embed"], tokens, axis=0)
