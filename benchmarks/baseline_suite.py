@@ -351,6 +351,87 @@ def bert_finetune():
         "unit": "tokens/sec/chip"}))
 
 
+def build_textgen_lstm(units: int = 512, seq: int = 128,
+                       batch: int = 256, k: int = 16,
+                       dtype: str = "f32", vocab: int = 77):
+    """The BASELINE TextGenerationLSTM throughput config (scaled
+    geometry: 2×LSTM-``units``, one-hot vocab inputs, RnnOutputLayer) —
+    shared by the ``lstm`` bench and ``profile_hw.py lstm`` so the
+    profiler measures the exact benchmarked graph."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models.multi_layer_network import (
+        MultiLayerNetwork)
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers.recurrent import LSTM
+    from deeplearning4j_tpu.nn.layers.output import RnnOutputLayer
+    from deeplearning4j_tpu.optimize.solver import make_scan_train_step
+    from deeplearning4j_tpu.optimize.updaters import Adam
+
+    # matches zoo TextGenerationLSTM.conf() incl. the gradient clip the
+    # named model ships with (zoo/models.py:341) — scaled geometry only
+    b = (NeuralNetConfiguration.Builder().seed(123).updater(Adam(2e-3))
+         .gradient_normalization("clip_value", 5.0))
+    if dtype == "bf16":
+        b = b.compute_dtype("bfloat16")
+    conf = (b.list()
+            .layer(LSTM(n_out=units))
+            .layer(LSTM(n_out=units))
+            .layer(RnnOutputLayer(n_out=vocab))
+            .set_input_type(InputType.recurrent(vocab, seq))
+            .build())
+    model = MultiLayerNetwork(conf).init()
+
+    def loss_fn(params, mstate, feats, labels, fmask, lmask, rng, it):
+        return model._loss(params, mstate, feats, labels, fmask, lmask,
+                           rng, it)
+
+    steps_fn = make_scan_train_step(loss_fn, model._tx)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, vocab, (batch, seq))
+    x = np.eye(vocab, dtype=np.float32)[ids]          # (N, T, vocab)
+    nxt = np.roll(ids, -1, axis=1)
+    y = np.eye(vocab, dtype=np.float32)[nxt]
+    xs = jnp.broadcast_to(jnp.asarray(x), (k,) + x.shape)
+    ys = jnp.broadcast_to(jnp.asarray(y), (k, ) + y.shape)
+    # prime model_state (the LSTM layers add last_h/last_c on first
+    # apply; the K-step scan needs carry-in == carry-out structure)
+    from deeplearning4j_tpu.optimize.solver import make_train_step
+    import jax.random as jrandom
+    one = make_train_step(loss_fn, model._tx, donate=False)
+    ts, _ = one(model.train_state, jnp.asarray(x), jnp.asarray(y),
+                None, None, jrandom.PRNGKey(99))
+    model.train_state = ts
+    return model, steps_fn, xs, ys
+
+
+def lstm():
+    """TextGenerationLSTM train throughput (BASELINE config: 2×LSTM-512,
+    T=128, batch 256). Optional argv: dtype f32|bf16."""
+    import jax.random as jrandom
+
+    dtype = sys.argv[2] if len(sys.argv) > 2 else "f32"
+    if dtype not in ("f32", "bf16"):
+        sys.exit(f"unknown dtype {dtype!r}: expected f32|bf16")
+    seq, batch, k, n = 128, 256, 16, 3
+    model, steps_fn, xs, ys = build_textgen_lstm(
+        seq=seq, batch=batch, k=k, dtype=dtype)
+    key = jrandom.PRNGKey(0)
+    ts = model.train_state
+    ts, losses = steps_fn(ts, xs, ys, None, None, key)
+    _sync(losses[-1])
+    t0 = time.perf_counter()
+    for i in range(n):
+        ts, losses = steps_fn(ts, xs, ys, None, None,
+                              jrandom.fold_in(key, i))
+    _sync(losses[-1])
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": f"textgen_lstm512_seq128_{dtype}_train_tokens_per_sec",
+        "value": round(n * k * batch * seq / dt, 1),
+        "unit": "tokens/sec/chip"}))
+
+
 def word2vec():
     """SGNS and HS at 100k vocab on a zipf-shaped corpus (the scale the
     reference's native AggregateSkipGram targets — SkipGram.java:176)."""

@@ -61,6 +61,25 @@ def capture_bert(batch: int, k: int, outdir: str, dtype: str):
         float(np.asarray(losses[-1]))
 
 
+def capture_lstm(batch: int, k: int, outdir: str, dtype: str):
+    """TextGenerationLSTM train step (BASELINE config) under a device
+    trace — same graph as baseline_suite.lstm via the shared builder."""
+    import jax
+    import jax.random as jrandom
+    from benchmarks.baseline_suite import build_textgen_lstm
+
+    model, steps_fn, xs, ys = build_textgen_lstm(
+        seq=128, batch=batch, k=k, dtype=dtype)
+    key = jrandom.PRNGKey(0)
+    ts = model.train_state
+    ts, losses = steps_fn(ts, xs, ys, None, None, key)
+    float(np.asarray(losses[-1]))
+    with jax.profiler.trace(outdir):
+        ts, losses = steps_fn(ts, xs, ys, None, None,
+                              jrandom.fold_in(key, 1))
+        float(np.asarray(losses[-1]))
+
+
 def capture(mode: str, batch: int, k: int, outdir: str):
     import jax
     import jax.numpy as jnp
@@ -157,17 +176,19 @@ if __name__ == "__main__":
     # modes: unfused (default) | fused (pallas blocks) | gram (xla
     # blocks + Gram stats) | vgg | bert [batch] [f32|bf16]
     mode = sys.argv[1] if len(sys.argv) > 1 else "unfused"
-    if mode not in ("unfused", "fused", "gram", "vgg", "bert"):
+    if mode not in ("unfused", "fused", "gram", "vgg", "bert", "lstm"):
         sys.exit(f"unknown mode {mode!r}: expected "
-                 "unfused|fused|gram|vgg|bert [batch] [f32|bf16]")
-    if mode == "bert":
-        batch = int(sys.argv[2]) if len(sys.argv) > 2 else 32
+                 "unfused|fused|gram|vgg|bert|lstm [batch] [f32|bf16]")
+    if mode in ("bert", "lstm"):
+        batch = int(sys.argv[2]) if len(sys.argv) > 2 else (
+            32 if mode == "bert" else 256)
         dtype = sys.argv[3] if len(sys.argv) > 3 else "f32"
         if dtype not in ("f32", "bf16"):
             sys.exit(f"unknown dtype {dtype!r}: expected f32|bf16")
         k = 8
         outdir = tempfile.mkdtemp(prefix="dl4j_hwprof_")
-        capture_bert(batch, k, outdir, dtype)
+        (capture_bert if mode == "bert" else capture_lstm)(
+            batch, k, outdir, dtype)
         print(f"trace: {outdir}")
         analyze(outdir, k)
         sys.exit(0)
