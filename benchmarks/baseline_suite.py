@@ -8,6 +8,7 @@ Subcommands (each prints one JSON line):
   bert_finetune   — imported-BERT fine-tune tokens/s (grafted head)
   inception_train — imported-InceptionV3 fine-tune img/s (299x299)
   word2vec   — SGNS + HS tokens/s at 100k vocab (corpus-shaped workload)
+  lstm       — TextGenerationLSTM train tokens/s (2xLSTM-512; [f32|bf16])
 
 Run: python benchmarks/baseline_suite.py <subcommand>
 """
@@ -369,7 +370,7 @@ def build_textgen_lstm(units: int = 512, seq: int = 128,
     from deeplearning4j_tpu.optimize.updaters import Adam
 
     # matches zoo TextGenerationLSTM.conf() incl. the gradient clip the
-    # named model ships with (zoo/models.py:341) — scaled geometry only
+    # named model ships with — scaled geometry only
     b = (NeuralNetConfiguration.Builder().seed(123).updater(Adam(2e-3))
          .gradient_normalization("clip_value", 5.0))
     if dtype == "bf16":
@@ -395,13 +396,15 @@ def build_textgen_lstm(units: int = 512, seq: int = 128,
     xs = jnp.broadcast_to(jnp.asarray(x), (k,) + x.shape)
     ys = jnp.broadcast_to(jnp.asarray(y), (k, ) + y.shape)
     # prime model_state (the LSTM layers add last_h/last_c on first
-    # apply; the K-step scan needs carry-in == carry-out structure)
-    from deeplearning4j_tpu.optimize.solver import make_train_step
+    # apply; the K-step scan needs carry-in == carry-out structure) —
+    # forward-only jit, much cheaper to compile than a full train step
+    import jax
     import jax.random as jrandom
-    one = make_train_step(loss_fn, model._tx, donate=False)
-    ts, _ = one(model.train_state, jnp.asarray(x), jnp.asarray(y),
-                None, None, jrandom.PRNGKey(99))
-    model.train_state = ts
+    _, ms = jax.jit(loss_fn)(
+        model.train_state.params, model.train_state.model_state,
+        jnp.asarray(x), jnp.asarray(y), None, None,
+        jrandom.PRNGKey(99), model.train_state.iteration)
+    model.train_state = model.train_state._replace(model_state=ms)
     return model, steps_fn, xs, ys
 
 

@@ -174,7 +174,7 @@ def analyze(outdir: str, n_steps: int):
 
 if __name__ == "__main__":
     # modes: unfused (default) | fused (pallas blocks) | gram (xla
-    # blocks + Gram stats) | vgg | bert [batch] [f32|bf16]
+    # blocks + Gram stats) | vgg | bert|lstm [batch] [f32|bf16]
     mode = sys.argv[1] if len(sys.argv) > 1 else "unfused"
     if mode not in ("unfused", "fused", "gram", "vgg", "bert", "lstm"):
         sys.exit(f"unknown mode {mode!r}: expected "

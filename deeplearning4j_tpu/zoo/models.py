@@ -26,7 +26,9 @@ from deeplearning4j_tpu.nn.layers.convolution import (
     ConvolutionLayer,
     ConvolutionMode,
     PoolingType,
+    SpaceToDepthLayer,
     SubsamplingLayer,
+    ZeroPaddingLayer,
 )
 from deeplearning4j_tpu.nn.layers.feedforward import (
     ActivationLayer,
@@ -242,6 +244,33 @@ class VGG16(ZooModel):
         return MultiLayerNetwork(self.conf()).init()
 
 
+def fold_stem_weights(w7):
+    """Fold 7×7/2 stem weights (7,7,C,O) HWIO into the exactly
+    equivalent 4×4/1 space-to-depth parameterization (4,4,4C,O):
+    ``Wf[ku, kv, (a·2+b)·C + c, o] = W7[2ku+a, 2kv+b, c, o]`` (zero
+    where 2ku+a > 6). The channel slot order matches
+    ``SpaceToDepthLayer(block_size=2)``'s (row, col, channel) packing,
+    so restoring a trained conv1 into a ``s2d_stem=True`` ResNet50 (or
+    back) is lossless — equivalence asserted in tests/test_zoo_extended."""
+    import numpy as np
+    w7 = np.asarray(w7)
+    kh, kw, c, o = w7.shape
+    wf = np.zeros((4, 4, 4 * c, o), w7.dtype)
+    for ku in range(4):
+        for a in range(2):
+            u = 2 * ku + a
+            if u >= kh:
+                continue
+            for kv in range(4):
+                for b in range(2):
+                    v = 2 * kv + b
+                    if v >= kw:
+                        continue
+                    wf[ku, kv, (a * 2 + b) * c:(a * 2 + b + 1) * c] = \
+                        w7[u, v]
+    return wf
+
+
 @dataclasses.dataclass
 class ResNet50(ZooModel):
     """reference: model/ResNet50.java (BASELINE cfgs 1 & 4) — bottleneck-v1
@@ -265,6 +294,12 @@ class ResNet50(ZooModel):
     # "xla" (plain-XLA convs + Gram-matrix BN stats — see
     # ops/fused_conv.py conv_bn_stats_xla)
     fused_impl: str = "pallas"
+    # Space-to-depth stem (round 5, VERDICT r4 #6): rearrange the input
+    # H×W×3 → H/2×W/2×12 and replace the 7×7/2 conv1 with the EXACTLY
+    # equivalent 4×4/1 conv on 12 channels (fold_stem_weights maps the
+    # weights; equivalence-tested). Fattens the 3-channel stem
+    # contraction the MXU underfills. Measured effect: PERF_ANALYSIS r5.
+    s2d_stem: bool = False
 
     def conf(self):
         g = (NeuralNetConfiguration.Builder()
@@ -314,7 +349,28 @@ class ResNet50(ZooModel):
                         f"{name}_add")
             return f"{name}_out"
 
-        x = conv_bn("conv1", "in", 64, (7, 7), (2, 2))
+        if self.s2d_stem:
+            # 7×7/2 SAME on (H,W,3) ≡ 4×4/1 VALID on the s2d tensor
+            # padded (1,2)×(1,2): y[i,j] = Σ x[2i+u-2, 2j+v-2]·W[u,v]
+            # with u = 2ku+a becomes a stride-1 conv over the 2×2-block
+            # channels — same math, fold_stem_weights carries weights
+            # between the two parameterizations
+            g.add_layer("s2d", SpaceToDepthLayer(block_size=2), "in")
+            g.add_layer("s2d_pad", ZeroPaddingLayer(pad=(1, 2, 1, 2)),
+                        "s2d")
+            g.add_layer("conv1_conv", ConvolutionLayer(
+                n_out=64, kernel_size=(4, 4), stride=(1, 1),
+                convolution_mode=ConvolutionMode.TRUNCATE,
+                padding=(0, 0), has_bias=False,
+                weight_init=WeightInit.HE_NORMAL,
+                activation=Activation.IDENTITY), "s2d_pad")
+            g.add_layer("conv1_bn", BatchNormalization(), "conv1_conv")
+            g.add_layer("conv1_act",
+                        ActivationLayer(activation=Activation.RELU),
+                        "conv1_bn")
+            x = "conv1_act"
+        else:
+            x = conv_bn("conv1", "in", 64, (7, 7), (2, 2))
         g.add_layer("pool1", SubsamplingLayer(
             kernel_size=(3, 3), stride=(2, 2),
             convolution_mode=ConvolutionMode.SAME), x)

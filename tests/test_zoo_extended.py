@@ -69,3 +69,54 @@ def test_facenet_nn4_small2_forward(rng):
     out = np.asarray(m.output(_img(rng, 2, 64, 64)))
     assert out.shape == (2, 11)
     np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-4)
+
+
+class TestS2dStem:
+    """Space-to-depth ResNet50 stem (round 5): exact refold equivalence
+    + end-to-end model build."""
+
+    def test_fold_is_exact(self, rng):
+        import jax.numpy as jnp
+        from jax import lax
+        from deeplearning4j_tpu.zoo.models import fold_stem_weights
+
+        x = jnp.asarray(rng.normal(size=(2, 64, 64, 3)), jnp.float32)
+        w7 = jnp.asarray(rng.normal(size=(7, 7, 3, 64)), jnp.float32)
+        y_ref = lax.conv_general_dilated(
+            x, w7, window_strides=(2, 2), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        # s2d + pad (1,2) + 4x4/1 VALID with folded weights
+        n, h, w, c = x.shape
+        x2 = x.reshape(n, h // 2, 2, w // 2, 2, c)
+        x2 = x2.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, w // 2,
+                                                    4 * c)
+        x2 = jnp.pad(x2, ((0, 0), (1, 2), (1, 2), (0, 0)))
+        wf = jnp.asarray(fold_stem_weights(w7))
+        y_s2d = lax.conv_general_dilated(
+            x2, wf, window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        np.testing.assert_allclose(np.asarray(y_s2d), np.asarray(y_ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_s2d_model_matches_standard_with_folded_weights(self, rng):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.zoo.models import (ResNet50,
+                                                   fold_stem_weights)
+
+        std = ResNet50(num_classes=8, height=32, width=32).init()
+        s2d = ResNet50(num_classes=8, height=32, width=32,
+                       s2d_stem=True).init()
+        # carry ALL params over; conv1 via the fold
+        p = dict(std.train_state.params)
+        p2 = dict(s2d.train_state.params)
+        for k in p2:
+            if k == "conv1_conv":
+                p2[k] = {"W": jnp.asarray(
+                    fold_stem_weights(p["conv1_conv"]["W"]))}
+            elif k in p:
+                p2[k] = p[k]
+        s2d.train_state = s2d.train_state._replace(params=p2)
+        x = _img(rng, 2, 32, 32)
+        np.testing.assert_allclose(np.asarray(s2d.output(x)),
+                                   np.asarray(std.output(x)),
+                                   rtol=1e-4, atol=1e-5)
