@@ -105,13 +105,21 @@ _ACTIVATIONS = {
     "swish": Activation.SWISH,
     "silu": Activation.SWISH,
     "mish": Activation.MISH,
-    "leaky_relu": Activation.LEAKYRELU,
-    "LeakyReLU": Activation.LEAKYRELU,
     "thresholded_relu": Activation.THRESHOLDEDRELU,
 }
 
 
 def map_activation(name: str) -> Activation:
+    if name in ("leaky_relu", "LeakyReLU"):
+        # Keras 3's fused string defaults to negative_slope=0.2; the
+        # fused Activation.LEAKYRELU enum is fixed at the reference's
+        # 0.01 default — importing would be silently wrong on every
+        # negative pre-activation. The standalone LeakyReLU LAYER
+        # carries its slope and imports exactly.
+        raise ValueError(
+            "unsupported fused activation 'leaky_relu' (its slope is "
+            "not representable in the fused activation enum); use a "
+            "standalone keras.layers.LeakyReLU layer instead")
     if name not in _ACTIVATIONS:
         raise ValueError(f"unsupported Keras activation {name!r}")
     return _ACTIVATIONS[name]
@@ -234,11 +242,25 @@ def separable_conv2d(cfg, _v):
         weights=_sep_conv_weights, activation=act)
 
 
+def _deconv_weights(w: Dict[str, np.ndarray]) -> Tuple[dict, dict]:
+    """Keras stores transpose-conv kernels (kh, kw, OUT, IN) in the
+    FORWARD-conv orientation (Conv2DTranspose is the gradient of a
+    correlation); our Deconvolution2D is a plain correlation on the
+    input-dilated tensor, so the kernel maps with the io axes swapped
+    AND a spatial rot180 (caught by the k3_conv e2e fixture — unit
+    tests never ran real Keras bytes through this path)."""
+    params, state = _dense_weights(w)
+    if "W" in params and params["W"].ndim == 4:
+        params["W"] = np.transpose(params["W"],
+                                   (0, 1, 3, 2))[::-1, ::-1].copy()
+    return params, state
+
+
 def conv2d_transpose(cfg, _v):
     act = map_activation(cfg.get("activation", "linear"))
     return Converted(
         layer=Deconvolution2D(activation=act, **_conv_common(cfg)),
-        weights=_dense_weights, activation=act)
+        weights=_deconv_weights, activation=act)
 
 
 def conv1d(cfg, _v):
@@ -320,7 +342,12 @@ def activation(cfg, _v):
 
 
 def leaky_relu(cfg, _v):
-    return Converted(layer=ActivationLayer(activation=Activation.LEAKYRELU),
+    """Keras 1/2 carry the slope as ``alpha`` (default 0.3), Keras 3 as
+    ``negative_slope`` — dropped entirely before the k3_conv fixture
+    caught the 0.3-vs-0.01 divergence."""
+    alpha = float(cfg.get("negative_slope", cfg.get("alpha", 0.3)))
+    return Converted(layer=ActivationLayer(activation=Activation.LEAKYRELU,
+                                           alpha=alpha),
                      activation=Activation.LEAKYRELU)
 
 
@@ -371,9 +398,13 @@ def simple_rnn(cfg, _v):
 
 
 def flatten(cfg, _v):
-    # shape-only: this framework auto-inserts Cnn→FF preprocessors from
-    # InputType inference (reference inserts KerasFlatten preprocessor)
-    return Converted(skip=True)
+    """Real flatten (ReshapeLayer to 1-D), not a skip: skipping only
+    works when the next layer's n_in inference collapses the shape the
+    same way, which is true after convs (Cnn→FF preprocessor) but WRONG
+    after recurrent/2-D tensors — a Dense after a skipped Flatten of
+    (T, F) silently became per-timestep (caught by the k3_merges
+    fixture). Row-major like Keras."""
+    return Converted(layer=ReshapeLayer(shape=(-1,)))
 
 
 def reshape(cfg, _v):
@@ -572,10 +603,8 @@ def softmax_layer(cfg, _v):
 
 def elu_layer(cfg, _v):
     alpha = float(cfg.get("alpha", 1.0))
-    if alpha != 1.0:
-        raise ValueError(
-            f"unsupported ELU config: alpha={alpha} (only 1.0)")
-    return Converted(layer=ActivationLayer(activation=Activation.ELU),
+    return Converted(layer=ActivationLayer(activation=Activation.ELU,
+                                           alpha=alpha),
                      activation=Activation.ELU)
 
 

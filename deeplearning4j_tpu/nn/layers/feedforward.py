@@ -9,6 +9,7 @@ only; backward is ``jax.grad``.
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -255,8 +256,12 @@ class ElementWiseMultiplicationLayer(FeedForwardLayer):
 @register_serializable
 @dataclasses.dataclass(frozen=True)
 class ActivationLayer(Layer):
-    """Standalone activation (reference: nn/conf/layers/ActivationLayer)."""
+    """Standalone activation (reference: nn/conf/layers/ActivationLayer).
+    ``alpha`` parameterizes LEAKYRELU (negative slope; the reference's
+    ActivationLReLU(alpha)) and ELU — None keeps each function's
+    default (leaky 0.01, elu 1.0)."""
     activation: Activation = Activation.RELU
+    alpha: Optional[float] = None
 
     @property
     def has_params(self):
@@ -266,6 +271,11 @@ class ActivationLayer(Layer):
         return input_type
 
     def apply(self, params, state, x, ctx):
+        if self.alpha is not None:
+            if self.activation == Activation.LEAKYRELU:
+                return jax.nn.leaky_relu(x, self.alpha), state
+            if self.activation == Activation.ELU:
+                return jax.nn.elu(x, self.alpha), state
         return self.activation.apply(x), state
 
 

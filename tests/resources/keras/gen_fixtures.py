@@ -438,9 +438,78 @@ def k2_selu_alpha_dropout():
     save_io("k2_selu_alpha_dropout", x, softmax(dense(h, Wd2, bd2)))
 
 
+def write_k1_model(path, layers, input_layers, output_layers,
+                   layer_weights):
+    """Keras-1 FUNCTIONAL file: class_name 'Model', layers carrying
+    K1-style inbound_nodes [[["src", 0, 0], ...]]."""
+    with h5py.File(path, "w") as f:
+        f.attrs["keras_version"] = np.bytes_("1.2.2")
+        f.attrs["model_config"] = np.bytes_(json.dumps(
+            {"class_name": "Model", "config": {
+                "name": "model_1", "layers": layers,
+                "input_layers": [[n, 0, 0] for n in input_layers],
+                "output_layers": [[n, 0, 0] for n in output_layers]}}))
+        mw = f.create_group("model_weights")
+        mw.attrs["layer_names"] = np.array(
+            [np.bytes_(n) for n in layer_weights])
+        for lname, weights in layer_weights.items():
+            g = mw.create_group(lname)
+            g.attrs["weight_names"] = np.array(
+                [np.bytes_(wn) for wn in weights])
+            for wn, arr in weights.items():
+                g.create_dataset(wn, data=arr.astype(np.float32))
+
+
+def k1_merge():
+    """Keras-1 functional Model with the K1 ``Merge`` layer in two modes
+    (sum + concat) — the 'Merge: resolved by mode' registry row gets
+    real e2e coverage."""
+    Wa = RNG.normal(0, 0.3, (6, 5))
+    ba = RNG.normal(0, 0.05, (5,))
+    Wb = RNG.normal(0, 0.3, (6, 5))
+    bb = RNG.normal(0, 0.05, (5,))
+    Wo = RNG.normal(0, 0.3, (10, 3))
+    bo = RNG.normal(0, 0.05, (3,))
+    layers = [
+        {"class_name": "InputLayer", "name": "in_1",
+         "config": {"name": "in_1",
+                    "batch_input_shape": [None, 6]},
+         "inbound_nodes": []},
+        {"class_name": "Dense", "name": "dense_a",
+         "config": {"name": "dense_a", "output_dim": 5,
+                    "activation": "tanh", "bias": True},
+         "inbound_nodes": [[["in_1", 0, 0]]]},
+        {"class_name": "Dense", "name": "dense_b",
+         "config": {"name": "dense_b", "output_dim": 5,
+                    "activation": "sigmoid", "bias": True},
+         "inbound_nodes": [[["in_1", 0, 0]]]},
+        {"class_name": "Merge", "name": "merge_sum",
+         "config": {"name": "merge_sum", "mode": "sum"},
+         "inbound_nodes": [[["dense_a", 0, 0], ["dense_b", 0, 0]]]},
+        {"class_name": "Merge", "name": "merge_cat",
+         "config": {"name": "merge_cat", "mode": "concat"},
+         "inbound_nodes": [[["merge_sum", 0, 0], ["dense_a", 0, 0]]]},
+        {"class_name": "Dense", "name": "dense_out",
+         "config": {"name": "dense_out", "output_dim": 3,
+                    "activation": "linear", "bias": True},
+         "inbound_nodes": [[["merge_cat", 0, 0]]]},
+    ]
+    weights = {"dense_a": {"dense_a_W": Wa, "dense_a_b": ba},
+               "dense_b": {"dense_b_W": Wb, "dense_b_b": bb},
+               "merge_sum": {}, "merge_cat": {},
+               "dense_out": {"dense_out_W": Wo, "dense_out_b": bo}}
+    write_k1_model(os.path.join(HERE, "k1_merge.h5"), layers,
+                   ["in_1"], ["dense_out"], weights)
+    x = RNG.normal(0, 1, (4, 6))
+    a = np.tanh(dense(x, Wa, ba))
+    b = 1.0 / (1.0 + np.exp(-dense(x, Wb, bb)))
+    cat = np.concatenate([a + b, a], axis=1)
+    save_io("k1_merge", x, dense(cat, Wo, bo))
+
+
 if __name__ == "__main__":
-    for fn in (k1_mlp, k1_cnn_atrous, k1_lstm, k2_googlenet_bits,
-               k2_yolo_bits, k2_temporal, k2_reshape_permute,
-               k2_selu_alpha_dropout):
+    for fn in (k1_mlp, k1_cnn_atrous, k1_lstm, k1_merge,
+               k2_googlenet_bits, k2_yolo_bits, k2_temporal,
+               k2_reshape_permute, k2_selu_alpha_dropout):
         fn()
         print("wrote", fn.__name__)

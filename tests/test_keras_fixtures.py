@@ -26,6 +26,68 @@ FIXTURES = ["k1_mlp", "k1_cnn_atrous", "k1_lstm",
             "k2_googlenet_bits", "k2_yolo_bits", "k2_temporal",
             "k2_reshape_permute", "k2_selu_alpha_dropout"]
 
+# functional fixtures (CG import): K1 Merge graph + the Keras-3 corpus
+# written by gen_keras3_fixtures.py with Keras' own outputs as goldens
+FUNC_FIXTURES = ["k1_merge", "k3_conv", "k3_temporal", "k3_merges",
+                 "k3_attention", "k3_pool_extras"]
+
+
+def _fixture_path(name):
+    ext = ".keras" if name.startswith("k3_") else ".h5"
+    return os.path.join(HERE, f"{name}{ext}")
+
+
+@pytest.mark.parametrize("name", FUNC_FIXTURES)
+def test_functional_fixture_end_to_end(name):
+    model = import_keras_model_and_weights(_fixture_path(name))
+    io = np.load(os.path.join(HERE, f"{name}_io.npz"))
+    out = np.asarray(model.output(io["x"]))
+    np.testing.assert_allclose(out, io["y"], rtol=1e-4, atol=1e-5)
+
+
+def test_registry_fully_covered():
+    """Executable supported-layer contract (VERDICT r4 #5): every
+    converter in the registry appears in >=1 committed e2e fixture
+    (aliases inherit their canonical converter's coverage); a new
+    converter cannot land without fixture evidence."""
+    from deeplearning4j_tpu.modelimport.manifest import (
+        coverage, supported_layers, uncovered)
+    assert uncovered(HERE) == []
+    cov = coverage(HERE)
+    assert set(cov) == set(supported_layers())
+    # spot-evidence the mapping is real, not vacuous
+    assert "k3_conv" in cov["Conv2DTranspose"]
+    assert "k1_merge" in cov["Merge"]
+    assert "k2_yolo_bits" in cov["SpaceToDepth"]
+    assert "k1_cnn_atrous" in cov["AtrousConvolution2D"]
+    assert cov["add"] == cov["Add"] != []
+
+
+def test_manifest_renders():
+    from deeplearning4j_tpu.modelimport.manifest import render_markdown
+    md = render_markdown(HERE)
+    assert "| Conv2D" in md and "alias of Conv2D" in md
+
+
+def test_committed_manifest_doc_current():
+    """SUPPORTED_KERAS_LAYERS.md must carry exactly what
+    render_markdown() produces — the doc cannot drift from the code it
+    claims to render from."""
+    from deeplearning4j_tpu.modelimport.manifest import render_markdown
+    doc = open(os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "SUPPORTED_KERAS_LAYERS.md")).read()
+    assert render_markdown(HERE) in doc
+
+
+def test_fused_leaky_relu_string_rejected():
+    """Keras 3's fused 'leaky_relu' string (slope 0.2) is not
+    representable in the fused activation enum (fixed 0.01) — must
+    error clearly, never import silently wrong."""
+    from deeplearning4j_tpu.modelimport.layers import convert_layer
+    with pytest.raises(ValueError, match="standalone"):
+        convert_layer("Dense", {"units": 4, "activation": "leaky_relu"},
+                      3)
+
 
 @pytest.mark.parametrize("name", FIXTURES)
 def test_fixture_end_to_end(name):
