@@ -337,7 +337,17 @@ def batchnorm(cfg, _v):
 
 
 def activation(cfg, _v):
-    act = map_activation(cfg["activation"])
+    name = cfg["activation"]
+    if name in ("leaky_relu", "LeakyReLU"):
+        # the STANDALONE Activation layer can carry the slope exactly
+        # (ActivationLayer.alpha) — only the fused-in-Dense string form
+        # is unrepresentable (map_activation rejects it)
+        return Converted(
+            layer=ActivationLayer(activation=Activation.LEAKYRELU,
+                                  alpha=float(cfg.get("negative_slope",
+                                                      0.2))),
+            activation=Activation.LEAKYRELU)
+    act = map_activation(name)
     return Converted(layer=ActivationLayer(activation=act), activation=act)
 
 

@@ -89,10 +89,8 @@ def fixture_layer_classes(path: str) -> Set[str]:
     return _layer_classes(cfg)
 
 
-def coverage(fixture_dir: str = DEFAULT_FIXTURE_DIR
-             ) -> Dict[str, List[str]]:
-    """supported class name → sorted fixtures exercising it (directly,
-    or via any registry name sharing the converter function)."""
+def _by_class(fixture_dir: str) -> Dict[str, Set[str]]:
+    """class name → fixture names containing it, over the corpus dir."""
     by_class: Dict[str, Set[str]] = {}
     for fn in sorted(os.listdir(fixture_dir)):
         if not (fn.endswith(".h5") or fn.endswith(".keras")):
@@ -100,6 +98,14 @@ def coverage(fixture_dir: str = DEFAULT_FIXTURE_DIR
         name = fn.rsplit(".", 1)[0]
         for cls in fixture_layer_classes(os.path.join(fixture_dir, fn)):
             by_class.setdefault(cls, set()).add(name)
+    return by_class
+
+
+def coverage(fixture_dir: str = DEFAULT_FIXTURE_DIR
+             ) -> Dict[str, List[str]]:
+    """supported class name → sorted fixtures exercising it (directly,
+    or via any registry name sharing the converter function)."""
+    by_class = _by_class(fixture_dir)
     groups = _alias_groups()
     out: Dict[str, List[str]] = {}
     for cls in supported_layers():
@@ -120,13 +126,7 @@ def uncovered(fixture_dir: str = DEFAULT_FIXTURE_DIR) -> List[str]:
 def render_markdown(fixture_dir: str = DEFAULT_FIXTURE_DIR) -> str:
     """The docs table: every supported layer with its fixture evidence
     (docs render from the same code path the test enforces)."""
-    by_class: Dict[str, Set[str]] = {}
-    for fn in sorted(os.listdir(fixture_dir)):
-        if fn.endswith(".h5") or fn.endswith(".keras"):
-            name = fn.rsplit(".", 1)[0]
-            for cls in fixture_layer_classes(
-                    os.path.join(fixture_dir, fn)):
-                by_class.setdefault(cls, set()).add(name)
+    by_class = _by_class(fixture_dir)
     groups = _alias_groups()
     lines = ["| Keras layer | e2e fixtures |", "|---|---|"]
     for cls, fixtures in coverage(fixture_dir).items():
