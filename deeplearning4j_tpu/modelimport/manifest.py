@@ -23,7 +23,7 @@ from __future__ import annotations
 import json
 import os
 import zipfile
-from typing import Dict, List, Set
+from typing import Dict, List, Optional, Set
 
 DEFAULT_FIXTURE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(
@@ -101,11 +101,14 @@ def _by_class(fixture_dir: str) -> Dict[str, Set[str]]:
     return by_class
 
 
-def coverage(fixture_dir: str = DEFAULT_FIXTURE_DIR
+def coverage(fixture_dir: str = DEFAULT_FIXTURE_DIR,
+             by_class: Optional[Dict[str, Set[str]]] = None
              ) -> Dict[str, List[str]]:
     """supported class name → sorted fixtures exercising it (directly,
-    or via any registry name sharing the converter function)."""
-    by_class = _by_class(fixture_dir)
+    or via any registry name sharing the converter function).
+    ``by_class`` lets callers that already walked the corpus reuse it."""
+    if by_class is None:
+        by_class = _by_class(fixture_dir)
     groups = _alias_groups()
     out: Dict[str, List[str]] = {}
     for cls in supported_layers():
@@ -129,7 +132,7 @@ def render_markdown(fixture_dir: str = DEFAULT_FIXTURE_DIR) -> str:
     by_class = _by_class(fixture_dir)
     groups = _alias_groups()
     lines = ["| Keras layer | e2e fixtures |", "|---|---|"]
-    for cls, fixtures in coverage(fixture_dir).items():
+    for cls, fixtures in coverage(fixture_dir, by_class).items():
         note = ""
         if not by_class.get(cls):
             direct = sorted(n for n in groups.get(cls, set())

@@ -38,18 +38,21 @@ svg text{font:11px sans-serif} .node rect{fill:#eef;stroke:#88a}
 img.act{image-rendering:pixelated;border:1px solid #ccc;margin:4px}
 </style></head><body>
 <nav id=nav>
-<a href=#overview class=on>Overview</a><a href=#model>Model</a>
-<a href=#system>System</a><a href=#activations>Activations</a>
-<a href=#tsne>t-SNE</a><a href=#evaluation>Evaluation</a></nav>
+<a href=#overview class=on>{{i18n:train.nav.overview}}</a>
+<a href=#model>{{i18n:train.nav.model}}</a>
+<a href=#system>{{i18n:train.nav.system}}</a>
+<a href=#activations>{{i18n:train.nav.activations}}</a>
+<a href=#tsne>{{i18n:train.nav.tsne}}</a>
+<a href=#evaluation>{{i18n:train.nav.evaluation}}</a></nav>
 <div id=overview class="tab on">
-<h2>Training overview</h2>
-<div class=card><b>Score vs iteration</b><canvas id=score></canvas></div>
-<div class=card><b>Samples/sec</b><canvas id=tput></canvas></div>
+<h2>{{i18n:train.overview.title}}</h2>
+<div class=card><b>{{i18n:train.overview.score}}</b><canvas id=score></canvas></div>
+<div class=card><b>{{i18n:train.overview.throughput}}</b><canvas id=tput></canvas></div>
 <div class=card><b>Per-layer mean |param|</b><canvas id=pm></canvas></div>
 <div class=card><b>Session</b><table id=info></table></div>
 </div>
 <div id=model class=tab>
-<h2>Model graph</h2>
+<h2>{{i18n:train.model.title}}</h2>
 <div class=card><svg id=dag width="100%" height="500"></svg></div>
 <div class=card><b>Layer detail</b> <span id=lname></span>
 <table id=ldetail></table>
@@ -60,13 +63,13 @@ img.act{image-rendering:pixelated;border:1px solid #ccc;margin:4px}
 <canvas id=luhist style="height:140px"></canvas></div>
 </div>
 <div id=system class=tab>
-<h2>System</h2>
+<h2>{{i18n:train.system.title}}</h2>
 <div class=card><b>Device memory (bytes in use)</b>
 <canvas id=mem></canvas></div>
 <div class=card><b>ETL ms / iteration</b><canvas id=etl></canvas></div>
 </div>
 <div id=activations class=tab>
-<h2>Layer activations</h2>
+<h2>{{i18n:train.activations.title}}</h2>
 <div class=card>iteration:
 <input type=range id=actslider min=0 max=0 step=1 value=0
 style="width:60%">
@@ -79,7 +82,7 @@ ConvolutionalListener</div>
 <div class=card><canvas id=tsneplot style="height:480px"></canvas></div>
 </div>
 <div id=evaluation class=tab>
-<h2>Evaluation</h2>
+<h2>{{i18n:train.evaluation.title}}</h2>
 <div class=card><b id=roctitle>ROC curve</b>
 <canvas id=rocplot style="height:260px"></canvas></div>
 <div class=card><b id=prtitle>Precision-recall curve</b>
@@ -330,6 +333,8 @@ tick(); setInterval(tick, 2000);
 class _Handler(BaseHTTPRequestHandler):
     server_version = "DL4JTpuUI/1.0"
     storage: StatsStorage = None   # set by UIServer
+    modules: list = []             # registered UIModule instances
+    modules_routes: list = []      # their merged Route list
 
     def log_message(self, *a):   # silence request logging
         pass
@@ -345,12 +350,24 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         u = urlparse(self.path)
         if u.path in ("/", "/train", "/train/overview"):
-            body = _PAGE.encode()
+            from deeplearning4j_tpu.ui.i18n import I18N
+            q = parse_qs(u.query)
+            lang = q.get("lang", [None])[0]
+            body = I18N.get_instance().render(_PAGE, lang).encode()
             self.send_response(200)
-            self.send_header("Content-Type", "text/html")
+            self.send_header("Content-Type", "text/html; charset=utf-8")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+            return
+        if u.path == "/api/i18n":
+            from deeplearning4j_tpu.ui.i18n import I18N
+            q = parse_qs(u.query)
+            lang = q.get("lang", [None])[0]
+            i18n = I18N.get_instance()
+            self._json({"language": lang or i18n.default_language,
+                        "languages": i18n.languages(),
+                        "messages": i18n.messages(lang)})
             return
         if u.path == "/api/sessions":
             self._json(self.storage.list_session_ids())
@@ -455,7 +472,43 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(getattr(self.server, "evaluation_data", None)
                        or {})
             return
+        if self._try_module_route("GET", u, None):
+            return
         self._json({"error": "not found"}, 404)
+
+    def _try_module_route(self, method: str, u, body) -> bool:
+        """Dispatch to a registered UIModule route (the UIModule.java
+        SPI); built-in routes have already had their chance, so core
+        paths cannot be shadowed."""
+        from deeplearning4j_tpu.ui.modules import UIModuleContext
+        for route in self.modules_routes:
+            if route.method != method or route.path != u.path:
+                continue
+            q = {k: v[0] for k, v in parse_qs(u.query).items()}
+            ctx = UIModuleContext(storage=self.storage,
+                                  server=self.server)
+            try:
+                out = route.handler(ctx, q, body)
+                if isinstance(out, tuple):
+                    payload, ctype = out
+                    if isinstance(payload, str):
+                        payload = payload.encode("utf-8")
+                    payload = bytes(payload)
+                else:
+                    payload, ctype = None, None
+            except Exception as e:            # module bug ≠ server crash
+                self._json({"error": f"module route failed: {e}"}, 500)
+                return True
+            if payload is not None:
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+            else:
+                self._json(out)
+            return True
+        return False
 
     def _session(self, u) -> Optional[str]:
         q = parse_qs(u.query)
@@ -524,7 +577,21 @@ class _Handler(BaseHTTPRequestHandler):
             return
         # RemoteReceiverModule analog: accept remote-routed records
         if path != "/remote":
-            self._json({"error": "not found"}, 404)
+            u = urlparse(self.path)
+            # match the route BEFORE touching the body: a routing miss
+            # must 404, not 400 on an unparseable probe payload
+            if not any(r.method == "POST" and r.path == u.path
+                       for r in self.modules_routes):
+                self._json({"error": "not found"}, 404)
+                return
+            try:
+                body = self._read_json_body()
+            except (ValueError, json.JSONDecodeError) as e:
+                self._json({"error": str(e)}, 400)
+                return
+            if body is None:
+                return
+            self._try_module_route("POST", u, body)
             return
         try:
             payload = self._read_json_body()
@@ -540,6 +607,11 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, KeyError, json.JSONDecodeError) as e:
             self._json({"error": str(e)}, 400)
             return
+        for m in self.modules:              # UIModule.reportStorageEvents
+            try:
+                m.on_update(record)
+            except Exception:               # module bug ≠ stored-record
+                pass                        # failure or server crash
         self._json({"ok": True})
 
     def _overview(self, session_id: Optional[str]) -> dict:
@@ -574,6 +646,7 @@ class UIServer:
         self.storage: Optional[StatsStorage] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._modules: List = []
 
     @classmethod
     def get_instance(cls, port: int = 9000) -> "UIServer":
@@ -585,6 +658,25 @@ class UIServer:
         self.storage = storage
         if self._httpd is not None:
             self._httpd.RequestHandlerClass.storage = storage
+        for m in self._modules:
+            m.on_attach(storage)
+        return self
+
+    def register_module(self, module):
+        """Plug a UIModule into the dashboard (reference:
+        PlayUIServer's uiModules list — custom modules merge their
+        routes; built-in paths cannot be shadowed)."""
+        from deeplearning4j_tpu.ui.modules import UIModule
+        if not isinstance(module, UIModule):
+            raise TypeError(f"expected a UIModule, got {type(module)}")
+        self._modules.append(module)
+        if self.storage is not None:
+            module.on_attach(self.storage)
+        if self._httpd is not None:
+            h = self._httpd.RequestHandlerClass
+            h.modules = list(self._modules)
+            h.modules_routes = [r for m in self._modules
+                                for r in m.get_routes()]
         return self
 
     def start(self):
@@ -595,7 +687,10 @@ class UIServer:
                 "attach(stats_storage) before start() — the UI has "
                 "nothing to serve otherwise")
         handler = type("BoundHandler", (_Handler,),
-                       {"storage": self.storage})
+                       {"storage": self.storage,
+                        "modules": list(self._modules),
+                        "modules_routes": [r for m in self._modules
+                                           for r in m.get_routes()]})
         self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port),
                                           handler)
         self.port = self._httpd.server_address[1]   # resolves port 0

@@ -331,3 +331,120 @@ class TestStatsPipeline:
                 assert json.loads(r.read())["labels"] == ["z"]
         finally:
             server.stop()
+
+
+class TestUIModuleSPI:
+    """UIModule SPI + i18n (round 5 — reference: UIModule.java routes +
+    I18NProvider/DefaultI18N bundles)."""
+
+    def _srv(self):
+        from deeplearning4j_tpu.ui.server import UIServer
+        from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+        return UIServer(port=0).attach(InMemoryStatsStorage())
+
+    def test_custom_module_routes(self):
+        import json as _json
+        import urllib.request
+        from deeplearning4j_tpu.ui.modules import Route, UIModule
+
+        class EchoModule(UIModule):
+            def __init__(self):
+                self.attached = None
+                self.records = []
+
+            def get_routes(self):
+                return [
+                    Route("GET", "/api/echo",
+                          lambda ctx, q, body: {
+                              "echo": q.get("msg", ""),
+                              "has_storage": ctx.storage is not None}),
+                    Route("POST", "/api/echo",
+                          lambda ctx, q, body: {"got": body}),
+                ]
+
+            def on_attach(self, storage):
+                self.attached = storage
+
+            def on_update(self, record):
+                self.records.append(record)
+
+        mod = EchoModule()
+        srv = self._srv().register_module(mod).start()
+        try:
+            assert mod.attached is not None
+            with urllib.request.urlopen(
+                    srv.url + "/api/echo?msg=hi") as r:
+                data = _json.loads(r.read())
+            assert data == {"echo": "hi", "has_storage": True}
+            req = urllib.request.Request(
+                srv.url + "/api/echo",
+                data=_json.dumps({"x": 1}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as r:
+                assert _json.loads(r.read()) == {"got": {"x": 1}}
+            # remote records fan out to modules (reportStorageEvents)
+            req = urllib.request.Request(
+                srv.url + "/remote",
+                data=_json.dumps({"record": {"session_id": "s",
+                                             "score": 1.0}}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as r:
+                assert _json.loads(r.read())["ok"]
+            assert mod.records and mod.records[0]["score"] == 1.0
+        finally:
+            srv.stop()
+
+    def test_module_error_does_not_kill_server(self):
+        import json as _json
+        import urllib.error
+        import urllib.request
+        from deeplearning4j_tpu.ui.modules import Route, UIModule
+
+        class BadModule(UIModule):
+            def get_routes(self):
+                return [Route("GET", "/api/boom",
+                              lambda ctx, q, body: 1 / 0)]
+
+        srv = self._srv().register_module(BadModule()).start()
+        try:
+            try:
+                urllib.request.urlopen(srv.url + "/api/boom")
+                raise AssertionError("expected 500")
+            except urllib.error.HTTPError as e:
+                assert e.code == 500
+                assert "module route failed" in _json.loads(
+                    e.read())["error"]
+            # server still serves built-ins afterwards
+            with urllib.request.urlopen(srv.url + "/api/sessions") as r:
+                assert r.status == 200
+        finally:
+            srv.stop()
+
+    def test_i18n_bundles_and_page(self):
+        import json as _json
+        import urllib.request
+        from deeplearning4j_tpu.ui.i18n import I18N
+
+        i18n = I18N.get_instance()
+        assert i18n.get_message("train.nav.overview") == "Overview"
+        assert i18n.get_message("train.nav.overview", "ja") == "概要"
+        assert i18n.get_message("train.nav.overview", "de") == "Übersicht"
+        # unknown key falls through to itself; unknown lang → English
+        assert i18n.get_message("no.such.key", "ja") == "no.such.key"
+        assert i18n.get_message("train.nav.model", "xx") == "Model"
+
+        srv = self._srv().start()
+        try:
+            with urllib.request.urlopen(srv.url + "/?lang=ja") as r:
+                page = r.read().decode("utf-8")
+            assert "概要" in page and "{{i18n:" not in page
+            with urllib.request.urlopen(srv.url + "/") as r:
+                page = r.read().decode("utf-8")
+            assert "Overview" in page
+            with urllib.request.urlopen(
+                    srv.url + "/api/i18n?lang=de") as r:
+                data = _json.loads(r.read())
+            assert data["messages"]["train.nav.system"] == "System"
+            assert "ja" in data["languages"]
+        finally:
+            srv.stop()
