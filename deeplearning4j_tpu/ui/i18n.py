@@ -13,9 +13,13 @@ template-substitution job Play's message interpolation does.
 
 from __future__ import annotations
 
+import functools
+import re
 from typing import Dict, Optional
 
 DEFAULT_LANGUAGE = "en"
+
+_PLACEHOLDER = re.compile(r"\{\{i18n:([a-zA-Z0-9_.]+)\}\}")
 
 _BUNDLES: Dict[str, Dict[str, str]] = {
     "en": {
@@ -90,20 +94,30 @@ class I18N:
                              f"{self.languages()}")
         self.default_language = lang
 
+    def resolve_language(self, lang: Optional[str]) -> str:
+        """The language actually served: unknown/absent codes fall back
+        to the default (clients must see the EFFECTIVE language, not an
+        echo of what they asked for)."""
+        if lang and lang in _BUNDLES:
+            return lang
+        return self.default_language
+
     def get_message(self, key: str, lang: Optional[str] = None) -> str:
-        lang = lang or self.default_language
-        bundle = _BUNDLES.get(lang, _BUNDLES[DEFAULT_LANGUAGE])
+        bundle = _BUNDLES[self.resolve_language(lang)]
         return bundle.get(key, _BUNDLES[DEFAULT_LANGUAGE].get(key, key))
 
     def messages(self, lang: Optional[str] = None) -> Dict[str, str]:
-        lang = lang or self.default_language
         out = dict(_BUNDLES[DEFAULT_LANGUAGE])
-        out.update(_BUNDLES.get(lang, {}))
+        out.update(_BUNDLES[self.resolve_language(lang)])
         return out
 
     def render(self, template: str, lang: Optional[str] = None) -> str:
-        """Substitute ``{{i18n:key}}`` placeholders."""
-        import re
-        return re.sub(
-            r"\{\{i18n:([a-zA-Z0-9_.]+)\}\}",
-            lambda m: self.get_message(m.group(1), lang), template)
+        """Substitute ``{{i18n:key}}`` placeholders (cached per
+        language — the bundles and template are static)."""
+        return _render_cached(self, template, self.resolve_language(lang))
+
+
+@functools.lru_cache(maxsize=16)
+def _render_cached(i18n: "I18N", template: str, lang: str) -> str:
+    return _PLACEHOLDER.sub(
+        lambda m: i18n.get_message(m.group(1), lang), template)

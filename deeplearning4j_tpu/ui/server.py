@@ -365,7 +365,7 @@ class _Handler(BaseHTTPRequestHandler):
             q = parse_qs(u.query)
             lang = q.get("lang", [None])[0]
             i18n = I18N.get_instance()
-            self._json({"language": lang or i18n.default_language,
+            self._json({"language": i18n.resolve_language(lang),
                         "languages": i18n.languages(),
                         "messages": i18n.messages(lang)})
             return
@@ -472,43 +472,47 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(getattr(self.server, "evaluation_data", None)
                        or {})
             return
-        if self._try_module_route("GET", u, None):
+        route = self._match_module_route("GET", u.path)
+        if route is not None:
+            self._run_module_route(route, u, None)
             return
         self._json({"error": "not found"}, 404)
 
-    def _try_module_route(self, method: str, u, body) -> bool:
+    def _match_module_route(self, method: str, path: str):
+        """The ONE place route matching happens (404-before-body in
+        do_POST and dispatch both use it)."""
+        for route in self.modules_routes:
+            if route.method == method and route.path == path:
+                return route
+        return None
+
+    def _run_module_route(self, route, u, body) -> None:
         """Dispatch to a registered UIModule route (the UIModule.java
         SPI); built-in routes have already had their chance, so core
         paths cannot be shadowed."""
         from deeplearning4j_tpu.ui.modules import UIModuleContext
-        for route in self.modules_routes:
-            if route.method != method or route.path != u.path:
-                continue
-            q = {k: v[0] for k, v in parse_qs(u.query).items()}
-            ctx = UIModuleContext(storage=self.storage,
-                                  server=self.server)
-            try:
-                out = route.handler(ctx, q, body)
-                if isinstance(out, tuple):
-                    payload, ctype = out
-                    if isinstance(payload, str):
-                        payload = payload.encode("utf-8")
-                    payload = bytes(payload)
-                else:
-                    payload, ctype = None, None
-            except Exception as e:            # module bug ≠ server crash
-                self._json({"error": f"module route failed: {e}"}, 500)
-                return True
-            if payload is not None:
-                self.send_response(200)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(payload)))
-                self.end_headers()
-                self.wfile.write(payload)
+        q = {k: v[0] for k, v in parse_qs(u.query).items()}
+        ctx = UIModuleContext(storage=self.storage, server=self.server)
+        try:
+            out = route.handler(ctx, q, body)
+            if isinstance(out, tuple):
+                payload, ctype = out
+                if isinstance(payload, str):
+                    payload = payload.encode("utf-8")
+                payload = bytes(payload)
             else:
-                self._json(out)
-            return True
-        return False
+                payload, ctype = None, None
+        except Exception as e:                # module bug ≠ server crash
+            self._json({"error": f"module route failed: {e}"}, 500)
+            return
+        if payload is not None:
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+        else:
+            self._json(out)
 
     def _session(self, u) -> Optional[str]:
         q = parse_qs(u.query)
@@ -580,8 +584,8 @@ class _Handler(BaseHTTPRequestHandler):
             u = urlparse(self.path)
             # match the route BEFORE touching the body: a routing miss
             # must 404, not 400 on an unparseable probe payload
-            if not any(r.method == "POST" and r.path == u.path
-                       for r in self.modules_routes):
+            route = self._match_module_route("POST", u.path)
+            if route is None:
                 self._json({"error": "not found"}, 404)
                 return
             try:
@@ -591,7 +595,7 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             if body is None:
                 return
-            self._try_module_route("POST", u, body)
+            self._run_module_route(route, u, body)
             return
         try:
             payload = self._read_json_body()

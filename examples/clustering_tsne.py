@@ -1,0 +1,54 @@
+"""Clustering + t-SNE — KMeans over feature vectors, VPTree
+nearest-neighbor lookup, and a Barnes-Hut t-SNE projection (the
+workflow the reference's deeplearning4j-nearestneighbors +
+dl4j-examples t-SNE tutorial covers).
+
+Run: JAX_PLATFORMS=cpu python examples/clustering_tsne.py
+"""
+
+import numpy as np
+
+from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
+from deeplearning4j_tpu.clustering.vptree import VPTree
+from deeplearning4j_tpu.manifold.tsne import BarnesHutTsne
+
+
+def main():
+    rng = np.random.default_rng(0)
+    # three well-separated gaussian blobs in 16-D
+    centers = rng.normal(0, 6.0, (3, 16))
+    labels = rng.integers(0, 3, 300)
+    x = (centers[labels] + rng.normal(0, 1.0, (300, 16))) \
+        .astype(np.float32)
+
+    # KMeans (reference API: KMeansClustering.setup(...).applyTo(points))
+    km = KMeansClustering.setup(n_clusters=3, max_iterations=50)
+    km.apply_to(x)
+    assign = km.predict(x)
+    # cluster purity vs the generating labels
+    purity = np.mean([
+        np.bincount(labels[assign == c]).max()
+        for c in range(3)]) / np.mean(np.bincount(assign))
+    print(f"kmeans: 3 clusters, purity ~{purity:.2f}")
+
+    # VPTree nearest neighbors: points in the same blob come back first
+    tree = VPTree(x)
+    idx, dists = tree.search(x[0], k=5)
+    print("5-NN of point 0 share its cluster:",
+          bool(np.all(labels[idx] == labels[0])))
+
+    # Barnes-Hut t-SNE down to 2-D (feed the coords to
+    # UIServer.upload_tsne to see them in the dashboard's t-SNE tab)
+    coords = BarnesHutTsne(perplexity=20.0, n_iter=250,
+                           seed=1).fit_transform(x)
+    # blobs stay separated in the embedding: mean within-cluster
+    # distance << mean between-cluster distance
+    within = np.mean([np.std(coords[labels == c], axis=0).mean()
+                      for c in range(3)])
+    between = np.std(coords, axis=0).mean()
+    print(f"t-SNE 2-D embedding: within-cluster spread {within:.2f} "
+          f"vs overall {between:.2f}")
+
+
+if __name__ == "__main__":
+    main()

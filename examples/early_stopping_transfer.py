@@ -1,0 +1,94 @@
+"""Early stopping + transfer learning — train with a validation-driven
+stop, then reuse the trunk on a new task (freeze + head replacement +
+bf16 fine-tune).
+
+Run: JAX_PLATFORMS=cpu python examples/early_stopping_transfer.py
+(analog of the reference's EarlyStoppingMNIST + TransferLearning
+tutorials, dl4j-examples/)
+"""
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import (
+    ArrayDataSetIterator,
+    DataSet,
+)
+from deeplearning4j_tpu.earlystopping import (
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    EarlyStoppingTrainer,
+    MaxEpochsTerminationCondition,
+    ScoreImprovementEpochsTerminationCondition,
+)
+from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+from deeplearning4j_tpu.nn.layers.output import OutputLayer
+from deeplearning4j_tpu.nn.transferlearning import (
+    FineTuneConfiguration,
+    TransferLearning,
+)
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.optimize.updaters import Adam, Sgd
+
+
+def blobs(rng, n, n_classes, dim=12, spread=2.5):
+    centers = rng.normal(0, spread, (n_classes, dim))
+    yi = rng.integers(0, n_classes, n)
+    x = centers[yi] + rng.normal(0, 1.0, (n, dim))
+    y = np.eye(n_classes, dtype=np.float32)[yi]
+    return x.astype(np.float32), y
+
+
+def main():
+    rng = np.random.default_rng(7)
+    x, y = blobs(rng, 512, 4)
+    xv, yv = blobs(rng, 128, 4)
+
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(7).updater(Adam(5e-3)).list()
+            .layer(DenseLayer(n_out=32, activation=Activation.RELU))
+            .layer(DenseLayer(n_out=16, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=4))
+            .set_input_type(InputType.feed_forward(12))
+            .build())
+
+    # early stopping: stop when validation loss stalls for 3 epochs
+    esc = (EarlyStoppingConfiguration.Builder()
+           .epoch_termination_conditions(
+               MaxEpochsTerminationCondition(30),
+               ScoreImprovementEpochsTerminationCondition(3))
+           .score_calculator(DataSetLossCalculator(
+               ArrayDataSetIterator(DataSet(xv, yv), batch_size=64)))
+           .evaluate_every_n_epochs(1)
+           .build())
+    result = EarlyStoppingTrainer(
+        esc, MultiLayerNetwork(conf),
+        ArrayDataSetIterator(DataSet(x, y), batch_size=64)).fit()
+    print(f"stopped: {result.termination_reason} "
+          f"(best epoch {result.best_model_epoch}, "
+          f"score {result.best_model_score:.4f})")
+    base = result.best_model
+
+    # transfer: freeze the trunk, swap the 4-way head for 3 classes,
+    # fine-tune at bf16 compute (the TPU recipe)
+    x3, y3 = blobs(rng, 256, 3)
+    ft = (TransferLearning.Builder(base)
+          .fine_tune_configuration(
+              FineTuneConfiguration.Builder().updater(Sgd(5e-2))
+              .compute_dtype("bfloat16").build())
+          .set_feature_extractor(1)          # freeze layers 0..1
+          .n_out_replace(2, 3)               # new 3-class head
+          .build())
+    ft.fit(ArrayDataSetIterator(DataSet(x3, y3), batch_size=64),
+           epochs=40)
+    ev = ft.evaluate(ArrayDataSetIterator(DataSet(x3, y3), batch_size=64))
+    print(f"fine-tuned accuracy on the new task: {ev.accuracy():.3f}")
+    w0 = np.asarray(base.train_state.params["layer_0"]["W"])
+    w0_ft = np.asarray(ft.train_state.params["layer_0"]["W"])
+    print("frozen trunk untouched:", bool(np.array_equal(w0, w0_ft)))
+
+
+if __name__ == "__main__":
+    main()

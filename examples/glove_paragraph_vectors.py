@@ -1,0 +1,61 @@
+"""GloVe + ParagraphVectors — co-occurrence embeddings and document
+vectors on a topical toy corpus (the reference's GloVe /
+ParagraphVectors tutorials, dl4j-examples/nlp).
+
+Run: JAX_PLATFORMS=cpu python examples/glove_paragraph_vectors.py
+"""
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.sentence_iterators import LabelledDocument
+from deeplearning4j_tpu.nlp.glove import Glove
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+
+
+def corpus(n=300, seed=0):
+    rng = np.random.default_rng(seed)
+    topics = {
+        "animals": ["cat", "dog", "bird", "fish", "horse", "fur",
+                    "paw", "tail"],
+        "vehicles": ["car", "truck", "train", "engine", "wheel",
+                     "road", "fuel", "driver"],
+    }
+    sents, labels = [], []
+    for _ in range(n):
+        t = rng.choice(sorted(topics))
+        sents.append(" ".join(rng.choice(topics[t], 10)))
+        labels.append(t)
+    return sents, labels
+
+
+def main():
+    sents, labels = corpus()
+
+    # GloVe: AdaGrad over the weighted log-co-occurrence objective
+    glove = Glove(layer_size=24, window_size=4, min_word_frequency=1,
+                  epochs=20, learning_rate=0.05, seed=3)
+    glove.fit(sents)
+    print("glove: cat~dog", round(glove.similarity("cat", "dog"), 3),
+          "vs cat~truck", round(glove.similarity("cat", "truck"), 3))
+    print("glove nearest to 'engine':",
+          glove.words_nearest("engine", top_n=3))
+
+    # ParagraphVectors (DBOW): label vectors live in the same space
+    docs = [LabelledDocument(content=s, labels=[f"doc_{i}"])
+            for i, s in enumerate(sents[:100])]
+    pv = ParagraphVectors(layer_size=24, window_size=4, epochs=10,
+                          negative=4, min_word_frequency=1, seed=5)
+    pv.fit(docs)
+    # two animal docs should be closer than an animal/vehicle pair
+    a = next(i for i, l in enumerate(labels[:100]) if l == "animals")
+    b = next(i for i, l in enumerate(labels[:100])
+             if l == "animals" and i != a)
+    v = next(i for i, l in enumerate(labels[:100]) if l == "vehicles")
+    same = pv.similarity(f"doc_{a}", f"doc_{b}")
+    diff = pv.similarity(f"doc_{a}", f"doc_{v}")
+    print(f"paragraph vectors: same-topic {same:.3f} "
+          f"vs cross-topic {diff:.3f}")
+
+
+if __name__ == "__main__":
+    main()
