@@ -1,0 +1,11 @@
+"""Shared example bootstrap: put the repo root on sys.path so every
+walkthrough runs as ``python examples/<name>.py`` without installing
+the package. Imported for its side effect (`import _bootstrap` — the
+script's own directory is first on sys.path, so this resolves here)."""
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)

@@ -6,6 +6,8 @@ dl4j-examples t-SNE tutorial covers).
 Run: JAX_PLATFORMS=cpu python examples/clustering_tsne.py
 """
 
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
+
 import numpy as np
 
 from deeplearning4j_tpu.clustering.kmeans import KMeansClustering

@@ -10,6 +10,8 @@ over the full recorded history, and the t-SNE tab refreshes itself from
 the live model's penultimate activations. Ctrl-C to stop.
 """
 
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")

@@ -7,6 +7,8 @@ Run on 8 virtual devices:
 On a real TPU host the same code uses all local chips.
 """
 
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
+
 import os
 
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")

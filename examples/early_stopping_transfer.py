@@ -7,6 +7,8 @@ Run: JAX_PLATFORMS=cpu python examples/early_stopping_transfer.py
 tutorials, dl4j-examples/)
 """
 
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
+
 import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import (

@@ -1,0 +1,65 @@
+"""Evaluation deep-dive — the full metric suite (top-N, MCC, F-beta,
+G-measure, false-alarm rate), ROC / precision-recall / calibration
+curve exports, and feeding them to the dashboard's Evaluation tab
+(reference: Evaluation.java + eval/curves/* + the UI's evaluation
+charts).
+
+Run: JAX_PLATFORMS=cpu python examples/evaluation_metrics_curves.py
+"""
+
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
+
+import numpy as np
+
+from deeplearning4j_tpu.evaluation.evaluation import (
+    ROC,
+    Evaluation,
+    EvaluationCalibration,
+)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    n, classes = 600, 5
+    labels = rng.integers(0, classes, n)
+    # a mediocre-on-purpose classifier: logits biased toward the truth
+    logits = rng.normal(0, 1.0, (n, classes))
+    logits[np.arange(n), labels] += 1.6
+    probs = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+
+    ev = Evaluation(num_classes=classes, top_n=2)
+    ev.eval(labels, probs)
+    print(f"accuracy        {ev.accuracy():.3f}")
+    print(f"top-2 accuracy  {ev.top_n_accuracy():.3f}")
+    print(f"macro F1        {ev.f1():.3f}   F2 {ev.f_beta(2.0):.3f}")
+    print(f"G-measure       {ev.g_measure():.3f}")
+    print(f"Matthews corr   {ev.matthews_correlation():.3f}")
+    print(f"false alarm     {ev.false_alarm_rate():.3f}")
+    print(ev.stats().splitlines()[-3])      # a per-class table row
+
+    # binary ROC + PR curves: exact, tie-collapsed threshold points
+    y_bin = (labels == 0).astype(float)
+    roc = ROC()
+    roc.eval(y_bin, probs[:, 0])
+    curve = roc.get_roc_curve()
+    pr = roc.get_precision_recall_curve()
+    print(f"AUC {roc.calculate_auc():.3f} "
+          f"({curve.num_points()} exact points), "
+          f"AUPRC {roc.calculate_auprc():.3f}")
+    t, p, r = pr.get_point_at_precision(0.5)
+    print(f"first threshold with precision>=0.5: {t:.3f} (recall {r:.3f})")
+
+    # calibration: reliability diagram + probability histogram
+    cal = EvaluationCalibration(reliability_bins=10)
+    onehot = np.eye(classes)[labels]
+    cal.eval(onehot, probs)
+    print(f"expected calibration error {cal.expected_calibration_error():.4f}")
+
+    # everything above renders in the dashboard's Evaluation tab:
+    #   srv = UIServer(port=9000).attach(InMemoryStatsStorage()).start()
+    #   srv.upload_evaluation(roc=roc, calibration=cal)
+    # (see examples/dashboard_training_ui.py for the server setup)
+
+
+if __name__ == "__main__":
+    main()

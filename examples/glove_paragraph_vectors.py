@@ -5,6 +5,8 @@ ParagraphVectors tutorials, dl4j-examples/nlp).
 Run: JAX_PLATFORMS=cpu python examples/glove_paragraph_vectors.py
 """
 
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
+
 import numpy as np
 
 from deeplearning4j_tpu.nlp.sentence_iterators import LabelledDocument

@@ -1,0 +1,55 @@
+"""Japanese NLP pipeline — morphological analysis (POS + readings) and
+word vectors over the CJK language pack (reference:
+deeplearning4j-nlp-japanese's Kuromoji tokenizer feeding Word2Vec).
+
+Run: JAX_PLATFORMS=cpu python examples/japanese_nlp_pipeline.py
+"""
+
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.language_packs import (
+    ChineseTokenizerFactory,
+    JapaneseTokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+
+def main():
+    ja = JapaneseTokenizerFactory()
+
+    # Kuromoji Token analog: surface + coarse ipadic POS + reading
+    print("-- morphological analysis --")
+    for t in ja.analyze("東京で日本語を勉強する。"):
+        print(f"  {t.surface}\t{t.part_of_speech}"
+              f"\t{t.reading or '-'}")
+
+    # the same factory drives Word2Vec (TokenizerFactory contract)
+    rng = np.random.default_rng(0)
+    sentences = [
+        "学生は学校で勉強する",       # school theme
+        "先生は学校で仕事をする",
+        "学生は学校に行く",
+        "会社で仕事をする",           # work theme
+        "電車で会社に行く",
+        "会社の仕事は大変",
+    ]
+    corpus = [sentences[i] for i in rng.integers(0, len(sentences), 400)]
+    w2v = Word2Vec(tokenizer_factory=ja, layer_size=16, window_size=3,
+                   min_word_frequency=2, epochs=8, negative=4, seed=1)
+    w2v.fit(corpus)
+    print("-- embeddings --")
+    print("  学校 ~ 学生:", round(w2v.similarity("学校", "学生"), 3),
+          " vs 学校 ~ 電車:", round(w2v.similarity("学校", "電車"), 3))
+    print("  nearest to 会社:", w2v.words_nearest("会社", top_n=3))
+
+    # Chinese unigram-DP segmenter from the same pack
+    zh = ChineseTokenizerFactory()
+    print("-- chinese segmentation --")
+    print(" ", "/".join(
+        zh.create("我们在学习机器学习和自然语言处理").get_tokens()))
+
+
+if __name__ == "__main__":
+    main()

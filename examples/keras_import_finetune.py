@@ -6,6 +6,8 @@ Run: JAX_PLATFORMS=cpu python examples/keras_import_finetune.py
 (requires keras to build the fixture; import itself needs only h5py)
 """
 
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
+
 import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet

@@ -5,6 +5,8 @@ Run: JAX_PLATFORMS=cpu python examples/lenet_mnist.py
 (analog of the reference's MNIST tutorial notebooks, dl4j-examples/)
 """
 
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
+
 import numpy as np
 
 from deeplearning4j_tpu.datasets.fetchers import MnistDataSetIterator

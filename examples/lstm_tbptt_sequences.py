@@ -4,6 +4,8 @@ inference (the reference's RNN tutorial workflow, SURVEY §5.7).
 Run: JAX_PLATFORMS=cpu python examples/lstm_tbptt_sequences.py
 """
 
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
+
 import numpy as np
 
 from deeplearning4j_tpu.datasets.fetchers import UciSequenceDataSetIterator

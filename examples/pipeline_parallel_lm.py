@@ -8,6 +8,8 @@ Four pipeline stages, each holding TWO interleaved transformer blocks
 outside the pipelined region and per-tick rematerialization.
 """
 
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
+
 import jax
 
 if jax.default_backend() == "cpu" and jax.device_count() < 4:

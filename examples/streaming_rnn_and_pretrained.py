@@ -12,6 +12,8 @@ streaming RNN inference + TBPTT training.
 Run: JAX_PLATFORMS=cpu python examples/streaming_rnn_and_pretrained.py
 """
 
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
+
 import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet

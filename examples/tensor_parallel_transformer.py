@@ -13,6 +13,8 @@ What it shows:
   identical to the single-device model (see tests/test_tensor_parallel).
 """
 
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
+
 import jax
 
 if jax.default_backend() == "cpu" and jax.device_count() < 8:

@@ -4,6 +4,8 @@ Word2Vec tutorial workflow — SURVEY §3.6).
 Run: JAX_PLATFORMS=cpu python examples/word2vec_embeddings.py
 """
 
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
+
 from deeplearning4j_tpu.nlp import serializer as WordVectorSerializer
 from deeplearning4j_tpu.nlp.word2vec import Word2Vec
 
