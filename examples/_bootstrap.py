@@ -9,3 +9,33 @@ import sys
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
+
+
+def pin_cpu_mesh(n_devices: int) -> None:
+    """Pin the example to an ``n_devices``-wide virtual CPU mesh BEFORE
+    jax initializes. The image's TPU shim exports JAX_PLATFORMS=axon
+    ambiently — that is not a user choice, so it is overridden; opt into
+    real accelerators explicitly with DL4J_EXAMPLE_PLATFORM=native
+    (then the example must find enough devices or it exits with a
+    message)."""
+    if os.environ.get("DL4J_EXAMPLE_PLATFORM", "cpu") != "cpu":
+        return
+    kept = [f for f in os.environ.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f]
+    kept.append(f"--xla_force_host_platform_device_count={n_devices}")
+    os.environ["XLA_FLAGS"] = " ".join(kept)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+def need_devices(n_devices: int) -> None:
+    """Actionable exit when the backend came up too small (instead of an
+    opaque mesh reshape error)."""
+    import jax
+    have = len(jax.devices())
+    if have < n_devices:
+        raise SystemExit(
+            f"this example needs {n_devices} devices, found {have} — "
+            "run with the default CPU pin (unset "
+            "DL4J_EXAMPLE_PLATFORM) or on a host with enough chips")

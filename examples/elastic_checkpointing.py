@@ -3,26 +3,16 @@ layout, restore onto another (the pod-scale orbax-style flow:
 every process writes only its shards; restore reads only the regions
 the new layout needs).
 
-Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-     JAX_PLATFORMS=cpu python examples/elastic_checkpointing.py
+Run: python examples/elastic_checkpointing.py
 """
 
 import _bootstrap  # noqa: F401  (repo root onto sys.path)
 
-import os
 import tempfile
 
-os.environ.setdefault("XLA_FLAGS",
-                      "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_bootstrap.pin_cpu_mesh(8)
 
 import jax
-
-# pin the default platform (the image's TPU shim overrides a bare env
-# var) — but respect an EXPLICIT user choice like JAX_PLATFORMS=tpu
-if os.environ.get("JAX_PLATFORMS", "cpu") == "cpu":
-    jax.config.update("jax_platforms", "cpu")
-
 import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import (
@@ -59,6 +49,7 @@ def model():
 
 
 def main():
+    _bootstrap.need_devices(8)
     rng = np.random.default_rng(0)
     x = rng.normal(size=(128, 16)).astype(np.float32)
     y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 128)]

@@ -7,24 +7,14 @@ circular/interleaved schedule (shard_map, manual over the pipe axis
 only). The pipelined loss is golden-checked against the sequential
 stack, and the sharded checkpoint restores onto a DIFFERENT 3D layout.
 
-Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-     JAX_PLATFORMS=cpu python examples/three_d_parallelism.py
+Run: python examples/three_d_parallelism.py
 """
 
 import _bootstrap  # noqa: F401  (repo root onto sys.path)
 
-import os
-
-os.environ.setdefault("XLA_FLAGS",
-                      "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_bootstrap.pin_cpu_mesh(8)
 
 import jax
-
-# pin the default platform (the image's TPU shim overrides a bare env
-# var) — but respect an EXPLICIT user choice like JAX_PLATFORMS=tpu
-if os.environ.get("JAX_PLATFORMS", "cpu") == "cpu":
-    jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp
 import numpy as np
@@ -38,6 +28,7 @@ from deeplearning4j_tpu.parallel.pipeline import (
 
 def main():
     dp, tp, pp = 2, 2, 2
+    _bootstrap.need_devices(dp * tp * pp)
     devices = np.asarray(jax.devices()[: dp * tp * pp])
     mesh = Mesh(devices.reshape(dp, tp, pp), ("data", "model", PIPE_AXIS))
     print(f"mesh: {dict(mesh.shape)} (dp x tp x pp)")
