@@ -457,7 +457,8 @@ def word2vec():
         # 64k-pair scanned superchunks (8 chunks/dispatch) amortize the
         # ~26 ms tunnel overhead; warm = steady-state throughput, cold =
         # warm + the one-off XLA compile (cached for the process)
-        times = []
+        times = []          # drained e2e (honest through the tunnel)
+        pipe_times = []     # fit-return (the host/producer pipeline rate)
         for _trial in range(2):
             model = Word2Vec(layer_size=128, window_size=5, negative=5,
                              min_word_frequency=1, epochs=1,
@@ -465,12 +466,27 @@ def word2vec():
             model.build_vocab(seqs)
             t0 = time.perf_counter()
             model.fit(seqs)
+            pipe_times.append(time.perf_counter() - t0)
+            # drain the async device queue INSIDE the timer (round-5
+            # methodology fix): fit() returns with dispatches queued,
+            # and through the tunneled transport the per-superchunk
+            # input transfers (~4.2 MB at a measured ~35 MB/s) dominate
+            # that queue — excluding the tail overstated e2e. The
+            # pipeline rate is reported too: it is what a PCIe-attached
+            # host (GB/s transfers) would sustain, where host pair
+            # generation (~1.5M tokens/s) is the real bound. Drain via
+            # a 4-byte element read — np.asarray(syn0) would pull the
+            # whole ~50 MB table back through the same slow tunnel
+            # INSIDE the timer.
+            _sync(model.syn0[0, 0])
             times.append(time.perf_counter() - t0)
         print(json.dumps({
             "metric": f"word2vec_{label}_100kvocab_tokens_per_sec",
             "value": round(n_tokens / times[1], 1),
             "cold_value": round(n_tokens / times[0], 1),
-            "unit": "tokens/sec (warm; cold includes one-off compile)",
+            "pipeline_value": round(n_tokens / pipe_times[1], 1),
+            "unit": "tokens/sec (warm, device-drained; pipeline_value ="
+                    " fit-return rate, the non-tunnel bound)",
             "vocab": int(model.vocab.num_words())}))
 
 

@@ -14,12 +14,12 @@ if _ROOT not in sys.path:
 def pin_cpu_mesh(n_devices: int) -> None:
     """Pin the example to an ``n_devices``-wide virtual CPU mesh BEFORE
     jax initializes. The image's TPU shim exports JAX_PLATFORMS=axon
-    ambiently — that is not a user choice, so it is overridden; opt into
-    real accelerators explicitly with DL4J_EXAMPLE_PLATFORM=native
-    (then the example must find enough devices or it exits with a
-    message)."""
-    if os.environ.get("DL4J_EXAMPLE_PLATFORM", "cpu") != "cpu":
-        return
+    ambiently — that is NOT a user choice, so it is overridden; an
+    explicit user setting like ``JAX_PLATFORMS=tpu`` IS respected (the
+    example then needs enough real devices or exits with a message)."""
+    ambient = os.environ.get("JAX_PLATFORMS")
+    if ambient not in (None, "", "axon", "cpu"):
+        return                      # explicit user platform choice
     kept = [f for f in os.environ.get("XLA_FLAGS", "").split()
             if "xla_force_host_platform_device_count" not in f]
     kept.append(f"--xla_force_host_platform_device_count={n_devices}")

@@ -1,19 +1,17 @@
 """Single-host data-parallel training over a device mesh — the
 ParallelWrapper workflow (SURVEY §3.3) the TPU way: mesh + sharded step.
 
-Run on 8 virtual devices:
-  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
-      python examples/data_parallel_mesh.py
-On a real TPU host the same code uses all local chips.
+Run: python examples/data_parallel_mesh.py   (8 virtual CPU devices)
+On a real TPU host, JAX_PLATFORMS=tpu uses all local chips instead.
 """
 
 import _bootstrap  # noqa: F401  (repo root onto sys.path)
 
-import os
-
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+_bootstrap.pin_cpu_mesh(8)
 
 import jax  # noqa: E402
+
+_bootstrap.need_devices(2)
 
 from deeplearning4j_tpu.datasets.fetchers import MnistDataSetIterator  # noqa: E402
 from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork  # noqa: E402

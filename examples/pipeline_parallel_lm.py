@@ -1,7 +1,6 @@
 """Pipeline-parallel causal LM with the circular/interleaved schedule.
 
-    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-    JAX_PLATFORMS=cpu python examples/pipeline_parallel_lm.py
+    python examples/pipeline_parallel_lm.py
 
 Four pipeline stages, each holding TWO interleaved transformer blocks
 (Megatron "virtual pipeline"): an 8-layer LM trains with embed/unembed
@@ -10,10 +9,11 @@ outside the pipelined region and per-tick rematerialization.
 
 import _bootstrap  # noqa: F401  (repo root onto sys.path)
 
+_bootstrap.pin_cpu_mesh(8)
+
 import jax
 
-if jax.default_backend() == "cpu" and jax.device_count() < 4:
-    raise SystemExit("set XLA_FLAGS=--xla_force_host_platform_device_count=8")
+_bootstrap.need_devices(4)
 
 import numpy as np
 import jax.numpy as jnp

@@ -1,8 +1,7 @@
 """Megatron-paired tensor parallelism on a transformer classifier.
 
 Run on any machine (virtual CPU mesh works):
-    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-    JAX_PLATFORMS=cpu python examples/tensor_parallel_transformer.py
+    python examples/tensor_parallel_transformer.py
 
 What it shows:
 - a 2-block transformer stack built with the ordinary layer API,
@@ -15,10 +14,11 @@ What it shows:
 
 import _bootstrap  # noqa: F401  (repo root onto sys.path)
 
+_bootstrap.pin_cpu_mesh(8)
+
 import jax
 
-if jax.default_backend() == "cpu" and jax.device_count() < 8:
-    raise SystemExit("set XLA_FLAGS=--xla_force_host_platform_device_count=8")
+_bootstrap.need_devices(8)
 
 import numpy as np
 
