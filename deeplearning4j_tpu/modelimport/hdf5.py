@@ -222,6 +222,21 @@ class Hdf5Archive:
         return [np.asarray(node[k]) for k in
                 sorted(node.keys(), key=lambda s: int(s))]
 
+    @staticmethod
+    def _custom_stateless(cls: str, lcfg: dict) -> bool:
+        """True when ``cls`` is a user-registered custom layer whose
+        converted form carries no params — its weights dir legitimately
+        has nothing to load (e.g. a pure-function Lambda-style layer)."""
+        from deeplearning4j_tpu.modelimport.layers import (
+            _CUSTOM, convert_layer)
+        if cls not in _CUSTOM:
+            return False
+        try:
+            conv = convert_layer(cls, lcfg, 3)
+        except Exception:
+            return False
+        return conv.layer is None or not conv.layer.has_params
+
     def _v3_layer_weights(self, layer_name: str) -> Dict[str, np.ndarray]:
         entry = self._v3_dirs.get(layer_name)
         if entry is None:
@@ -233,7 +248,7 @@ class Hdf5Archive:
         root = ("layers" if self.has_group("layers")
                 else "_layer_checkpoint_dependencies")
         if not self.has_group(root, entry["dir"]):
-            if cls in _V3_STATELESS:
+            if cls in _V3_STATELESS or self._custom_stateless(cls, lcfg):
                 return {}
             # a weighted layer whose dir can't be found is a layout
             # mismatch (different Keras-3 naming, nested sub-model,
@@ -284,7 +299,8 @@ class Hdf5Archive:
                 else:
                     names = [f"var_{i}" for i in range(len(arrs))]
             put(names, arrs)
-        if not out and cls not in _V3_STATELESS:
+        if not out and cls not in _V3_STATELESS \
+                and not self._custom_stateless(cls, lcfg):
             raise ValueError(
                 f".keras layer {layer_name!r} ({cls}) should carry "
                 "weights but none were found under "

@@ -20,10 +20,12 @@ def pin_cpu_mesh(n_devices: int) -> None:
     ambient = os.environ.get("JAX_PLATFORMS")
     if ambient not in (None, "", "axon", "cpu"):
         return                      # explicit user platform choice
-    kept = [f for f in os.environ.get("XLA_FLAGS", "").split()
-            if "xla_force_host_platform_device_count" not in f]
-    kept.append(f"--xla_force_host_platform_device_count={n_devices}")
-    os.environ["XLA_FLAGS"] = " ".join(kept)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        # only fill in the device count the user did NOT choose
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{n_devices}").strip()
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
     jax.config.update("jax_platforms", "cpu")
@@ -37,5 +39,5 @@ def need_devices(n_devices: int) -> None:
     if have < n_devices:
         raise SystemExit(
             f"this example needs {n_devices} devices, found {have} — "
-            "run with the default CPU pin (unset "
-            "DL4J_EXAMPLE_PLATFORM) or on a host with enough chips")
+            "unset JAX_PLATFORMS to use the default virtual CPU mesh, "
+            "or run on a host with enough chips")

@@ -1,0 +1,78 @@
+"""Custom Keras-layer registration — import a model containing a layer
+the converter registry does not know, by registering your own converter
+(reference: KerasLayer.registerCustomLayer + the custom-layer docs).
+
+Run: JAX_PLATFORMS=cpu python examples/custom_keras_layer.py
+"""
+
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
+
+import os
+import tempfile
+
+import numpy as np
+
+from deeplearning4j_tpu.modelimport.keras import (
+    import_keras_model_and_weights,
+)
+from deeplearning4j_tpu.modelimport.layers import (
+    Converted,
+    register_custom_layer,
+)
+
+
+def main():
+    import keras
+    from keras import layers as L
+
+    # a user-defined Keras layer (here: a scaled tanh)
+    @keras.saving.register_keras_serializable(package="demo")
+    class ScaledTanh(L.Layer):
+        def __init__(self, scale=2.0, **kw):
+            super().__init__(**kw)
+            self.scale = scale
+
+        def call(self, x):
+            return keras.ops.tanh(x) * self.scale
+
+        def get_config(self):
+            return {**super().get_config(), "scale": self.scale}
+
+    keras.utils.set_random_seed(0)
+    inp = keras.Input((6,))
+    x = L.Dense(8)(inp)
+    x = ScaledTanh(scale=2.0)(x)
+    out = L.Dense(3)(x)
+    km = keras.Model(inp, out)
+    path = os.path.join(tempfile.mkdtemp(), "custom.keras")
+    km.save(path)
+
+    # without registration: a clear unsupported-layer error
+    try:
+        import_keras_model_and_weights(path)
+    except ValueError as e:
+        print("unregistered:", str(e)[:72], "...")
+
+    # register a converter mapping ScaledTanh onto framework layers
+    # (the pure function becomes a LambdaLayer)
+    from deeplearning4j_tpu.nn.layers.misc import LambdaLayer
+
+    def scaled_tanh(cfg, _version):
+        import jax.numpy as jnp
+        s = float(cfg.get("scale", 1.0))
+        return Converted(layer=LambdaLayer(
+            fn=lambda x: jnp.tanh(x) * s))
+
+    register_custom_layer("ScaledTanh", scaled_tanh)
+    model = import_keras_model_and_weights(path)
+
+    x = np.random.default_rng(0).normal(size=(4, 6)).astype(np.float32)
+    want = np.asarray(km(x))
+    got = np.asarray(model.output(x))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    print("custom layer imports exactly: max err",
+          float(np.max(np.abs(got - want))))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""Custom dashboard module + i18n — plug your own routes into the
+training UI via the UIModule SPI and serve it in another language
+(reference: the Play UI's UIModule.java + I18NProvider).
+
+Run: JAX_PLATFORMS=cpu python examples/custom_ui_module.py
+"""
+
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
+
+import json
+import urllib.request
+
+from deeplearning4j_tpu.ui.modules import Route, UIModule
+from deeplearning4j_tpu.ui.server import UIServer
+from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+
+class LossBudgetModule(UIModule):
+    """A monitoring module: tracks whether training stays under a loss
+    budget, updated live from the records the server receives."""
+
+    def __init__(self, budget: float):
+        self.budget = budget
+        self.worst = None
+
+    def get_routes(self):
+        return [
+            Route("GET", "/api/lossbudget",
+                  lambda ctx, q, body: {
+                      "budget": self.budget,
+                      "worst_seen": self.worst,
+                      "ok": self.worst is None
+                      or self.worst <= self.budget}),
+            Route("POST", "/api/lossbudget",
+                  self._set_budget),
+        ]
+
+    def _set_budget(self, ctx, q, body):
+        self.budget = float(body["budget"])
+        return {"ok": True, "budget": self.budget}
+
+    def on_update(self, record):          # every remote-routed record
+        score = record.get("score")
+        if score is not None:
+            self.worst = (score if self.worst is None
+                          else max(self.worst, score))
+
+
+def main():
+    mod = LossBudgetModule(budget=2.0)
+    srv = (UIServer(port=0).attach(InMemoryStatsStorage())
+           .register_module(mod).start())
+    try:
+        # feed a couple of records through the remote-receiver route
+        for it, score in enumerate((1.2, 0.9, 2.6)):
+            req = urllib.request.Request(
+                srv.url + "/remote",
+                data=json.dumps({"record": {
+                    "session_id": "demo", "iteration": it,
+                    "score": score}}).encode(),
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req).read()
+
+        with urllib.request.urlopen(srv.url + "/api/lossbudget") as r:
+            print("module state:", json.loads(r.read()))
+
+        # raise the budget through the module's own POST route
+        req = urllib.request.Request(
+            srv.url + "/api/lossbudget",
+            data=json.dumps({"budget": 3.0}).encode(),
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req).read()
+        with urllib.request.urlopen(srv.url + "/api/lossbudget") as r:
+            print("after raise: ", json.loads(r.read()))
+
+        # the dashboard itself, served in Japanese
+        with urllib.request.urlopen(srv.url + "/?lang=ja") as r:
+            page = r.read().decode("utf-8")
+        print("ja dashboard nav contains 概要:", "概要" in page)
+    finally:
+        srv.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""Memory reports + listener-based profiling — the observability
+toolkit: analytic per-layer memory estimates, XLA compiled-buffer
+analysis, and the performance listeners that feed the dashboard
+(reference: NetworkMemoryReport + PerformanceListener).
+
+Run: JAX_PLATFORMS=cpu python examples/memory_and_profiling.py
+"""
+
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import (
+    ArrayDataSetIterator,
+    DataSet,
+)
+from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers.convolution import (
+    ConvolutionLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+from deeplearning4j_tpu.nn.layers.output import OutputLayer
+from deeplearning4j_tpu.nn.memory import memory_report, xla_memory_analysis
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.optimize.listeners import (
+    PerformanceListener,
+    ScoreIterationListener,
+)
+from deeplearning4j_tpu.optimize.updaters import Adam
+
+
+def main():
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(1).updater(Adam(1e-3)).list()
+            .layer(ConvolutionLayer(n_out=16, kernel_size=(3, 3),
+                                    activation=Activation.RELU))
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=64, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=10))
+            .set_input_type(InputType.convolutional(16, 16, 1))
+            .build())
+
+    # analytic estimate BEFORE building anything (NetworkMemoryReport)
+    rep = memory_report(conf)
+    print(rep)
+
+    model = MultiLayerNetwork(conf).init()
+
+    # compiled truth: what XLA actually allocates for the train step
+    xla = xla_memory_analysis(model, batch_size=64, train=True)
+    print("XLA train-step buffer stats (bytes):",
+          {k: f"{v:,}" for k, v in xla.items()})
+
+    # listener-based profiling during fit (PerformanceListener analog)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 16, 16, 1)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 256)]
+    model.set_listeners(ScoreIterationListener(5),
+                        PerformanceListener(5))
+    model.fit(ArrayDataSetIterator(DataSet(x, y), batch_size=64),
+              epochs=3)
+    print("done — per-iteration samples/sec + ETL ms were printed by "
+          "PerformanceListener above")
+
+
+if __name__ == "__main__":
+    main()

@@ -167,3 +167,38 @@ def test_fixtures_trainable_after_import():
     before = int(model.train_state.iteration)
     model.fit(DataSet(x, y))
     assert int(model.train_state.iteration) == before + 1
+
+
+def test_custom_stateless_layer_keras3_import(tmp_path):
+    """A user-registered parameter-free custom layer imports from the
+    .keras format without tripping the weights-expected guard
+    (round 5; reference: KerasLayer.registerCustomLayer)."""
+    import keras
+    from keras import layers as L
+
+    from deeplearning4j_tpu.modelimport.layers import (
+        Converted, _CUSTOM, register_custom_layer)
+    from deeplearning4j_tpu.nn.layers.misc import LambdaLayer
+
+    @keras.saving.register_keras_serializable(package="t")
+    class Doubler(L.Layer):
+        def call(self, x):
+            return x * 2.0
+
+    keras.utils.set_random_seed(0)
+    inp = keras.Input((4,))
+    out = L.Dense(3)(Doubler()(inp))
+    km = keras.Model(inp, out)
+    p = str(tmp_path / "m.keras")
+    km.save(p)
+
+    register_custom_layer("Doubler", lambda cfg, v: Converted(
+        layer=LambdaLayer(fn=lambda x: x * 2.0)))
+    try:
+        model = import_keras_model_and_weights(p)
+        x = np.random.default_rng(0).normal(size=(3, 4)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(model.output(x)),
+                                   np.asarray(km(x)), rtol=1e-5,
+                                   atol=1e-6)
+    finally:
+        _CUSTOM.pop("Doubler", None)
