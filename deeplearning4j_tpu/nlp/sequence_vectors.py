@@ -23,6 +23,21 @@ from deeplearning4j_tpu.nlp import skipgram as sk
 from deeplearning4j_tpu.nlp.vocab import Huffman, VocabCache, VocabConstructor
 
 
+def _corpus_positions(seq_id: np.ndarray):
+    """Per-token (position-within-sequence, sequence-length) for a flat
+    encoded corpus — ONE numpy pass, no per-sequence loop. Shared by the
+    SGNS and CBOW corpus-level pair generators."""
+    n = len(seq_id)
+    change = np.empty(n, bool)
+    change[0] = True
+    np.not_equal(seq_id[1:], seq_id[:-1], out=change[1:])
+    starts = np.flatnonzero(change)
+    seg = np.cumsum(change) - 1
+    pos = np.arange(n) - starts[seg]
+    lens = np.diff(np.append(starts, n))
+    return pos, lens[seg]
+
+
 class _PairStream:
     """Chunked (center, context) consumer for the vectorized SGNS/HS
     paths (used by SequenceVectors and ParagraphVectors' DBOW): buffers
@@ -524,16 +539,7 @@ class SequenceVectors:
                 if n < 2:
                     stream.seen += n
                     continue
-                # per-token position/length within its (post-subsample)
-                # sequence, computed without any per-sequence loop
-                change = np.empty(n, bool)
-                change[0] = True
-                np.not_equal(seq_id[1:], seq_id[:-1], out=change[1:])
-                starts = np.flatnonzero(change)
-                seg = np.cumsum(change) - 1
-                pos = np.arange(n) - starts[seg]
-                lens = np.diff(np.append(starts, n))
-                length = lens[seg]
+                pos, length = _corpus_positions(seq_id)
                 # randomized effective window per center (word2vec.c's b)
                 w_eff = (self._rng.integers(1, W + 1, size=n)
                          if W > 1 else np.ones(n, np.int64))

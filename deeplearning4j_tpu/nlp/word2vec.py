@@ -197,19 +197,32 @@ class Word2Vec(SequenceVectors):
                 if d == depth:
                     flush()
 
-            for _epoch in range(self.epochs):
-                for si, seq in enumerate(seqs):
-                    idxs = np.asarray(self._indices(seq), np.int32)
-                    n = len(idxs)
-                    # with label columns (DM) even a 1-token doc trains
-                    # its label vector (slow-path parity); without,
-                    # need a window
-                    if n < 1 or (n < 2 and not max_extra):
-                        seen += n
-                        continue
-                    grid, valid = sk.window_grid(n, W, rng)
-                    ctx = idxs[np.clip(grid, 0, n - 1)]
-                    if max_extra:
+            def push_rows(cens, ctxs, valids):
+                nonlocal fill
+                p, n = 0, len(cens)
+                while p < n:
+                    take = min(chunk - fill, n - p)
+                    sl = slice(fill, fill + take)
+                    cen_buf[d, sl] = cens[p:p + take]
+                    ctx_buf[d, sl] = ctxs[p:p + take]
+                    cmask_buf[d, sl] = \
+                        valids[p:p + take].astype(np.float32)
+                    fill += take
+                    p += take
+                    if fill == chunk:
+                        seal()
+
+            if max_extra:
+                # DM: per-sequence loop (label columns vary per doc)
+                for _epoch in range(self.epochs):
+                    for si, seq in enumerate(seqs):
+                        idxs = np.asarray(self._indices(seq), np.int32)
+                        n = len(idxs)
+                        # even a 1-token doc trains its label vector
+                        if n < 1:
+                            continue
+                        grid, valid = sk.window_grid(n, W, rng)
+                        ctx = idxs[np.clip(grid, 0, n - 1)]
                         e = np.asarray(extra_per_seq[si], np.int32)
                         pad = np.zeros(max_extra - len(e), np.int32)
                         ctx = np.concatenate(
@@ -221,19 +234,45 @@ class Word2Vec(SequenceVectors):
                              np.zeros(max_extra - len(e), bool)])
                         valid = np.concatenate(
                             [valid, np.tile(evalid, (n, 1))], axis=1)
-                    seen += n
-                    p = 0
-                    while p < n:
-                        take = min(chunk - fill, n - p)
-                        sl = slice(fill, fill + take)
-                        cen_buf[d, sl] = idxs[p:p + take]
-                        ctx_buf[d, sl] = ctx[p:p + take]
-                        cmask_buf[d, sl] = \
-                            valid[p:p + take].astype(np.float32)
-                        fill += take
-                        p += take
-                        if fill == chunk:
-                            seal()
+                        seen += n
+                        push_rows(idxs, ctx, valid)
+            else:
+                # plain CBOW (round 5): corpus-level numpy, like the
+                # SGNS fast path — one flat encode, offsets-grid slabs,
+                # no per-sequence Python (the measured host bound)
+                from deeplearning4j_tpu.nlp.sequence_vectors import (
+                    _corpus_positions)
+                ids_all, seq_all = self._encode_corpus_flat(seqs)
+                offsets = np.concatenate([np.arange(-W, 0),
+                                          np.arange(1, W + 1)])
+                for _epoch in range(self.epochs):
+                    if self.sampling > 0:
+                        m = self._subsample_mask(ids_all)
+                        ids, seq_id = ids_all[m], seq_all[m]
+                    else:
+                        ids, seq_id = ids_all, seq_all
+                    n_tok = len(ids)
+                    if n_tok < 2:
+                        seen += n_tok
+                        continue
+                    pos, length = _corpus_positions(seq_id)
+                    w_eff = (rng.integers(1, W + 1, size=n_tok)
+                             if W > 1 else np.ones(n_tok, np.int64))
+                    slab = 1 << 20
+                    for lo in range(0, n_tok, slab):
+                        hi = min(n_tok, lo + slab)
+                        o = offsets[None, :]
+                        p_ = pos[lo:hi, None]
+                        valid = ((np.abs(o) <= w_eff[lo:hi, None])
+                                 & (p_ + o >= 0)
+                                 & (p_ + o < length[lo:hi, None]))
+                        keep = valid.any(axis=1)   # centers w/ context
+                        gpos = np.clip(
+                            np.arange(lo, hi)[:, None] + o, 0,
+                            n_tok - 1)
+                        seen += hi - lo
+                        push_rows(ids[lo:hi][keep], ids[gpos][keep],
+                                  valid[keep])
             if fill:
                 seal()
             flush()
