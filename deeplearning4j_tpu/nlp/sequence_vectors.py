@@ -512,48 +512,66 @@ class SequenceVectors:
             / np.maximum(f, 1e-300)
         return self._rng.random(len(ids)) < keep_p
 
+    def _window_slabs(self, ids_all, seq_all, slab: int = 1 << 20):
+        """The ONE corpus-level randomized-window walk (word2vec.c's
+        ``b`` per center): per epoch — subsample, per-token positions,
+        effective windows — then ~1M-token slabs, each yielding
+        ``(ids, lo, hi, grid, valid)`` where ``grid`` is the clipped
+        (slab, 2W) context-position grid and ``valid`` its mask. An
+        epoch too short to window yields ``(ids, 0, n, None, None)``
+        (token progress only). SGNS flattens the valid cells into
+        pairs; CBOW consumes the rows whole — one implementation, one
+        anneal-accounting contract."""
+        W = self.window_size
+        offsets = np.concatenate([np.arange(-W, 0), np.arange(1, W + 1)])
+        for _epoch in range(self.epochs):
+            if self.sampling > 0:
+                m = self._subsample_mask(ids_all)
+                ids = ids_all[m]
+                seq_id = seq_all[m]
+            else:
+                ids, seq_id = ids_all, seq_all
+            n = len(ids)
+            if n < 2:
+                yield ids, 0, n, None, None
+                continue
+            pos, length = _corpus_positions(seq_id)
+            # randomized effective window per center (word2vec.c's b)
+            w_eff = (self._rng.integers(1, W + 1, size=n)
+                     if W > 1 else np.ones(n, np.int64))
+            for lo in range(0, n, slab):
+                hi = min(n, lo + slab)
+                o = offsets[None, :]
+                p = pos[lo:hi, None]
+                valid = ((np.abs(o) <= w_eff[lo:hi, None])
+                         & (p + o >= 0)
+                         & (p + o < length[lo:hi, None]))
+                grid = np.clip(np.arange(lo, hi)[:, None] + o, 0, n - 1)
+                yield ids, lo, hi, grid, valid
+
     def _fit_fast_sgns(self, seqs, total_words: int):
         """Whole-corpus vectorized skip-gram (negative sampling OR
         hierarchical softmax): ONE vocab-lookup pass flattens the corpus
         (``_encode_corpus_flat``), then pair generation runs as
         corpus-level numpy over an offsets grid in ~1M-token slabs —
-        no per-sequence Python. Negatives are one table gather per
-        chunk, Huffman paths are gathered on device from precomputed
-        matrices; each superchunk is a single donated scanned device
-        step — the TPU-shaped version of the reference's
+        no per-sequence Python (``_window_slabs``). Negatives are one
+        table gather per chunk, Huffman paths are gathered on device
+        from precomputed matrices; each superchunk is a single donated
+        scanned device step — the TPU-shaped version of the reference's
         AggregateSkipGram batching (SkipGram.java:176-186)."""
         W = self.window_size
         chunk = self._pair_chunk_size(total_words * (W + 1))
         ids_all, seq_all = self._encode_corpus_flat(seqs)
-        offsets = np.concatenate([np.arange(-W, 0), np.arange(1, W + 1)])
 
         def produce(sink):
             stream = _PairStream(self, chunk, total_words, sink=sink)
-            for _epoch in range(self.epochs):
-                if self.sampling > 0:
-                    m = self._subsample_mask(ids_all)
-                    ids, seq_id = ids_all[m], seq_all[m]
-                else:
-                    ids, seq_id = ids_all, seq_all
-                n = len(ids)
-                if n < 2:
-                    stream.seen += n
+            for ids, lo, hi, grid, valid in self._window_slabs(
+                    ids_all, seq_all):
+                if valid is None:
+                    stream.seen += hi - lo
                     continue
-                pos, length = _corpus_positions(seq_id)
-                # randomized effective window per center (word2vec.c's b)
-                w_eff = (self._rng.integers(1, W + 1, size=n)
-                         if W > 1 else np.ones(n, np.int64))
-                slab = 1 << 20
-                for lo in range(0, n, slab):
-                    hi = min(n, lo + slab)
-                    o = offsets[None, :]
-                    p = pos[lo:hi, None]
-                    valid = ((np.abs(o) <= w_eff[lo:hi, None])
-                             & (p + o >= 0)
-                             & (p + o < length[lo:hi, None]))
-                    centers = np.repeat(ids[lo:hi], valid.sum(axis=1))
-                    gpos = (np.arange(lo, hi)[:, None] + o)[valid]
-                    stream.push(centers, ids[gpos], tokens=hi - lo)
+                centers = np.repeat(ids[lo:hi], valid.sum(axis=1))
+                stream.push(centers, ids[grid[valid]], tokens=hi - lo)
             stream.finish()
 
         if self.overlap_pairgen:

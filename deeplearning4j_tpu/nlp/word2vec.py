@@ -197,12 +197,21 @@ class Word2Vec(SequenceVectors):
                 if d == depth:
                     flush()
 
-            def push_rows(cens, ctxs, valids):
-                nonlocal fill
+            def push_rows(cens, ctxs, valids, tokens=0.0):
+                """``tokens`` of anneal progress spreads evenly over the
+                rows (the _PairStream.push contract — advancing ``seen``
+                up front snaps small corpora straight to
+                min_learning_rate; code-review r4/r5)."""
+                nonlocal fill, seen
                 p, n = 0, len(cens)
+                if n == 0:
+                    seen += tokens
+                    return
+                per = tokens / n
                 while p < n:
                     take = min(chunk - fill, n - p)
                     sl = slice(fill, fill + take)
+                    seen += per * take
                     cen_buf[d, sl] = cens[p:p + take]
                     ctx_buf[d, sl] = ctxs[p:p + take]
                     cmask_buf[d, sl] = \
@@ -234,45 +243,21 @@ class Word2Vec(SequenceVectors):
                              np.zeros(max_extra - len(e), bool)])
                         valid = np.concatenate(
                             [valid, np.tile(evalid, (n, 1))], axis=1)
-                        seen += n
-                        push_rows(idxs, ctx, valid)
+                        push_rows(idxs, ctx, valid, tokens=n)
             else:
-                # plain CBOW (round 5): corpus-level numpy, like the
-                # SGNS fast path — one flat encode, offsets-grid slabs,
-                # no per-sequence Python (the measured host bound)
-                from deeplearning4j_tpu.nlp.sequence_vectors import (
-                    _corpus_positions)
+                # plain CBOW (round 5): corpus-level numpy via the SAME
+                # window walk the SGNS fast path uses (_window_slabs) —
+                # one flat encode, offsets-grid slabs, no per-sequence
+                # Python (the measured host bound)
                 ids_all, seq_all = self._encode_corpus_flat(seqs)
-                offsets = np.concatenate([np.arange(-W, 0),
-                                          np.arange(1, W + 1)])
-                for _epoch in range(self.epochs):
-                    if self.sampling > 0:
-                        m = self._subsample_mask(ids_all)
-                        ids, seq_id = ids_all[m], seq_all[m]
-                    else:
-                        ids, seq_id = ids_all, seq_all
-                    n_tok = len(ids)
-                    if n_tok < 2:
-                        seen += n_tok
-                        continue
-                    pos, length = _corpus_positions(seq_id)
-                    w_eff = (rng.integers(1, W + 1, size=n_tok)
-                             if W > 1 else np.ones(n_tok, np.int64))
-                    slab = 1 << 20
-                    for lo in range(0, n_tok, slab):
-                        hi = min(n_tok, lo + slab)
-                        o = offsets[None, :]
-                        p_ = pos[lo:hi, None]
-                        valid = ((np.abs(o) <= w_eff[lo:hi, None])
-                                 & (p_ + o >= 0)
-                                 & (p_ + o < length[lo:hi, None]))
-                        keep = valid.any(axis=1)   # centers w/ context
-                        gpos = np.clip(
-                            np.arange(lo, hi)[:, None] + o, 0,
-                            n_tok - 1)
+                for ids, lo, hi, grid, valid in self._window_slabs(
+                        ids_all, seq_all):
+                    if valid is None:
                         seen += hi - lo
-                        push_rows(ids[lo:hi][keep], ids[gpos][keep],
-                                  valid[keep])
+                        continue
+                    keep = valid.any(axis=1)   # centers w/ context
+                    push_rows(ids[lo:hi][keep], ids[grid][keep],
+                              valid[keep], tokens=hi - lo)
             if fill:
                 seal()
             flush()

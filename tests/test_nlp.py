@@ -163,6 +163,23 @@ class TestWord2Vec:
         for tok in corpus[5].split():
             assert w2v.has_word(tok)
 
+    def test_cbow_lr_anneals_within_one_slab(self):
+        """The corpus-level CBOW producer must SPREAD anneal progress
+        over pushed rows (code-review r5): a corpus that fits in one
+        slab must still see the lr walk from ~learning_rate down, not
+        snap to min_learning_rate before the first chunk seals."""
+        w2v = Word2Vec(layer_size=8, window_size=3, use_cbow=True,
+                       min_word_frequency=1, epochs=1, negative=2,
+                       batch_size=512, seed=1)
+        calls = []
+        orig = w2v._lr
+        w2v._lr = lambda seen, total: (calls.append(seen / max(total, 1))
+                                       or orig(seen, total))
+        w2v.fit(_toy_corpus(400))
+        assert len(calls) >= 4
+        assert calls[0] < 0.3, calls[:3]      # first seal: early anneal
+        assert calls[-1] > 0.7, calls[-3:]    # last seal: near the end
+
     def test_static_copy(self):
         w2v = Word2Vec(layer_size=8, epochs=1, negative=2, seed=1)
         w2v.fit(_toy_corpus(20))
