@@ -506,8 +506,10 @@ def flash_attention(q, k, v, mask=None, causal: bool = False,
 # Dh=64): plain XLA wins at T<=512 (the full score matrix is small and
 # XLA fuses it into large batched MXU matmuls; the flash grid degenerates
 # to tiny single-block programs), the streaming kernel wins from T=1024
-# on (1024: 8.9 vs 11.2 ms; 2048: 12.8 vs 20.8; 4096: 22.4 vs 33.6, and
-# plain XLA eventually OOMs on the O(T^2) scores).
+# on. Re-measured after the head-trailing score-order change sped the
+# XLA path up: 1024: 9.6 vs 9.9 ms; 2048: 13.4 vs 14.8; 4096: 20.7 vs
+# 24.5 — narrower, same crossover, and plain XLA still OOMs on the
+# O(T^2) scores at long T.
 _FLASH_MIN_SEQ = 1024
 
 
