@@ -208,13 +208,15 @@ def bert_train():
         "unit": "tokens/sec/chip"}))
 
 
-def inception_train():
-    """Imported-InceptionV3 FINE-TUNE throughput (BASELINE config 3's
-    training half): import the canonical Keras graph, swap the 1000-way
-    head for 200 classes via TransferLearning.GraphBuilder, and train the
-    WHOLE network (fwd+bwd+Adam) with K scanned steps per dispatch."""
+def build_inception_finetune(batch: int = 64, k: int = 8):
+    """The canonical imported-InceptionV3 fine-tune setup (BASELINE
+    config 3's training half): import the Keras graph, swap the
+    1000-way head for 200 classes via TransferLearning.GraphBuilder,
+    train the WHOLE network (fwd+bwd+Adam) with K scanned steps per
+    dispatch. Shared by ``inception_train`` and ``profile_hw.py
+    inception`` so the profiler measures the EXACT graph the benchmark
+    ships. Returns ``(model, steps_fn, xs, ys)``."""
     import jax.numpy as jnp
-    import jax.random as jrandom
     import keras
     import os
     import tempfile
@@ -247,7 +249,6 @@ def inception_train():
              .n_out_replace(head, 200)
              .build())
 
-    batch, k, n = 64, 8, 3
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.normal(size=(batch, 299, 299, 3))
                     .astype(np.float32))
@@ -261,6 +262,16 @@ def inception_train():
                            lmask, rng_, it)
 
     steps_fn = make_scan_train_step(loss_fn, model._tx)
+    return model, steps_fn, xs, ys
+
+
+def inception_train():
+    """Imported-InceptionV3 FINE-TUNE throughput — see
+    build_inception_finetune."""
+    import jax.random as jrandom
+
+    batch, k, n = 64, 8, 3
+    model, steps_fn, xs, ys = build_inception_finetune(batch, k)
     key = jrandom.PRNGKey(0)
     ts = model.train_state
     ts, losses = steps_fn(ts, xs, ys, None, None, key)
@@ -328,7 +339,15 @@ def build_bert_finetune(seq: int = 128, batch: int = 128, k: int = 16,
         return ft._loss(params, mstate, feats, labels, fmask, lmask,
                         rng_, it)
 
-    steps_fn = make_scan_train_step(loss_fn, ft._tx)
+    # bf16 shadow params carried through the scan (round 6): kills the
+    # per-step f32→bf16 recast at the top of the loss (~6.8 ms/step
+    # measured in PERF_ANALYSIS r5) — the cast rides the optimizer
+    # update's epilogue instead. Bit-identical numerics.
+    shadow = None
+    if dtype == "bf16":
+        from deeplearning4j_tpu.models.base import cast_params
+        shadow = lambda p: cast_params(p, "bfloat16")
+    steps_fn = make_scan_train_step(loss_fn, ft._tx, shadow_cast=shadow)
     return ft, steps_fn, (idss, poss), ys
 
 
@@ -488,6 +507,83 @@ def word2vec():
             "unit": "tokens/sec (warm, device-drained; pipeline_value ="
                     " fit-return rate, the non-tunnel bound)",
             "vocab": int(model.vocab.num_words())}))
+
+
+def doc2vec_producer():
+    """DBOW host pair-generation rate (the r5 measured bound: 249k
+    tokens/s fit-return, "per-doc host pairgen bound") at the r5
+    geometry — 20k docs × 100 tokens, 50k vocab. Device dispatch is
+    no-op'd so both numbers isolate the HOST producer: the round-6
+    corpus-level walk (_window_slabs + per-slot label gathers) vs the
+    r5 per-doc loop it replaced (inlined here as the baseline)."""
+    from deeplearning4j_tpu.nlp import skipgram as sk
+    from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+    from deeplearning4j_tpu.nlp.sentence_iterators import LabelledDocument
+    from deeplearning4j_tpu.nlp.sequence_vectors import _PairStream
+
+    v, n_docs, doc_len = 50_000, 20_000, 100
+    rng = np.random.default_rng(0)
+    freq = 1.0 / np.arange(1, v + 1) ** 1.05
+    freq /= freq.sum()
+    tokens = rng.choice(v, size=n_docs * doc_len, p=freq)
+    words = np.char.add("w", tokens.astype("U7"))
+    docs = [LabelledDocument(" ".join(words[i * doc_len:(i + 1) * doc_len]),
+                             [f"DOC_{i}"]) for i in range(n_docs)]
+    n_tokens = n_docs * doc_len
+
+    def per_doc_produce(pv, tokenized, total, chunk):
+        # the r5 producer this round replaced — per-doc numpy
+        stream = _PairStream(pv, chunk, total, sink=lambda prep: None)
+        W = pv.window_size
+        for _ep in range(pv.epochs):
+            for toks, labels in tokenized:
+                idxs = np.asarray(pv._indices(toks), np.int32)
+                lidxs = np.asarray(
+                    [i for i in (pv.vocab.index_of(lb) for lb in labels)
+                     if i >= 0], np.int32)
+                n = len(idxs)
+                if n and len(lidxs):
+                    stream.push(np.repeat(lidxs, n),
+                                np.tile(idxs, len(lidxs)))
+                    stream.seen += len(lidxs) * n
+                if n >= 2:
+                    grid, valid = sk.window_grid(n, W, pv._rng)
+                    stream.push(np.repeat(idxs, valid.sum(axis=1)),
+                                idxs[grid[valid]])
+                stream.seen += n
+        stream.finish()
+
+    out = {}
+    for label in ("corpus_level", "per_doc_r5"):
+        pv = ParagraphVectors(dm=False, layer_size=128, window_size=5,
+                              negative=5, min_word_frequency=1, epochs=1,
+                              batch_size=65536, seed=3,
+                              overlap_pairgen=False)
+        tokenized = [(d.content.split(), d.labels) for d in docs]
+        pv._label_set = {lb for _t, lbs in tokenized for lb in lbs}
+        pv.build_vocab([t for t, _ in tokenized],
+                       special_tokens=sorted(pv._label_set))
+        pv._init_tables()
+        pv._dispatch_chunks = lambda prep: None   # host producer only
+        total = max(1, n_tokens * 2)
+        best = np.inf
+        for _trial in range(2):
+            t0 = time.perf_counter()
+            if label == "corpus_level":
+                pv._fit_fast_dbow(tokenized, total)
+            else:
+                chunk = pv._pair_chunk_size(
+                    (total // 2) * (pv.window_size + 2))
+                per_doc_produce(pv, tokenized, total, chunk)
+            best = min(best, time.perf_counter() - t0)
+        out[label] = n_tokens / best
+    print(json.dumps({
+        "metric": "doc2vec_dbow_host_producer_tokens_per_sec",
+        "value": round(out["corpus_level"], 1),
+        "per_doc_r5_value": round(out["per_doc_r5"], 1),
+        "speedup": round(out["corpus_level"] / out["per_doc_r5"], 2),
+        "unit": "tokens/sec (host pair generation only, dispatch "
+                "no-op'd; 20k docs x 100 tokens, 50k vocab)"}))
 
 
 if __name__ == "__main__":

@@ -80,6 +80,27 @@ def capture_lstm(batch: int, k: int, outdir: str, dtype: str):
         float(np.asarray(losses[-1]))
 
 
+def capture_inception(batch: int, k: int, outdir: str, dtype: str):
+    """Imported-InceptionV3 fine-tune step (BASELINE config 3 training
+    half) under a device trace — same graph as
+    baseline_suite.inception_train via the shared builder. ``dtype`` is
+    accepted for CLI uniformity; the builder's FineTuneConfiguration
+    fixes bf16 compute (the shipped benchmark config)."""
+    import jax
+    import jax.random as jrandom
+    from benchmarks.baseline_suite import build_inception_finetune
+
+    model, steps_fn, xs, ys = build_inception_finetune(batch, k)
+    key = jrandom.PRNGKey(0)
+    ts = model.train_state
+    ts, losses = steps_fn(ts, xs, ys, None, None, key)
+    float(np.asarray(losses[-1]))
+    with jax.profiler.trace(outdir):
+        ts, losses = steps_fn(ts, xs, ys, None, None,
+                              jrandom.fold_in(key, 1))
+        float(np.asarray(losses[-1]))
+
+
 def capture(mode: str, batch: int, k: int, outdir: str):
     import jax
     import jax.numpy as jnp
@@ -174,21 +195,26 @@ def analyze(outdir: str, n_steps: int):
 
 if __name__ == "__main__":
     # modes: unfused (default) | fused (pallas blocks) | gram (xla
-    # blocks + Gram stats) | vgg | bert|lstm [batch] [f32|bf16]
+    # blocks + Gram stats) | vgg | bert|lstm|inception [batch] [f32|bf16]
+    # For the lstm mode, DL4J_LSTM_IMPL=fused|scan selects the
+    # recurrence implementation (ops/pallas_lstm dispatch) so the fused
+    # kernel's per-tick time can be profiled against the scan's.
     mode = sys.argv[1] if len(sys.argv) > 1 else "unfused"
-    if mode not in ("unfused", "fused", "gram", "vgg", "bert", "lstm"):
+    if mode not in ("unfused", "fused", "gram", "vgg", "bert", "lstm",
+                    "inception"):
         sys.exit(f"unknown mode {mode!r}: expected "
-                 "unfused|fused|gram|vgg|bert|lstm [batch] [f32|bf16]")
-    if mode in ("bert", "lstm"):
+                 "unfused|fused|gram|vgg|bert|lstm|inception "
+                 "[batch] [f32|bf16]")
+    if mode in ("bert", "lstm", "inception"):
         batch = int(sys.argv[2]) if len(sys.argv) > 2 else (
-            32 if mode == "bert" else 256)
+            {"bert": 32, "lstm": 256, "inception": 64}[mode])
         dtype = sys.argv[3] if len(sys.argv) > 3 else "f32"
         if dtype not in ("f32", "bf16"):
             sys.exit(f"unknown dtype {dtype!r}: expected f32|bf16")
         k = 8
         outdir = tempfile.mkdtemp(prefix="dl4j_hwprof_")
-        (capture_bert if mode == "bert" else capture_lstm)(
-            batch, k, outdir, dtype)
+        {"bert": capture_bert, "lstm": capture_lstm,
+         "inception": capture_inception}[mode](batch, k, outdir, dtype)
         print(f"trace: {outdir}")
         analyze(outdir, k)
         sys.exit(0)

@@ -182,7 +182,11 @@ class DistributedWord2Vec:
                 syn1s.append(np.asarray(worker.syn1))
             if syn0s:
                 import jax.numpy as jnp
-                master.syn0 = jnp.asarray(np.mean(syn0s, axis=0))
-                master.syn1 = jnp.asarray(np.mean(syn1s, axis=0))
+                # jnp.array (owning copies): the averaged tables feed
+                # models whose kernels donate syn0/syn1; adopting the
+                # np.mean temps zero-copy risks a use-after-free (see
+                # SequenceVectors._init_tables)
+                master.syn0 = jnp.array(np.mean(syn0s, axis=0))
+                master.syn1 = jnp.array(np.mean(syn1s, axis=0))
         self.model = master
         return master

@@ -129,8 +129,11 @@ class Glove(SequenceVectors):
 
         n, d = self.vocab.num_words(), self.layer_size
         rng = np.random.default_rng(self.seed)
-        w = jnp.asarray(((rng.random((n, d)) - 0.5) / d).astype(np.float32))
-        wc = jnp.asarray(((rng.random((n, d)) - 0.5) / d).astype(np.float32))
+        # jnp.array (owning copies): _glove_step donates w/wc, and the
+        # CPU backend zero-copy adopts numpy temps — a donated adopted
+        # buffer is a use-after-free (see SequenceVectors._init_tables)
+        w = jnp.array(((rng.random((n, d)) - 0.5) / d).astype(np.float32))
+        wc = jnp.array(((rng.random((n, d)) - 0.5) / d).astype(np.float32))
         b = jnp.zeros(n, jnp.float32)
         bc = jnp.zeros(n, jnp.float32)
         gw = jnp.full((n, d), 1e-8, jnp.float32)

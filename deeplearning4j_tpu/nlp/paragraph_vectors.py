@@ -101,38 +101,61 @@ class ParagraphVectors(Word2Vec):
         return self
 
     def _fit_fast_dbow(self, tokenized, total: int):
-        """Vectorized DBOW: the (label, word) product plus the joint
-        word-window pairs stream through the shared chunked pair
-        consumer (one donated device step per chunk) instead of the
-        per-pair Python loop — NS and HS alike."""
+        """Corpus-level vectorized DBOW (round 6): ONE vocab-lookup
+        pass flattens the corpus (``_encode_corpus_flat``), the label
+        of every token is materialized as a per-token array per label
+        slot (one numpy gather each), and both passes stream as
+        corpus-level numpy over ``_window_slabs`` — the same walk the
+        SGNS and CBOW producers share, no per-doc Python. Per slab:
+        the (label, word) product (the doc vector predicts each of its
+        words — DBOW.java), then the joint word-window pairs
+        (trainWordVectors=true semantics). The previous per-doc
+        producer was the measured host bound at 249k tokens/s
+        (PERF_ANALYSIS r5)."""
         from deeplearning4j_tpu.nlp.sequence_vectors import _PairStream
         W = self.window_size
         # total already carries DBOW's x2 token factor; the pair count
         # is ~tokens * (W + 2), so halve before scaling
         chunk = self._pair_chunk_size((total // 2) * (W + 2))
 
+        seqs = [t for t, _ in tokenized]
+        ids_all, seq_all = self._encode_corpus_flat(seqs)
+        lidx_lists = [
+            [i for i in (self.vocab.index_of(lb) for lb in labels)
+             if i >= 0] for _t, labels in tokenized]
+        # label slot j -> per-token label row (-1 where the doc has
+        # fewer than j+1 labels); docs rarely carry more than one
+        max_l = max((len(ls) for ls in lidx_lists), default=0)
+        extras = []
+        for j in range(max_l):
+            lab = np.full(len(tokenized), -1, np.int32)
+            for d, ls in enumerate(lidx_lists):
+                if len(ls) > j:
+                    lab[d] = ls[j]
+            extras.append(lab[seq_all])
+        extras = tuple(extras)
+
         def produce(sink):
             stream = _PairStream(self, chunk, total, sink=sink)
-            for _ep in range(self.epochs):
-                for tokens, labels in tokenized:
-                    idxs = np.asarray(self._indices(tokens), np.int32)
-                    lidxs = np.asarray(
-                        [i for i in (self.vocab.index_of(lb)
-                                     for lb in labels) if i >= 0],
-                        np.int32)
-                    n = len(idxs)
-                    if n and len(lidxs):
-                        # every (label, word) pair — the doc vector
-                        # predicts each of its words (DBOW.java)
-                        stream.push(np.repeat(lidxs, n),
-                                    np.tile(idxs, len(lidxs)))
-                        stream.seen += len(lidxs) * n
-                    # joint word pass (trainWordVectors=true semantics)
-                    if n >= 2:
-                        grid, valid = sk.window_grid(n, W, self._rng)
-                        stream.push(np.repeat(idxs, valid.sum(axis=1)),
-                                    idxs[grid[valid]])
-                    stream.seen += n
+            for ids, lo, hi, grid, valid, labs in self._window_slabs(
+                    ids_all, seq_all, extras=extras):
+                ids_slab = ids[lo:hi]
+                for lab in labs:
+                    lm = lab >= 0
+                    # per-doc accounting advanced n tokens per label
+                    # slot; spread the same progress over these pairs.
+                    # All-labeled slabs (the common single-label-per-doc
+                    # corpus) skip the two boolean gathers.
+                    if lm.all():
+                        stream.push(lab, ids_slab, tokens=len(lab))
+                    else:
+                        stream.push(lab[lm], ids_slab[lm],
+                                    tokens=int(lm.sum()))
+                if valid is not None:
+                    stream.push(np.repeat(ids_slab, valid.sum(axis=1)),
+                                ids[grid[valid]], tokens=hi - lo)
+                else:
+                    stream.seen += hi - lo
             stream.finish()
 
         if self.overlap_pairgen:
@@ -192,8 +215,11 @@ class ParagraphVectors(Word2Vec):
         idxs = [self.vocab.index_of(t) for t in tokens]
         idxs = [i for i in idxs if i >= 0]
         rng = np.random.default_rng(0)
-        vec = jnp.asarray(((rng.random(self.layer_size) - 0.5)
-                           / self.layer_size).astype(np.float32))
+        # jnp.array (owning copy): infer_step donates the doc vector, so
+        # it must not zero-copy adopt the numpy temp (use-after-free —
+        # see SequenceVectors._init_tables)
+        vec = jnp.array(((rng.random(self.layer_size) - 0.5)
+                         / self.layer_size).astype(np.float32))
         if not idxs:
             return np.asarray(vec)
         k = self._k()

@@ -33,9 +33,11 @@ def _corpus_positions(seq_id: np.ndarray):
     np.not_equal(seq_id[1:], seq_id[:-1], out=change[1:])
     starts = np.flatnonzero(change)
     seg = np.cumsum(change) - 1
-    pos = np.arange(n) - starts[seg]
+    # int32: the (slab, 2W) window arithmetic downstream is memory
+    # bound — half-width indices halve its traffic
+    pos = (np.arange(n) - starts[seg]).astype(np.int32)
     lens = np.diff(np.append(starts, n))
-    return pos, lens[seg]
+    return pos, lens[seg].astype(np.int32)
 
 
 class _PairStream:
@@ -225,7 +227,13 @@ class SequenceVectors:
         rng = np.random.default_rng(self.seed)
         syn0 = ((rng.random((n, d)) - 0.5) / d).astype(np.float32)
         rows1 = max(n - 1, 1) if self.use_hs else n
-        self.syn0 = jnp.asarray(syn0)
+        # jnp.array, NOT jnp.asarray: the CPU backend zero-copy ADOPTS
+        # numpy buffers, and the training kernels DONATE syn0/syn1 — a
+        # donated adopted buffer is freed by numpy when the temp dies
+        # while the donation chain still lives there (use-after-free:
+        # syn0 reads back garbage/NaN at GC-dependent times). Any array
+        # entering a donated argument chain must own its buffer.
+        self.syn0 = jnp.array(syn0)
         self.syn1 = jnp.zeros((rows1, d), jnp.float32)
         if not self.use_hs:
             self._table = self.vocab.unigram_table()
@@ -482,12 +490,20 @@ class SequenceVectors:
         lookup = self.vocab._by_word
         lens = np.fromiter((len(s) for s in seqs), np.int64, len(seqs))
         total = int(lens.sum())
-        # stream the corpus through map(dict.get) without materializing
-        # a flat 3M-element Python list first
+        # stream the corpus through map(dict.get, tokens, repeat(-1))
+        # — an index dict keeps the whole lookup in C (map feeds get's
+        # default from the second iterable), where the previous
+        # ``vw.index if vw is not None`` genexpr ran a Python-level
+        # branch per token (~1.1 s of the 3 s DBOW producer at the
+        # 2M-token bench). Cached on the vocab object: lookup dicts
+        # outlive fits, rebuilds swap the vocab instance.
+        by_idx = getattr(self.vocab, "_index_by_word", None)
+        if by_idx is None:
+            by_idx = {w: vw.index for w, vw in lookup.items()}
+            self.vocab._index_by_word = by_idx
         idx = np.fromiter(
-            (vw.index if vw is not None else -1
-             for vw in map(lookup.get, itertools.chain.from_iterable(
-                 seqs))), np.int32, total)
+            map(by_idx.get, itertools.chain.from_iterable(seqs),
+                itertools.repeat(-1)), np.int32, total)
         keep = idx >= 0
         seq_id = np.repeat(np.arange(len(seqs)), lens)[keep]
         return idx[keep], seq_id
@@ -512,7 +528,8 @@ class SequenceVectors:
             / np.maximum(f, 1e-300)
         return self._rng.random(len(ids)) < keep_p
 
-    def _window_slabs(self, ids_all, seq_all, slab: int = 1 << 20):
+    def _window_slabs(self, ids_all, seq_all, slab: int = 1 << 20,
+                      extras=None):
         """The ONE corpus-level randomized-window walk (word2vec.c's
         ``b`` per center): per epoch — subsample, per-token positions,
         effective windows — then ~1M-token slabs, each yielding
@@ -521,33 +538,52 @@ class SequenceVectors:
         epoch too short to window yields ``(ids, 0, n, None, None)``
         (token progress only). SGNS flattens the valid cells into
         pairs; CBOW consumes the rows whole — one implementation, one
-        anneal-accounting contract."""
+        anneal-accounting contract.
+
+        ``extras``: optional tuple of per-token corpus-level arrays
+        (same length as ``ids_all``) that must ride along through the
+        per-epoch subsample filter — e.g. DBOW's per-token label rows.
+        When given, each yield grows a sixth element: the tuple of
+        ``[lo:hi]`` slab slices of the filtered extras."""
         W = self.window_size
-        offsets = np.concatenate([np.arange(-W, 0), np.arange(1, W + 1)])
+        offsets = np.concatenate([np.arange(-W, 0),
+                                  np.arange(1, W + 1)]).astype(np.int32)
+        abs_off = np.abs(offsets)[None, :]
         for _epoch in range(self.epochs):
             if self.sampling > 0:
                 m = self._subsample_mask(ids_all)
                 ids = ids_all[m]
                 seq_id = seq_all[m]
+                ex = (tuple(e[m] for e in extras)
+                      if extras is not None else None)
             else:
                 ids, seq_id = ids_all, seq_all
+                ex = extras
             n = len(ids)
             if n < 2:
-                yield ids, 0, n, None, None
+                if extras is not None:
+                    yield ids, 0, n, None, None, ex
+                else:
+                    yield ids, 0, n, None, None
                 continue
             pos, length = _corpus_positions(seq_id)
             # randomized effective window per center (word2vec.c's b)
-            w_eff = (self._rng.integers(1, W + 1, size=n)
-                     if W > 1 else np.ones(n, np.int64))
+            w_eff = (self._rng.integers(1, W + 1, size=n).astype(np.int32)
+                     if W > 1 else np.ones(n, np.int32))
             for lo in range(0, n, slab):
                 hi = min(n, lo + slab)
                 o = offsets[None, :]
-                p = pos[lo:hi, None]
-                valid = ((np.abs(o) <= w_eff[lo:hi, None])
-                         & (p + o >= 0)
-                         & (p + o < length[lo:hi, None]))
-                grid = np.clip(np.arange(lo, hi)[:, None] + o, 0, n - 1)
-                yield ids, lo, hi, grid, valid
+                po = pos[lo:hi, None] + o
+                valid = ((abs_off <= w_eff[lo:hi, None])
+                         & (po >= 0)
+                         & (po < length[lo:hi, None]))
+                grid = np.arange(lo, hi, dtype=np.int32)[:, None] + o
+                np.clip(grid, 0, n - 1, out=grid)
+                if extras is not None:
+                    yield (ids, lo, hi, grid, valid,
+                           tuple(e[lo:hi] for e in ex))
+                else:
+                    yield ids, lo, hi, grid, valid
 
     def _fit_fast_sgns(self, seqs, total_words: int):
         """Whole-corpus vectorized skip-gram (negative sampling OR

@@ -95,8 +95,12 @@ def read_full_model(path: str) -> Word2Vec:
     cache.total_word_count = meta["total_word_count"]
     model.vocab = cache
     import jax.numpy as jnp
-    model.syn0 = jnp.asarray(data["syn0"])
-    model.syn1 = jnp.asarray(data["syn1"])
+    # jnp.array (owning copy): a loaded model can train further, and the
+    # kernels donate syn0/syn1 — adopting the npz-owned buffers zero-copy
+    # would hand numpy-backed memory to the donation chain
+    # (use-after-free; see SequenceVectors._init_tables)
+    model.syn0 = jnp.array(data["syn0"])
+    model.syn1 = jnp.array(data["syn1"])
     if not model.use_hs:
         model._table = cache.unigram_table()
     if model.use_hs:

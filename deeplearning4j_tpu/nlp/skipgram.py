@@ -459,13 +459,20 @@ def draw_negatives(rng: np.random.Generator, table: np.ndarray,
     once, then cycled to (pos+1) mod vocab — the single home of the
     collision policy shared by the SGNS and CBOW fast paths."""
     n = pos.shape[0]
-    negs = table[rng.integers(0, len(table), (n, n_neg))]
-    bad = negs == pos
-    if bad.any():
-        negs[bad] = table[rng.integers(0, len(table), int(bad.sum()))]
-        bad = negs == pos
-        negs[bad] = (np.broadcast_to(pos, negs.shape)[bad] + 1) \
-            % max(n_words, 2)
+    # uint32 draws: ~2x faster than the int64 default in numpy's
+    # Lemire path, and table indices always fit
+    negs = table[rng.integers(0, len(table), (n, n_neg),
+                              dtype=np.uint32)]
+    bad = np.nonzero(negs == pos)
+    if bad[0].size:
+        # redraw/cycle only the colliding cells (~1 in vocab^0.25 of
+        # pairs) — a second full-width compare cost more than all the
+        # collisions combined at the 500k-pair chunk size
+        redraw = table[rng.integers(0, len(table), bad[0].size)]
+        pb = pos[bad[0], 0]
+        still = redraw == pb
+        redraw[still] = (pb[still] + 1) % max(n_words, 2)
+        negs[bad] = redraw
     return negs
 
 

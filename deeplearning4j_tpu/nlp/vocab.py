@@ -42,6 +42,8 @@ class VocabCache:
         vw.index = len(self._words)
         self._words.append(vw)
         self._by_word[vw.word] = vw
+        # word->index cache (built lazily by _encode_corpus_flat)
+        self._index_by_word = None
 
     def contains_word(self, word: str) -> bool:
         return word in self._by_word

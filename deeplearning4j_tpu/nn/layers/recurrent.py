@@ -105,6 +105,16 @@ class LSTM(FeedForwardLayer):
             c = _apply_mask_step(mask_t, c, c_prev)
         return (hy, c)
 
+    def _fused_eligible(self) -> bool:
+        """The fused Pallas recurrence implements exactly the default
+        cell: gate-major [i|f|o|g] columns, sigmoid gates, tanh
+        activation, no peepholes. Subclasses overriding ``_cell``
+        (GravesLSTM) or non-default configs stay on the scan path."""
+        return (type(self)._cell is LSTM._cell
+                and self.gate_layout == "gate_major"
+                and self.activation == Activation.TANH
+                and self.gate_activation == Activation.SIGMOID)
+
     def apply(self, params, state, x, ctx, initial_state=None):
         ctx, dk = ctx.split_rng()
         x = self.maybe_dropout(x, ctx, dk)
@@ -118,6 +128,27 @@ class LSTM(FeedForwardLayer):
         else:
             h0, c0 = initial_state
         mask = ctx.mask
+
+        # Helper tier (CudnnLSTMHelper analog): route the recurrence to
+        # the fused Pallas kernel where the measured crossover (or an
+        # explicit DL4J_LSTM_IMPL=fused) says it wins; any trace-time
+        # kernel failure falls back silently to the scan below.
+        if self._fused_eligible():
+            from deeplearning4j_tpu.ops import pallas_lstm
+            if pallas_lstm.choose_impl(n, h, t) == "fused":
+                try:
+                    ysT, hT, cT = pallas_lstm.lstm_fused(
+                        zx.transpose(1, 0, 2), h0, c0, params["Wh"],
+                        None if mask is None else mask.transpose(1, 0))
+                    out = ysT.transpose(1, 0, 2)
+                    if mask is not None:
+                        out = out * mask[:, :, None].astype(out.dtype)
+                    new_state = dict(state)
+                    new_state["last_h"] = hT
+                    new_state["last_c"] = cT
+                    return out, new_state
+                except Exception:
+                    pass
 
         def step(carry, inp):
             if mask is None:

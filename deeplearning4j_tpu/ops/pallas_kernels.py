@@ -98,8 +98,13 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
         lse_ref[0, 0] = jnp.where(valid, m + jnp.log(l_safe), _NEG)
 
 
+# jax >= 0.5 renamed TPUCompilerParams -> CompilerParams; support both
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
+
 def _dim_sem(n: int):
-    return pltpu.CompilerParams(
+    return _CompilerParams(
         dimension_semantics=("parallel",) * (n - 1) + ("arbitrary",))
 
 

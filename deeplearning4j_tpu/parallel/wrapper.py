@@ -33,11 +33,11 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
 from deeplearning4j_tpu.datasets.dataset import DataSet, DataSetIterator
 from deeplearning4j_tpu.optimize.solver import TrainState
-from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, create_mesh
+from deeplearning4j_tpu.parallel.mesh import (DATA_AXIS, compat_shard_map,
+                                              create_mesh)
 
 
 class TrainingMode(enum.Enum):
@@ -237,7 +237,7 @@ class ParallelWrapper:
 
         # Everything replicated except the batch: (k, B, ...) sharded on B.
         pspec_batch = P(None, DATA_AXIS)
-        wrapped = shard_map(
+        wrapped = compat_shard_map(
             worker_steps, mesh=mesh,
             in_specs=(P(), pspec_batch, pspec_batch, pspec_batch,
                       pspec_batch, P()),

@@ -12,6 +12,7 @@ ThreadingHTTPServer + one self-contained HTML page drawing charts on a
 from __future__ import annotations
 
 import json
+import logging
 import math
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -24,6 +25,8 @@ from deeplearning4j_tpu.ui.storage import StatsStorage
 # dashboard binds localhost, but an unbounded Content-Length read could
 # still exhaust memory on a bad client.
 _MAX_UPLOAD_BYTES = 8 << 20
+
+_log = logging.getLogger(__name__)
 
 _PAGE = """<!doctype html>
 <html><head><title>deeplearning4j_tpu training UI</title><style>
@@ -500,10 +503,22 @@ class _Handler(BaseHTTPRequestHandler):
                 if isinstance(payload, str):
                     payload = payload.encode("utf-8")
                 payload = bytes(payload)
-            else:
+            elif isinstance(out, dict):
                 payload, ctype = None, None
+            else:
+                # a handler returning anything else is a module bug;
+                # surface it as one instead of a 200 with JSON null
+                raise TypeError(
+                    "module route handler must return a dict or a "
+                    f"(payload, content_type) tuple, got "
+                    f"{type(out).__name__}")
         except Exception as e:                # module bug ≠ server crash
-            self._json({"error": f"module route failed: {e}"}, 500)
+            # full detail stays in the server log; HTTP clients only
+            # learn the exception class (no message text leaks)
+            _log.exception("module route %s %s failed",
+                           route.method, route.path)
+            self._json({"error": "module route failed: "
+                                 f"{type(e).__name__}"}, 500)
             return
         if payload is not None:
             self.send_response(200)

@@ -31,6 +31,21 @@ def pin_cpu_mesh(n_devices: int) -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
+def smoke() -> bool:
+    """True when the DL4J_EXAMPLE_SMOKE env knob is set: examples
+    shrink shapes/step counts to seconds-scale and skip interactive
+    waits, so the test suite's smoke tier can assert each walkthrough
+    still runs to rc=0 (see tests/test_examples.py,
+    ``./runtests.sh --examples``)."""
+    return os.environ.get("DL4J_EXAMPLE_SMOKE", "") not in ("", "0")
+
+
+def sized(full, tiny):
+    """Pick a tunable's full-size value, or the tiny smoke-tier value
+    when DL4J_EXAMPLE_SMOKE is set."""
+    return tiny if smoke() else full
+
+
 def need_devices(n_devices: int) -> None:
     """Actionable exit when the backend came up too small (instead of an
     opaque mesh reshape error)."""

@@ -41,7 +41,8 @@ def main():
 
     # Barnes-Hut t-SNE down to 2-D (feed the coords to
     # UIServer.upload_tsne to see them in the dashboard's t-SNE tab)
-    coords = BarnesHutTsne(perplexity=20.0, n_iter=250,
+    coords = BarnesHutTsne(perplexity=20.0,
+                           n_iter=_bootstrap.sized(250, 30),
                            seed=1).fit_transform(x)
     # blobs stay separated in the embedding: mean within-cluster
     # distance << mean between-cluster distance

@@ -27,7 +27,9 @@ from deeplearning4j_tpu.ui import (
 from deeplearning4j_tpu.ui.convolutional import ConvolutionalListener
 
 storage = InMemoryStatsStorage()
-server = UIServer.get_instance(port=9000).attach(storage).start()
+# smoke tier: ephemeral port so parallel test runs never collide
+server = UIServer.get_instance(
+    port=_bootstrap.sized(9000, 0)).attach(storage).start()
 print("dashboard:", server.url)
 
 model = LeNet(compute_dtype="float32").init()
@@ -39,18 +41,22 @@ model.set_listeners(
     ConvolutionalListener(storage, session_id="digits",
                           frequency=5).set_example(example),
     # the t-SNE tab populates itself from the live model every 20 steps
-    TsneListener(server, frequency=20, n_iter=250).set_example(
+    TsneListener(server, frequency=20,
+                 n_iter=_bootstrap.sized(250, 20)).set_example(
         test_imgs[:300], test_labels[:300]))
 train_it.reset()
-model.fit(train_it, epochs=10)
+model.fit(train_it, epochs=_bootstrap.sized(10, 1))
 
 acc = model.evaluate(DigitsDataSetIterator(batch_size=64, train=False,
                                            shuffle=False)).accuracy()
 print("test accuracy:", acc)
-print("dashboard live — press Ctrl-C to exit")
-try:
-    import time
-    time.sleep(3600)
-except KeyboardInterrupt:
-    pass
+if _bootstrap.smoke():
+    print("smoke mode: exiting without the interactive wait")
+else:
+    print("dashboard live — press Ctrl-C to exit")
+    try:
+        import time
+        time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
 server.stop()

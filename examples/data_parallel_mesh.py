@@ -44,8 +44,9 @@ def main():
           .workers(len(jax.devices()))
           .training_mode(TrainingMode.SHARED_GRADIENTS)
           .build())
-    train = MnistDataSetIterator(batch_size=256, subset=4096)
-    pw.fit(train, epochs=2)
+    train = MnistDataSetIterator(batch_size=256,
+                                 subset=_bootstrap.sized(4096, 512))
+    pw.fit(train, epochs=_bootstrap.sized(2, 1))
 
     test = MnistDataSetIterator(batch_size=256, subset=1024, train=False)
     print("accuracy:", model.evaluate(test).accuracy())

@@ -59,7 +59,7 @@ def main():
     # early stopping: stop when validation loss stalls for 3 epochs
     esc = (EarlyStoppingConfiguration.Builder()
            .epoch_termination_conditions(
-               MaxEpochsTerminationCondition(30),
+               MaxEpochsTerminationCondition(_bootstrap.sized(30, 5)),
                ScoreImprovementEpochsTerminationCondition(3))
            .score_calculator(DataSetLossCalculator(
                ArrayDataSetIterator(DataSet(xv, yv), batch_size=64)))
@@ -84,7 +84,7 @@ def main():
           .n_out_replace(2, 3)               # new 3-class head
           .build())
     ft.fit(ArrayDataSetIterator(DataSet(x3, y3), batch_size=64),
-           epochs=40)
+           epochs=_bootstrap.sized(40, 6))
     ev = ft.evaluate(ArrayDataSetIterator(DataSet(x3, y3), batch_size=64))
     print(f"fine-tuned accuracy on the new task: {ev.accuracy():.3f}")
     w0 = np.asarray(base.train_state.params["layer_0"]["W"])

@@ -55,7 +55,8 @@ def main():
     y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 128)]
 
     m = model()
-    m.fit(ArrayDataSetIterator(DataSet(x, y), batch_size=64), epochs=2)
+    m.fit(ArrayDataSetIterator(DataSet(x, y), batch_size=64),
+          epochs=_bootstrap.sized(2, 1))
     out_before = np.asarray(m.output(x[:8]), np.float32)
 
     ckpt_dir = tempfile.mkdtemp(prefix="dl4j_ckpt_")

@@ -35,7 +35,8 @@ def main():
 
     # GloVe: AdaGrad over the weighted log-co-occurrence objective
     glove = Glove(layer_size=24, window_size=4, min_word_frequency=1,
-                  epochs=20, learning_rate=0.05, seed=3)
+                  epochs=_bootstrap.sized(20, 3),
+                  learning_rate=0.05, seed=3)
     glove.fit(sents)
     print("glove: cat~dog", round(glove.similarity("cat", "dog"), 3),
           "vs cat~truck", round(glove.similarity("cat", "truck"), 3))
@@ -45,7 +46,8 @@ def main():
     # ParagraphVectors (DBOW): label vectors live in the same space
     docs = [LabelledDocument(content=s, labels=[f"doc_{i}"])
             for i, s in enumerate(sents[:100])]
-    pv = ParagraphVectors(layer_size=24, window_size=4, epochs=10,
+    pv = ParagraphVectors(layer_size=24, window_size=4,
+                          epochs=_bootstrap.sized(10, 2),
                           negative=4, min_word_frequency=1, seed=5)
     pv.fit(docs)
     # two animal docs should be closer than an animal/vehicle pair

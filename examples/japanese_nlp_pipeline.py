@@ -35,9 +35,11 @@ def main():
         "電車で会社に行く",
         "会社の仕事は大変",
     ]
-    corpus = [sentences[i] for i in rng.integers(0, len(sentences), 400)]
+    corpus = [sentences[i] for i in rng.integers(
+        0, len(sentences), _bootstrap.sized(400, 60))]
     w2v = Word2Vec(tokenizer_factory=ja, layer_size=16, window_size=3,
-                   min_word_frequency=2, epochs=8, negative=4, seed=1)
+                   min_word_frequency=2, epochs=_bootstrap.sized(8, 2),
+                   negative=4, seed=1)
     w2v.fit(corpus)
     print("-- embeddings --")
     print("  学校 ~ 学生:", round(w2v.similarity("学校", "学生"), 3),

@@ -41,7 +41,7 @@ def main():
     y = np.eye(4, dtype=np.float32)[
         np.random.default_rng(1).integers(0, 4, 64)]
     before = model.score(DataSet(x, y))
-    for _ in range(30):
+    for _ in range(_bootstrap.sized(30, 2)):
         model.fit(DataSet(x, y))
     print(f"fine-tune loss {before:.3f} -> {model.score(DataSet(x, y)):.3f}")
 

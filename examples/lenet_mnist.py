@@ -54,9 +54,12 @@ def main():
     model.set_listeners(ScoreIterationListener(10),
                         PerformanceListener(frequency=10))
 
-    train = MnistDataSetIterator(batch_size=128, subset=4096)
-    test = MnistDataSetIterator(batch_size=128, subset=1024, train=False)
-    model.fit(train, epochs=2)
+    train = MnistDataSetIterator(batch_size=128,
+                                 subset=_bootstrap.sized(4096, 256))
+    test = MnistDataSetIterator(batch_size=128,
+                                subset=_bootstrap.sized(1024, 128),
+                                train=False)
+    model.fit(train, epochs=_bootstrap.sized(2, 1))
 
     ev = model.evaluate(test)
     print(ev.stats())

@@ -35,7 +35,7 @@ def main():
     model = MultiLayerNetwork(conf).init()
     train = UciSequenceDataSetIterator(32, train=True)
     test = UciSequenceDataSetIterator(32, train=False)
-    model.fit(train, epochs=5)
+    model.fit(train, epochs=_bootstrap.sized(5, 1))
     ev = model.evaluate(test)
     print(f"test accuracy: {ev.accuracy():.3f}")
 

@@ -61,7 +61,7 @@ def main():
     model.set_listeners(ScoreIterationListener(5),
                         PerformanceListener(5))
     model.fit(ArrayDataSetIterator(DataSet(x, y), batch_size=64),
-              epochs=3)
+              epochs=_bootstrap.sized(3, 1))
     print("done — per-iteration samples/sec + ETL ms were printed by "
           "PerformanceListener above")
 

@@ -42,12 +42,13 @@ def step(p):
     return jax.tree_util.tree_map(lambda a, b: a - 0.5 * b, p, g), loss
 
 
-for i in range(70):
+for i in range(_bootstrap.sized(70, 12)):
     params, loss = step(params)
     if i % 10 == 0:
         print(f"step {i:3d}  loss {float(loss):.4f}")
 print(f"final loss {float(loss):.4f}")
-assert float(loss) < 1.0
+# the smoke tier runs too few steps to demand convergence
+assert _bootstrap.smoke() or float(loss) < 1.0
 
 # sanity: the pipelined loss equals the sequential stack bit-for-bit
 seq = float(lm.loss(params, toks, tgts, pipelined=False))

@@ -60,7 +60,7 @@ def streaming_rnn():
     y[..., 1] = (run_mean > 0)
     y[..., 0] = 1.0 - y[..., 1]
     ds = DataSet(x, y)
-    for epoch in range(30):
+    for epoch in range(_bootstrap.sized(30, 4)):
         net.fit(ds)               # chunks of 8 timesteps under the hood
     print(f"TBPTT-trained graph score: {float(net.score(ds)):.4f}")
 

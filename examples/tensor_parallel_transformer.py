@@ -58,7 +58,7 @@ labels[np.arange(64)[:, None], np.arange(T)[None, :],
        (feats.astype(int) % CLASSES)] = 1.0   # learnable: class = token%4
 
 wrapper.fit(ArrayDataSetIterator(DataSet(feats, labels), batch_size=64),
-            epochs=30)
+            epochs=_bootstrap.sized(30, 4))
 
 print("loss:", float(model._last_loss))
 wqkv = model.params["layer_1"]["attn"]["Wqkv"]
@@ -66,4 +66,5 @@ print("Wqkv sharding:", wqkv.sharding.spec)
 acc = (np.asarray(model.output(feats)).argmax(-1)
        == feats.astype(int) % CLASSES).mean()
 print("token accuracy:", acc)
-assert acc > 0.95
+# the smoke tier trains too few epochs to demand convergence
+assert _bootstrap.smoke() or acc > 0.95

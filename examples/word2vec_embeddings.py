@@ -16,12 +16,12 @@ CORPUS = [
     "the dog chased the cat",
     "mice fear the cat",
     "dogs and cats are pets",
-] * 50
+] * _bootstrap.sized(50, 4)
 
 
 def main():
     w2v = Word2Vec(layer_size=32, window_size=3, negative=5,
-                   min_word_frequency=1, epochs=5, seed=7)
+                   min_word_frequency=1, epochs=_bootstrap.sized(5, 1), seed=7)
     w2v.fit(CORPUS)
 
     print("vocab size:", w2v.vocab.num_words())

@@ -4,4 +4,10 @@
 # args through, e.g. ./runtests.sh -k keras
 set -euo pipefail
 cd "$(dirname "$0")"
+# --examples: the examples/ smoke tier (each walkthrough runs as a
+# subprocess with DL4J_EXAMPLE_SMOKE=1 and must exit rc=0)
+if [[ "${1:-}" == "--examples" ]]; then
+  shift
+  exec python -m pytest tests/test_examples.py -q -m slow "$@"
+fi
 exec python -m pytest tests/ -q "$@"

@@ -211,6 +211,27 @@ class TestParagraphVectors:
                                           np.linalg.norm(b) + 1e-9))
         assert cos(va, cat) > cos(va, car)
 
+    def test_dbow_lr_anneals_within_one_slab(self):
+        """The corpus-level DBOW producer must SPREAD anneal progress
+        over pushed pairs exactly like the CBOW/SGNS walks (the
+        first-seal/last-seal contract from code-review r5): a corpus
+        that fits in one slab must see the lr walk down smoothly, not
+        snap to min_learning_rate before the first chunk seals."""
+        docs = [LabelledDocument(
+            f"cat dog pet fur paw tail whisker meow purr claw d{i % 7}",
+            [f"DOC_{i}"]) for i in range(120)]
+        pv = ParagraphVectors(dm=False, layer_size=8, window_size=3,
+                              min_word_frequency=1, epochs=1, negative=2,
+                              batch_size=512, seed=1)
+        calls = []
+        orig = pv._lr
+        pv._lr = lambda seen, total: (calls.append(seen / max(total, 1))
+                                      or orig(seen, total))
+        pv.fit(docs)
+        assert len(calls) >= 4
+        assert calls[0] < 0.3, calls[:3]      # first seal: early anneal
+        assert calls[-1] > 0.7, calls[-3:]    # last seal: near the end
+
     def test_infer_and_predict(self):
         pv = ParagraphVectors(layer_size=16, window_size=3, epochs=6,
                               negative=4, learning_rate=0.05, seed=3)

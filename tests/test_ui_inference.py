@@ -420,6 +420,60 @@ class TestUIModuleSPI:
         finally:
             srv.stop()
 
+    def test_module_error_detail_stays_server_side(self):
+        import json as _json
+        import urllib.error
+        import urllib.request
+        from deeplearning4j_tpu.ui.modules import Route, UIModule
+
+        class LeakyModule(UIModule):
+            def get_routes(self):
+                def boom(ctx, q, body):
+                    raise RuntimeError("secret /etc/path in message")
+                return [Route("GET", "/api/leak", boom)]
+
+        srv = self._srv().register_module(LeakyModule()).start()
+        try:
+            try:
+                urllib.request.urlopen(srv.url + "/api/leak")
+                raise AssertionError("expected 500")
+            except urllib.error.HTTPError as e:
+                assert e.code == 500
+                err = _json.loads(e.read())["error"]
+            # clients learn the exception class, never the message
+            assert "RuntimeError" in err
+            assert "secret" not in err and "/etc/path" not in err
+        finally:
+            srv.stop()
+
+    def test_module_bad_return_type_is_500(self):
+        import json as _json
+        import urllib.error
+        import urllib.request
+        from deeplearning4j_tpu.ui.modules import Route, UIModule
+
+        class WrongModule(UIModule):
+            def get_routes(self):
+                return [
+                    Route("GET", "/api/str",
+                          lambda ctx, q, body: "not a dict"),
+                    Route("GET", "/api/none",
+                          lambda ctx, q, body: None),
+                ]
+
+        srv = self._srv().register_module(WrongModule()).start()
+        try:
+            for path in ("/api/str", "/api/none"):
+                try:
+                    urllib.request.urlopen(srv.url + path)
+                    raise AssertionError(f"expected 500 for {path}")
+                except urllib.error.HTTPError as e:
+                    assert e.code == 500
+                    assert "TypeError" in _json.loads(
+                        e.read())["error"]
+        finally:
+            srv.stop()
+
     def test_i18n_bundles_and_page(self):
         import json as _json
         import urllib.request
