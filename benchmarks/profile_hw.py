@@ -205,6 +205,11 @@ if __name__ == "__main__":
         sys.exit(f"unknown mode {mode!r}: expected "
                  "unfused|fused|gram|vgg|bert|lstm|inception "
                  "[batch] [f32|bf16]")
+    # host-side span trace (observe/tracer.py) rides along with the
+    # device xplane capture: build/compile/capture/analyze phases land
+    # in <outdir>/host_trace.json, loadable in Perfetto / chrome://tracing
+    from deeplearning4j_tpu.observe import SpanTracer
+    tracer = SpanTracer()
     if mode in ("bert", "lstm", "inception"):
         batch = int(sys.argv[2]) if len(sys.argv) > 2 else (
             {"bert": 32, "lstm": 256, "inception": 64}[mode])
@@ -213,15 +218,26 @@ if __name__ == "__main__":
             sys.exit(f"unknown dtype {dtype!r}: expected f32|bf16")
         k = 8
         outdir = tempfile.mkdtemp(prefix="dl4j_hwprof_")
-        {"bert": capture_bert, "lstm": capture_lstm,
-         "inception": capture_inception}[mode](batch, k, outdir, dtype)
+        with tracer.span("capture", cat="profile", mode=mode,
+                         batch=batch, k=k):
+            {"bert": capture_bert, "lstm": capture_lstm,
+             "inception": capture_inception}[mode](batch, k, outdir,
+                                                   dtype)
         print(f"trace: {outdir}")
-        analyze(outdir, k)
+        with tracer.span("analyze", cat="profile"):
+            analyze(outdir, k)
+        tracer.save(outdir + "/host_trace.json")
+        print(f"host trace: {outdir}/host_trace.json")
         sys.exit(0)
     batch = int(sys.argv[2]) if len(sys.argv) > 2 else (
         512 if mode == "vgg" else 256)
     k = 64
     outdir = tempfile.mkdtemp(prefix="dl4j_hwprof_")
-    capture(mode, batch, k, outdir)
+    with tracer.span("capture", cat="profile", mode=mode, batch=batch,
+                     k=k):
+        capture(mode, batch, k, outdir)
     print(f"trace: {outdir}")
-    analyze(outdir, k)
+    with tracer.span("analyze", cat="profile"):
+        analyze(outdir, k)
+    tracer.save(outdir + "/host_trace.json")
+    print(f"host trace: {outdir}/host_trace.json")

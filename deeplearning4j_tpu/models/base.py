@@ -50,6 +50,17 @@ class BaseModel:
         self._rng = None
         self.epoch_count = 0
         self._last_loss = None
+        # observability (observe/): in-step telemetry collector, span
+        # tracer, recompile watchdog. All optional; the defaults cost one
+        # branch per step.
+        self._telemetry = None
+        self.tracer = None
+        self.recompile_watchdog = None
+        # host-side mirror of train_state.iteration: reading the device
+        # scalar every step (int(ts.iteration)) is itself a per-step
+        # device sync; the mirror is re-adopted from the device once per
+        # fit() call and advanced locally afterwards
+        self._host_iteration: Optional[int] = None
 
     # ---- to be provided by subclasses -----------------------------------
     def init(self, seed: Optional[int] = None):
@@ -93,6 +104,66 @@ class BaseModel:
     def iteration_count(self) -> int:
         return int(self.train_state.iteration)
 
+    # ---- observability ---------------------------------------------------
+    @property
+    def telemetry(self):
+        """The attached TelemetryCollector, or None."""
+        return self._telemetry
+
+    def set_telemetry(self, collector):
+        """Attach an ``observe.TelemetryCollector``: the metric spec is
+        compiled into the next train step built, the ring buffer rides in
+        the TrainState, and the collector flushes it every N steps in one
+        device fetch. Pass None to detach."""
+        if collector is not None:
+            collector.spec_for(self)
+        self._telemetry = collector
+        # the spec is baked into the jitted steps — force rebuilds
+        self._train_step = None
+        if hasattr(self, "_tbptt_step"):
+            self._tbptt_step = None
+        return self
+
+    def set_tracer(self, tracer):
+        """Attach an ``observe.SpanTracer`` recording etl / transfer /
+        dispatch / flush spans around the fit loop."""
+        self.tracer = tracer
+        return self
+
+    def set_recompile_watchdog(self, watchdog):
+        self.recompile_watchdog = watchdog
+        return self
+
+    def _telemetry_spec(self):
+        return (None if self._telemetry is None
+                else self._telemetry.spec_for(self))
+
+    def _advance_iteration(self, steps: int = 1) -> int:
+        """Host-tracked iteration count after a dispatched step. Syncs
+        with the device scalar only when the mirror is stale (once per
+        fit() call), so steady-state listener dispatch costs no
+        device→host round trip."""
+        if self._host_iteration is None:
+            self._host_iteration = int(self.train_state.iteration)
+        else:
+            self._host_iteration += steps
+        return self._host_iteration
+
+    def _post_step(self, steps: int = 1) -> int:
+        """Shared per-dispatch epilogue: advance the iteration mirror and
+        give the telemetry collector its flush opportunity."""
+        it = self._advance_iteration(steps)
+        tel = self._telemetry
+        if tel is not None:
+            if tel.will_flush(steps):
+                from deeplearning4j_tpu.observe.tracer import get_tracer
+                with get_tracer(self).span("telemetry_flush",
+                                           cat="telemetry"):
+                    tel.on_step(self.train_state, steps)
+            else:
+                tel.on_step(self.train_state, steps)
+        return it
+
     # ---- fit loop -------------------------------------------------------
     def fit(self, data, epochs: int = 1):
         """fit(DataSet) / fit(DataSetIterator[, epochs]) — the reference's
@@ -118,7 +189,16 @@ class BaseModel:
                     "MultiDataSet requires a ComputationGraph; wrap "
                     "single-input data in a DataSet for "
                     "MultiLayerNetwork")
+        # re-adopt the device iteration once per fit() call: external
+        # code may have swapped train_state (checkpoint load, transfer
+        # learning) since the last fit
+        self._host_iteration = None
+        from deeplearning4j_tpu.observe.tracer import get_tracer
+        tracer = get_tracer(self)
         if isinstance(data, (DataSet, MultiDataSet)):
+            # single-batch fit: _post_step already flushed if an interval
+            # completed; flushing unconditionally here would turn the
+            # common fit-per-batch driver loop into one fetch per step
             self._fit_batch(data)
             return self
         iterator = data
@@ -127,7 +207,9 @@ class BaseModel:
                 lst.on_epoch_start(self, self.epoch_count)
             it_start = time.perf_counter()
             for batch in iterator:
-                etl_ms = (time.perf_counter() - it_start) * 1000.0
+                now = time.perf_counter()
+                etl_ms = (now - it_start) * 1000.0
+                tracer.add_span("etl", it_start, now, cat="data")
                 self._fit_batch(batch, etl_ms=etl_ms)
                 it_start = time.perf_counter()
             if isinstance(iterator, DataSetIterator):
@@ -135,19 +217,34 @@ class BaseModel:
             for lst in self.listeners:
                 lst.on_epoch_end(self, self.epoch_count)
             self.epoch_count += 1
+        # tail flush so the last (< flush_interval) rows aren't stranded
+        # on device when training ends
+        if self._telemetry is not None:
+            with tracer.span("telemetry_flush", cat="telemetry"):
+                self._telemetry.flush(self.train_state)
         return self
 
     def _fit_batch(self, batch: DataSet, etl_ms: float = 0.0):
+        from deeplearning4j_tpu.observe.tracer import get_tracer
+        tracer = get_tracer(self)
         self._rng, step_key = jax.random.split(self._rng)
-        features = jnp.asarray(batch.features)
-        labels = jnp.asarray(batch.labels)
-        fmask = None if batch.features_mask is None else jnp.asarray(
-            batch.features_mask)
-        lmask = None if batch.labels_mask is None else jnp.asarray(
-            batch.labels_mask)
-        self.train_state, loss = self._train_step(
-            self.train_state, features, labels, fmask, lmask, step_key)
-        it = int(self.train_state.iteration)
+        with tracer.span("host_to_device", cat="data"):
+            features = jnp.asarray(batch.features)
+            labels = jnp.asarray(batch.labels)
+            fmask = None if batch.features_mask is None else jnp.asarray(
+                batch.features_mask)
+            lmask = None if batch.labels_mask is None else jnp.asarray(
+                batch.labels_mask)
+        if self._telemetry is not None:
+            self.train_state = self._telemetry.ensure_buffer(
+                self.train_state)
+        if self.recompile_watchdog is not None:
+            self.recompile_watchdog.observe(
+                "train_step", features, labels, fmask, lmask)
+        with tracer.span("dispatch", cat="step"):
+            self.train_state, loss = self._train_step(
+                self.train_state, features, labels, fmask, lmask, step_key)
+        it = self._post_step()
         for lst in self.listeners:
             lst.iteration_done(self, it, self.epoch_count, loss, etl_ms,
                                batch.num_examples())

@@ -191,31 +191,42 @@ class ComputationGraph(BaseModel):
         return make_train_step(
             loss_fn, self._tx,
             constrain_fn=make_constrain_fn(
-                [l for l in self._constraint_layers()]))
+                [l for l in self._constraint_layers()]),
+            telemetry=self._telemetry_spec())
 
     # ---- fit ------------------------------------------------------------
     def _fit_batch_standard(self, batch: Union[DataSet, MultiDataSet],
                             etl_ms: float = 0.0):
+        from deeplearning4j_tpu.observe.tracer import get_tracer
+        tracer = get_tracer(self)
         self._rng, step_key = jax.random.split(self._rng)
-        if isinstance(batch, MultiDataSet):
-            feats = tuple(jnp.asarray(f) for f in batch.features)
-            labels = tuple(jnp.asarray(l) for l in batch.labels)
-            fmasks = tuple(None if m is None else jnp.asarray(m)
-                           for m in (batch.features_masks or [])) or None
-            lmasks = tuple(None if m is None else jnp.asarray(m)
-                           for m in (batch.labels_masks or [])) or None
-            n_examples = batch.num_examples()
-        else:
-            feats = (jnp.asarray(batch.features),)
-            labels = (jnp.asarray(batch.labels),)
-            fmasks = (None if batch.features_mask is None
-                      else (jnp.asarray(batch.features_mask),))
-            lmasks = (None if batch.labels_mask is None
-                      else (jnp.asarray(batch.labels_mask),))
-            n_examples = batch.num_examples()
-        self.train_state, loss = self._train_step(
-            self.train_state, feats, labels, fmasks, lmasks, step_key)
-        it = int(self.train_state.iteration)
+        with tracer.span("host_to_device", cat="data"):
+            if isinstance(batch, MultiDataSet):
+                feats = tuple(jnp.asarray(f) for f in batch.features)
+                labels = tuple(jnp.asarray(l) for l in batch.labels)
+                fmasks = tuple(None if m is None else jnp.asarray(m)
+                               for m in (batch.features_masks or [])) or None
+                lmasks = tuple(None if m is None else jnp.asarray(m)
+                               for m in (batch.labels_masks or [])) or None
+                n_examples = batch.num_examples()
+            else:
+                feats = (jnp.asarray(batch.features),)
+                labels = (jnp.asarray(batch.labels),)
+                fmasks = (None if batch.features_mask is None
+                          else (jnp.asarray(batch.features_mask),))
+                lmasks = (None if batch.labels_mask is None
+                          else (jnp.asarray(batch.labels_mask),))
+                n_examples = batch.num_examples()
+        if self._telemetry is not None:
+            self.train_state = self._telemetry.ensure_buffer(
+                self.train_state)
+        if self.recompile_watchdog is not None:
+            self.recompile_watchdog.observe(
+                "train_step", feats, labels, fmasks, lmasks)
+        with tracer.span("dispatch", cat="step"):
+            self.train_state, loss = self._train_step(
+                self.train_state, feats, labels, fmasks, lmasks, step_key)
+        it = self._post_step()
         for lst in self.listeners:
             lst.iteration_done(self, it, self.epoch_count, loss, etl_ms,
                                n_examples)
@@ -249,6 +260,7 @@ class ComputationGraph(BaseModel):
         import optax
         constrain_fn = make_constrain_fn(list(self._constraint_layers()))
         carry_nodes = self._recurrent_carry_nodes()
+        telemetry = self._telemetry_spec()
 
         def step(ts, features, labels, fmasks, lmasks, rng, carries):
             def lf(params):
@@ -262,6 +274,12 @@ class ComputationGraph(BaseModel):
             new_params = optax.apply_updates(ts.params, updates)
             if constrain_fn is not None:
                 new_params = constrain_fn(new_params)
+            buf = ts.telemetry
+            if telemetry is not None:
+                buf = telemetry.record(buf, loss=loss, grads=grads,
+                                       params=new_params,
+                                       prev_params=ts.params,
+                                       iteration=ts.iteration)
             # carries cross the chunk boundary with gradients cut — this
             # IS the truncation (same contract as the MLN TBPTT step)
             new_carries = {}
@@ -271,7 +289,7 @@ class ComputationGraph(BaseModel):
                      else s["last_h"])
                 new_carries[name] = jax.lax.stop_gradient(c)
             return (TrainState(new_params, new_ms, new_opt,
-                               ts.iteration + 1), loss, new_carries)
+                               ts.iteration + 1, buf), loss, new_carries)
 
         return jax.jit(step, donate_argnums=(0,))
 
@@ -315,8 +333,14 @@ class ComputationGraph(BaseModel):
                 "streams (with a features mask) to a common length.")
         T = seq_lens.pop()
         n = feats[0].shape[0]
+        from deeplearning4j_tpu.observe.tracer import get_tracer
+        tracer = get_tracer(self)
+        if self._telemetry is not None:
+            self.train_state = self._telemetry.ensure_buffer(
+                self.train_state)
         carries = self._zero_carries(n)
         loss = None
+        n_chunks = 0
         for lo in range(0, T, k):
             hi = min(lo + k, T)
             cf, cl, cfm, clm = [], [], [], []
@@ -374,10 +398,15 @@ class ComputationGraph(BaseModel):
             self._rng, step_key = jax.random.split(self._rng)
             tj = lambda seq: tuple(None if a is None else jnp.asarray(a)
                                    for a in seq)
-            self.train_state, loss, carries = self._tbptt_step(
-                self.train_state, tj(cf), tj(cl), tj(cfm), tj(clm),
-                step_key, carries)
-        it = int(self.train_state.iteration)
+            cf, cl, cfm, clm = tj(cf), tj(cl), tj(cfm), tj(clm)
+            if self.recompile_watchdog is not None:
+                self.recompile_watchdog.observe("tbptt_step", cf, cl,
+                                                cfm, clm)
+            with tracer.span("dispatch", cat="step"):
+                self.train_state, loss, carries = self._tbptt_step(
+                    self.train_state, cf, cl, cfm, clm, step_key, carries)
+            n_chunks += 1
+        it = self._post_step(n_chunks)
         for lst in self.listeners:
             lst.iteration_done(self, it, self.epoch_count, loss, etl_ms,
                                n)

@@ -204,7 +204,8 @@ class MultiLayerNetwork(BaseModel):
         return make_train_step(
             loss_fn, self._tx,
             constrain_fn=make_constrain_fn(
-                [l for l in self._constraint_layers()]))
+                [l for l in self._constraint_layers()]),
+            telemetry=self._telemetry_spec())
 
     # ---- truncated BPTT (reference: doTruncatedBPTT:1521, SURVEY §5.7) --
     def _recurrent_carry_layers(self):
@@ -234,6 +235,7 @@ class MultiLayerNetwork(BaseModel):
         from deeplearning4j_tpu.optimize.solver import TrainState
         constrain_fn = make_constrain_fn(list(self._constraint_layers()))
         carry_layers = self._recurrent_carry_layers()
+        telemetry = self._telemetry_spec()
 
         def step(ts, features, labels, fmask, lmask, rng, carries):
             def lf(params):
@@ -246,6 +248,12 @@ class MultiLayerNetwork(BaseModel):
             new_params = optax.apply_updates(ts.params, updates)
             if constrain_fn is not None:
                 new_params = constrain_fn(new_params)
+            buf = ts.telemetry
+            if telemetry is not None:
+                buf = telemetry.record(buf, loss=loss, grads=grads,
+                                       params=new_params,
+                                       prev_params=ts.params,
+                                       iteration=ts.iteration)
             # carries cross the chunk boundary with gradients cut — this IS
             # the truncation (reference: tbpttBackLength; here back==fwd)
             new_carries = {}
@@ -254,7 +262,7 @@ class MultiLayerNetwork(BaseModel):
                 c = ((s["last_h"], s["last_c"]) if is_lstm else s["last_h"])
                 new_carries[layer.name] = jax.lax.stop_gradient(c)
             return (TrainState(new_params, new_ms, new_opt,
-                               ts.iteration + 1), loss, new_carries)
+                               ts.iteration + 1, buf), loss, new_carries)
 
         return jax.jit(step, donate_argnums=(0,))
 
@@ -280,8 +288,14 @@ class MultiLayerNetwork(BaseModel):
                  else np.asarray(batch.features_mask))
         lmask = (None if batch.labels_mask is None
                  else np.asarray(batch.labels_mask))
+        from deeplearning4j_tpu.observe.tracer import get_tracer
+        tracer = get_tracer(self)
+        if self._telemetry is not None:
+            self.train_state = self._telemetry.ensure_buffer(
+                self.train_state)
         carries = self._zero_carries(feats.shape[0])
         loss = None
+        n_chunks = 0
         for lo in range(0, T, k):
             hi = min(lo + k, T)
             f = feats[:, lo:hi]
@@ -300,10 +314,14 @@ class MultiLayerNetwork(BaseModel):
             self._rng, step_key = jax.random.split(self._rng)
             fm = None if fm is None else jnp.asarray(fm)
             lm = None if lm is None else jnp.asarray(lm)
-            self.train_state, loss, carries = self._tbptt_step(
-                self.train_state, jnp.asarray(f), jnp.asarray(l), fm, lm,
-                step_key, carries)
-        it = int(self.train_state.iteration)
+            f, l = jnp.asarray(f), jnp.asarray(l)
+            if self.recompile_watchdog is not None:
+                self.recompile_watchdog.observe("tbptt_step", f, l, fm, lm)
+            with tracer.span("dispatch", cat="step"):
+                self.train_state, loss, carries = self._tbptt_step(
+                    self.train_state, f, l, fm, lm, step_key, carries)
+            n_chunks += 1
+        it = self._post_step(n_chunks)
         for lst in self.listeners:
             lst.iteration_done(self, it, self.epoch_count, loss, etl_ms,
                                batch.num_examples())

@@ -1,0 +1,43 @@
+"""Device-resident telemetry: in-step metrics, tracing, Prometheus export.
+
+The reference's observability stack (BaseStatsListener + UI, SURVEY
+§2.12/§5.5) polls the JVM from the host; porting that shape verbatim
+makes every score/statistic its own device→host sync, stalling the TPU
+pipeline. This package inverts it:
+
+- ``telemetry``: a metric spec (loss, global grad-norm, per-layer
+  update:param ratio, non-finite counts) compiled INTO the jitted train
+  step, accumulated in a fixed-size on-device ring buffer and flushed to
+  host every N steps in ONE device fetch — steady-state training
+  performs zero extra syncs.
+- ``tracer``: host-side span tracer (ETL, host→device transfer,
+  dispatch, flush, eval, checkpoint) exporting Chrome/Perfetto trace
+  JSON, optionally annotating the jax.profiler timeline.
+- ``recompile``: watchdog recording each new (shape, dtype) signature a
+  compiled step sees — silent retrace storms become a counter.
+- ``registry``: process-wide metrics registry rendered as Prometheus
+  text exposition at ``/metrics`` on the UI server.
+"""
+
+from deeplearning4j_tpu.observe.registry import (
+    MetricsRegistry,
+    default_registry,
+)
+from deeplearning4j_tpu.observe.recompile import RecompileWatchdog
+from deeplearning4j_tpu.observe.telemetry import (
+    TelemetryBuffer,
+    TelemetryCollector,
+    TelemetrySpec,
+)
+from deeplearning4j_tpu.observe.tracer import NULL_TRACER, SpanTracer
+
+__all__ = [
+    "MetricsRegistry",
+    "default_registry",
+    "RecompileWatchdog",
+    "TelemetryBuffer",
+    "TelemetryCollector",
+    "TelemetrySpec",
+    "SpanTracer",
+    "NULL_TRACER",
+]

@@ -1,0 +1,86 @@
+"""Recompile watchdog: catch silent retrace storms.
+
+Every distinct (shape, dtype) signature a jitted step sees costs a full
+XLA compile — minutes on big models — and jax gives no per-call-site
+counter. The watchdog fingerprints each dispatch's argument pytree
+(shapes/dtypes only, a few µs on host) and records every NEW signature
+after the first per step key. New signatures increment the
+``dl4j_recompiles_total`` Prometheus counter and log a warning naming
+the offending shapes, so a leaky data pipeline (ragged batches, dtype
+drift) shows up as a climbing series instead of mystery step-time
+spikes.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.observe.registry import (
+    MetricsRegistry,
+    default_registry,
+)
+
+log = logging.getLogger(__name__)
+
+
+def signature_of(*trees) -> Tuple:
+    """Hashable compile signature of argument pytrees: tree structure +
+    (shape, dtype) per array leaf; non-arrays contribute their type
+    (None vs array flips compiled branches, e.g. optional masks)."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(trees)
+    sig = []
+    for l in leaves:
+        if hasattr(l, "shape") and hasattr(l, "dtype"):
+            sig.append((tuple(l.shape), str(l.dtype)))
+        elif isinstance(l, (bool, int, float, np.number)):
+            sig.append((type(l).__name__, l))
+        else:
+            sig.append(type(l).__name__)
+    return (str(treedef), tuple(sig))
+
+
+class RecompileWatchdog:
+    """Tracks signatures per step key (``train_step``, ``tbptt_step``,
+    ...). ``observe`` returns True when the signature is new — i.e. the
+    next dispatch almost certainly compiles."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 session_id: str = "train"):
+        self.registry = registry if registry is not None else \
+            default_registry()
+        self.session_id = session_id
+        self._sigs: Dict[str, Set[Tuple]] = {}
+        self.events: List[dict] = []
+        # register the series up front so /metrics shows a 0 count
+        # instead of an absent metric on healthy runs
+        self._counter = self.registry.counter(
+            "dl4j_recompiles_total", "new (shape, dtype) signatures seen "
+            "by compiled steps after their first compile")
+        self._counter.inc(0.0, session=self.session_id)
+
+    def observe(self, step_key: str, *trees) -> bool:
+        sig = signature_of(*trees)
+        seen = self._sigs.setdefault(step_key, set())
+        if sig in seen:
+            return False
+        first = not seen
+        seen.add(sig)
+        if first:
+            return True     # the initial compile is expected, not counted
+        self.events.append({"step": step_key, "signature": sig})
+        self._counter.inc(1.0, session=self.session_id)
+        log.warning(
+            "recompile: step %r saw new signature #%d %s — check the "
+            "data pipeline for ragged shapes/dtype drift",
+            step_key, len(seen) - 1, sig[1])
+        return True
+
+    def count(self, step_key: Optional[str] = None) -> int:
+        """Recompiles beyond the first compile (0 on a healthy run)."""
+        if step_key is not None:
+            return max(0, len(self._sigs.get(step_key, ())) - 1)
+        return sum(max(0, len(s) - 1) for s in self._sigs.values())

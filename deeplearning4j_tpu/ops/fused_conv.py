@@ -626,7 +626,7 @@ def conv_bn_stats_xla(x, w, scale, shift, relu_in: bool = True,
         # T=64 → 43.5-45.2k img/s — PERF_ANALYSIS.md r4): the direct
         # stat reductions XLA fuses for those stages are cheaper than
         # the extra contraction. 64 is the measured optimum.
-        thresh = float(os.environ.get("DL4J_GRAM_T", "64"))
+        thresh = float(os.environ.get("DL4J_GRAM_T", "64"))  # host-sync-ok: env var
         use_gram = (mode == "always" or
                     (mode == "auto" and cout > cin
                      and cin * cin <= thresh * cout))

@@ -31,7 +31,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 # Large-finite instead of -inf: -inf scores make softmax VJPs emit NaN for
 # fully-masked rows (matches nn/layers/attention.py's choice).
-_NEG = float(jnp.finfo(jnp.float32).min) / 2.0
+_NEG = float(jnp.finfo(jnp.float32).min) / 2.0  # host-sync-ok: finfo constant
 
 _DEF_BLOCK_Q = 1024  # tuned on v5e: 16k-seq causal attn 21.5ms vs 84ms at 128
 _DEF_BLOCK_K = 1024
@@ -112,7 +112,7 @@ def _flash_forward(q, k, v, mask, causal: bool, block_q: int, block_k: int,
                    interpret: bool):
     n, h, tq, dh = q.shape
     tk = k.shape[2]
-    scale = 1.0 / float(dh) ** 0.5
+    scale = 1.0 / float(dh) ** 0.5  # host-sync-ok: static shape
     grid = (n, h, tq // block_q, tk // block_k)
     vm = pl.ANY if interpret else pltpu.VMEM
 
@@ -278,7 +278,7 @@ def _flash_backward_pallas(q, k, v, mask, out, lse, do, causal: bool,
     pass); everything matmul-shaped runs on the MXU in Pallas."""
     n, h, tq, dh = q.shape
     tk = k.shape[2]
-    scale = 1.0 / float(dh) ** 0.5
+    scale = 1.0 / float(dh) ** 0.5  # host-sync-ok: static shape
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)                # (n, h, tq, 1)
     lse4 = lse[..., None]                                  # (n, h, tq, 1)
@@ -402,7 +402,7 @@ def _flash_bwd_xla(causal, block_q, block_k, interpret, res, do):
     O(Tq * block_k) per (batch, head), not O(Tq * Tk)."""
     q, k, v, mask, out, lse = res
     dh = q.shape[-1]
-    scale = 1.0 / float(dh) ** 0.5
+    scale = 1.0 / float(dh) ** 0.5  # host-sync-ok: static shape
     f32 = jnp.float32
     qf, kf, vf, dof = (x.astype(f32) for x in (q, k, v, do))
     delta = jnp.sum(dof * out.astype(f32), axis=-1)        # (n, h, tq)

@@ -10,6 +10,12 @@ TimeIterationListener, EvaluativeListener, CheckpointListener
 Listeners run on host, outside the jitted step; reading the loss forces a
 device sync, so score-reporting listeners honor a ``frequency`` to avoid
 stalling the TPU pipeline every iteration.
+
+When the model carries an ``observe.TelemetryCollector``
+(``model.set_telemetry``), score-reporting listeners consume the
+host-side values the collector flushed from the on-device ring buffer —
+zero extra syncs, values lagging at most one flush interval — and only
+fall back to ``float(loss)`` (a sync) on unmonitored models.
 """
 
 from __future__ import annotations
@@ -22,6 +28,16 @@ from typing import List, Optional
 import numpy as np
 
 log = logging.getLogger(__name__)
+
+
+def _telemetry_score(model, loss):
+    """(score, available): flushed loss when a collector is attached
+    (never syncs; None until the first flush), else ``float(loss)`` —
+    the legacy device sync, kept for unmonitored models."""
+    tel = getattr(model, "telemetry", None)
+    if tel is not None:
+        return tel.last("loss"), tel.last_record() is not None
+    return float(loss), True  # host-sync-ok: unmonitored fallback
 
 
 class TrainingListener:
@@ -45,7 +61,9 @@ class ScoreIterationListener(TrainingListener):
 
     def iteration_done(self, model, iteration, epoch, loss, etl_ms, batch_size):
         if iteration % self.frequency == 0:
-            score = float(loss)  # device sync
+            score, ok = _telemetry_score(model, loss)
+            if not ok:
+                return  # monitored model, nothing flushed yet: no sync
             self.scores.append(score)
             log.info("Score at iteration %d is %.6f", iteration, score)
 
@@ -59,36 +77,61 @@ class PerformanceListener(TrainingListener):
         self.frequency = max(1, frequency)
         self.report_score = report_score
         self._last_time: Optional[float] = None
-        self._last_iter = 0
+        self._last_iter: Optional[int] = None
         self._samples = 0
+        # ETL accumulates over the whole reporting window: reporting only
+        # the last iteration's ETL hid stalls on the skipped iterations
+        self._etl_sum = 0.0
+        self._etl_n = 0
         self.history: List[dict] = []
+
+    def on_epoch_start(self, model, epoch: int):
+        # seed the clock BEFORE the first batch runs, so its samples and
+        # wall time both count (previously the first batch only set the
+        # baseline and its samples were silently dropped)
+        if self._last_time is None:
+            self._last_time = time.perf_counter()
 
     def iteration_done(self, model, iteration, epoch, loss, etl_ms, batch_size):
         self._samples += batch_size
+        self._etl_sum += float(etl_ms)
+        self._etl_n += 1
         now = time.perf_counter()
-        if self._last_time is None:
-            self._last_time = now
-            self._last_iter = iteration
-            self._samples = 0
-            return
+        if self._last_iter is None:
+            # attribute exactly this one batch to the window; without an
+            # on_epoch_start seed (direct calls) fall back to `now` —
+            # that window is empty and reports on the next iteration
+            self._last_iter = iteration - 1
+            if self._last_time is None:
+                self._last_time = now
+                self._samples = 0
+                self._etl_sum = 0.0
+                self._etl_n = 0
         if iteration % self.frequency == 0 and iteration > self._last_iter:
             dt = now - self._last_time
+            if dt <= 0:
+                return
             batches = iteration - self._last_iter
             rec = {
                 "iteration": iteration,
                 "samples_per_sec": self._samples / dt,
                 "batches_per_sec": batches / dt,
-                "etl_ms": etl_ms,
+                # mean over the window, not the last iteration's value
+                "etl_ms": self._etl_sum / max(1, self._etl_n),
             }
             if self.report_score:
-                rec["score"] = float(loss)
+                score, ok = _telemetry_score(model, loss)
+                if ok:
+                    rec["score"] = score
             self.history.append(rec)
             log.info("iter %d: %.1f samples/sec, %.2f batches/sec, ETL %.2f ms",
                      iteration, rec["samples_per_sec"], rec["batches_per_sec"],
-                     etl_ms)
+                     rec["etl_ms"])
             self._last_time = now
             self._last_iter = iteration
             self._samples = 0
+            self._etl_sum = 0.0
+            self._etl_n = 0
 
 
 class CollectScoresIterationListener(TrainingListener):
@@ -98,7 +141,9 @@ class CollectScoresIterationListener(TrainingListener):
 
     def iteration_done(self, model, iteration, epoch, loss, etl_ms, batch_size):
         if iteration % self.frequency == 0:
-            self.scores.append((iteration, float(loss)))
+            score, ok = _telemetry_score(model, loss)
+            if ok:
+                self.scores.append((iteration, score))
 
 
 class TimeIterationListener(TrainingListener):
@@ -132,7 +177,9 @@ class EvaluativeListener(TrainingListener):
 
     def on_epoch_end(self, model, epoch):
         if epoch % self.frequency == 0:
-            e = model.evaluate(self.iterator)
+            from deeplearning4j_tpu.observe.tracer import get_tracer
+            with get_tracer(model).span("eval", cat="eval", epoch=epoch):
+                e = model.evaluate(self.iterator)
             self.evaluations.append((epoch, e))
             log.info("epoch %d eval: accuracy=%.4f", epoch, e.accuracy())
 
@@ -152,8 +199,10 @@ class CheckpointListener(TrainingListener):
 
     def _save(self, model, tag: str):
         from deeplearning4j_tpu.models.serialization import save_model
+        from deeplearning4j_tpu.observe.tracer import get_tracer
         path = os.path.join(self.dir, f"checkpoint_{tag}.zip")
-        save_model(model, path, save_updater=True)
+        with get_tracer(model).span("checkpoint", cat="io", tag=tag):
+            save_model(model, path, save_updater=True)
         self._saved.append(path)
         while len(self._saved) > self.keep_last:
             old = self._saved.pop(0)

@@ -30,11 +30,15 @@ from deeplearning4j_tpu.optimize.updaters import (
 
 class TrainState(NamedTuple):
     """Pytree carried across iterations. ``model_state`` holds non-trainable
-    layer state (BN running stats, last RNN hidden states)."""
+    layer state (BN running stats, last RNN hidden states). ``telemetry``
+    carries the on-device metrics ring buffer (observe/telemetry.py) when
+    a collector is attached; the default is an empty pytree so untracked
+    code constructing 4-field TrainStates keeps working."""
     params: Any
     model_state: Any
     opt_state: Any
     iteration: jnp.ndarray  # int32 scalar
+    telemetry: Any = ()
 
 
 def build_optimizer(
@@ -78,7 +82,8 @@ LossFn = Callable[..., Tuple[jnp.ndarray, Any]]
 
 
 def make_train_step(loss_fn: LossFn, tx: optax.GradientTransformation,
-                    donate: bool = True, constrain_fn=None):
+                    donate: bool = True, constrain_fn=None,
+                    telemetry=None):
     """Build the jitted train step.
 
     ``loss_fn(params, model_state, features, labels, fmask, lmask, rng,
@@ -88,6 +93,12 @@ def make_train_step(loss_fn: LossFn, tx: optax.GradientTransformation,
     (new_train_state, loss)``. The train state is donated: XLA reuses the
     parameter/optimizer buffers in place, halving peak HBM — the analog of
     the reference's workspace reuse (WorkspaceMode; SURVEY §2.14).
+
+    ``telemetry``: optional ``TelemetrySpec`` (observe/telemetry.py).
+    When given, the step computes the spec's metrics from the in-flight
+    loss/grads/updates and appends one row to the on-device ring buffer
+    carried in ``TrainState.telemetry`` — no host interaction; the host
+    fetches the ring in one transfer every N steps.
     """
 
     def step(ts: TrainState, features, labels, fmask, lmask, rng):
@@ -100,14 +111,21 @@ def make_train_step(loss_fn: LossFn, tx: optax.GradientTransformation,
         new_params = optax.apply_updates(ts.params, updates)
         if constrain_fn is not None:
             new_params = constrain_fn(new_params)
-        return TrainState(new_params, new_ms, new_opt, ts.iteration + 1), loss
+        buf = ts.telemetry
+        if telemetry is not None:
+            buf = telemetry.record(buf, loss=loss, grads=grads,
+                                   params=new_params,
+                                   prev_params=ts.params,
+                                   iteration=ts.iteration)
+        return TrainState(new_params, new_ms, new_opt, ts.iteration + 1,
+                          buf), loss
 
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
 def make_scan_train_step(loss_fn: LossFn, tx: optax.GradientTransformation,
                          donate: bool = True, constrain_fn=None,
-                         shadow_cast=None):
+                         shadow_cast=None, telemetry=None):
     """Multi-step variant of ``make_train_step``: one dispatch runs K
     optimizer steps via ``lax.scan`` over pre-staged batches.
 
@@ -152,8 +170,16 @@ def make_scan_train_step(loss_fn: LossFn, tx: optax.GradientTransformation,
         new_params = optax.apply_updates(ts.params, updates)
         if constrain_fn is not None:
             new_params = constrain_fn(new_params)
+        buf = ts.telemetry
+        if telemetry is not None:
+            # identical row math to the unscanned step: per inner step,
+            # from the same in-flight loss/grads/updates
+            buf = telemetry.record(buf, loss=loss, grads=grads,
+                                   params=new_params,
+                                   prev_params=ts.params,
+                                   iteration=ts.iteration)
         new_ts = TrainState(new_params, new_ms, new_opt,
-                            ts.iteration + 1)
+                            ts.iteration + 1, buf)
         if shadow_cast is not None:
             return (new_ts, shadow_cast(new_params)), loss
         return new_ts, loss

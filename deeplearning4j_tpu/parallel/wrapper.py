@@ -182,8 +182,10 @@ class ParallelWrapper:
                 ts.params)
             updates, new_opt = tx.update(grads, ts.opt_state, ts.params)
             new_params = optax.apply_updates(ts.params, updates)
+            # thread the telemetry slot: donation would otherwise delete
+            # an attached ring buffer
             return TrainState(new_params, new_ms, new_opt,
-                              ts.iteration + 1), loss
+                              ts.iteration + 1, ts.telemetry), loss
 
         return jax.jit(
             step,
@@ -222,7 +224,7 @@ class ParallelWrapper:
                 updates, new_opt = tx.update(grads, ts.opt_state, ts.params)
                 new_params = optax.apply_updates(ts.params, updates)
                 return TrainState(new_params, new_ms, new_opt,
-                                  ts.iteration + 1), loss
+                                  ts.iteration + 1, ts.telemetry), loss
 
             ts, losses = jax.lax.scan(one, ts, (feats, labels, fmask, lmask,
                                                 jnp.arange(k)))
@@ -232,7 +234,8 @@ class ParallelWrapper:
             new_ms = jax.tree_util.tree_map(avg, ts.model_state)
             new_opt = (jax.tree_util.tree_map(avg, ts.opt_state)
                        if avg_upd else ts.opt_state)
-            return (TrainState(new_params, new_ms, new_opt, ts.iteration),
+            return (TrainState(new_params, new_ms, new_opt, ts.iteration,
+                               ts.telemetry),
                     jax.lax.pmean(jnp.mean(losses), DATA_AXIS))
 
         # Everything replicated except the batch: (k, B, ...) sharded on B.

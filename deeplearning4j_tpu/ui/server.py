@@ -363,6 +363,25 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        if u.path == "/metrics":
+            # Prometheus text exposition of the process-wide registry
+            # (observe/registry.py): training loops publish here via
+            # TelemetryCollector / RecompileWatchdog
+            from deeplearning4j_tpu.observe import default_registry
+            body = default_registry().render().encode("utf-8")
+            self.send_response(200)
+            self.send_header(
+                "Content-Type",
+                "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if u.path == "/healthz":
+            self._json({"status": "ok",
+                        "sessions": len(self.storage.list_session_ids())
+                        if self.storage is not None else 0})
+            return
         if u.path == "/api/i18n":
             from deeplearning4j_tpu.ui.i18n import I18N
             q = parse_qs(u.query)

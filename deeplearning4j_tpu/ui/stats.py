@@ -52,6 +52,10 @@ class StatsListener(TrainingListener):
         self.collect_histograms = collect_histograms
         self.histogram_bins = histogram_bins
         self._static_sent = False
+        # seed timing from a start timestamp so the FIRST flushed record
+        # carries real dt-based throughput instead of None (the record
+        # used to be emitted with absent timing)
+        self._start_time: float = time.time()
         self._last_time: Optional[float] = None
         self._prev_params: Optional[Dict] = None
         # accumulated across skipped iterations when update_frequency > 1
@@ -59,6 +63,13 @@ class StatsListener(TrainingListener):
         self._acc_iters = 0
 
     # ---- TrainingListener hooks -----------------------------------------
+    def on_epoch_start(self, model, epoch: int):
+        # re-anchor the start stamp to when training actually begins
+        # (construction can predate fit() by a long time); only until the
+        # first record is out
+        if self._last_time is None:
+            self._start_time = time.time()
+
     def iteration_done(self, model, iteration: int, epoch: int, loss,
                        etl_ms: float, batch_size: int):
         if not self._static_sent:
@@ -68,26 +79,38 @@ class StatsListener(TrainingListener):
         if iteration % self.update_frequency != 0:
             return
         now = time.time()
-        dt = (now - self._last_time) if self._last_time else None
+        anchor = self._last_time if self._last_time is not None \
+            else self._start_time
+        dt = now - anchor
         self._last_time = now
         samples, iters = self._acc_samples, self._acc_iters
         self._acc_samples = 0
         self._acc_iters = 0
 
+        tel = getattr(model, "telemetry", None)
+        if tel is not None:
+            # flushed from the on-device ring: no device sync here
+            score = tel.last("loss")
+        else:
+            score = float(loss)  # host-sync-ok: unmonitored fallback
         record = {
             "session_id": self.session_id,
             "worker_id": self.worker_id,
             "timestamp": now,
             "iteration": iteration,
             "epoch": epoch,
-            "score": float(loss),
+            "score": score,
             "etl_ms": float(etl_ms),
             "batch_size": int(batch_size),
             # throughput over ALL iterations since the last report, not
             # just the reported one
-            "samples_per_sec": (samples / dt) if dt else None,
-            "minibatches_per_sec": (iters / dt) if dt else None,
+            "samples_per_sec": (samples / dt) if dt > 0 else None,
+            "minibatches_per_sec": (iters / dt) if dt > 0 else None,
         }
+        if tel is not None and tel.last_record() is not None:
+            # device-computed series (grad norm, update ratios, NaN
+            # counts) ride along for the dashboard
+            record["device_metrics"] = dict(tel.last_record())
         params = model.train_state.params
         if self.collect_histograms:
             record["param_stats"] = self._layer_stats(params)

@@ -10,4 +10,6 @@ if [[ "${1:-}" == "--examples" ]]; then
   shift
   exec python -m pytest tests/test_examples.py -q -m slow "$@"
 fi
+# lint tier: no hidden device syncs in the jit hot paths (ops/, solver)
+python tools/check_host_sync.py
 exec python -m pytest tests/ -q "$@"

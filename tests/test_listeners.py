@@ -1,0 +1,187 @@
+"""Listener SPI tests: dispatch ordering, PerformanceListener window
+accounting, StatsListener update_frequency accumulation + first-record
+timing."""
+
+from types import SimpleNamespace
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.optimize.listeners import (
+    PerformanceListener,
+    TrainingListener,
+)
+
+
+def _tiny_model(seed=1):
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+    from deeplearning4j_tpu.models.multi_layer_network import (
+        MultiLayerNetwork)
+    from deeplearning4j_tpu.ops.losses import LossFunction
+    from deeplearning4j_tpu.optimize.updaters import Adam
+    conf = (NeuralNetConfiguration.Builder().seed(seed).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT))
+            .set_input_type(InputType.feed_forward(5)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _batches(n, batch=16, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        x = rng.normal(size=(batch, 5)).astype(np.float32)
+        y = np.zeros((batch, 3), np.float32)
+        y[np.arange(batch), rng.integers(0, 3, batch)] = 1.0
+        out.append(DataSet(x, y))
+    return out
+
+
+class _ListIter:
+    def __init__(self, batches):
+        self.batches = batches
+
+    def __iter__(self):
+        return iter(self.batches)
+
+    def reset(self):
+        pass
+
+
+class _Recorder(TrainingListener):
+    def __init__(self, name, events):
+        self.name = name
+        self.events = events
+
+    def on_epoch_start(self, model, epoch):
+        self.events.append((self.name, "epoch_start", epoch))
+
+    def iteration_done(self, model, iteration, epoch, loss, etl_ms,
+                       batch_size):
+        self.events.append((self.name, "iter", iteration))
+
+    def on_epoch_end(self, model, epoch):
+        self.events.append((self.name, "epoch_end", epoch))
+
+
+class TestDispatchOrdering:
+    def test_listeners_fire_in_registration_order(self):
+        """Per event, every listener fires in set_listeners order before
+        the loop advances — the reference's listener-list contract."""
+        events = []
+        m = _tiny_model()
+        m.set_listeners(_Recorder("A", events), _Recorder("B", events))
+        m.fit(_ListIter(_batches(2)), epochs=1)
+        assert events == [
+            ("A", "epoch_start", 0), ("B", "epoch_start", 0),
+            ("A", "iter", 1), ("B", "iter", 1),
+            ("A", "iter", 2), ("B", "iter", 2),
+            ("A", "epoch_end", 0), ("B", "epoch_end", 0),
+        ]
+
+    def test_add_listeners_appends(self):
+        events = []
+        m = _tiny_model()
+        m.set_listeners(_Recorder("A", events))
+        m.add_listeners(_Recorder("B", events), _Recorder("C", events))
+        m.fit(_batches(1)[0])
+        iters = [e for e in events if e[1] == "iter"]
+        assert [n for n, _, _ in iters] == ["A", "B", "C"]
+
+
+class TestPerformanceListener:
+    def test_first_batch_samples_counted_and_etl_is_window_mean(
+            self, monkeypatch):
+        """The two reported bugs: (1) the first batch's samples were
+        dropped because the clock was only seeded inside the first
+        iteration_done; (2) etl_ms reported the LAST iteration's value
+        instead of the window mean."""
+        clock = iter([100.0, 101.0, 102.0, 103.0, 104.0])
+        monkeypatch.setattr("time.perf_counter", lambda: next(clock))
+        lst = PerformanceListener(frequency=2)
+        model = SimpleNamespace()
+        lst.on_epoch_start(model, 0)                    # clock = 100
+        for it, etl in zip((1, 2, 3, 4), (10.0, 20.0, 30.0, 40.0)):
+            lst.iteration_done(model, it, 0, 0.5, etl, 8)
+        assert len(lst.history) == 2
+        first, second = lst.history
+        # window 1 spans epoch start (t=100) .. iter 2 (t=102): BOTH
+        # batches' 16 samples over 2s
+        assert first["iteration"] == 2
+        assert first["samples_per_sec"] == 8.0
+        assert first["batches_per_sec"] == 1.0
+        assert first["etl_ms"] == 15.0                  # mean(10, 20)
+        assert second["samples_per_sec"] == 8.0
+        assert second["etl_ms"] == 35.0                 # mean(30, 40)
+
+    def test_direct_calls_without_epoch_seed_still_report(self):
+        # no on_epoch_start (direct driving): the first call only anchors
+        # the window, later ones report
+        lst = PerformanceListener(frequency=1)
+        model = SimpleNamespace()
+        for it in (1, 2, 3):
+            lst.iteration_done(model, it, 0, 0.5, 1.0, 4)
+        assert len(lst.history) == 2
+        assert all(r["samples_per_sec"] > 0 for r in lst.history)
+
+    def test_fit_integration(self):
+        lst = PerformanceListener(frequency=1)
+        m = _tiny_model()
+        m.set_listeners(lst)
+        m.fit(_ListIter(_batches(3)), epochs=1)
+        assert len(lst.history) == 3
+        assert all(r["samples_per_sec"] > 0 for r in lst.history)
+        assert all(np.isfinite(r["etl_ms"]) for r in lst.history)
+
+
+class TestStatsListenerAccumulation:
+    def test_update_frequency_accumulates_and_first_record_timed(self):
+        """update_frequency=2 -> records only at even iterations, each
+        covering BOTH batches since the last report; the FIRST record
+        carries real throughput (seeded from the start timestamp) instead
+        of None."""
+        from deeplearning4j_tpu.ui import (
+            InMemoryStatsStorage, StatsListener)
+        storage = InMemoryStatsStorage()
+        lst = StatsListener(storage, update_frequency=2,
+                            collect_histograms=False)
+        m = _tiny_model()
+        m.set_listeners(lst)
+        m.fit(_ListIter(_batches(4)), epochs=1)
+        ups = storage.get_all_updates(lst.session_id)
+        assert [u["iteration"] for u in ups] == [2, 4]
+        for u in ups:
+            # the satellite fix: no None/garbage timing on record #1
+            assert u["samples_per_sec"] is not None
+            assert u["samples_per_sec"] > 0
+            assert u["minibatches_per_sec"] is not None
+            assert np.isfinite(u["score"])
+
+    def test_telemetry_backed_score_and_device_metrics(self):
+        from deeplearning4j_tpu.observe import (
+            MetricsRegistry, TelemetryCollector)
+        from deeplearning4j_tpu.ui import (
+            InMemoryStatsStorage, StatsListener)
+        storage = InMemoryStatsStorage()
+        lst = StatsListener(storage, update_frequency=1,
+                            collect_histograms=False)
+        m = _tiny_model()
+        tel = TelemetryCollector(flush_interval=2,
+                                 registry=MetricsRegistry())
+        m.set_telemetry(tel)
+        m.set_listeners(lst)
+        m.fit(_ListIter(_batches(4)), epochs=1)
+        ups = storage.get_all_updates(lst.session_id)
+        assert len(ups) == 4
+        # from iteration 2 on, the score is the flushed device value and
+        # the device-metric row rides along
+        assert ups[-1]["score"] == tel.history[-1 - 1]["loss"] or \
+            np.isfinite(ups[-1]["score"])
+        flushed = [u for u in ups if "device_metrics" in u]
+        assert flushed, "no record carried device metrics"
+        dm = flushed[-1]["device_metrics"]
+        assert {"loss", "grad_norm", "nonfinite_count"} <= set(dm)

@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Static checker for host-sync patterns in jit-traced hot paths.
+
+``float(x)``, ``np.asarray(x)`` and ``x.item()`` on a traced jax value
+force a device->host transfer (and, inside a jit trace, a
+ConcretizationTypeError at best or a silent per-step sync at worst).
+The telemetry design (observe/) exists so the train loop does exactly
+ONE device fetch per flush interval; a stray ``float(loss)`` in ops/
+or the solver undoes that.
+
+This tool greps the hot-path modules -- ``deeplearning4j_tpu/ops/`` and
+``deeplearning4j_tpu/optimize/solver.py`` -- for those patterns and
+fails if any line matches without an explicit ``# host-sync-ok``
+pragma. Trace-time constants (Python ints/floats computed from shapes
+or env vars before tracing) are legitimate: annotate them with the
+pragma plus a short reason.
+
+Usage:
+    python tools/check_host_sync.py            # check the default paths
+    python tools/check_host_sync.py --paths a.py dir/   # explicit set
+
+Exit status: 0 when clean, 1 when unallowed hits are found.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+# hot paths: everything here runs inside (or builds) jitted step
+# functions, where a hidden sync is a per-iteration cost
+DEFAULT_PATHS = (
+    "deeplearning4j_tpu/ops",
+    "deeplearning4j_tpu/optimize/solver.py",
+)
+
+PRAGMA = "# host-sync-ok"
+
+# pattern -> what it does on a device value
+PATTERNS = (
+    (re.compile(r"\bfloat\("), "float() blocks on a device value"),
+    (re.compile(r"\bnp\.asarray\("),
+     "np.asarray() copies device->host (jnp.asarray stays on device)"),
+    (re.compile(r"\.item\(\)"), ".item() blocks on a device value"),
+)
+
+
+def iter_files(paths):
+    for p in paths:
+        path = Path(p)
+        if not path.is_absolute():
+            path = REPO_ROOT / path
+        if path.is_dir():
+            yield from sorted(path.rglob("*.py"))
+        elif path.suffix == ".py":
+            yield path
+
+
+def check_file(path: Path):
+    """Yield (lineno, line, reason) for each unallowed hit."""
+    try:
+        text = path.read_text(encoding="utf-8")
+    except OSError as e:
+        print(f"warning: cannot read {path}: {e}", file=sys.stderr)
+        return
+    for lineno, line in enumerate(text.splitlines(), 1):
+        stripped = line.strip()
+        if stripped.startswith("#"):        # comment-only line
+            continue
+        if PRAGMA in line:                  # explicit allowlist
+            continue
+        # ignore the trailing comment: a pattern named in prose
+        # ("avoid float(x) here") is not a hit
+        code = line.split("#", 1)[0] if '"#"' not in line \
+            and "'#'" not in line else line
+        for rx, reason in PATTERNS:
+            if rx.search(code):
+                yield lineno, line.rstrip(), reason
+                break
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--paths", nargs="+", default=list(DEFAULT_PATHS),
+                    help="files/directories to scan (default: the "
+                         "jit hot paths)")
+    args = ap.parse_args(argv)
+
+    hits = []
+    for path in iter_files(args.paths):
+        for lineno, line, reason in check_file(path):
+            hits.append((path, lineno, line, reason))
+
+    if not hits:
+        print("check_host_sync: clean "
+              f"({', '.join(str(p) for p in args.paths)})")
+        return 0
+    print("check_host_sync: host-sync patterns in jit hot paths "
+          f"({len(hits)} hit{'s' if len(hits) != 1 else ''}):\n",
+          file=sys.stderr)
+    for path, lineno, line, reason in hits:
+        try:
+            rel = path.relative_to(REPO_ROOT)
+        except ValueError:
+            rel = path
+        print(f"  {rel}:{lineno}: {reason}\n    {line.strip()}",
+              file=sys.stderr)
+    print("\nIf the value is a trace-time Python constant (shape math, "
+          "env var), annotate the line with\n"
+          f"  `{PRAGMA}: <reason>`\n"
+          "otherwise move the read out of the hot path (the telemetry "
+          "ring buffer in observe/ exists for this).", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
