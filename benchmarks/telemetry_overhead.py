@@ -82,6 +82,13 @@ def main(argv=None) -> int:
     ap.add_argument("--steps", type=int, default=300,
                     help="timed steps per arm (plus warmup)")
     ap.add_argument("--flush-interval", type=int, default=50)
+    ap.add_argument("--with-histograms", action="store_true",
+                    help="also compile in-step param/grad/update "
+                         "histograms (flight-recorder config) — still "
+                         "one fetch per flush interval")
+    ap.add_argument("--hist-interval", type=int, default=10,
+                    help="steps between in-step histogram snapshots "
+                         "(with --with-histograms)")
     ap.add_argument("--assert-overhead", action="store_true",
                     help="exit 1 when overhead exceeds --tolerance")
     ap.add_argument("--tolerance", type=float, default=0.02,
@@ -95,14 +102,18 @@ def main(argv=None) -> int:
 
     base = build_model()
     mon = build_model()
-    tel = TelemetryCollector(flush_interval=args.flush_interval)
+    tel = TelemetryCollector(flush_interval=args.flush_interval,
+                             histograms=args.with_histograms,
+                             hist_interval=args.hist_interval)
     mon.set_telemetry(tel)
     t_off, t_on = time_interleaved(base, mon, batches, warmup)
 
     overhead = (t_on - t_off) / t_off
+    mode = ("telemetry+histograms" if args.with_histograms
+            else "telemetry")
     print(f"telemetry off: {t_off * 1e3:8.3f} ms/step (median of "
           f"{args.steps})")
-    print(f"telemetry on:  {t_on * 1e3:8.3f} ms/step "
+    print(f"{mode} on: {t_on * 1e3:8.3f} ms/step "
           f"(flush every {args.flush_interval}, "
           f"{tel.fetch_count} device fetches)")
     print(f"overhead:      {overhead * 100:+.2f}%")

@@ -20,6 +20,7 @@ from deeplearning4j_tpu.earlystopping.config import (
     EarlyStoppingResult,
     TerminationReason,
 )
+from deeplearning4j_tpu.observe.tracer import get_tracer
 
 
 class EarlyStoppingTrainer:
@@ -76,9 +77,11 @@ class EarlyStoppingTrainer:
 
             # ---- held-out score + best-model tracking -------------------
             score = None
+            tracer = get_tracer(self.model)
             if (cfg.score_calculator is not None
                     and epoch % cfg.evaluate_every_n_epochs == 0):
-                score = cfg.score_calculator.calculate_score(self.model)
+                with tracer.span("eval", cat="eval"):
+                    score = cfg.score_calculator.calculate_score(self.model)
                 score_vs_epoch[epoch] = score
                 improved = (best_score is None
                             or (minimize and score < best_score)
@@ -86,7 +89,8 @@ class EarlyStoppingTrainer:
                 if improved:
                     best_score = score
                     best_epoch = epoch
-                    cfg.saver.save_best_model(self.model, score)
+                    with tracer.span("checkpoint", cat="io"):
+                        cfg.saver.save_best_model(self.model, score)
                 if self.listener is not None:
                     self.listener(epoch, score, self.model)
             elif cfg.score_calculator is None:
@@ -95,9 +99,10 @@ class EarlyStoppingTrainer:
                 score = self.model.score()
 
             if cfg.save_last_model:
-                cfg.saver.save_latest_model(
-                    self.model, score if score is not None
-                    else self.model.score())
+                with tracer.span("checkpoint", cat="io"):
+                    cfg.saver.save_latest_model(
+                        self.model, score if score is not None
+                        else self.model.score())
 
             # ---- epoch conditions ---------------------------------------
             # Score-based conditions only see the calculator's metric; on

@@ -56,6 +56,10 @@ class BaseModel:
         self._telemetry = None
         self.tracer = None
         self.recompile_watchdog = None
+        # flight recorder: None means "use the process-wide default",
+        # which is armed unless DL4J_CRASH_DUMPS=0 (the reference's
+        # CrashReportingUtil is likewise on by default)
+        self._flight_recorder = None
         # host-side mirror of train_state.iteration: reading the device
         # scalar every step (int(ts.iteration)) is itself a per-step
         # device sync; the mirror is re-adopted from the device once per
@@ -134,6 +138,21 @@ class BaseModel:
         self.recompile_watchdog = watchdog
         return self
 
+    def set_flight_recorder(self, recorder):
+        """Attach an ``observe.FlightRecorder`` (post-mortem dumps on
+        NaN/OOM/crash). Without one the process-wide default recorder is
+        used; attach a recorder with ``enabled=False`` to opt this model
+        out without touching the environment."""
+        self._flight_recorder = recorder
+        return self
+
+    def _recorder(self):
+        if self._flight_recorder is not None:
+            return self._flight_recorder
+        from deeplearning4j_tpu.observe.flight_recorder import (
+            default_flight_recorder)
+        return default_flight_recorder()
+
     def _telemetry_spec(self):
         return (None if self._telemetry is None
                 else self._telemetry.spec_for(self))
@@ -150,24 +169,44 @@ class BaseModel:
         return self._host_iteration
 
     def _post_step(self, steps: int = 1) -> int:
-        """Shared per-dispatch epilogue: advance the iteration mirror and
-        give the telemetry collector its flush opportunity."""
+        """Shared per-dispatch epilogue: advance the iteration mirror,
+        give the telemetry collector its flush opportunity, and let the
+        flight recorder scan whatever that flush decoded (the recorder
+        reads host-side history only — no device interaction)."""
         it = self._advance_iteration(steps)
         tel = self._telemetry
         if tel is not None:
-            if tel.will_flush(steps):
+            flushed = tel.will_flush(steps)
+            if flushed:
                 from deeplearning4j_tpu.observe.tracer import get_tracer
                 with get_tracer(self).span("telemetry_flush",
                                            cat="telemetry"):
                     tel.on_step(self.train_state, steps)
             else:
                 tel.on_step(self.train_state, steps)
+            if flushed:
+                rec = self._recorder()
+                if rec is not None:
+                    rec.poll(self)
         return it
 
     # ---- fit loop -------------------------------------------------------
     def fit(self, data, epochs: int = 1):
         """fit(DataSet) / fit(DataSetIterator[, epochs]) — the reference's
-        MultiLayerNetwork.fit(DataSetIterator) hot loop."""
+        MultiLayerNetwork.fit(DataSetIterator) hot loop. Any exception
+        escaping the loop (including XLA OOM) first passes through the
+        flight recorder, which writes a post-mortem dump and re-raises —
+        the CrashReportingUtil contract: the crash still surfaces, but
+        the evidence survives."""
+        try:
+            return self._fit_inner(data, epochs)
+        except Exception as e:
+            rec = self._recorder()
+            if rec is not None:
+                rec.record_crash(self, exc=e)
+            raise
+
+    def _fit_inner(self, data, epochs: int = 1):
         if self.train_state is None:
             self.init()
         else:
@@ -222,6 +261,9 @@ class BaseModel:
         if self._telemetry is not None:
             with tracer.span("telemetry_flush", cat="telemetry"):
                 self._telemetry.flush(self.train_state)
+            rec = self._recorder()
+            if rec is not None:
+                rec.poll(self)
         return self
 
     def _fit_batch(self, batch: DataSet, etl_ms: float = 0.0):
@@ -257,8 +299,8 @@ class BaseModel:
             if self._last_loss is None:
                 raise RuntimeError("no score yet: call fit() first or pass a"
                                    " DataSet to score(dataset)")
-            return float(self._last_loss)
-        return float(self.compute_loss(dataset))
+            return float(self._last_loss)  # host-sync-ok: score() API returns a Python float
+        return float(self.compute_loss(dataset))  # host-sync-ok: eval-path loss read, not the train loop
 
     def compute_loss(self, dataset: DataSet):
         raise NotImplementedError
@@ -276,7 +318,7 @@ class BaseModel:
         batches = [iterator] if single else iterator
         for batch in batches:
             preds = self._output_for_eval(batch)
-            e.eval(batch.labels, np.asarray(preds),
+            e.eval(batch.labels, np.asarray(preds),  # host-sync-ok: evaluation consumes host arrays
                    mask=batch.labels_mask if batch.labels_mask is not None
                    else batch.features_mask)
         if not single and isinstance(iterator, DataSetIterator):
@@ -289,7 +331,7 @@ class BaseModel:
         batches = [iterator] if single else iterator
         for batch in batches:
             preds = self._output_for_eval(batch)
-            e.eval(batch.labels, np.asarray(preds), mask=batch.labels_mask)
+            e.eval(batch.labels, np.asarray(preds), mask=batch.labels_mask)  # host-sync-ok: evaluation consumes host arrays
         if not single and isinstance(iterator, DataSetIterator):
             iterator.reset()
         return e

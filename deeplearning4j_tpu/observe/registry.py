@@ -57,6 +57,12 @@ class _Metric:
         with self._lock:
             return self._series.get(self._key(labels))
 
+    def series(self) -> Dict[Tuple[Tuple[str, str], ...], float]:
+        """Snapshot of every label set -> value (health checks iterate
+        this; the render path keeps its own copy-under-lock)."""
+        with self._lock:
+            return dict(self._series)
+
     def render(self) -> str:
         with self._lock:
             series = dict(self._series)
@@ -116,6 +122,12 @@ class MetricsRegistry:
 
     def gauge(self, name: str, help_text: str = "") -> Gauge:
         return self._get_or_create(Gauge, name, help_text)
+
+    def get_metric(self, name: str) -> Optional[_Metric]:
+        """The registered metric, or None — read-only lookups (health
+        checks) must not create empty series as a side effect."""
+        with self._lock:
+            return self._metrics.get(name)
 
     def render(self) -> str:
         with self._lock:

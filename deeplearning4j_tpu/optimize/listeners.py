@@ -51,6 +51,12 @@ class TrainingListener:
                        loss, etl_ms: float, batch_size: int):
         pass
 
+    def on_crash_dump(self, model, path: str, reason: str):
+        """Fired by the flight recorder (observe/flight_recorder.py) right
+        after a post-mortem dump directory is written — ``reason`` is one
+        of ``nonfinite`` / ``oom`` / ``exception``. Default: no-op."""
+        pass
+
 
 class ScoreIterationListener(TrainingListener):
     """Logs the loss every N iterations (reference: ScoreIterationListener)."""

@@ -66,7 +66,7 @@ def _barrier(name: str) -> None:
         import zlib
         from deeplearning4j_tpu.parallel.mesh import (
             global_device_value_range)
-        h = float(zlib.crc32(name.encode()) % (1 << 20))
+        h = float(zlib.crc32(name.encode()) % (1 << 20))  # host-sync-ok: Python crc32 constant, no device value
         mn, mx = global_device_value_range(h)
         if mn != mx:             # pragma: no cover
             raise RuntimeError(
@@ -129,7 +129,7 @@ def save_sharded(train_state: TrainState, directory: str,
                 for i, s in enumerate(v.addressable_shards):
                     if s.replica_id != 0:
                         continue
-                    a = np.asarray(s.data)
+                    a = np.asarray(s.data)  # host-sync-ok: checkpoint save writes host shards by design
                     if is_bf16:
                         a = a.view(np.uint16)
                     ent = f"{k}::{i}"
@@ -137,7 +137,7 @@ def save_sharded(train_state: TrainState, directory: str,
                     index[ent] = {"leaf": k, "dtype": str(a.dtype),
                                   "start": _shard_starts(s.index, v.shape)}
             elif pidx == 0:  # plain numpy leaf: identical everywhere
-                a = np.asarray(v)
+                a = np.asarray(v)  # host-sync-ok: checkpoint save writes host shards by design
                 if is_bf16:
                     a = a.view(np.uint16)
                 arrays[f"{k}::0"] = a
@@ -411,15 +411,19 @@ class ElasticTrainer:
     def resume(self) -> bool:
         """Restore the newest committed checkpoint (resharding onto this
         process's mesh). Returns True when a checkpoint was found."""
+        from deeplearning4j_tpu.observe.tracer import get_tracer
         path = latest_checkpoint(self.directory)
         if path is None:
             return False
-        restore_sharded(self.model, path, mesh=self.mesh)
+        with get_tracer(self.model).span("checkpoint", cat="io",
+                                         op="restore"):
+            restore_sharded(self.model, path, mesh=self.mesh)
         return True
 
     def fit(self, iterator, epochs: int = 1):
         """Delegates to the model's own fit loop (listeners and epoch
         accounting intact); periodic saves ride a TrainingListener."""
+        from deeplearning4j_tpu.observe.tracer import get_tracer
         from deeplearning4j_tpu.optimize.listeners import TrainingListener
 
         trainer = self
@@ -433,8 +437,10 @@ class ElasticTrainer:
                 if self.last_saved is None:
                     self.last_saved = int(iteration) - 1
                 if iteration - self.last_saved >= trainer.checkpoint_every:
-                    save_sharded(model.train_state, trainer.directory)
-                    trainer._prune()
+                    with get_tracer(model).span("checkpoint", cat="io",
+                                                op="save"):
+                        save_sharded(model.train_state, trainer.directory)
+                        trainer._prune()
                     self.last_saved = int(iteration)
 
         m = self.model
@@ -445,6 +451,8 @@ class ElasticTrainer:
         finally:
             m.listeners.remove(saver)
         if saver.last_saved != int(m.train_state.iteration):
-            save_sharded(m.train_state, self.directory)
-            self._prune()
+            with get_tracer(m).span("checkpoint", cat="io",
+                                    op="save"):
+                save_sharded(m.train_state, self.directory)
+                self._prune()
         return m

@@ -299,15 +299,15 @@ class DistributedNetwork:
         ev = Evaluation(num_classes)
         w = self.training_master.num_workers
         for batch in iterator:
-            feats = np.asarray(batch.features)
-            labels = np.asarray(batch.labels)
+            feats = np.asarray(batch.features)  # host-sync-ok: eval host staging
+            labels = np.asarray(batch.labels)  # host-sync-ok: eval host staging
             n = feats.shape[0]
             pad = (-n) % w
             if pad:
                 feats = np.concatenate(
                     [feats, np.repeat(feats[-1:], pad, axis=0)], axis=0)
             x = jax.device_put(feats, batch_sh)
-            preds = np.asarray(self.network.output(x))[:n]
+            preds = np.asarray(self.network.output(x))[:n]  # host-sync-ok: eval output consumed on host
             ev.eval(labels, preds, mask=batch.labels_mask)
         iterator.reset()
         return ev

@@ -110,7 +110,7 @@ def encode(signs: np.ndarray) -> np.ndarray:
     """Pick FLEXIBLE vs BITMAP by density, as the reference's native
     ThresholdCompression does (EncodedGradientsAccumulator.java:255-292).
     Uses the C++ codec (native/dl4j_native.cpp) when built."""
-    signs = np.asarray(signs)
+    signs = np.asarray(signs)  # host-sync-ok: host-side codec input
     from deeplearning4j_tpu.utils import native
     msg = native.encode(signs)
     if msg is not None:
@@ -124,7 +124,7 @@ def encode(signs: np.ndarray) -> np.ndarray:
 
 def decode(message: np.ndarray, shape=None) -> np.ndarray:
     """Decode either codec back to an int8 sign array."""
-    message = np.asarray(message, dtype=np.int32)
+    message = np.asarray(message, dtype=np.int32)  # host-sync-ok: host-side codec input
     from deeplearning4j_tpu.utils import native
     if native.available():
         out = native.decode(message)
@@ -256,7 +256,7 @@ class EncodedGradientsAccumulator:
         signs, new_res = quantize_pytree(grads, residual, threshold)
 
         flat, treedef = jax.tree_util.tree_flatten(signs)
-        flat_np = [np.asarray(s) for s in flat]
+        flat_np = [np.asarray(s) for s in flat]  # host-sync-ok: host gather IS the compression boundary
         nnz = sum(int(np.count_nonzero(s)) for s in flat_np)
         total = sum(s.size for s in flat_np)
         concat = np.concatenate([s.reshape(-1) for s in flat_np])

@@ -49,13 +49,13 @@ class ParallelInference:
     # ---- public API ------------------------------------------------------
     def output(self, features) -> np.ndarray:
         """Blocking inference (reference: ParallelInference.output:113)."""
-        x = np.asarray(features)
+        x = np.asarray(features)  # host-sync-ok: inference host staging
         if x.ndim == 0:
             raise ValueError("features must have a batch dimension; got a"
                              " 0-d array")
         if self.mode == InferenceMode.INPLACE:
             with self._lock:
-                return np.asarray(self.model.output(x))
+                return np.asarray(self.model.output(x))  # host-sync-ok: inference result returned as host array
         f: Future = Future()
         while True:
             if self._shutdown.is_set():
@@ -140,7 +140,7 @@ class ParallelInference:
             if bucket != n:
                 pad = np.repeat(x[-1:], bucket - n, axis=0)
                 x = np.concatenate([x, pad], axis=0)
-            out = np.asarray(self.model.output(x))[:n]
+            out = np.asarray(self.model.output(x))[:n]  # host-sync-ok: inference result returned as host array
             ofs = 0
             for arr, f in zip(arrays, futures):
                 f.set_result(out[ofs:ofs + arr.shape[0]])

@@ -57,7 +57,7 @@ def create_mesh(axes: Optional[Dict[str, int]] = None,
     if total != n:
         raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total}"
                          f" devices, have {n}")
-    dev_array = np.asarray(devices).reshape(sizes)
+    dev_array = np.asarray(devices).reshape(sizes)  # host-sync-ok: device objects, not device data
     return Mesh(dev_array, tuple(names))
 
 
@@ -105,7 +105,7 @@ def global_device_value_range(value: float) -> tuple:
     arr = jax.make_array_from_process_local_data(
         sh, np.full((loc,), value, np.float64), (len(devs),))
     mn, mx = fn(arr)
-    return float(mn), float(mx)
+    return float(mn), float(mx)  # host-sync-ok: barrier helper: the sync is the point
 
 
 def compat_shard_map(f, mesh: Mesh, in_specs, out_specs,

@@ -31,7 +31,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 SEQ_AXIS = "sp"
 
-_NEG = float(jnp.finfo(jnp.float32).min) / 2
+_NEG = float(jnp.finfo(jnp.float32).min) / 2  # host-sync-ok: trace-time Python constant
 
 
 def _ring_attention_local(q, k, v, mask, axis_name: str, causal: bool):

@@ -35,6 +35,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.datasets.dataset import DataSet, DataSetIterator
+from deeplearning4j_tpu.observe.telemetry import has_buffer
 from deeplearning4j_tpu.optimize.solver import TrainState
 from deeplearning4j_tpu.parallel.mesh import (DATA_AXIS, compat_shard_map,
                                               create_mesh)
@@ -160,6 +161,26 @@ class ParallelWrapper:
         tx = self.model._tx
         mesh = self.mesh
         batch_sh = NamedSharding(mesh, P(DATA_AXIS))
+        spec = self.model._telemetry_spec()
+        self._built_spec = spec
+        # grads here are globally reduced before any code sees them, so
+        # the per-device observable is whether the REPLICAS still agree:
+        # an L2 param fingerprint per device, gathered over the data axis
+        # (desync / silent-data-corruption detector). TP params are
+        # model-sharded — per-device norms would differ by construction.
+        probe_replicas = (spec is not None and spec.replicas > 1
+                          and not self.tensor_parallel)
+
+        def _param_fingerprint(params):
+            def l2(p):
+                leaves = jax.tree_util.tree_leaves(p)
+                sumsq = sum((jnp.sum(jnp.square(l.astype(jnp.float32)))
+                             for l in leaves),
+                            jnp.zeros((), jnp.float32))
+                return jnp.sqrt(sumsq).reshape(1, 1)
+            return compat_shard_map(
+                l2, mesh=mesh, in_specs=(P(),),
+                out_specs=P(DATA_AXIS), check_vma=False)(params)
 
         ts_sh = None
         if self.tensor_parallel:
@@ -182,10 +203,20 @@ class ParallelWrapper:
                 ts.params)
             updates, new_opt = tx.update(grads, ts.opt_state, ts.params)
             new_params = optax.apply_updates(ts.params, updates)
-            # thread the telemetry slot: donation would otherwise delete
-            # an attached ring buffer
+            buf = ts.telemetry
+            if spec is not None and has_buffer(buf):
+                # loss/grads are global here — the base row records the
+                # same quantities as the single-device step
+                buf = spec.record(buf, loss=loss, grads=grads,
+                                  params=new_params,
+                                  prev_params=ts.params,
+                                  iteration=ts.iteration)
+                if probe_replicas:
+                    buf = spec.record_replica(
+                        buf, values=_param_fingerprint(new_params),
+                        iteration=ts.iteration)
             return TrainState(new_params, new_ms, new_opt,
-                              ts.iteration + 1, ts.telemetry), loss
+                              ts.iteration + 1, buf), loss
 
         return jax.jit(
             step,
@@ -205,6 +236,9 @@ class ParallelWrapper:
         mesh = self.mesh
         k = self.averaging_frequency
         avg_upd = self.average_updaters
+        spec = self.model._telemetry_spec()
+        self._built_spec = spec
+        record_replicas = spec is not None and spec.replicas > 1
 
         def worker_steps(ts: TrainState, feats, labels, fmask, lmask, rng):
             # feats: (k, local_batch, ...) — k local steps for this worker
@@ -223,19 +257,42 @@ class ParallelWrapper:
                     lf, has_aux=True)(ts.params)
                 updates, new_opt = tx.update(grads, ts.opt_state, ts.params)
                 new_params = optax.apply_updates(ts.params, updates)
-                return TrainState(new_params, new_ms, new_opt,
-                                  ts.iteration + 1, ts.telemetry), loss
+                # local grad-norm rides the scan ys: this worker's
+                # gradients never leave the device otherwise, so this is
+                # the ONLY place a genuine per-replica norm exists
+                gnorm = jnp.sqrt(sum(
+                    (jnp.sum(jnp.square(g.astype(jnp.float32)))
+                     for g in jax.tree_util.tree_leaves(grads)),
+                    jnp.zeros((), jnp.float32)))
+                return (TrainState(new_params, new_ms, new_opt,
+                                   ts.iteration + 1, ts.telemetry),
+                        (loss, gnorm))
 
-            ts, losses = jax.lax.scan(one, ts, (feats, labels, fmask, lmask,
-                                                jnp.arange(k)))
+            ts, (losses, gnorms) = jax.lax.scan(
+                one, ts, (feats, labels, fmask, lmask, jnp.arange(k)))
+            buf = ts.telemetry
+            if record_replicas and has_buffer(buf):
+                # per-worker means over the k local steps, gathered so
+                # every device writes the identical [n_workers, 2] row —
+                # the replicated layout the buffer lives in
+                wl = jax.lax.all_gather(
+                    jnp.mean(losses.astype(jnp.float32)), DATA_AXIS)
+                wg = jax.lax.all_gather(jnp.mean(gnorms), DATA_AXIS)
+                buf = spec.record_replica(
+                    buf, values=jnp.stack([wl, wg], axis=-1),
+                    iteration=ts.iteration - 1)
             # --- parameter averaging across the data axis (ICI psum) ---
-            avg = lambda t: jax.lax.pmean(t, DATA_AXIS)
+            # integer leaves (Adam/updater step counts) are identical on
+            # every replica and pmean would promote them to float,
+            # corrupting the next round's tx.update — keep them verbatim
+            avg = lambda t: (t if jnp.issubdtype(t.dtype, jnp.integer)
+                             else jax.lax.pmean(t, DATA_AXIS))
             new_params = jax.tree_util.tree_map(avg, ts.params)
             new_ms = jax.tree_util.tree_map(avg, ts.model_state)
             new_opt = (jax.tree_util.tree_map(avg, ts.opt_state)
                        if avg_upd else ts.opt_state)
             return (TrainState(new_params, new_ms, new_opt, ts.iteration,
-                               ts.telemetry),
+                               buf),
                     jax.lax.pmean(jnp.mean(losses), DATA_AXIS))
 
         # Everything replicated except the batch: (k, B, ...) sharded on B.
@@ -264,11 +321,50 @@ class ParallelWrapper:
         *non-final* batch's per-device count drifts from the checked
         value — the final batch legitimately may."""
         self._pending_uneven_per = None     # fresh fit: prior tail is fine
-        if self.mode is TrainingMode.SHARED_GRADIENTS:
-            return self._fit_sync(iterator, epochs)
-        if self.mode is TrainingMode.AVERAGING:
+        if self.mode not in (TrainingMode.SHARED_GRADIENTS,
+                             TrainingMode.AVERAGING):
+            raise ValueError(f"unsupported mode: {self.mode}")
+        m = self.model
+        # re-adopt the device iteration once per fit (BaseModel.fit does
+        # the same); listener dispatch then advances a host mirror
+        m._host_iteration = None
+        self._arm_telemetry()
+        try:
+            if self.mode is TrainingMode.SHARED_GRADIENTS:
+                return self._fit_sync(iterator, epochs)
             return self._fit_averaging(iterator, epochs)
-        raise ValueError(f"unsupported mode: {self.mode}")
+        except Exception as e:
+            # same crash-forensics contract as BaseModel.fit: dump, then
+            # let the exception surface
+            rec = m._recorder()
+            if rec is not None:
+                rec.record_crash(m, exc=e)
+            raise
+
+    def _arm_telemetry(self):
+        """Extend an attached TelemetryCollector with the per-device row
+        ring: AVERAGING workers report genuine per-worker loss/grad-norm
+        (local gradients exist per device there); synchronous DP reports
+        an L2 param fingerprint per device, since its gradients are
+        globally reduced before any code sees them. Enabling changes the
+        buffer pytree, so the step is rebuilt and the buffer rebound —
+        once, before the next dispatch. Also rebuilds the step when a
+        collector was attached/detached after the step was compiled."""
+        m = self.model
+        tel = m.telemetry
+        spec = m._telemetry_spec()
+        if (self._step is not None
+                and getattr(self, "_built_spec", None) is not spec):
+            self._step = None
+        if tel is None or self.num_workers <= 1 or self.tensor_parallel:
+            return
+        metrics = (("loss", "grad_norm")
+                   if self.mode is TrainingMode.AVERAGING
+                   else ("param_norm",))
+        if tel.enable_replicas(self.num_workers, metrics):
+            self._step = None
+            if m.train_state is not None:
+                m.train_state = tel.rebind_buffer(m.train_state)
 
     def _pad_batch(self, batch: DataSet, target: int | None = None) -> DataSet:
         """Pad to a multiple of num_workers (and optionally to ``target``
@@ -289,26 +385,26 @@ class ParallelWrapper:
         def rep(a):
             if a is None:
                 return None
-            a = np.asarray(a)
+            a = np.asarray(a)  # host-sync-ok: host-side batch split/pad before transfer
             return np.concatenate([a, np.repeat(a[-1:], pad, axis=0)], axis=0)
 
         lmask = batch.labels_mask
         if lmask is None:
-            lab = np.asarray(batch.labels)
+            lab = np.asarray(batch.labels)  # host-sync-ok: host-side batch split/pad before transfer
             if lab.ndim <= 2:
                 # (N,) sparse or (N, C) dense labels → per-example weights
                 mask_shape = (n,)
             elif lab.ndim == 3 and batch.features_mask is not None:
                 # variable-length sequences: keep the features-mask
                 # semantics the unpadded loss path would have used
-                lmask = np.asarray(batch.features_mask, np.float32)
+                lmask = np.asarray(batch.features_mask, np.float32)  # host-sync-ok: host-side batch split/pad before transfer
                 mask_shape = None
             else:
                 # (N, T, C) → (N, T); (N, H, W, C) → (N, H, W)
                 mask_shape = lab.shape[:-1]
             if lmask is None:
                 lmask = np.ones(mask_shape, np.float32)
-        lmask = np.asarray(lmask)
+        lmask = np.asarray(lmask)  # host-sync-ok: host-side batch split/pad before transfer
         zeros = np.zeros((pad,) + lmask.shape[1:], lmask.dtype)
         return DataSet(rep(batch.features), rep(batch.labels),
                        rep(batch.features_mask),
@@ -332,7 +428,7 @@ class ParallelWrapper:
         sh = self._batch_sh if sharding is None else sharding
         if jax.process_count() == 1:
             return jax.device_put(jnp.asarray(a), sh)
-        a = np.asarray(a)
+        a = np.asarray(a)  # host-sync-ok: host-side batch split/pad before transfer
         total = self._global_batch_size(a.shape[batch_dim])
         gshape = list(a.shape)
         gshape[batch_dim] = total
@@ -363,7 +459,7 @@ class ParallelWrapper:
             self._checked_per = per
             from deeplearning4j_tpu.parallel.mesh import (
                 global_device_value_range)
-            mn, mx = global_device_value_range(float(per))
+            mn, mx = global_device_value_range(float(per))  # host-sync-ok: one-time per-device batch barrier
             if mn != mx:
                 raise ValueError(
                     "multi-host fit needs the SAME per-device batch on "
@@ -444,9 +540,15 @@ class ParallelWrapper:
                 n_real = batch.num_examples()
                 m._rng, key = jax.random.split(m._rng)
                 feats, labels, fmask, lmask = self._stage_batch(batch)
+                if m._telemetry is not None:
+                    m.train_state = m._telemetry.ensure_buffer(
+                        m.train_state)
                 m.train_state, loss = self._step(m.train_state, feats,
                                                  labels, fmask, lmask, key)
-                it = int(m.train_state.iteration)
+                # _post_step: host iteration mirror + telemetry flush
+                # opportunity + flight-recorder poll — no per-batch
+                # device sync (the old int(iteration) read was one)
+                it = m._post_step()
                 for lst in m.listeners:
                     lst.iteration_done(m, it, m.epoch_count, loss, etl_ms,
                                        n_real)
@@ -459,7 +561,18 @@ class ParallelWrapper:
             for lst in m.listeners:
                 lst.on_epoch_end(m, m.epoch_count)
             m.epoch_count += 1
+        self._tail_flush()
         return m
+
+    def _tail_flush(self):
+        """Drain rows still on device when the fit ends (mirrors
+        BaseModel's tail flush), then give the recorder a final look."""
+        m = self.model
+        if m._telemetry is not None:
+            m._telemetry.flush(m.train_state)
+            rec = m._recorder()
+            if rec is not None:
+                rec.poll(m)
 
     def _fit_averaging(self, iterator, epochs):
         if self._step is None:
@@ -489,6 +602,7 @@ class ParallelWrapper:
             for lst in m.listeners:
                 lst.on_epoch_end(m, m.epoch_count)
             m.epoch_count += 1
+        self._tail_flush()
         return m
 
     def _run_averaging_round(self, batches):
@@ -504,11 +618,11 @@ class ParallelWrapper:
             self._monitor_uneven_batch(batches[0].num_examples())
 
         def ones_lmask(b: DataSet):
-            lab = np.asarray(b.labels)
+            lab = np.asarray(b.labels)  # host-sync-ok: host-side batch staging for averaging round
             if lab.ndim <= 2:
                 return np.ones((b.num_examples(),), np.float32)
             if lab.ndim == 3 and b.features_mask is not None:
-                return np.asarray(b.features_mask, np.float32)
+                return np.asarray(b.features_mask, np.float32)  # host-sync-ok: host-side batch staging for averaging round
             return np.ones(lab.shape[:-1], np.float32)
 
         # padding gave short batches a labels_mask; full-size batches must
@@ -523,7 +637,7 @@ class ParallelWrapper:
             vals = [get(b) for b in batches]
             if any(v is None for v in vals):
                 return None
-            stacked = np.stack([np.asarray(v) for v in vals])
+            stacked = np.stack([np.asarray(v) for v in vals])  # host-sync-ok: host-side batch staging for averaging round
             # multi-host: each process holds its slice of the (k, B)
             # global batch along the batch dim (dim 1)
             return self._put_batch(stacked, sharding=self._avg_batch_sh,
@@ -532,9 +646,12 @@ class ParallelWrapper:
         labels = stack(lambda b: b.labels)
         fmask = stack(lambda b: b.features_mask)
         lmask = stack(lambda b: b.labels_mask)
+        if m._telemetry is not None:
+            m.train_state = m._telemetry.ensure_buffer(m.train_state)
         m.train_state, loss = self._step(m.train_state, feats, labels,
                                          fmask, lmask, key)
-        it = int(m.train_state.iteration)
+        # the round advanced the device iteration by k local steps
+        it = m._post_step(len(batches))
         for lst in m.listeners:
             lst.iteration_done(m, it, m.epoch_count, loss, 0.0, n_real)
         m._last_loss = loss

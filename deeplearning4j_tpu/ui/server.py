@@ -61,9 +61,10 @@ img.act{image-rendering:pixelated;border:1px solid #ccc;margin:4px}
 <table id=ldetail></table>
 <b>mean |param| and mean |update| over iterations</b>
 <canvas id=lseries></canvas>
-<b>latest param / update histograms</b>
+<b>latest param / update / gradient histograms</b>
 <canvas id=lhist style="height:140px"></canvas>
-<canvas id=luhist style="height:140px"></canvas></div>
+<canvas id=luhist style="height:140px"></canvas>
+<canvas id=lghist style="height:140px"></canvas></div>
 </div>
 <div id=system class=tab>
 <h2>{{i18n:train.system.title}}</h2>
@@ -182,6 +183,8 @@ async function drillDown(n){
            '#1668b8');
   drawBars(document.getElementById('luhist'), ld.update_histogram,
            '#c2410c');
+  drawBars(document.getElementById('lghist'), ld.grad_histogram,
+           '#15803d');
 }
 function scatter(cv, pts, labels){
   const c=cv.getContext('2d');
@@ -338,6 +341,8 @@ class _Handler(BaseHTTPRequestHandler):
     storage: StatsStorage = None   # set by UIServer
     modules: list = []             # registered UIModule instances
     modules_routes: list = []      # their merged Route list
+    registry = None                # metrics registry for /healthz
+    #                                (None -> the process default)
 
     def log_message(self, *a):   # silence request logging
         pass
@@ -378,9 +383,16 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(body)
             return
         if u.path == "/healthz":
-            self._json({"status": "ok",
-                        "sessions": len(self.storage.list_session_ids())
-                        if self.storage is not None else 0})
+            # liveness + degradation: the verdict comes from the metrics
+            # registry (observe/health.py) — NaN storm, recompile storm
+            # or replica divergence turn the probe into a 503 with the
+            # reasons spelled out, while /metrics stays a plain scrape
+            from deeplearning4j_tpu.observe.health import health_status
+            health = health_status(self.registry)
+            health["sessions"] = (len(self.storage.list_session_ids())
+                                  if self.storage is not None else 0)
+            self._json(health,
+                       200 if health["status"] == "ok" else 503)
             return
         if u.path == "/api/i18n":
             from deeplearning4j_tpu.ui.i18n import I18N
@@ -461,7 +473,7 @@ class _Handler(BaseHTTPRequestHandler):
             q = parse_qs(u.query)
             name = q.get("name", [None])[0]
             its, pmag, pstd, umag, ratio = [], [], [], [], []
-            phist = uhist = None
+            phist = uhist = ghist = None
             for up in (self.storage.get_all_updates(sess)
                        if sess else []):
                 ps = (up.get("param_stats") or {}).get(name)
@@ -479,11 +491,14 @@ class _Handler(BaseHTTPRequestHandler):
                              else None)
                 phist = ps.get("histogram") or phist
                 uhist = us.get("histogram") or uhist
+                gs = (up.get("grad_stats") or {}).get(name) or {}
+                ghist = gs.get("histogram") or ghist
             self._json({
                 "name": name, "iterations": its,
                 "param_mean_magnitude": pmag, "param_stdev": pstd,
                 "update_mean_magnitude": umag, "update_ratio": ratio,
                 "param_histogram": phist, "update_histogram": uhist,
+                "grad_histogram": ghist,
             })
             return
         if u.path == "/api/tsne":
@@ -679,9 +694,12 @@ class UIServer:
 
     _instance: Optional["UIServer"] = None
 
-    def __init__(self, port: int = 9000):
+    def __init__(self, port: int = 9000, registry=None):
         self.port = port
         self.storage: Optional[StatsStorage] = None
+        # registry backing /healthz degradation checks; None uses the
+        # process-wide default (tests pass isolated registries)
+        self.registry = registry
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._modules: List = []
@@ -726,6 +744,7 @@ class UIServer:
                 "nothing to serve otherwise")
         handler = type("BoundHandler", (_Handler,),
                        {"storage": self.storage,
+                        "registry": self.registry,
                         "modules": list(self._modules),
                         "modules_routes": [r for m in self._modules
                                            for r in m.get_routes()]})

@@ -111,14 +111,28 @@ class StatsListener(TrainingListener):
             # device-computed series (grad norm, update ratios, NaN
             # counts) ride along for the dashboard
             record["device_metrics"] = dict(tel.last_record())
-        params = model.train_state.params
         if self.collect_histograms:
-            record["param_stats"] = self._layer_stats(params)
-            if self._prev_params is not None:
-                record["update_stats"] = self._update_stats(
-                    self._prev_params, params)
-            # device→host param copy only when histograms consume it
-            self._prev_params = jax.tree_util.tree_map(np.asarray, params)
+            # histogram-enabled telemetry already computed fixed-bin
+            # param/grad/update histograms INSIDE the train step and
+            # flushed them in the ring's one fetch — consume those and
+            # skip the device→host parameter copy entirely
+            from_tel = (self._stats_from_telemetry(tel)
+                        if tel is not None else None)
+            if from_tel is not None:
+                record["param_stats"] = from_tel["param"]
+                if from_tel["update"]:
+                    record["update_stats"] = from_tel["update"]
+                if from_tel["grad"]:
+                    record["grad_stats"] = from_tel["grad"]
+            else:
+                params = model.train_state.params
+                record["param_stats"] = self._layer_stats(params)
+                if self._prev_params is not None:
+                    record["update_stats"] = self._update_stats(
+                        self._prev_params, params)
+                # device→host param copy only when histograms consume it
+                self._prev_params = jax.tree_util.tree_map(np.asarray,
+                                                           params)
         record["memory"] = self._memory_stats()
         self.router.put_update(record)
 
@@ -178,6 +192,41 @@ class StatsListener(TrainingListener):
                     "n_params": count(params.get(name, {})),
                 })
         return nodes
+
+    def _stats_from_telemetry(self, tel) -> Optional[Dict[str, dict]]:
+        """param/update/grad stats rebuilt from the device-computed
+        histograms the collector last flushed, or None when the ring has
+        no histograms (collector not histogram-enabled, or nothing
+        flushed yet). Moment estimates come from bin centers — a
+        bounded-error approximation that is ample for dashboard charts
+        and costs zero device transfers."""
+        hist = getattr(tel, "last_histograms", lambda: None)()
+        if not hist:
+            return None
+        kinds: Dict[str, Dict[str, dict]] = {
+            "param": {}, "update": {}, "grad": {}}
+        for lname, by_kind in hist.get("layers", {}).items():
+            for kind, h in by_kind.items():
+                if kind not in kinds:
+                    continue
+                counts = np.asarray(h.get("counts", ()), np.float64)
+                total = counts.sum()
+                if counts.size == 0 or total <= 0:
+                    continue
+                lo, hi = float(h["min"]), float(h["max"])
+                centers = lo + (np.arange(counts.size) + 0.5) \
+                    * (hi - lo) / counts.size
+                mean = float((counts * centers).sum() / total)
+                var = float((counts * (centers - mean) ** 2).sum()
+                            / total)
+                kinds[kind][lname] = {
+                    "mean_magnitude": float(
+                        (counts * np.abs(centers)).sum() / total),
+                    "stdev": float(np.sqrt(max(var, 0.0))),
+                    "histogram": {"counts": counts.tolist(),
+                                  "min": lo, "max": hi},
+                }
+        return kinds if kinds["param"] else None
 
     def _layer_stats(self, params) -> Dict[str, dict]:
         out: Dict[str, dict] = {}

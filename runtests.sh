@@ -10,6 +10,12 @@ if [[ "${1:-}" == "--examples" ]]; then
   shift
   exec python -m pytest tests/test_examples.py -q -m slow "$@"
 fi
-# lint tier: no hidden device syncs in the jit hot paths (ops/, solver)
+# lint tier: no hidden device syncs in the jit hot paths (ops/,
+# solver, models/, parallel/)
 python tools/check_host_sync.py
+# perf tier: compiled-in telemetry WITH in-step histograms (the flight
+# recorder's config) must stay within a 3% step-overhead budget on the
+# CPU path — the observe/ "one fetch per flush interval" claim
+JAX_PLATFORMS=cpu python -m benchmarks.telemetry_overhead \
+  --steps 150 --with-histograms --assert-overhead --tolerance 0.03
 exec python -m pytest tests/ -q "$@"

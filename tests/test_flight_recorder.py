@@ -1,0 +1,343 @@
+"""Flight recorder tests: forced-NaN post-mortem parse-back, exception
+classification, zero-extra-fetch guarantee with the recorder armed,
+healthz degradation, and cross-replica divergence telemetry."""
+
+import json
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.observe import (
+    MetricsRegistry,
+    TelemetryCollector,
+)
+from deeplearning4j_tpu.observe.flight_recorder import (
+    FlightRecorder,
+    _classify,
+)
+from deeplearning4j_tpu.observe.health import health_status
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+
+
+def _model(lr=1e-2, updater=None, seed=1):
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+    from deeplearning4j_tpu.models.multi_layer_network import (
+        MultiLayerNetwork)
+    from deeplearning4j_tpu.ops.losses import LossFunction
+    from deeplearning4j_tpu.optimize.updaters import Adam
+    conf = (NeuralNetConfiguration.Builder().seed(seed)
+            .updater(updater if updater is not None else Adam(lr))
+            .list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT))
+            .set_input_type(InputType.feed_forward(5)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _batches(n, batch=16, seed=0, scale=1.0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        x = (rng.normal(size=(batch, 5)) * scale).astype(np.float32)
+        y = np.zeros((batch, 3), np.float32)
+        y[np.arange(batch), rng.integers(0, 3, batch)] = 1.0
+        out.append(DataSet(x, y))
+    return out
+
+
+class _ListIter:
+    def __init__(self, batches):
+        self.batches = batches
+
+    def __iter__(self):
+        return iter(self.batches)
+
+    def reset(self):
+        pass
+
+
+def _nan_model():
+    """Sgd with an absurd learning rate + huge inputs: the params blow
+    up to inf/NaN within a couple of steps — deterministic NaN storm."""
+    from deeplearning4j_tpu.optimize.updaters import Sgd
+    return _model(updater=Sgd(1e28))
+
+
+class _DumpListener(TrainingListener):
+    def __init__(self):
+        self.dumps = []
+
+    def on_crash_dump(self, model, path, reason):
+        self.dumps.append((path, reason))
+
+
+class TestNaNDump:
+    def test_forced_nan_writes_parseable_dump(self, tmp_path):
+        m = _nan_model()
+        tel = TelemetryCollector(flush_interval=2,
+                                 registry=MetricsRegistry(),
+                                 histograms=True, hist_interval=1)
+        m.set_telemetry(tel)
+        rec = FlightRecorder(dump_dir=str(tmp_path), enabled=True)
+        m.set_flight_recorder(rec)
+        lst = _DumpListener()
+        m.set_listeners(lst)
+
+        m.fit(_ListIter(_batches(6, scale=1e6)), epochs=1)
+
+        assert len(rec.dumps) == 1, "NaN run must write exactly one dump"
+        dump = Path(rec.dumps[0])
+        assert dump.is_dir() and "nonfinite" in dump.name
+        # the listener hook announced the same dump
+        assert lst.dumps == [(str(dump), "nonfinite")]
+
+        # every section parses back
+        telj = json.loads((dump / "telemetry.json").read_text())
+        assert telj["records"], "dump must carry decoded telemetry rows"
+        assert any(r.get("nonfinite_count", 0) > 0
+                   or not np.isfinite(r.get("loss", 0.0))
+                   for r in telj["records"])
+        assert "loss" in telj["metric_names"]
+
+        hist = json.loads((dump / "histograms.json").read_text())
+        assert hist["records"], "in-step histograms must be in the dump"
+        layers = hist["records"][-1]["layers"]
+        assert set(layers) == {"layer_0", "layer_1"}
+        for by_kind in layers.values():
+            assert set(by_kind) == {"param", "grad", "update"}
+
+        mem = json.loads((dump / "memory.json").read_text())
+        assert mem["devices"], "device watermarks missing"
+        env = json.loads((dump / "environment.json").read_text())
+        assert env["model_class"] == "MultiLayerNetwork"
+
+        report = (dump / "report.md").read_text()
+        assert "nonfinite" in report
+        assert "telemetry.json" in report
+
+        # the health surface degrades off the same registry
+        h = health_status(tel.registry)
+        assert h["status"] == "degraded"
+        assert any("nonfinite" in r for r in h["reasons"])
+
+    def test_reason_dedupe_and_max_dumps(self, tmp_path):
+        m = _nan_model()
+        tel = TelemetryCollector(flush_interval=2,
+                                 registry=MetricsRegistry())
+        m.set_telemetry(tel)
+        rec = FlightRecorder(dump_dir=str(tmp_path), enabled=True)
+        m.set_flight_recorder(rec)
+        m.fit(_ListIter(_batches(6, scale=1e6)), epochs=1)
+        # a NaN STORM (every later flush is non-finite too) still dumps
+        # only once per reason
+        m.fit(_ListIter(_batches(6, scale=1e6)), epochs=1)
+        assert len(rec.dumps) == 1
+        assert rec.record_crash(m, reason="nonfinite") is None
+
+    def test_disabled_recorder_writes_nothing(self, tmp_path):
+        m = _nan_model()
+        tel = TelemetryCollector(flush_interval=2,
+                                 registry=MetricsRegistry())
+        m.set_telemetry(tel)
+        rec = FlightRecorder(dump_dir=str(tmp_path), enabled=False)
+        m.set_flight_recorder(rec)
+        m.fit(_ListIter(_batches(4, scale=1e6)), epochs=1)
+        assert rec.dumps == []
+        assert list(tmp_path.iterdir()) == []
+
+
+class TestExceptionDump:
+    def test_exception_dump_and_reraise(self, tmp_path):
+        class _Boom(TrainingListener):
+            def iteration_done(self, model, iteration, epoch, loss,
+                               etl_ms, examples):
+                raise RuntimeError("boom at iteration_done")
+
+        m = _model()
+        rec = FlightRecorder(dump_dir=str(tmp_path), enabled=True)
+        m.set_flight_recorder(rec)
+        m.set_listeners(_Boom())
+        with pytest.raises(RuntimeError, match="boom"):
+            m.fit(_ListIter(_batches(2)), epochs=1)
+        assert len(rec.dumps) == 1
+        dump = Path(rec.dumps[0])
+        assert "exception" in dump.name
+        report = (dump / "report.md").read_text()
+        assert "RuntimeError" in report
+        assert "boom at iteration_done" in report
+
+    def test_oom_classification(self):
+        assert _classify(RuntimeError(
+            "RESOURCE_EXHAUSTED: Out of memory allocating 1073741824 "
+            "bytes")) == "oom"
+        assert _classify(ValueError("plain failure")) == "exception"
+        assert _classify(None) == "exception"
+
+    def test_crash_handler_never_raises(self, tmp_path):
+        rec = FlightRecorder(dump_dir=str(tmp_path), enabled=True)
+        # a model-shaped object whose attributes all explode must not
+        # mask the original crash
+        class _Hostile:
+            def __getattr__(self, name):
+                raise RuntimeError("hostile attribute")
+        assert rec.record_crash(_Hostile(), exc=ValueError("x")) is None
+
+
+class TestOneFetchWithRecorder:
+    def test_histograms_and_recorder_add_zero_fetches(self, monkeypatch,
+                                                      tmp_path):
+        """The acceptance property extended: histogram rows + per-layer
+        rings + an ARMED flight recorder still cost exactly one
+        jax.device_get per flush interval (3 flushes + tail = 4)."""
+        fetches = []
+        real = jax.device_get
+
+        def counting(x):
+            fetches.append(type(x).__name__)
+            return real(x)
+
+        m = _model()
+        tel = TelemetryCollector(flush_interval=4,
+                                 registry=MetricsRegistry(),
+                                 histograms=True, hist_interval=2)
+        m.set_telemetry(tel)
+        rec = FlightRecorder(dump_dir=str(tmp_path), enabled=True)
+        m.set_flight_recorder(rec)
+        monkeypatch.setattr(jax, "device_get", counting)
+        m.fit(_ListIter(_batches(12)), epochs=1)
+        monkeypatch.setattr(jax, "device_get", real)
+        assert tel.fetch_count == 4
+        assert len(fetches) == 4
+        # the histograms really were decoded from those same 4 fetches
+        assert tel.hist_history
+        assert len(tel.history) == 12
+        # healthy run: the armed recorder stayed silent
+        assert rec.dumps == []
+
+
+class TestHealthz:
+    def test_healthz_degrades_to_503(self):
+        from deeplearning4j_tpu.ui import InMemoryStatsStorage, UIServer
+
+        reg = MetricsRegistry()
+        reg.counter("dl4j_nonfinite_values_total",
+                    "non-finite values").inc(7.0, session="s")
+        srv = UIServer(port=0, registry=reg).attach(
+            InMemoryStatsStorage()).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{srv.url}/healthz")
+            assert ei.value.code == 503
+            body = json.loads(ei.value.read())
+            assert body["status"] == "degraded"
+            assert any("nonfinite" in r for r in body["reasons"])
+        finally:
+            srv.stop()
+
+    def test_health_status_ok_on_clean_registry(self):
+        assert health_status(MetricsRegistry())["status"] == "ok"
+
+
+class TestEvalCheckpointSpans:
+    def test_earlystopping_emits_eval_and_checkpoint_spans(self):
+        from deeplearning4j_tpu.datasets.dataset import (
+            ArrayDataSetIterator)
+        from deeplearning4j_tpu.earlystopping import (
+            DataSetLossCalculator,
+            EarlyStoppingConfiguration,
+            EarlyStoppingTrainer,
+            InMemoryModelSaver,
+            MaxEpochsTerminationCondition,
+        )
+        from deeplearning4j_tpu.observe import SpanTracer
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(32, 5)).astype(np.float32)
+        y = np.zeros((32, 3), np.float32)
+        y[np.arange(32), rng.integers(0, 3, 32)] = 1.0
+        train = ArrayDataSetIterator(DataSet(x, y), batch_size=16)
+        test = ArrayDataSetIterator(DataSet(x, y), batch_size=32)
+        esc = (EarlyStoppingConfiguration.Builder()
+               .epoch_termination_conditions(
+                   MaxEpochsTerminationCondition(2))
+               .score_calculator(DataSetLossCalculator(test))
+               .model_saver(InMemoryModelSaver())
+               .build())
+        m = _model()
+        m.set_tracer(SpanTracer())
+        EarlyStoppingTrainer(esc, m, train).fit()
+        names = {e["name"] for e in m.tracer.events}
+        assert "eval" in names, "held-out scoring must open an eval span"
+        assert "checkpoint" in names, \
+            "best-model save must open a checkpoint span"
+
+    def test_elastic_trainer_emits_checkpoint_spans(self, tmp_path):
+        from deeplearning4j_tpu.observe import SpanTracer
+        from deeplearning4j_tpu.parallel.checkpoint import ElasticTrainer
+
+        m = _model()
+        m.set_tracer(SpanTracer())
+        ElasticTrainer(m, str(tmp_path / "ckpt"),
+                       checkpoint_every=2).fit(_ListIter(_batches(4)),
+                                               epochs=1)
+        ckpt = [e for e in m.tracer.events if e["name"] == "checkpoint"]
+        assert ckpt, "periodic/tail saves must open checkpoint spans"
+
+
+@pytest.mark.skipif(jax.device_count() < 2,
+                    reason="needs multiple (virtual) devices")
+class TestReplicaDivergence:
+    def test_divergence_fires_on_desynced_replica(self):
+        from deeplearning4j_tpu.parallel.wrapper import (
+            ParallelWrapper, TrainingMode)
+
+        m = _model()
+        reg = MetricsRegistry()
+        tel = TelemetryCollector(flush_interval=2, registry=reg)
+        m.set_telemetry(tel)
+        w = (ParallelWrapper.builder(m)
+             .training_mode(TrainingMode.AVERAGING)
+             .workers(jax.device_count())
+             .averaging_frequency(2).build())
+        nw = jax.device_count()
+        batches = _batches(4, batch=8 * nw)
+        # worker 0's shard (the first batch/W rows) sees inputs 1e4x
+        # larger: its loss/grad-norm must stand out in the per-replica
+        # rows and push the divergence gauge up
+        for b in batches:
+            b.features[:8] *= 1e4
+        w.fit(_ListIter(batches), epochs=1)
+
+        assert tel.replica_history, "per-replica rows must have flushed"
+        last = tel.replica_history[-1]
+        assert len(last["loss"]) == nw
+        assert len(last["grad_norm"]) == nw
+        div = reg.gauge("dl4j_replica_divergence").get(session="train")
+        assert div is not None and div > 1.0
+
+    def test_divergence_quiet_on_healthy_replicas(self):
+        from deeplearning4j_tpu.parallel.wrapper import (
+            ParallelWrapper, TrainingMode)
+
+        m = _model(seed=3)
+        reg = MetricsRegistry()
+        tel = TelemetryCollector(flush_interval=2, registry=reg)
+        m.set_telemetry(tel)
+        w = (ParallelWrapper.builder(m)
+             .training_mode(TrainingMode.SHARED_GRADIENTS)
+             .workers(jax.device_count()).build())
+        w.fit(_ListIter(_batches(4, batch=8 * jax.device_count(),
+                                 seed=3)), epochs=1)
+        assert tel.replica_history
+        # sync replicas hold identical params: the fingerprint column is
+        # flat and the divergence gauge stays ~0
+        div = reg.gauge("dl4j_replica_divergence").get(session="train")
+        assert div is not None and div < 1e-3

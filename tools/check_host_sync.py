@@ -36,6 +36,8 @@ REPO_ROOT = Path(__file__).resolve().parent.parent
 DEFAULT_PATHS = (
     "deeplearning4j_tpu/ops",
     "deeplearning4j_tpu/optimize/solver.py",
+    "deeplearning4j_tpu/models",
+    "deeplearning4j_tpu/parallel",
 )
 
 PRAGMA = "# host-sync-ok"
