@@ -1,0 +1,204 @@
+"""Fed vs unfed input pipeline: does the DeviceFeeder hide host ETL?
+
+The claim under test (datasets/feeder.py): with prefetch on, host-side
+batch production (decode/augment — simulated here as a sleep) and the
+host→device staging issue overlap the asynchronously-dispatched step,
+so epoch wall time approaches max(etl, compute) per batch instead of
+their sum. The unfed arm (``fit(..., prefetch=0)``) serializes the two
+— the pre-feeder behavior.
+
+Arms run as alternating whole epochs (A/B interleaved, like
+telemetry_overhead.py) so machine-load drift hits both equally. The fed
+arm carries a SpanTracer; the report includes its cumulative
+``feed_stall`` time — the portion of ETL the pipeline FAILED to hide
+(0 = fully overlapped) — which is the evidence row PERF_ANALYSIS r7
+quotes.
+
+Usage:
+    python benchmarks/input_pipeline.py                 # timed A/B
+    python benchmarks/input_pipeline.py --k-steps 4     # + fused arm
+    python benchmarks/input_pipeline.py --smoke         # correctness
+        # only (bitwise fed-vs-unfed check + span evidence), no timing
+        # gate — the runtests.sh CPU tier
+    python benchmarks/input_pipeline.py --assert-speedup 1.5
+"""
+
+from __future__ import annotations
+
+import argparse
+import statistics
+import time
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, DataSetIterator
+
+
+def build_model(seed: int = 7, width: int = 1024):
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+    from deeplearning4j_tpu.models.multi_layer_network import (
+        MultiLayerNetwork)
+    from deeplearning4j_tpu.ops.losses import LossFunction
+    from deeplearning4j_tpu.optimize.updaters import Adam
+    conf = (NeuralNetConfiguration.Builder().seed(seed)
+            .updater(Adam(1e-3)).list()
+            .layer(DenseLayer(n_out=width))
+            .layer(OutputLayer(n_out=10, loss=LossFunction.MCXENT))
+            .set_input_type(InputType.feed_forward(128)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def make_batches(n: int, batch: int = 512, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        x = rng.normal(size=(batch, 128)).astype(np.float32)
+        idx = rng.integers(0, 10, batch)
+        y = np.zeros((batch, 10), np.float32)
+        y[np.arange(batch), idx] = 1.0
+        out.append(DataSet(x, y))
+    return out
+
+
+class SleepyIterator(DataSetIterator):
+    """In-memory batches behind a per-batch host-ETL delay — the
+    decode/augment cost a real reader pays. time.sleep releases the
+    GIL, so the async prefetch thread genuinely overlaps it."""
+
+    def __init__(self, batches, etl_s: float):
+        self._batches = batches
+        self._etl_s = etl_s
+
+    def __iter__(self):
+        for b in self._batches:
+            if self._etl_s > 0:
+                time.sleep(self._etl_s)
+            yield b
+
+    @property
+    def batch_size(self):
+        return self._batches[0].num_examples()
+
+
+def _epoch_time(model, batches, etl_s, **fit_kw) -> float:
+    it = SleepyIterator(batches, etl_s)
+    t0 = time.perf_counter()
+    model.fit(it, epochs=1, **fit_kw)
+    return time.perf_counter() - t0
+
+
+def _stall_ms(tracer) -> float:
+    return sum(e["dur"] for e in tracer._events
+               if e["name"] == "feed_stall") / 1e3
+
+
+def run_timed(args) -> int:
+    from deeplearning4j_tpu.observe import SpanTracer
+
+    batches = make_batches(args.batches, batch=args.batch)
+    unfed = build_model(width=args.width)
+    fed = build_model(width=args.width)
+    fed_tracer = SpanTracer()
+    fed.set_tracer(fed_tracer)
+    arms = [("unfed", unfed, dict(prefetch=0)),
+            ("fed", fed, dict())]
+    if args.k_steps > 1:
+        fused = build_model(width=args.width)
+        arms.append(("fed+scan", fused, dict(k_steps=args.k_steps)))
+
+    # warmup epoch per arm: compile outside the timed region
+    for _, model, kw in arms:
+        _epoch_time(model, batches[:max(2, args.k_steps)], 0.0, **kw)
+
+    times = {name: [] for name, _, _ in arms}
+    for _ in range(args.rounds):
+        for name, model, kw in arms:
+            times[name].append(
+                _epoch_time(model, batches, args.etl_ms / 1e3, **kw))
+
+    med = {name: statistics.median(ts) for name, ts in times.items()}
+    n = len(batches)
+    print(f"{n} batches/epoch, {args.etl_ms:.1f} ms simulated host ETL "
+          f"per batch, median of {args.rounds} epochs per arm:")
+    for name in times:
+        print(f"  {name:9s} {med[name] * 1e3 / n:8.3f} ms/step "
+              f"({n / med[name]:7.1f} steps/s)")
+    speedup = med["unfed"] / med["fed"]
+    stall = _stall_ms(fed_tracer)
+    total_etl = args.etl_ms * n * args.rounds
+    print(f"fed speedup:   {speedup:.2f}x")
+    print(f"feed_stall:    {stall:.1f} ms unhidden of "
+          f"{total_etl:.0f} ms ETL issued to the fed arm "
+          f"({100 * stall / max(total_etl, 1e-9):.1f}% leaked)")
+    if args.k_steps > 1:
+        print(f"fed+scan:      {med['unfed'] / med['fed+scan']:.2f}x "
+              f"vs unfed (k={args.k_steps})")
+
+    if args.assert_speedup and speedup < args.assert_speedup:
+        print(f"FAIL: fed speedup {speedup:.2f}x below the "
+              f"{args.assert_speedup:.2f}x floor")
+        return 1
+    return 0
+
+
+def run_smoke(args) -> int:
+    """Correctness-only tier: the fed path must replay the unfed
+    trajectory bitwise and leave span evidence of staged transfers.
+    No timing gate — CI boxes are too noisy for a ratio assert."""
+    import jax
+    from deeplearning4j_tpu.observe import SpanTracer
+
+    batches = make_batches(8, batch=64)
+    unfed = build_model(width=64)
+    fed = build_model(width=64)
+    tracer = SpanTracer()
+    fed.set_tracer(tracer)
+    unfed.fit(SleepyIterator(batches, 0.0), epochs=1, prefetch=0)
+    fed.fit(SleepyIterator(batches, 0.0), epochs=1)
+    a = jax.tree_util.tree_leaves(jax.device_get(unfed.train_state.params))
+    b = jax.tree_util.tree_leaves(jax.device_get(fed.train_state.params))
+    for x, y in zip(a, b):
+        if not np.array_equal(np.asarray(x), np.asarray(y)):
+            print("FAIL: fed trajectory diverged from unfed")
+            return 1
+    names = {e["name"] for e in tracer._events}
+    for required in ("etl", "host_to_device"):
+        if required not in names:
+            print(f"FAIL: no '{required}' span from the fed run")
+            return 1
+    print("input_pipeline smoke: fed == unfed bitwise, "
+          f"{sum(1 for e in tracer._events if e['name'] == 'host_to_device')}"
+          " staged transfers traced")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--batches", type=int, default=60,
+                    help="batches per epoch")
+    ap.add_argument("--rounds", type=int, default=5,
+                    help="timed epochs per arm (interleaved)")
+    ap.add_argument("--etl-ms", type=float, default=8.0,
+                    help="simulated host ETL per batch (the default "
+                         "roughly matches the default model's CPU step "
+                         "time — the regime the double buffer targets)")
+    ap.add_argument("--width", type=int, default=1024,
+                    help="hidden width of the benchmark model")
+    ap.add_argument("--batch", type=int, default=512,
+                    help="examples per batch")
+    ap.add_argument("--k-steps", type=int, default=1,
+                    help=">1 adds a fused-dispatch arm")
+    ap.add_argument("--assert-speedup", type=float, default=None,
+                    help="exit 1 when fed/unfed speedup falls below")
+    ap.add_argument("--smoke", action="store_true",
+                    help="correctness-only CI tier (no timing gate)")
+    args = ap.parse_args(argv)
+    return run_smoke(args) if args.smoke else run_timed(args)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

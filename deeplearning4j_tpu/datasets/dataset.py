@@ -42,14 +42,17 @@ class DataSet:
         rng = np.random.default_rng(seed)
         perm = rng.permutation(self.num_examples())
         def idx(a):
-            return None if a is None else np.asarray(a)[perm]
+            # host-sync-ok: host-side shuffle of numpy arrays pre-transfer
+            return None if a is None else np.asarray(a)[perm]  # host-sync-ok: host shuffle
         return DataSet(*(idx(a) for a in self._arrays()))
 
     @staticmethod
     def merge(batches: Sequence["DataSet"]) -> "DataSet":
         def cat(xs):
             xs = [x for x in xs if x is not None]
-            return np.concatenate([np.asarray(x) for x in xs], axis=0) if xs else None
+            return np.concatenate(  # host-sync-ok: host-side batch merge
+                [np.asarray(x) for x in xs],  # host-sync-ok: host batch merge
+                axis=0) if xs else None
         return DataSet(cat([b.features for b in batches]),
                        cat([b.labels for b in batches]),
                        cat([b.features_mask for b in batches]),
@@ -129,11 +132,11 @@ class ArrayDataSetIterator(DataSetIterator):
         end = n - (n % self._bs) if self._drop_last else n
         for lo in range(0, end, self._bs):
             hi = min(lo + self._bs, n)
-            yield DataSet(
-                np.asarray(d.features)[lo:hi],
-                None if d.labels is None else np.asarray(d.labels)[lo:hi],
-                None if d.features_mask is None else np.asarray(d.features_mask)[lo:hi],
-                None if d.labels_mask is None else np.asarray(d.labels_mask)[lo:hi])
+            def cut(a):
+                # host-sync-ok: host-side batch slicing before transfer
+                return None if a is None else np.asarray(a)[lo:hi]  # host-sync-ok: host slice
+            yield DataSet(cut(d.features), cut(d.labels),
+                          cut(d.features_mask), cut(d.labels_mask))
 
     @property
     def batch_size(self):

@@ -202,8 +202,8 @@ def write_idx_gz(images: np.ndarray, labels: np.ndarray, directory: str,
     ``DL4J_TPU_DATA_DIR`` cache so fetchers take the real-file path; the
     reference's MnistFetcher downloads these same files
     (deeplearning4j-data/.../MnistDataFetcher.java:1)."""
-    images = np.asarray(images, np.uint8)
-    labels = np.asarray(labels, np.uint8)
+    images = np.asarray(images, np.uint8)  # host-sync-ok: host-side data decode/build pre-transfer
+    labels = np.asarray(labels, np.uint8)  # host-sync-ok: host-side data decode/build pre-transfer
     n, rows, cols = images.shape
     os.makedirs(directory, exist_ok=True)
     with gzip.open(os.path.join(
@@ -305,11 +305,11 @@ class TinyImageNetFetcher:
     def _decode(self, path: str) -> np.ndarray:
         from PIL import Image
         with Image.open(path) as im:
-            a = np.asarray(im.convert("RGB"), np.uint8)
+            a = np.asarray(im.convert("RGB"), np.uint8)  # host-sync-ok: host-side data decode/build pre-transfer
         if a.shape[:2] != (self.H, self.W):   # canonical files are 64x64
             from PIL import Image as I
             with I.open(path) as im:
-                a = np.asarray(im.convert("RGB").resize((self.W, self.H)),
+                a = np.asarray(im.convert("RGB").resize((self.W, self.H)),  # host-sync-ok: host-side data decode/build pre-transfer
                                np.uint8)
         return a
 
@@ -354,7 +354,7 @@ class TinyImageNetFetcher:
                     if len(images) >= self.subset:
                         break
         x = np.stack(images).astype(np.float32) / 255.0
-        return x, np.asarray(labels, np.int64)
+        return x, np.asarray(labels, np.int64)  # host-sync-ok: host-side data decode/build pre-transfer
 
     def fetch(self) -> Tuple[np.ndarray, np.ndarray]:
         base = os.path.join(DATA_DIR, "tinyimagenet")
@@ -542,8 +542,8 @@ def write_cifar_bin(images: np.ndarray, labels: np.ndarray,
     canonical ``cifar-10-batches-bin`` record format (label byte + 3072
     CHW bytes) — lets tests/users populate the cache so the real-file
     path is exercised byte-for-byte (same contract as write_idx_gz)."""
-    images = np.asarray(images, np.uint8)
-    labels = np.asarray(labels, np.uint8)
+    images = np.asarray(images, np.uint8)  # host-sync-ok: host-side data decode/build pre-transfer
+    labels = np.asarray(labels, np.uint8)  # host-sync-ok: host-side data decode/build pre-transfer
     n = images.shape[0]
     chw = images.transpose(0, 3, 1, 2).reshape(n, 3072)
     rec = np.concatenate([labels[:, None], chw], axis=1)
@@ -632,6 +632,6 @@ class UciSequenceDataSetIterator(_ArrayBackedIterator):
                     base[cls.LENGTH // 2:] -= rng.uniform(7.5, 20)
                 rows.append(base)
                 labels.append(k)
-        return (np.asarray(rows, np.float32),
-                np.asarray(labels, np.int64))
+        return (np.asarray(rows, np.float32),  # host-sync-ok: host-side data decode/build pre-transfer
+                np.asarray(labels, np.int64))  # host-sync-ok: host-side data decode/build pre-transfer
 

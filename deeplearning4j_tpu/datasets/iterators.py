@@ -30,32 +30,86 @@ class AsyncDataSetIterator(DataSetIterator):
     def __init__(self, base: DataSetIterator, queue_size: int = 8):
         self.base = base
         self.queue_size = queue_size
+        self._worker: Optional[threading.Thread] = None
+        self._stop: Optional[threading.Event] = None
+        self._q: Optional[queue.Queue] = None
 
     def __iter__(self) -> Iterator[DataSet]:
+        # one pass at a time: an unfinished previous pass (early break)
+        # must not keep filling the queue we are about to read
+        self._shutdown_worker()
         q: queue.Queue = queue.Queue(maxsize=self.queue_size)
+        stop = threading.Event()
         error = []
 
         def worker():
             try:
                 for batch in self.base:
-                    q.put(batch)
+                    while not stop.is_set():
+                        try:
+                            q.put(batch, timeout=0.05)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
             except BaseException as e:  # propagate to consumer
                 error.append(e)
             finally:
-                q.put(self._SENTINEL)
+                while not stop.is_set():
+                    try:
+                        q.put(self._SENTINEL, timeout=0.05)
+                        break
+                    except queue.Full:
+                        continue
 
         t = threading.Thread(target=worker, daemon=True)
+        self._worker, self._stop, self._q = t, stop, q
         t.start()
-        while True:
-            item = q.get()
-            if item is self._SENTINEL:
-                break
-            yield item
-        t.join()
+        finished = False
+        try:
+            while True:
+                item = q.get()
+                if item is self._SENTINEL:
+                    finished = True
+                    break
+                yield item
+        finally:
+            if finished:
+                t.join()
+            else:
+                # consumer abandoned the pass (break / exception / GC):
+                # stop and reap the worker instead of leaving it blocked
+                # on a full queue forever
+                self._reap(t, stop, q)
+            if self._worker is t:
+                self._worker = self._stop = self._q = None
         if error:
             raise error[0]
 
+    @staticmethod
+    def _reap(t: threading.Thread, stop: threading.Event, q: queue.Queue):
+        stop.set()
+        while t.is_alive():
+            try:          # drain so a put-blocked worker sees the stop
+                q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=0.05)
+
+    def _shutdown_worker(self):
+        t, stop, q = self._worker, self._stop, self._q
+        self._worker = self._stop = self._q = None
+        if t is None or not t.is_alive():
+            return
+        self._reap(t, stop, q)
+
     def reset(self):
+        # stop → drain → JOIN, and only then reset the base: resetting
+        # first would let the still-running worker interleave stale
+        # batches from the old pass (or race a non-reentrant base) into
+        # the next one
+        self._shutdown_worker()
         self.base.reset()
 
     @property

@@ -70,8 +70,8 @@ class CSVRecordReader(RecordReader):
             mat = native.parse_csv(text, self.delimiter)
             if mat is None:   # no toolchain: numpy fallback
                 rows = [r for r in text.splitlines() if r.strip()]
-                mat = np.asarray(
-                    [[float(v) for v in r.split(self.delimiter)]
+                mat = np.asarray(  # host-sync-ok: host-side data decode/build pre-transfer
+                    [[float(v) for v in r.split(self.delimiter)]  # host-sync-ok: host-side data decode/build pre-transfer
                      for r in rows], np.float32)
             self._data = mat
         return self._data
@@ -84,7 +84,7 @@ class CollectionRecordReader(RecordReader):
     """In-memory records (DataVec CollectionRecordReader)."""
 
     def __init__(self, records: Sequence[Sequence[float]]):
-        self._records = [np.asarray(r, np.float32) for r in records]
+        self._records = [np.asarray(r, np.float32) for r in records]  # host-sync-ok: host-side data decode/build pre-transfer
 
     def __iter__(self):
         return iter(self._records)
@@ -103,7 +103,7 @@ class SequenceRecordReader:
 
 class CollectionSequenceRecordReader(SequenceRecordReader):
     def __init__(self, sequences: Sequence[Sequence[Sequence[float]]]):
-        self._seqs = [np.asarray(s, np.float32) for s in sequences]
+        self._seqs = [np.asarray(s, np.float32) for s in sequences]  # host-sync-ok: host-side data decode/build pre-transfer
 
     def __iter__(self):
         return iter(self._seqs)
@@ -173,7 +173,7 @@ class RecordReaderDataSetIterator(DataSetIterator):
     def __iter__(self) -> Iterator[DataSet]:
         buf: List[np.ndarray] = []
         for rec in self.reader:
-            buf.append(np.asarray(rec, np.float32))
+            buf.append(np.asarray(rec, np.float32))  # host-sync-ok: host-side data decode/build pre-transfer
             if len(buf) == self._batch:
                 f, l = self._split(np.stack(buf))
                 yield DataSet(f, l)
@@ -255,9 +255,9 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
         label_iter = (iter(self.label_reader)
                       if self.label_reader is not None else None)
         for f in self.feature_reader:
-            f = np.asarray(f, np.float32)
+            f = np.asarray(f, np.float32)  # host-sync-ok: host-side data decode/build pre-transfer
             if label_iter is not None:
-                l = np.asarray(next(label_iter), np.float32)
+                l = np.asarray(next(label_iter), np.float32)  # host-sync-ok: host-side data decode/build pre-transfer
             else:
                 # single-reader mode: last column is the per-step label
                 l = f[:, -1:]

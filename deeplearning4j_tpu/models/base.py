@@ -47,6 +47,7 @@ class BaseModel:
         self.train_state: Optional[TrainState] = None
         self.listeners: List[TrainingListener] = []
         self._train_step = None
+        self._scan_step = None
         self._rng = None
         self.epoch_count = 0
         self._last_loss = None
@@ -71,6 +72,11 @@ class BaseModel:
         raise NotImplementedError
 
     def _build_train_step(self):
+        raise NotImplementedError
+
+    def _build_scan_train_step(self):
+        """K-step fused variant (optimize/solver.make_scan_train_step);
+        built lazily by the fit loop when ``k_steps > 1``."""
         raise NotImplementedError
 
     def output(self, features, train: bool = False):
@@ -124,6 +130,7 @@ class BaseModel:
         self._telemetry = collector
         # the spec is baked into the jitted steps — force rebuilds
         self._train_step = None
+        self._scan_step = None
         if hasattr(self, "_tbptt_step"):
             self._tbptt_step = None
         return self
@@ -191,22 +198,60 @@ class BaseModel:
         return it
 
     # ---- fit loop -------------------------------------------------------
-    def fit(self, data, epochs: int = 1):
+    def fit(self, data, epochs: int = 1, k_steps: Optional[int] = None,
+            prefetch: Optional[int] = None,
+            byte_budget: Optional[int] = None):
         """fit(DataSet) / fit(DataSetIterator[, epochs]) — the reference's
-        MultiLayerNetwork.fit(DataSetIterator) hot loop. Any exception
-        escaping the loop (including XLA OOM) first passes through the
-        flight recorder, which writes a post-mortem dump and re-raises —
-        the CrashReportingUtil contract: the crash still surfaces, but
-        the evidence survives."""
+        MultiLayerNetwork.fit(DataSetIterator) hot loop.
+
+        Iterator fits run through the DeviceFeeder input pipeline
+        (datasets/feeder.py): the next ``prefetch`` batches (default 2)
+        are staged onto the device while the current step computes, and
+        plain iterators are auto-wrapped in an AsyncDataSetIterator so
+        host-side batch production overlaps too (the reference wraps at
+        MultiLayerNetwork.java:1273). Wrap the iterator in
+        AsyncShieldDataSetIterator (``async_supported = False``) or pass
+        ``prefetch=0`` to opt out and get the strictly synchronous loop.
+
+        ``k_steps > 1`` additionally fuses K prefetched batches into ONE
+        device dispatch via the scanned train step — per-dispatch
+        overhead is paid once per K optimizer steps. Ragged batches are
+        padded to the bucket size with a zero labels mask (bitwise-
+        neutral for the masked loss), so the whole epoch — partial final
+        batch included — runs on one compiled signature. Iteration
+        counts advance by K and telemetry still records one row per
+        inner step; listeners fire once per dispatch with the last inner
+        loss.
+
+        Any exception escaping the loop (including XLA OOM) first passes
+        through the flight recorder, which writes a post-mortem dump and
+        re-raises — the CrashReportingUtil contract: the crash still
+        surfaces, but the evidence survives."""
         try:
-            return self._fit_inner(data, epochs)
+            return self._fit_inner(data, epochs, k_steps=k_steps,
+                                   prefetch=prefetch,
+                                   byte_budget=byte_budget)
         except Exception as e:
             rec = self._recorder()
             if rec is not None:
                 rec.record_crash(self, exc=e)
             raise
 
-    def _fit_inner(self, data, epochs: int = 1):
+    def _feed_supported(self) -> bool:
+        """TBPTT slices batches along time on the host, so those configs
+        take the unfed path; everything else can be staged ahead."""
+        return getattr(getattr(self, "conf", None), "backprop_type",
+                       None) != "tbptt"
+
+    def _staged_step_args(self, features, labels, fmask, lmask):
+        """Adapt device-staged arrays to this model's step signature
+        (ComputationGraph wraps singles into input/output tuples)."""
+        return features, labels, fmask, lmask
+
+    def _fit_inner(self, data, epochs: int = 1,
+                   k_steps: Optional[int] = None,
+                   prefetch: Optional[int] = None,
+                   byte_budget: Optional[int] = None):
         if self.train_state is None:
             self.init()
         else:
@@ -241,18 +286,46 @@ class BaseModel:
             self._fit_batch(data)
             return self
         iterator = data
+        k = 1 if k_steps is None else int(k_steps)
+        if k < 1:
+            raise ValueError("k_steps must be >= 1")
+        from deeplearning4j_tpu.datasets.feeder import (
+            DEFAULT_DEPTH, DeviceFeeder)
+        from deeplearning4j_tpu.datasets.iterators import (
+            AsyncDataSetIterator)
+        depth = DEFAULT_DEPTH if prefetch is None else int(prefetch)
+        feed = (depth > 0 and self._feed_supported()
+                and getattr(iterator, "async_supported", True))
+        if k > 1 and not feed:
+            raise ValueError(
+                "k_steps > 1 needs the device feeder: prefetch must be "
+                ">= 1, the iterator async-capable (no AsyncShield), and "
+                "the model not configured for TBPTT")
+        source = iterator
+        if (feed and isinstance(iterator, DataSetIterator)
+                and not isinstance(iterator, AsyncDataSetIterator)):
+            # the reference's contract: fit() itself provides the
+            # prefetch thread unless the iterator opted out (shield) or
+            # already is one
+            source = AsyncDataSetIterator(iterator)
+        feeder = (DeviceFeeder(source, depth=depth, byte_budget=byte_budget,
+                               k_steps=k, tracer=tracer)
+                  if feed else None)
         for epoch in range(epochs):
             for lst in self.listeners:
                 lst.on_epoch_start(self, self.epoch_count)
-            it_start = time.perf_counter()
-            for batch in iterator:
-                now = time.perf_counter()
-                etl_ms = (now - it_start) * 1000.0
-                tracer.add_span("etl", it_start, now, cat="data")
-                self._fit_batch(batch, etl_ms=etl_ms)
+            if feeder is not None:
+                self._fit_epoch_fed(feeder, tracer)
+            else:
                 it_start = time.perf_counter()
-            if isinstance(iterator, DataSetIterator):
-                iterator.reset()
+                for batch in iterator:
+                    now = time.perf_counter()
+                    etl_ms = (now - it_start) * 1000.0
+                    tracer.add_span("etl", it_start, now, cat="data")
+                    self._fit_batch(batch, etl_ms=etl_ms)
+                    it_start = time.perf_counter()
+            if isinstance(source, DataSetIterator):
+                source.reset()
             for lst in self.listeners:
                 lst.on_epoch_end(self, self.epoch_count)
             self.epoch_count += 1
@@ -290,6 +363,68 @@ class BaseModel:
         for lst in self.listeners:
             lst.iteration_done(self, it, self.epoch_count, loss, etl_ms,
                                batch.num_examples())
+        self._last_loss = loss
+
+    # ---- fed fit path (datasets/feeder.DeviceFeeder) --------------------
+    def _fit_epoch_fed(self, feeder, tracer):
+        """One epoch off the device feeder: arrays arrive pre-staged, so
+        the only host work per dispatch is handing them to the jitted
+        step. ``k == 0`` items are foreign objects (e.g. MultiDataSet)
+        the feeder passed through — they take the classic unfed path."""
+        for item in feeder:
+            if item.k == 0:
+                self._fit_batch(item.raw, etl_ms=item.queue_wait_ms)
+            elif item.k == 1:
+                self._fit_staged(item, tracer)
+            else:
+                self._fit_group(item, tracer)
+
+    def _fit_staged(self, item, tracer):
+        """Single pre-staged batch → one step dispatch. Mirrors
+        _fit_batch exactly (same rng split, same step, same watchdog
+        key), minus the host→device transfer that already happened in
+        the feeder — the K=1 fed trajectory is bitwise-equal to unfed."""
+        self._rng, step_key = jax.random.split(self._rng)
+        args = self._staged_step_args(item.features, item.labels,
+                                      item.features_mask, item.labels_mask)
+        if self._telemetry is not None:
+            self.train_state = self._telemetry.ensure_buffer(
+                self.train_state)
+        if self.recompile_watchdog is not None:
+            self.recompile_watchdog.observe("train_step", *args)
+        with tracer.span("dispatch", cat="step"):
+            self.train_state, loss = self._train_step(
+                self.train_state, *args, step_key)
+        it = self._post_step()
+        for lst in self.listeners:
+            lst.iteration_done(self, it, self.epoch_count, loss,
+                               item.queue_wait_ms, item.n_examples)
+        self._last_loss = loss
+
+    def _fit_group(self, item, tracer):
+        """K stacked pre-staged batches → ONE scanned dispatch running K
+        optimizer steps (bench.py's amortization, promoted to fit).
+        Iteration advances by K, telemetry records a row per inner step
+        on-device, listeners fire once with the last inner loss and the
+        group's REAL (pre-padding) example count."""
+        if self._scan_step is None:
+            self._scan_step = self._build_scan_train_step()
+        self._rng, group_key = jax.random.split(self._rng)
+        args = self._staged_step_args(item.features, item.labels,
+                                      item.features_mask, item.labels_mask)
+        if self._telemetry is not None:
+            self.train_state = self._telemetry.ensure_buffer(
+                self.train_state)
+        if self.recompile_watchdog is not None:
+            self.recompile_watchdog.observe("scan_train_step", *args)
+        with tracer.span("dispatch", cat="step", k=item.k):
+            self.train_state, losses = self._scan_step(
+                self.train_state, *args, group_key)
+        it = self._post_step(item.k)
+        loss = losses[-1]
+        for lst in self.listeners:
+            lst.iteration_done(self, it, self.epoch_count, loss,
+                               item.queue_wait_ms, item.n_examples)
         self._last_loss = loss
 
     def score(self, dataset: Optional[DataSet] = None) -> float:

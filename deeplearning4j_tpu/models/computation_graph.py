@@ -26,6 +26,7 @@ from deeplearning4j_tpu.optimize.solver import (
     TrainState,
     make_constrain_fn,
     build_optimizer,
+    make_scan_train_step,
     make_train_step,
 )
 
@@ -193,6 +194,26 @@ class ComputationGraph(BaseModel):
             constrain_fn=make_constrain_fn(
                 [l for l in self._constraint_layers()]),
             telemetry=self._telemetry_spec())
+
+    def _build_scan_train_step(self):
+        """K fused steps per dispatch; the scan carries the input/output
+        tuples so each inner step sees per-batch (B, ...) elements."""
+        def loss_fn(params, model_state, features, labels, fmask, lmask,
+                    rng, iteration):
+            return self._loss(params, model_state, features, labels, fmask,
+                              lmask, rng, iteration)
+        return make_scan_train_step(
+            loss_fn, self._tx,
+            constrain_fn=make_constrain_fn(
+                [l for l in self._constraint_layers()]),
+            telemetry=self._telemetry_spec())
+
+    def _staged_step_args(self, features, labels, fmask, lmask):
+        # the DeviceFeeder stages plain DataSets; this graph's step takes
+        # input/output tuples (multi-input safe) like _fit_batch_standard
+        return ((features,), (labels,),
+                None if fmask is None else (fmask,),
+                None if lmask is None else (lmask,))
 
     # ---- fit ------------------------------------------------------------
     def _fit_batch_standard(self, batch: Union[DataSet, MultiDataSet],

@@ -32,6 +32,7 @@ from deeplearning4j_tpu.optimize.solver import (
     TrainState,
     make_constrain_fn,
     build_optimizer,
+    make_scan_train_step,
     make_train_step,
 )
 
@@ -202,6 +203,22 @@ class MultiLayerNetwork(BaseModel):
             return self._loss(params, model_state, features, labels, fmask,
                               lmask, rng, iteration)
         return make_train_step(
+            loss_fn, self._tx,
+            constrain_fn=make_constrain_fn(
+                [l for l in self._constraint_layers()]),
+            telemetry=self._telemetry_spec())
+
+    def _build_scan_train_step(self):
+        """K fused optimizer steps per dispatch (fit(k_steps=K)); same
+        loss/constraint/telemetry spec as the per-batch step, scanned
+        over a leading K dim. No bf16 shadow here: the regularization
+        term reads master params, and the fed path promises a bitwise
+        match with the per-batch trajectory."""
+        def loss_fn(params, model_state, features, labels, fmask, lmask,
+                    rng, iteration):
+            return self._loss(params, model_state, features, labels, fmask,
+                              lmask, rng, iteration)
+        return make_scan_train_step(
             loss_fn, self._tx,
             constrain_fn=make_constrain_fn(
                 [l for l in self._constraint_layers()]),

@@ -137,6 +137,15 @@ def make_scan_train_step(loss_fn: LossFn, tx: optax.GradientTransformation,
     TPU analog of the reference keeping its fit loop inside one native
     workspace iteration.
 
+    This is the step behind ``fit(..., k_steps=K)``: the DeviceFeeder
+    (datasets/feeder.py) stages K prefetched batches as one stacked
+    (K, B, ...) device array (ragged tails padded to the bucket size
+    with a zero labels mask, so the whole epoch keeps one compiled
+    signature) and the fit loop dispatches them here. ``None`` masks
+    scan through as empty pytrees — a mask must be None for ALL K
+    batches or an array for all K, which the feeder's bucket
+    normalization guarantees.
+
     ``shadow_cast``: optional ``params -> low-precision params`` (e.g.
     ``lambda p: cast_params(p, "bfloat16")``). When given, the scan
     carries a CAST SHADOW of the parameters next to the f32 masters:

@@ -375,40 +375,14 @@ class ParallelWrapper:
         duplicated rows (mask-free batch moments) — a bounded, usually
         negligible perturbation. (The reference rebalances queues across
         trainer threads instead — ParallelWrapper.java:225; static shapes
-        make padding the XLA way.)"""
+        make padding the XLA way.) Row duplication + mask synthesis live
+        in datasets/feeder.pad_rows — one implementation for the fit loop
+        and the wrapper."""
+        from deeplearning4j_tpu.datasets.feeder import pad_rows
         n = batch.num_examples()
         w = self.num_workers
         pad = ((target - n) if target else 0) + ((-(target or n)) % w)
-        if pad == 0:
-            return batch
-
-        def rep(a):
-            if a is None:
-                return None
-            a = np.asarray(a)  # host-sync-ok: host-side batch split/pad before transfer
-            return np.concatenate([a, np.repeat(a[-1:], pad, axis=0)], axis=0)
-
-        lmask = batch.labels_mask
-        if lmask is None:
-            lab = np.asarray(batch.labels)  # host-sync-ok: host-side batch split/pad before transfer
-            if lab.ndim <= 2:
-                # (N,) sparse or (N, C) dense labels → per-example weights
-                mask_shape = (n,)
-            elif lab.ndim == 3 and batch.features_mask is not None:
-                # variable-length sequences: keep the features-mask
-                # semantics the unpadded loss path would have used
-                lmask = np.asarray(batch.features_mask, np.float32)  # host-sync-ok: host-side batch split/pad before transfer
-                mask_shape = None
-            else:
-                # (N, T, C) → (N, T); (N, H, W, C) → (N, H, W)
-                mask_shape = lab.shape[:-1]
-            if lmask is None:
-                lmask = np.ones(mask_shape, np.float32)
-        lmask = np.asarray(lmask)  # host-sync-ok: host-side batch split/pad before transfer
-        zeros = np.zeros((pad,) + lmask.shape[1:], lmask.dtype)
-        return DataSet(rep(batch.features), rep(batch.labels),
-                       rep(batch.features_mask),
-                       np.concatenate([lmask, zeros], axis=0))
+        return pad_rows(batch, pad)
 
     def _put_batch(self, a, sharding=None, batch_dim: int = 0):
         """Stage one batch tensor onto the data-sharded layout.
@@ -495,16 +469,55 @@ class ParallelWrapper:
         self._pending_uneven_per = per if (checked is not None
                                            and per != checked) else None
 
-    def _stage_batch(self, batch: DataSet):
-        """Pad to the worker multiple and stage the four batch arrays on
-        the mesh — the single home for sync-step argument staging."""
+    def _sync_prepare(self, batch: DataSet) -> DataSet:
+        """Host-side prep for one sync-mode batch: pad to the worker
+        multiple, then run the multi-host drift monitor. Shared by the
+        legacy per-batch staging and the DeviceFeeder ``prepare`` hook."""
         batch = self._pad_batch(batch)
         if jax.process_count() > 1:
             self._monitor_uneven_batch(batch.num_examples())
+        return batch
+
+    def _stage_batch(self, batch: DataSet):
+        """Pad to the worker multiple and stage the four batch arrays on
+        the mesh — the single home for sync-step argument staging."""
+        batch = self._sync_prepare(batch)
         return (self._put_batch(batch.features),
                 self._put_batch(batch.labels),
                 self._put_batch(batch.features_mask),
                 self._put_batch(batch.labels_mask))
+
+    def _make_feeder(self, iterator):
+        """Build the DeviceFeeder for this mode: per-replica shards are
+        placed on the mesh (``_put_batch``) while the current round
+        computes, and plain iterators get the AsyncDataSetIterator wrap —
+        the same overlap fit() has, honoring AsyncShield. Returns
+        (feeder, source); feeder is None when the iterator opted out."""
+        from deeplearning4j_tpu.datasets.feeder import DeviceFeeder
+        from deeplearning4j_tpu.datasets.iterators import (
+            AsyncDataSetIterator)
+        from deeplearning4j_tpu.observe.tracer import get_tracer
+        if not getattr(iterator, "async_supported", True):
+            return None, iterator
+        source = iterator
+        if (isinstance(iterator, DataSetIterator)
+                and not isinstance(iterator, AsyncDataSetIterator)):
+            source = AsyncDataSetIterator(iterator)
+        tracer = get_tracer(self.model)
+        if self.mode is TrainingMode.AVERAGING:
+            feeder = DeviceFeeder(
+                source, k_steps=self.averaging_frequency,
+                pad_ragged=False,
+                group_prepare=self._avg_group_prepare,
+                group_remainder="pad",
+                put=lambda a: self._put_batch(
+                    a, sharding=self._avg_batch_sh, batch_dim=1),
+                tracer=tracer, session_id="parallel")
+        else:
+            feeder = DeviceFeeder(source, prepare=self._sync_prepare,
+                                  pad_ragged=False, put=self._put_batch,
+                                  tracer=tracer, session_id="parallel")
+        return feeder, source
 
     def collective_census(self, batch: DataSet):
         """Compile the sync step for this batch's shapes and count its
@@ -531,30 +544,25 @@ class ParallelWrapper:
         if self._step is None:
             self._step, self._batch_sh = self._build_sync_step()
         m = self.model
+        feeder, source = self._make_feeder(iterator)
         for epoch in range(epochs):
             for lst in m.listeners:
                 lst.on_epoch_start(m, m.epoch_count)
-            t0 = time.perf_counter()
-            for batch in iterator:
-                etl_ms = (time.perf_counter() - t0) * 1000
-                n_real = batch.num_examples()
-                m._rng, key = jax.random.split(m._rng)
-                feats, labels, fmask, lmask = self._stage_batch(batch)
-                if m._telemetry is not None:
-                    m.train_state = m._telemetry.ensure_buffer(
-                        m.train_state)
-                m.train_state, loss = self._step(m.train_state, feats,
-                                                 labels, fmask, lmask, key)
-                # _post_step: host iteration mirror + telemetry flush
-                # opportunity + flight-recorder poll — no per-batch
-                # device sync (the old int(iteration) read was one)
-                it = m._post_step()
-                for lst in m.listeners:
-                    lst.iteration_done(m, it, m.epoch_count, loss, etl_ms,
-                                       n_real)
-                m._last_loss = loss
+            if feeder is not None:
+                for item in feeder:
+                    if item.k == 0:
+                        # foreign object the feeder passed through:
+                        # legacy staging (raises where it always did)
+                        self._fit_sync_one(item.raw, item.queue_wait_ms)
+                    else:
+                        self._dispatch_sync(item)
+            else:
                 t0 = time.perf_counter()
-            iterator.reset()
+                for batch in iterator:
+                    etl_ms = (time.perf_counter() - t0) * 1000
+                    self._fit_sync_one(batch, etl_ms)
+                    t0 = time.perf_counter()
+            source.reset()
             # an epoch's final batch is "final" — a legal uneven tail
             # must not trip the drift monitor on the next epoch
             self._pending_uneven_per = None
@@ -563,6 +571,42 @@ class ParallelWrapper:
             m.epoch_count += 1
         self._tail_flush()
         return m
+
+    def _fit_sync_one(self, batch, etl_ms: float):
+        """Legacy (unfed) sync-mode body: stage this batch now, then
+        dispatch — used when the feeder is shielded off, and for foreign
+        passthrough objects."""
+        m = self.model
+        n_real = batch.num_examples()
+        m._rng, key = jax.random.split(m._rng)
+        feats, labels, fmask, lmask = self._stage_batch(batch)
+        if m._telemetry is not None:
+            m.train_state = m._telemetry.ensure_buffer(m.train_state)
+        m.train_state, loss = self._step(m.train_state, feats, labels,
+                                         fmask, lmask, key)
+        # _post_step: host iteration mirror + telemetry flush
+        # opportunity + flight-recorder poll — no per-batch
+        # device sync (the old int(iteration) read was one)
+        it = m._post_step()
+        for lst in m.listeners:
+            lst.iteration_done(m, it, m.epoch_count, loss, etl_ms, n_real)
+        m._last_loss = loss
+
+    def _dispatch_sync(self, item):
+        """Fed sync-mode body: the feeder already padded and placed the
+        per-replica shards; only the dispatch remains on this thread."""
+        m = self.model
+        m._rng, key = jax.random.split(m._rng)
+        if m._telemetry is not None:
+            m.train_state = m._telemetry.ensure_buffer(m.train_state)
+        m.train_state, loss = self._step(
+            m.train_state, item.features, item.labels, item.features_mask,
+            item.labels_mask, key)
+        it = m._post_step()
+        for lst in m.listeners:
+            lst.iteration_done(m, it, m.epoch_count, loss,
+                               item.queue_wait_ms, item.n_examples)
+        m._last_loss = loss
 
     def _tail_flush(self):
         """Drain rows still on device when the fit ends (mirrors
@@ -583,21 +627,35 @@ class ParallelWrapper:
                                            P(None, DATA_AXIS))
         m = self.model
         k = self.averaging_frequency
+        feeder, source = self._make_feeder(iterator)
         for epoch in range(epochs):
             for lst in m.listeners:
                 lst.on_epoch_start(m, m.epoch_count)
-            pending = []
-            for batch in iterator:
-                pending.append(batch)
-                if len(pending) == k:
+            if feeder is not None:
+                # the feeder groups k batches per round (short tails
+                # repeat the last batch — the old pending loop's
+                # contract), runs _avg_group_prepare on the host thread,
+                # and places the stacked (k, B, ...) round shards before
+                # the previous round finishes
+                for item in feeder:
+                    if item.k == 0:
+                        raise TypeError(
+                            "ParallelWrapper AVERAGING consumes DataSet "
+                            f"batches, got {type(item.raw).__name__}")
+                    self._dispatch_averaging(item)
+            else:
+                pending = []
+                for batch in iterator:
+                    pending.append(batch)
+                    if len(pending) == k:
+                        self._run_averaging_round(pending)
+                        pending = []
+                if pending:
+                    # pad the round reusing batches (keeps shapes static)
+                    while len(pending) < k:
+                        pending.append(pending[-1])
                     self._run_averaging_round(pending)
-                    pending = []
-            if pending:
-                # pad the round by reusing batches (keeps shapes static)
-                while len(pending) < k:
-                    pending.append(pending[-1])
-                self._run_averaging_round(pending)
-            iterator.reset()
+            source.reset()
             self._pending_uneven_per = None     # legal uneven tail round
             for lst in m.listeners:
                 lst.on_epoch_end(m, m.epoch_count)
@@ -605,10 +663,12 @@ class ParallelWrapper:
         self._tail_flush()
         return m
 
-    def _run_averaging_round(self, batches):
-        m = self.model
-        m._rng, key = jax.random.split(m._rng)
-        n_real = sum(b.num_examples() for b in batches)
+    def _avg_group_prepare(self, batches):
+        """Host-side staging of one averaging round: equalize example
+        counts with masked padding, harmonize labels masks, stack to
+        (k, B, ...) host arrays. Shared by the legacy round path and the
+        DeviceFeeder ``group_prepare`` hook."""
+        from deeplearning4j_tpu.datasets.feeder import ones_labels_mask
         # equalize batch sizes (stacking needs it), padding w/ masked rows
         target = max(b.num_examples() for b in batches)
         batches = [self._pad_batch(b, target=target) for b in batches]
@@ -616,36 +676,52 @@ class ParallelWrapper:
             # same drift contract as _stage_batch: every mid-stream
             # round's per-host rows must match the checked value
             self._monitor_uneven_batch(batches[0].num_examples())
-
-        def ones_lmask(b: DataSet):
-            lab = np.asarray(b.labels)  # host-sync-ok: host-side batch staging for averaging round
-            if lab.ndim <= 2:
-                return np.ones((b.num_examples(),), np.float32)
-            if lab.ndim == 3 and b.features_mask is not None:
-                return np.asarray(b.features_mask, np.float32)  # host-sync-ok: host-side batch staging for averaging round
-            return np.ones(lab.shape[:-1], np.float32)
-
         # padding gave short batches a labels_mask; full-size batches must
         # then get an all-ones mask, or stack() would drop every mask and
         # train on the padded rows as real examples
         if any(b.labels_mask is not None for b in batches):
             batches = [b if b.labels_mask is not None else DataSet(
-                b.features, b.labels, b.features_mask, ones_lmask(b))
+                b.features, b.labels, b.features_mask, ones_labels_mask(b))
                 for b in batches]
 
         def stack(get):
             vals = [get(b) for b in batches]
             if any(v is None for v in vals):
                 return None
-            stacked = np.stack([np.asarray(v) for v in vals])  # host-sync-ok: host-side batch staging for averaging round
-            # multi-host: each process holds its slice of the (k, B)
-            # global batch along the batch dim (dim 1)
-            return self._put_batch(stacked, sharding=self._avg_batch_sh,
-                                   batch_dim=1)
-        feats = stack(lambda b: b.features)
-        labels = stack(lambda b: b.labels)
-        fmask = stack(lambda b: b.features_mask)
-        lmask = stack(lambda b: b.labels_mask)
+            return np.stack([np.asarray(v) for v in vals])  # host-sync-ok: host-side batch staging for averaging round
+
+        return (stack(lambda b: b.features), stack(lambda b: b.labels),
+                stack(lambda b: b.features_mask),
+                stack(lambda b: b.labels_mask))
+
+    def _dispatch_averaging(self, item):
+        """Fed averaging-round body: arrays arrive stacked and placed;
+        dispatch, then advance the host mirrors by the k local steps the
+        round ran."""
+        m = self.model
+        m._rng, key = jax.random.split(m._rng)
+        if m._telemetry is not None:
+            m.train_state = m._telemetry.ensure_buffer(m.train_state)
+        m.train_state, loss = self._step(
+            m.train_state, item.features, item.labels, item.features_mask,
+            item.labels_mask, key)
+        it = m._post_step(item.k)
+        for lst in m.listeners:
+            lst.iteration_done(m, it, m.epoch_count, loss,
+                               item.queue_wait_ms, item.n_examples)
+        m._last_loss = loss
+
+    def _run_averaging_round(self, batches):
+        m = self.model
+        m._rng, key = jax.random.split(m._rng)
+        n_real = sum(b.num_examples() for b in batches)
+        arrays = self._avg_group_prepare(batches)
+        # multi-host: each process holds its slice of the (k, B) global
+        # batch along the batch dim (dim 1)
+        feats, labels, fmask, lmask = (
+            None if a is None else self._put_batch(
+                a, sharding=self._avg_batch_sh, batch_dim=1)
+            for a in arrays)
         if m._telemetry is not None:
             m.train_state = m._telemetry.ensure_buffer(m.train_state)
         m.train_state, loss = self._step(m.train_state, feats, labels,

@@ -18,4 +18,8 @@ python tools/check_host_sync.py
 # CPU path — the observe/ "one fetch per flush interval" claim
 JAX_PLATFORMS=cpu python -m benchmarks.telemetry_overhead \
   --steps 150 --with-histograms --assert-overhead --tolerance 0.03
+# input-pipeline tier: the fed fit path must replay the unfed
+# trajectory bitwise and leave host_to_device span evidence
+# (correctness only — the timed fed-vs-unfed A/B is not CI-gated)
+JAX_PLATFORMS=cpu python -m benchmarks.input_pipeline --smoke
 exec python -m pytest tests/ -q "$@"

@@ -38,6 +38,9 @@ DEFAULT_PATHS = (
     "deeplearning4j_tpu/optimize/solver.py",
     "deeplearning4j_tpu/models",
     "deeplearning4j_tpu/parallel",
+    # the input-feeder hot path: a stray per-batch host sync here would
+    # serialize ETL back onto the step loop the feeder exists to unblock
+    "deeplearning4j_tpu/datasets",
 )
 
 PRAGMA = "# host-sync-ok"
