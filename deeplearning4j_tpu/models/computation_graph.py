@@ -513,6 +513,31 @@ class ComputationGraph(BaseModel):
         self._rnn_carries = None if carries is None else dict(carries)
 
     # ---- inference ------------------------------------------------------
+    def build_inference_fn(self):
+        """Pure inference forward ``(params, model_state, x, fmask) ->
+        y`` for single-input single-output graphs — the shape the
+        serving engine (parallel/serving.py) batches over. Multi-input /
+        multi-output graphs have no single batchable signature; serve
+        those through ``output()`` directly."""
+        if len(self.conf.network_inputs) != 1 or \
+                len(self.conf.network_outputs) != 1:
+            raise ValueError(
+                "build_inference_fn requires a single-input single-output"
+                f" graph; this one has inputs={self.conf.network_inputs}"
+                f" outputs={self.conf.network_outputs}")
+        if self.train_state is None:
+            self.init()
+        in_name = self.conf.network_inputs[0]
+        out_name = self.conf.network_outputs[0]
+
+        def fwd(params, model_state, x, fmask):
+            inputs = {in_name: x}
+            fm = {"__default__": fmask}
+            acts, _ = self._walk(params, model_state, inputs, fm, False,
+                                 None, stop_before_loss=False)
+            return acts[out_name]
+        return fwd
+
     def output(self, *features, train: bool = False, mask=None):
         """Forward pass; returns a single array for single-output graphs,
         else a list (reference: ComputationGraph.output(INDArray...)).

@@ -345,6 +345,33 @@ class MultiLayerNetwork(BaseModel):
         self._last_loss = loss
 
     # ---- inference ------------------------------------------------------
+    def build_inference_fn(self):
+        """The pure inference forward ``(params, model_state, x, fmask)
+        -> y`` behind ``output()``. The serving engine
+        (parallel/serving.py) compiles this against its OWN committed
+        (optionally bf16) parameter copies — one executable per batch
+        bucket — instead of going through ``output()``'s trace cache
+        keyed on ``self.train_state``."""
+        if self.train_state is None:
+            self.init()
+
+        def fwd(params, model_state, x, fmask):
+            n = len(self.layers)
+            h, _ = self._forward(params, model_state, x, fmask, False,
+                                 None, upto=n - 1)
+            out = self.layers[-1]
+            pp = self._preprocessors.get(n - 1)
+            if pp is not None:
+                h = pp.apply(h)
+            ctx = LayerContext(train=False, rng=None, mask=fmask)
+            y, _ = out.apply(params.get(out.name, {}),
+                             model_state.get(out.name, {}), h, ctx)
+            if hasattr(out, "pre_output") and hasattr(out, "activation"):
+                # OutputLayer.apply already applies activation
+                pass
+            return y
+        return fwd
+
     def output(self, features, train: bool = False, mask=None):
         """Inference forward pass (reference: output:2031 /
         output(INDArray, ..., featuresMask)). Jit-cached; the final output
@@ -353,22 +380,7 @@ class MultiLayerNetwork(BaseModel):
         if self.train_state is None:
             self.init()
         if self._output_fn is None:
-            def fwd(params, model_state, x, fmask):
-                n = len(self.layers)
-                h, _ = self._forward(params, model_state, x, fmask, False,
-                                     None, upto=n - 1)
-                out = self.layers[-1]
-                pp = self._preprocessors.get(n - 1)
-                if pp is not None:
-                    h = pp.apply(h)
-                ctx = LayerContext(train=False, rng=None, mask=fmask)
-                y, _ = out.apply(params.get(out.name, {}),
-                                 model_state.get(out.name, {}), h, ctx)
-                if hasattr(out, "pre_output") and hasattr(out, "activation"):
-                    # OutputLayer.apply already applies activation
-                    pass
-                return y
-            self._output_fn = jax.jit(fwd)
+            self._output_fn = jax.jit(self.build_inference_fn())
         return self._output_fn(self.train_state.params,
                                self.train_state.model_state,
                                jnp.asarray(features),

@@ -32,6 +32,7 @@ from deeplearning4j_tpu.observe.flight_recorder import (
     default_flight_recorder,
 )
 from deeplearning4j_tpu.observe.health import health_status
+from deeplearning4j_tpu.observe.latency import LatencyRing
 from deeplearning4j_tpu.observe.registry import (
     MetricsRegistry,
     default_registry,
@@ -53,6 +54,7 @@ __all__ = [
     "default_flight_recorder",
     "crash_dumps_enabled",
     "health_status",
+    "LatencyRing",
     "RecompileWatchdog",
     "HistRing",
     "ReplicaRing",

@@ -1,0 +1,61 @@
+"""Streaming latency quantiles for the serving path.
+
+A serving engine needs p50/p95/p99 over recent requests without keeping
+an unbounded history or adding per-request allocation. ``LatencyRing``
+is a fixed-capacity ring of the last N observations (seconds) with a
+lock cheap enough to take per request; ``quantiles()`` sorts a snapshot
+on demand (the scrape path, not the hot path). Nearest-rank quantiles —
+the convention Prometheus summaries use — so p99 of 100 samples is the
+99th ordered sample, not an interpolation.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Sequence, Tuple
+
+DEFAULT_QUANTILES: Tuple[float, ...] = (0.5, 0.95, 0.99)
+
+
+class LatencyRing:
+    """Last-``capacity`` latency observations, in seconds."""
+
+    def __init__(self, capacity: int = 4096):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = int(capacity)
+        self._buf = [0.0] * self.capacity
+        self._n = 0            # total ever recorded
+        self._lock = threading.Lock()
+
+    def record(self, seconds: float):
+        with self._lock:
+            self._buf[self._n % self.capacity] = float(seconds)
+            self._n += 1
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    def snapshot(self) -> list:
+        """The live window (unordered), at most ``capacity`` samples."""
+        with self._lock:
+            if self._n >= self.capacity:
+                return list(self._buf)
+            return self._buf[:self._n]
+
+    def quantiles(self, qs: Sequence[float] = DEFAULT_QUANTILES
+                  ) -> Dict[float, float]:
+        """Nearest-rank quantiles of the window; empty ring -> {}."""
+        window = self.snapshot()
+        if not window:
+            return {}
+        window.sort()
+        n = len(window)
+        out = {}
+        for q in qs:
+            if not 0.0 <= q <= 1.0:
+                raise ValueError(f"quantile out of range: {q}")
+            rank = min(n - 1, max(0, int(q * n + 0.5) - 1))
+            out[q] = window[rank]
+        return out

@@ -1,0 +1,13 @@
+"""Multi-device training and serving (mesh, wrappers, serving engine)."""
+
+from deeplearning4j_tpu.parallel.inference import (
+    InferenceMode,
+    ParallelInference,
+)
+from deeplearning4j_tpu.parallel.serving import ServingEngine
+
+__all__ = [
+    "InferenceMode",
+    "ParallelInference",
+    "ServingEngine",
+]

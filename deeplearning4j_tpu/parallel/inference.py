@@ -1,27 +1,29 @@
-"""ParallelInference: thread-safe serving with dynamic batching.
+"""ParallelInference: thread-safe serving facade.
 
 Analog of the reference's ParallelInference.java:35 (SURVEY §2.11):
 ``InferenceMode.BATCHED`` aggregates concurrent requests into one device
 batch (observable queue, ParallelInference.java:55-65), INPLACE runs the
 caller's request directly.
 
-TPU-first adjustments: the reference pins one model replica per GPU and
-round-robins requests; under XLA a single jitted forward already owns the
-chip, so "workers" collapse into one dispatcher. Batches are padded to
-power-of-two buckets so every request size reuses a cached executable
-instead of triggering recompiles.
+Since PR 5 the BATCHED path delegates to
+``parallel/serving.py``'s ServingEngine — pipelined dispatch, committed
+inference params, a bounded warmed bucket ladder, multi-replica fan-out
+and tail-latency telemetry — keeping this class as the drop-in facade
+matching the reference API. INPLACE remains a direct locked call but
+gains the same request validation (non-empty batch) and oversized-request
+clamp+split so it, too, never mints an unbounded executable per request
+size.
 """
 
 from __future__ import annotations
 
 import enum
-import queue
 import threading
-import time
-from concurrent.futures import Future
-from typing import List, Optional, Tuple
+from typing import Optional
 
 import numpy as np
+
+from deeplearning4j_tpu.parallel.serving import ServingEngine
 
 
 class InferenceMode(enum.Enum):
@@ -29,123 +31,68 @@ class InferenceMode(enum.Enum):
     BATCHED = "batched"   # reference default (ParallelInference.java:55)
 
 
+def _validate_request(x: np.ndarray) -> np.ndarray:
+    if x.ndim == 0 or x.shape[0] == 0:
+        raise ValueError(
+            "features must be a non-empty batch (got shape "
+            f"{x.shape}); a single example is shape (1, ...)")
+    return x
+
+
 class ParallelInference:
+    """Facade over ServingEngine (BATCHED) / the model itself (INPLACE).
+
+    Constructor keywords beyond the reference's four are forwarded to
+    ServingEngine (``replicas=``, ``feature_shape=``, ``bf16=``, ...).
+    """
+
     def __init__(self, model, inference_mode: InferenceMode =
                  InferenceMode.BATCHED, batch_limit: int = 32,
-                 queue_limit: int = 64, timeout_ms: float = 5.0):
+                 queue_limit: int = 64, timeout_ms: float = 5.0,
+                 **engine_kwargs):
         self.model = model
         self.mode = inference_mode
         self.batch_limit = batch_limit
         self.timeout_ms = timeout_ms
-        self._queue: "queue.Queue[Tuple[np.ndarray, Future]]" = \
-            queue.Queue(maxsize=queue_limit)
-        self._shutdown = threading.Event()
-        self._worker: Optional[threading.Thread] = None
         self._lock = threading.Lock()
+        self.engine: Optional[ServingEngine] = None
         if self.mode == InferenceMode.BATCHED:
-            self._worker = threading.Thread(target=self._run, daemon=True)
-            self._worker.start()
+            self.engine = ServingEngine(
+                model, batch_limit=batch_limit, queue_limit=queue_limit,
+                timeout_ms=timeout_ms, **engine_kwargs)
 
     # ---- public API ------------------------------------------------------
     def output(self, features) -> np.ndarray:
         """Blocking inference (reference: ParallelInference.output:113)."""
-        x = np.asarray(features)  # host-sync-ok: inference host staging
-        if x.ndim == 0:
-            raise ValueError("features must have a batch dimension; got a"
-                             " 0-d array")
-        if self.mode == InferenceMode.INPLACE:
-            with self._lock:
-                return np.asarray(self.model.output(x))  # host-sync-ok: inference result returned as host array
-        f: Future = Future()
-        while True:
-            if self._shutdown.is_set():
-                raise RuntimeError("ParallelInference is shut down")
-            try:
-                # bounded wait so a full queue + dead worker can't block
-                # the caller forever
-                self._queue.put((x, f), timeout=0.1)
-                break
-            except queue.Full:
-                continue
-        if self._shutdown.is_set():
-            # raced with shutdown(): the worker/drain may already be done
-            # and will never pop this item — fail it ourselves
-            self._drain()
-        return f.result()
+        if self.mode == InferenceMode.BATCHED:
+            return self.engine.output(features)
+        x = _validate_request(np.asarray(features))  # host-sync-ok: inference host staging
+        with self._lock:
+            return self._output_inplace(x)
+
+    def _output_inplace(self, x: np.ndarray) -> np.ndarray:
+        """Direct call, but clamped to the pow2 ladder <= batch_limit:
+        oversized requests split across dispatches instead of padding
+        past the limit into a fresh executable per size."""
+        outs = []
+        for ofs in range(0, x.shape[0], self.batch_limit):
+            chunk = x[ofs:ofs + self.batch_limit]
+            n = chunk.shape[0]
+            bucket = min(1 << (n - 1).bit_length(), self.batch_limit)
+            if bucket > n:
+                pad = np.repeat(chunk[-1:], bucket - n, axis=0)
+                chunk = np.concatenate([chunk, pad], axis=0)
+            outs.append(np.asarray(self.model.output(chunk))[:n])  # host-sync-ok: inference result returned as host array
+        if len(outs) == 1:
+            return outs[0]
+        return np.concatenate(outs, axis=0)
 
     def shutdown(self):
-        self._shutdown.set()
-        if self._worker is not None:
-            self._worker.join(timeout=5)
-        self._drain()
-
-    def _drain(self):
-        """Fail any still-queued request (post-shutdown)."""
-        while True:
-            try:
-                _x, f = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            if not f.done():
-                f.set_exception(
-                    RuntimeError("ParallelInference shut down"))
+        if self.engine is not None:
+            self.engine.shutdown()
 
     def __enter__(self):
         return self
 
     def __exit__(self, *exc):
         self.shutdown()
-
-    # ---- batching worker -------------------------------------------------
-    def _run(self):
-        while not self._shutdown.is_set():
-            try:
-                first = self._queue.get(timeout=0.1)
-            except queue.Empty:
-                continue
-            batch: List[Tuple[np.ndarray, Future]] = [first]
-            try:
-                total = first[0].shape[0]
-                # one absolute aggregation deadline per batch; later
-                # arrivals don't extend the first caller's latency window
-                deadline = time.monotonic() + self.timeout_ms / 1000.0
-                while total < self.batch_limit:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        break
-                    try:
-                        item = self._queue.get(timeout=remaining)
-                    except queue.Empty:
-                        break
-                    batch.append(item)
-                    total += item[0].shape[0]
-            except Exception as e:
-                # a malformed request must fail its future, not kill the
-                # worker thread (waiters would then hang forever)
-                for _x, f in batch:
-                    if not f.done():
-                        f.set_exception(e)
-                continue
-            self._process(batch)
-
-    def _process(self, batch):
-        arrays = [x for x, _f in batch]
-        futures = [f for _x, f in batch]
-        try:
-            x = np.concatenate(arrays, axis=0)
-            n = x.shape[0]
-            # pad to a power-of-two bucket: one cached executable per
-            # bucket, never a recompile per request size
-            bucket = 1 << (n - 1).bit_length()
-            if bucket != n:
-                pad = np.repeat(x[-1:], bucket - n, axis=0)
-                x = np.concatenate([x, pad], axis=0)
-            out = np.asarray(self.model.output(x))[:n]  # host-sync-ok: inference result returned as host array
-            ofs = 0
-            for arr, f in zip(arrays, futures):
-                f.set_result(out[ofs:ofs + arr.shape[0]])
-                ofs += arr.shape[0]
-        except Exception as e:   # propagate to every waiter
-            for f in futures:
-                if not f.done():
-                    f.set_exception(e)

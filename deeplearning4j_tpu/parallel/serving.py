@@ -1,0 +1,686 @@
+"""ServingEngine: pipelined batched inference with warmed bucket
+executables and multi-replica fan-out.
+
+The seed dispatcher (parallel/inference.py pre-PR5) host-synced on
+the model output fetch inside its batching loop, so the queue
+drained at device-roundtrip latency, every bucket paid first-request
+compile cost, and a request larger than ``batch_limit`` minted an
+unbounded set of pow2 executables. This engine replaces it with five
+coordinated pieces:
+
+1. **Pipelined dispatch.** The dispatcher thread issues the compiled
+   forward and hands the still-on-device result (plus its waiters) to a
+   completion thread over a bounded pipe; JAX async dispatch means batch
+   N+1 is being formed and issued while batch N computes and its
+   device→host fetch completes — the same double-buffer discipline as
+   ``datasets/feeder.py``. The pipe's bound doubles as the aggregation
+   policy: while the device is busy (pipe full) the dispatcher keeps
+   coalescing arrivals up to ``timeout_ms``; the moment a slot frees it
+   dispatches what it has. The seed's fixed aggregation window — which
+   idled the device for the full ``timeout_ms`` whenever offered load
+   sat below ``batch_limit`` — survives only as the upper bound.
+2. **Committed inference params.** Parameters and model state are
+   ``device_put`` once at engine start (optionally cast to bf16), per
+   replica and — for the sharded path — replicated over the mesh. No
+   per-call reliance on the global trace cache keyed off
+   ``model.train_state``: the engine owns an explicit per-bucket
+   executable table (AOT ``jit.lower(...).compile()``, falling back to
+   the jitted call where AOT is unavailable).
+3. **Bounded bucket ladder + request splitting.** Batches pad to the
+   smallest power-of-two bucket in ``[min_bucket, batch_limit]``;
+   oversized requests are split across dispatches at ``output()`` and
+   reassembled, so the executable table is bounded by the ladder no
+   matter what arrives. A warmup sweep over the ladder at start means
+   no live request ever pays a compile (``recompiles_after_warmup``
+   asserts it; the RecompileWatchdog sees every dispatch signature).
+4. **Multi-replica fan-out.** With R > 1 visible devices, full
+   ``batch_limit`` buckets shard data-parallel across the mesh
+   (parallel/mesh.py); partial buckets round-robin whole replicas.
+   Per-replica dispatch and busy-time counters feed utilization gauges.
+5. **Tail-latency observability.** Per-request ``queue_wait`` and
+   per-batch ``batch_form``/``dispatch``/``device``/``fetch`` spans ride
+   the SpanTracer; streaming p50/p95/p99 (observe/latency.py), in-flight
+   depth, queue depth, batch occupancy and ``dl4j_serving_*`` series
+   publish to the Prometheus registry scraped at ``/metrics``.
+
+The reference analog is ParallelInference.java:35 (SURVEY §2.11) — its
+model-per-GPU workers become replicas here; ``parallel/inference.py``
+keeps the ParallelInference facade on top of this engine.
+
+Numerical contract: a request's rows are computed at the bucket shape
+and sliced back, so padded and split requests are bitwise-equal to the
+direct ``model.output`` call. A request CO-BATCHED with other callers
+runs at whatever bucket the batch lands in; on backends whose matmul
+kernel selection depends on the batch dimension (CPU gemv vs gemm)
+that can shift results by ~1 ulp vs the exact-size direct call.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple, Union
+
+import numpy as np
+
+from deeplearning4j_tpu.observe.latency import LatencyRing
+from deeplearning4j_tpu.observe.recompile import RecompileWatchdog
+from deeplearning4j_tpu.observe.registry import default_registry
+from deeplearning4j_tpu.observe.tracer import NULL_TRACER
+
+MESH = "mesh"            # dispatch-target key for the sharded full bucket
+
+
+class _Request(NamedTuple):
+    """One enqueued chunk: host features, its waiter, arrival time."""
+    x: np.ndarray
+    future: Future
+    t_enqueue: float
+
+
+class _InFlight(NamedTuple):
+    """A dispatched batch travelling dispatcher -> completion thread."""
+    out: Any                 # device-resident result (un-fetched)
+    requests: List[_Request]
+    n_real: int
+    bucket: int
+    where: Union[int, str]
+    t_dispatched: float
+
+
+class ServingEngine:
+    """Thread-safe batched inference over one model's committed params.
+
+    Parameters
+    ----------
+    model : MultiLayerNetwork / single-io ComputationGraph (must expose
+        ``build_inference_fn``)
+    batch_limit : max examples per dispatch; also the ladder's top bucket
+    queue_limit : bound on queued request chunks (producers block)
+    timeout_ms : UPPER bound on batch aggregation; the pipelined engine
+        only waits at all while the completion pipe is full
+    depth : in-flight batches handed to the completion thread (the
+        double-buffer depth; 1 = aggregate exactly while device is busy)
+    pipelined : False reproduces the seed's blocking dispatcher (fixed
+        aggregation window + inline fetch) — kept for the benchmark A/B
+    replicas : device count to serve on; "auto" = all visible devices
+    feature_shape : per-example feature shape (no batch dim); providing
+        it (with ``dtype``) enables the warmup sweep at start
+    dtype : feature dtype requests are cast to (default float32)
+    bf16 : cast committed float params to bfloat16 (inference-only copy;
+        the model's train_state is untouched)
+    warmup : compile the whole bucket ladder at start (default: True
+        when ``feature_shape`` is known)
+    """
+
+    def __init__(self, model, *, batch_limit: int = 32,
+                 queue_limit: int = 128, timeout_ms: float = 5.0,
+                 depth: int = 1, pipelined: bool = True,
+                 replicas: Union[int, str] = 1,
+                 min_bucket: int = 1,
+                 feature_shape: Optional[Tuple[int, ...]] = None,
+                 dtype: Any = np.float32, bf16: bool = False,
+                 warmup: Optional[bool] = None,
+                 tracer=None, registry=None, watchdog=None,
+                 session_id: str = "serve"):
+        import jax
+        if batch_limit < 1:
+            raise ValueError("batch_limit must be >= 1")
+        if not 1 <= min_bucket <= batch_limit:
+            raise ValueError("need 1 <= min_bucket <= batch_limit")
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
+        self.model = model
+        self.batch_limit = int(batch_limit)
+        self.timeout_ms = float(timeout_ms)  # host-sync-ok: Python config scalar, not a device value
+        self.depth = int(depth)
+        self.pipelined = bool(pipelined)
+        self.session_id = session_id
+        self.dtype = np.dtype(dtype)
+        self.feature_shape = (None if feature_shape is None
+                              else tuple(feature_shape))
+        self.bf16 = bool(bf16)
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self.watchdog = watchdog if watchdog is not None else \
+            RecompileWatchdog(self.registry, session_id=session_id)
+        self.latency = LatencyRing()
+
+        devs = jax.devices()
+        n = len(devs) if replicas == "auto" else int(replicas)
+        if not 1 <= n <= len(devs):
+            raise ValueError(f"replicas={replicas!r} but {len(devs)} "
+                             "devices are visible")
+        self.devices = devs[:n]
+        self.n_replicas = n
+
+        # bounded pow2 ladder: min_bucket..batch_limit (limit included
+        # even when it is not itself a power of two)
+        ladder, b = [], 1 << (min_bucket - 1).bit_length()
+        while b < self.batch_limit:
+            ladder.append(b)
+            b <<= 1
+        ladder.append(self.batch_limit)
+        self.ladder = ladder
+
+        # ---- metrics -----------------------------------------------------
+        reg = self.registry
+        self._c_requests = reg.counter(
+            "dl4j_serving_requests_total",
+            "inference requests accepted by the serving engine")
+        self._c_batches = reg.counter(
+            "dl4j_serving_batches_total",
+            "device batches dispatched by the serving engine")
+        self._c_compiles = reg.counter(
+            "dl4j_serving_compiles_total",
+            "bucket executables compiled, by phase (warmup|live); a "
+            "nonzero live count means a request paid a compile")
+        self._g_inflight = reg.gauge(
+            "dl4j_serving_inflight",
+            "requests accepted but not yet answered")
+        self._g_queue = reg.gauge(
+            "dl4j_serving_queue_depth",
+            "request chunks waiting for the dispatcher")
+        self._g_occupancy = reg.gauge(
+            "dl4j_serving_batch_occupancy",
+            "real examples / bucket size of the last dispatched batch")
+        self._g_latency = reg.gauge(
+            "dl4j_serving_latency_ms",
+            "streaming request latency quantiles over the last 4096 "
+            "requests")
+        self._c_replica_disp = reg.counter(
+            "dl4j_serving_replica_dispatches_total",
+            "batches dispatched per replica ('mesh' = sharded full "
+            "buckets across all replicas)")
+        self._c_replica_busy = reg.counter(
+            "dl4j_serving_replica_busy_ms",
+            "cumulative ms a replica spent computing dispatched batches")
+        self._c_requests.inc(0.0, session=session_id)
+        self._c_batches.inc(0.0, session=session_id)
+        self._c_compiles.inc(0.0, session=session_id, phase="live")
+        self._g_inflight.set(0.0, session=session_id)
+
+        # ---- committed inference params ----------------------------------
+        # Duck-typed models exposing only .output() (pre-engine callers,
+        # test doubles) skip the committed-params/AOT machinery and run
+        # the legacy direct call under the same batching discipline.
+        self._committed: Dict[Union[int, str], Any] = {}
+        self._batch_sharding = None
+        self._jit = None
+        if hasattr(model, "build_inference_fn"):
+            if model.train_state is None:
+                model.init()
+            params = model.train_state.params
+            mstate = model.train_state.model_state
+            if self.bf16:
+                import jax.numpy as jnp
+                params = jax.tree_util.tree_map(
+                    lambda a: a.astype(jnp.bfloat16)
+                    if jnp.issubdtype(a.dtype, jnp.floating) else a,
+                    params)
+            fwd = model.build_inference_fn()
+            self._jit = jax.jit(lambda p, s, x: fwd(p, s, x, None))
+            # one committed (params, model_state) copy per replica; plus
+            # a mesh-replicated copy backing the sharded full-bucket path
+            for r, dev in enumerate(self.devices):
+                self._committed[r] = jax.device_put((params, mstate),
+                                                    dev)
+            if self.n_replicas > 1:
+                from deeplearning4j_tpu.parallel.mesh import (
+                    DATA_AXIS, batch_sharding, create_mesh, replicated)
+                mesh = create_mesh({DATA_AXIS: self.n_replicas},
+                                   self.devices)
+                self._committed[MESH] = jax.device_put(
+                    (params, mstate), replicated(mesh))
+                self._batch_sharding = batch_sharding(mesh)
+        elif self.n_replicas > 1 or self.bf16:
+            raise ValueError(
+                "replicas > 1 / bf16 need a model exposing "
+                "build_inference_fn (committed per-replica params); "
+                f"{type(model).__name__} only has .output")
+
+        # ---- dispatch machinery ------------------------------------------
+        self._exe: Dict[Tuple[int, Union[int, str]], Any] = {}
+        self._exe_lock = threading.Lock()
+        self._warmed = False
+        self._post_warmup_compiles = 0
+        self._rr = 0                       # round-robin replica cursor
+        self._inflight_count = 0
+        self._count_lock = threading.Lock()
+        self._queue: "queue.Queue[_Request]" = queue.Queue(
+            maxsize=queue_limit)
+        self._carry: Optional[_Request] = None   # aggregation overflow
+        self._completions: "queue.Queue[Optional[_InFlight]]" = \
+            queue.Queue(maxsize=self.depth)
+        self._shutdown = threading.Event()
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True,
+            name=f"serving-dispatch-{session_id}")
+        self._completer: Optional[threading.Thread] = None
+        if self.pipelined:
+            self._completer = threading.Thread(
+                target=self._complete_loop, daemon=True,
+                name=f"serving-complete-{session_id}")
+
+        do_warmup = (self.feature_shape is not None if warmup is None
+                     else bool(warmup))
+        if do_warmup:
+            if self.feature_shape is None:
+                raise ValueError("warmup needs feature_shape (and dtype)")
+            self._warmup_sweep()
+        self._warmed = True
+        self._dispatcher.start()
+        if self._completer is not None:
+            self._completer.start()
+
+    # ---- bucket ladder ---------------------------------------------------
+    def bucket_of(self, n: int) -> int:
+        """Smallest ladder bucket >= n (n must be <= batch_limit)."""
+        for b in self.ladder:
+            if b >= n:
+                return b
+        raise ValueError(f"batch of {n} exceeds batch_limit "
+                         f"{self.batch_limit}")
+
+    def _target_for(self, bucket: int) -> Union[int, str]:
+        """Full buckets shard across the mesh; everything else
+        round-robins whole replicas."""
+        if (bucket == self.batch_limit and self.n_replicas > 1
+                and bucket % self.n_replicas == 0):
+            return MESH
+        t = self._rr % self.n_replicas
+        self._rr += 1
+        return t
+
+    # ---- executables -----------------------------------------------------
+    def _place(self, x: np.ndarray, where: Union[int, str]):
+        import jax
+        if where == MESH:
+            return jax.device_put(x, self._batch_sharding)
+        return jax.device_put(x, self.devices[where])
+
+    def _get_exe(self, bucket: int, where: Union[int, str]):
+        key = (bucket, where)
+        exe = self._exe.get(key)
+        if exe is not None:
+            return exe
+        with self._exe_lock:
+            exe = self._exe.get(key)
+            if exe is not None:
+                return exe
+            params, mstate = self._committed[where]
+            x = self._place(np.zeros((bucket,) + self.feature_shape,
+                                     self.dtype), where)
+            try:
+                exe = self._jit.lower(params, mstate, x).compile()
+            except Exception:
+                # AOT unavailable (older jax / exotic shardings): the
+                # jitted call still caches one executable per signature
+                exe = self._jit
+            self._exe[key] = exe
+            phase = "warmup" if not self._warmed else "live"
+            if self._warmed:
+                self._post_warmup_compiles += 1
+            self._c_compiles.inc(1.0, session=self.session_id,
+                                 phase=phase)
+            self.tracer.instant("serve_compile", cat="serve",
+                                bucket=bucket, where=str(where),
+                                phase=phase)
+            return exe
+
+    def _warmup_sweep(self):
+        """Compile the whole ladder for every dispatch target the live
+        traffic can hit, so no request ever pays a compile."""
+        t0 = time.perf_counter()
+        for bucket in self.ladder:
+            targets: List[Union[int, str]]
+            if (bucket == self.batch_limit and self.n_replicas > 1
+                    and bucket % self.n_replicas == 0):
+                targets = [MESH]
+            else:
+                targets = list(range(self.n_replicas))
+            for where in targets:
+                x = np.zeros((bucket,) + self.feature_shape, self.dtype)
+                out = self._run(x, bucket, where)
+                # block so compile cost lands here, not on a request
+                if hasattr(out, "block_until_ready"):
+                    out.block_until_ready()  # host-sync-ok: warmup sweep is pre-traffic by design
+        self.tracer.add_span("serve_warmup", t0, time.perf_counter(),
+                             cat="serve", buckets=len(self.ladder),
+                             replicas=self.n_replicas)
+
+    def _run(self, x: np.ndarray, bucket: int, where: Union[int, str]):
+        """Issue the compiled forward for one padded batch; returns the
+        device-resident (un-fetched) result."""
+        if x.dtype != self.dtype:
+            x = x.astype(self.dtype)
+        self.watchdog.observe(f"serve_fwd_b{bucket}", x)
+        if self._jit is None:        # legacy duck-typed model
+            return self.model.output(x)
+        exe = self._get_exe(bucket, where)
+        params, mstate = self._committed[where]
+        return exe(params, mstate, self._place(x, where))
+
+    # ---- public API ------------------------------------------------------
+    def submit(self, features) -> Future:
+        """Enqueue a request; the Future resolves to the (N, ...) host
+        output. Oversized requests split across dispatches and
+        reassemble transparently."""
+        x = np.asarray(features)  # host-sync-ok: serving ingress stages request features on host
+        if x.ndim == 0 or x.shape[0] == 0:
+            raise ValueError(
+                "features must be a non-empty batch (got shape "
+                f"{x.shape}); a single example is shape (1, ...)")
+        if self.feature_shape is None:
+            # first request fixes the wire contract
+            self.feature_shape = x.shape[1:]
+            if self.dtype is None:
+                self.dtype = x.dtype
+        elif x.shape[1:] != self.feature_shape:
+            raise ValueError(
+                f"request feature shape {x.shape[1:]} does not match "
+                f"the engine's {self.feature_shape}")
+        if x.dtype != self.dtype:
+            x = x.astype(self.dtype)
+        if self._shutdown.is_set():
+            raise RuntimeError("ServingEngine is shut down")
+        chunks = [x[i:i + self.batch_limit]
+                  for i in range(0, x.shape[0], self.batch_limit)]
+        self._c_requests.inc(1.0, session=self.session_id)
+        with self._count_lock:
+            self._inflight_count += 1
+            self._g_inflight.set(self._inflight_count,
+                                 session=self.session_id)
+        futures = [self._enqueue(c) for c in chunks]
+        if len(futures) == 1:
+            self._track(futures[0])
+            return futures[0]
+        return self._join_futures(futures)
+
+    def output(self, features) -> np.ndarray:
+        """Blocking inference (reference: ParallelInference.output:113)."""
+        return self.submit(features).result()
+
+    def _enqueue(self, chunk: np.ndarray) -> Future:
+        f: Future = Future()
+        req = _Request(chunk, f, time.perf_counter())
+        while True:
+            if self._shutdown.is_set():
+                raise RuntimeError("ServingEngine is shut down")
+            try:
+                # bounded wait so a full queue + dead worker can't block
+                # the caller forever
+                self._queue.put(req, timeout=0.1)
+                break
+            except queue.Full:
+                continue
+        self._g_queue.set(self._queue.qsize(), session=self.session_id)
+        if self._shutdown.is_set():
+            # raced with shutdown(): the dispatcher may never pop this
+            self._drain_queue()
+        return f
+
+    def _track(self, f: Future):
+        def done(_):
+            with self._count_lock:
+                self._inflight_count -= 1
+                self._g_inflight.set(self._inflight_count,
+                                     session=self.session_id)
+        f.add_done_callback(done)
+
+    def _join_futures(self, parts: List[Future]) -> Future:
+        """One Future over a split request: concatenated result in chunk
+        order, or the first chunk failure."""
+        outer: Future = Future()
+        self._track(outer)
+        remaining = [len(parts)]
+        lock = threading.Lock()
+
+        def on_done(_f):
+            with lock:
+                remaining[0] -= 1
+                last = remaining[0] == 0
+            if not last or outer.done():
+                return
+            try:
+                outer.set_result(
+                    np.concatenate([p.result() for p in parts], axis=0))
+            except Exception as e:
+                outer.set_exception(e)
+        for p in parts:
+            p.add_done_callback(on_done)
+        return outer
+
+    def stats(self) -> Dict[str, Any]:
+        """Point-in-time snapshot for the CLI / UI module."""
+        q = self.latency.quantiles()
+        return {
+            "session": self.session_id,
+            "replicas": self.n_replicas,
+            "ladder": list(self.ladder),
+            "pipelined": self.pipelined,
+            "requests": self.latency.count,
+            "inflight": self._inflight_count,
+            "queue_depth": self._queue.qsize(),
+            "recompiles_after_warmup": self._post_warmup_compiles,
+            "latency_ms": {f"p{int(k * 100)}": v * 1e3
+                           for k, v in q.items()},
+        }
+
+    @property
+    def recompiles_after_warmup(self) -> int:
+        return self._post_warmup_compiles
+
+    def assert_warm(self):
+        """Raise when any live request paid a compile after the warmup
+        sweep — the zero-recompile serving contract."""
+        if self._post_warmup_compiles:
+            raise AssertionError(
+                f"{self._post_warmup_compiles} bucket executables were "
+                "compiled by live traffic after warmup; widen the warmup"
+                " sweep (feature_shape/min_bucket/batch_limit)")
+        if self.watchdog.count() > 0:
+            raise AssertionError(
+                "RecompileWatchdog saw new dispatch signatures after "
+                f"first compile: {self.watchdog.events}")
+
+    # ---- dispatcher ------------------------------------------------------
+    def _form_batch(self) -> Optional[List[_Request]]:
+        if self._carry is not None:
+            first, self._carry = self._carry, None
+        else:
+            try:
+                first = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                return None
+        batch = [first]
+        total = first.x.shape[0]
+        deadline = time.monotonic() + self.timeout_ms / 1000.0
+        while total < self.batch_limit:
+            if self.pipelined:
+                # backpressure aggregation: only wait for stragglers
+                # while the completion pipe is full (device busy) —
+                # never idle a free device on the timer
+                try:
+                    item = self._queue.get_nowait()
+                except queue.Empty:
+                    rem = deadline - time.monotonic()
+                    if rem <= 0 or not self._completions.full():
+                        break
+                    try:
+                        item = self._queue.get(timeout=min(rem, 0.001))
+                    except queue.Empty:
+                        continue
+            else:
+                # the seed's fixed window: one absolute aggregation
+                # deadline per batch
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    break
+                try:
+                    item = self._queue.get(timeout=rem)
+                except queue.Empty:
+                    break
+            if total + item.x.shape[0] > self.batch_limit:
+                # doesn't fit: hold it for the next batch (the seed
+                # padded past the limit instead — minting an executable
+                # per overflow size)
+                self._carry = item
+                break
+            batch.append(item)
+            total += item.x.shape[0]
+        return batch
+
+    def _dispatch_loop(self):
+        while not self._shutdown.is_set():
+            t_form0 = time.perf_counter()
+            batch = self._form_batch()
+            if not batch:
+                continue
+            self._g_queue.set(self._queue.qsize(),
+                              session=self.session_id)
+            try:
+                inflight = self._dispatch(batch, t_form0)
+            except Exception as e:
+                # a malformed batch must fail its waiters, not kill the
+                # dispatcher (they would hang forever)
+                for req in batch:
+                    if not req.future.done():
+                        req.future.set_exception(e)
+                continue
+            if not self.pipelined:
+                self._complete(inflight)
+                continue
+            while True:
+                try:
+                    self._completions.put(inflight, timeout=0.1)
+                    break
+                except queue.Full:
+                    if (self._completer is None
+                            or not self._completer.is_alive()):
+                        err = RuntimeError(
+                            "serving completion thread died")
+                        for req in inflight.requests:
+                            if not req.future.done():
+                                req.future.set_exception(err)
+                        break
+
+    def _dispatch(self, batch: List[_Request],
+                  t_form0: float) -> _InFlight:
+        tracer = self.tracer
+        n = sum(req.x.shape[0] for req in batch)
+        bucket = self.bucket_of(n)
+        # write requests straight into one bucket-sized staging buffer
+        # (a fresh one per dispatch: the CPU backend zero-copy adopts
+        # numpy buffers, so reuse would corrupt in-flight batches)
+        x = np.empty((bucket,) + batch[0].x.shape[1:], self.dtype)
+        ofs = 0
+        for req in batch:
+            k = req.x.shape[0]
+            x[ofs:ofs + k] = req.x
+            ofs += k
+        if bucket > n:
+            # duplicate the last row (finite activations) — padded rows
+            # are sliced off before waiters see the result
+            x[n:] = x[n - 1]
+        t_formed = time.perf_counter()
+        for req in batch:
+            tracer.add_span("queue_wait", req.t_enqueue, t_form0,
+                            cat="serve")
+        tracer.add_span("batch_form", t_form0, t_formed, cat="serve",
+                        n=n, bucket=bucket)
+        where = self._target_for(bucket)
+        out = self._run(x, bucket, where)
+        t_dispatched = time.perf_counter()
+        tracer.add_span("dispatch", t_formed, t_dispatched, cat="serve",
+                        where=str(where))
+        self._c_batches.inc(1.0, session=self.session_id)
+        self._c_replica_disp.inc(1.0, session=self.session_id,
+                                 replica=str(where))
+        self._g_occupancy.set(n / bucket, session=self.session_id)
+        return _InFlight(out, batch, n, bucket, where, t_dispatched)
+
+    # ---- completion ------------------------------------------------------
+    def _complete_loop(self):
+        while True:
+            item = self._completions.get()
+            if item is None:
+                return
+            self._complete(item)
+
+    def _complete(self, inflight: _InFlight):
+        tracer = self.tracer
+        try:
+            if hasattr(inflight.out, "block_until_ready"):
+                inflight.out.block_until_ready()  # host-sync-ok: completion thread absorbs the device wait off the dispatch path
+            t_ready = time.perf_counter()
+            host = np.asarray(inflight.out)  # host-sync-ok: completion-thread fetch is the one place results come to host
+            t_fetched = time.perf_counter()
+            tracer.add_span("device", inflight.t_dispatched, t_ready,
+                            cat="serve", where=str(inflight.where))
+            tracer.add_span("fetch", t_ready, t_fetched, cat="serve",
+                            bytes=host.nbytes)
+            self._c_replica_busy.inc(
+                (t_ready - inflight.t_dispatched) * 1e3,
+                session=self.session_id, replica=str(inflight.where))
+            ofs = 0
+            now = time.perf_counter()
+            for req in inflight.requests:
+                k = req.x.shape[0]
+                if not req.future.done():
+                    req.future.set_result(host[ofs:ofs + k])
+                ofs += k
+                self.latency.record(now - req.t_enqueue)
+            self._publish_latency()
+        except Exception as e:    # propagate to every waiter
+            for req in inflight.requests:
+                if not req.future.done():
+                    req.future.set_exception(e)
+
+    def _publish_latency(self):
+        q = self.latency.quantiles()
+        for qq, v in q.items():
+            self._g_latency.set(v * 1e3, session=self.session_id,
+                                quantile=f"p{int(qq * 100)}")
+
+    # ---- lifecycle -------------------------------------------------------
+    def shutdown(self):
+        if self._shutdown.is_set():
+            return
+        self._shutdown.set()
+        self._dispatcher.join(timeout=5)
+        if self._completer is not None:
+            # sentinel after the dispatcher stops feeding; the completer
+            # drains in-flight batches first (their results are valid)
+            while self._completer.is_alive():
+                try:
+                    self._completions.put(None, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+            self._completer.join(timeout=5)
+        self._drain_queue()
+
+    def _drain_queue(self):
+        """Fail any still-queued request (post-shutdown)."""
+        carried, self._carry = self._carry, None
+        if carried is not None and not carried.future.done():
+            carried.future.set_exception(
+                RuntimeError("ServingEngine shut down"))
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if not req.future.done():
+                req.future.set_exception(
+                    RuntimeError("ServingEngine shut down"))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()

@@ -1,0 +1,49 @@
+"""ServingModule: UI routes for a live ServingEngine.
+
+Plugs the serving engine (parallel/serving.py) into the dashboard via
+the UIModule SPI — the same extension point custom reference modules
+use (UIModule.java). Two routes:
+
+- ``POST /api/predict``   {"features": [[...], ...]} -> {"output": ...}
+  A convenience ingress for smoke tests and the CLI demo; production
+  traffic should call ``ServingEngine.submit`` in-process. Requests ride
+  the exact same queue/batching path, so a curl during a load test lands
+  in the same buckets as everything else.
+- ``GET /api/serving/stats``  engine snapshot: streaming p50/p95/p99,
+  in-flight depth, queue depth, ladder, recompiles-after-warmup.
+
+The Prometheus series the engine publishes (``dl4j_serving_*``) are
+scraped from the server's existing ``/metrics``; this module only adds
+the JSON/ingress surface.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from deeplearning4j_tpu.ui.modules import Route, UIModule
+
+
+class ServingModule(UIModule):
+    def __init__(self, engine):
+        self.engine = engine
+
+    def get_routes(self) -> List[Route]:
+        return [
+            Route("POST", "/api/predict", self._predict),
+            Route("GET", "/api/serving/stats", self._stats),
+        ]
+
+    def _predict(self, ctx, query, body):
+        if not isinstance(body, dict) or "features" not in body:
+            raise ValueError('expected {"features": [[...], ...]}')
+        x = np.asarray(body["features"],  # host-sync-ok: decoding the JSON request body, already host data
+                       dtype=self.engine.dtype)
+        out = self.engine.output(x)
+        return {"output": np.asarray(out).tolist(),  # host-sync-ok: HTTP response must be host JSON
+                "n": int(x.shape[0])}
+
+    def _stats(self, ctx, query, body):
+        return self.engine.stats()

@@ -22,4 +22,8 @@ JAX_PLATFORMS=cpu python -m benchmarks.telemetry_overhead \
 # trajectory bitwise and leave host_to_device span evidence
 # (correctness only — the timed fed-vs-unfed A/B is not CI-gated)
 JAX_PLATFORMS=cpu python -m benchmarks.input_pipeline --smoke
+# serving tier: engine outputs bitwise-equal to direct model.output,
+# zero recompiles after the warmup sweep (watchdog-asserted), and
+# pipelined dispatch >=1.3x the blocking dispatcher closed-loop
+JAX_PLATFORMS=cpu python -m benchmarks.serving --smoke
 exec python -m pytest tests/ -q "$@"

@@ -1,0 +1,344 @@
+"""ServingEngine / ParallelInference facade / serve CLI tests (PR 5).
+
+Covers the serving concurrency contract: concurrent requests come back
+bitwise-equal to direct ``model.output``, warmup means zero live
+compiles, shutdown mid-flight fails waiters instead of hanging them,
+malformed requests fail only their caller, and the multi-replica path
+holds all of it under the 8-device CPU mesh.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.observe.latency import LatencyRing
+from deeplearning4j_tpu.observe.registry import MetricsRegistry
+from deeplearning4j_tpu.parallel.inference import (
+    InferenceMode,
+    ParallelInference,
+)
+from deeplearning4j_tpu.parallel.serving import ServingEngine
+
+N_IN = 5
+
+
+def _tiny_model(seed: int = 1):
+    from deeplearning4j_tpu.models.multi_layer_network import (
+        MultiLayerNetwork)
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+    from deeplearning4j_tpu.ops.losses import LossFunction
+    from deeplearning4j_tpu.optimize.updaters import Adam
+    conf = (NeuralNetConfiguration.Builder().seed(seed)
+            .updater(Adam(1e-2)).list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT))
+            .set_input_type(InputType.feed_forward(N_IN)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _engine(model, **kw):
+    kw.setdefault("batch_limit", 8)
+    kw.setdefault("feature_shape", (N_IN,))
+    kw.setdefault("registry", MetricsRegistry())
+    return ServingEngine(model, **kw)
+
+
+class TestServingEngine:
+    def test_bitwise_vs_direct_across_sizes(self):
+        m = _tiny_model()
+        rng = np.random.default_rng(0)
+        with _engine(m) as eng:
+            for n in (1, 2, 3, 5, 8):
+                x = rng.normal(size=(n, N_IN)).astype(np.float32)
+                got = eng.output(x)
+                want = np.asarray(m.output(x))
+                assert got.shape == want.shape
+                assert np.array_equal(got, want), \
+                    f"size {n} diverged from direct output"
+
+    def test_concurrent_threads_bitwise(self):
+        m = _tiny_model()
+        rng = np.random.default_rng(1)
+        xs = [rng.normal(size=(1 + i % 4, N_IN)).astype(np.float32)
+              for i in range(24)]
+        want = [np.asarray(m.output(x)) for x in xs]
+        results = [None] * len(xs)
+        with _engine(m) as eng:
+            def worker(lo, hi):
+                for i in range(lo, hi):
+                    results[i] = eng.output(xs[i])
+            threads = [threading.Thread(target=worker,
+                                        args=(i * 6, (i + 1) * 6))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            eng.assert_warm()
+        for got, exp in zip(results, want):
+            assert np.array_equal(got, exp)
+
+    def test_oversized_request_splits_bounded_ladder(self):
+        m = _tiny_model()
+        rng = np.random.default_rng(2)
+        with _engine(m, batch_limit=4) as eng:
+            x = rng.normal(size=(19, N_IN)).astype(np.float32)
+            got = eng.output(x)
+            assert got.shape == (19, 3)
+            assert np.array_equal(got, np.asarray(m.output(x)))
+            # the ladder stays bounded: no executable above batch_limit
+            assert all(b <= 4 for b, _w in eng._exe)
+            eng.assert_warm()
+
+    def test_empty_and_misshaped_requests(self):
+        m = _tiny_model()
+        with _engine(m) as eng:
+            with pytest.raises(ValueError, match="non-empty"):
+                eng.output(np.zeros((0, N_IN), np.float32))
+            with pytest.raises(ValueError, match="non-empty"):
+                eng.output(np.float32(3.0))       # 0-d
+            with pytest.raises(ValueError, match="feature shape"):
+                eng.output(np.zeros((2, N_IN + 1), np.float32))
+            # the engine survives bad requests: a good one still lands
+            x = np.zeros((2, N_IN), np.float32)
+            assert np.array_equal(eng.output(x),
+                                  np.asarray(m.output(x)))
+
+    def test_warmup_then_zero_recompiles(self):
+        m = _tiny_model()
+        rng = np.random.default_rng(3)
+        reg = MetricsRegistry()
+        with _engine(m, registry=reg) as eng:
+            warm = reg.get_metric("dl4j_serving_compiles_total")
+            for n in (3, 1, 7, 8, 2, 5):
+                eng.output(rng.normal(size=(n, N_IN)).astype(np.float32))
+            assert eng.recompiles_after_warmup == 0
+            eng.assert_warm()                 # watchdog-backed
+            rendered = reg.render()
+            assert 'phase="warmup"' in rendered
+            assert ('dl4j_serving_compiles_total{phase="live",'
+                    'session="serve"} 0.0') in rendered
+
+    def test_shutdown_fails_waiters_no_hang(self):
+        class Slow:
+            def output(self, x):
+                time.sleep(0.05)
+                return np.zeros((x.shape[0], 3), np.float32)
+
+        eng = ServingEngine(Slow(), batch_limit=2, timeout_ms=1.0,
+                            registry=MetricsRegistry())
+        futures = [eng.submit(np.zeros((1, N_IN), np.float32))
+                   for _ in range(16)]
+        eng.shutdown()
+        done = [f for f in futures
+                if f.done() or f.exception(timeout=5) is not None
+                or f.result(timeout=5) is not None]
+        assert len(done) == len(futures)      # nobody hangs
+        # at least the tail of the queue was failed, not silently lost
+        failed = [f for f in futures if f.exception() is not None]
+        for f in failed:
+            assert "shut down" in str(f.exception())
+        with pytest.raises(RuntimeError, match="shut down"):
+            eng.submit(np.zeros((1, N_IN), np.float32))
+
+    def test_error_propagates_to_all_waiters(self):
+        class Broken:
+            def output(self, x):
+                raise RuntimeError("boom")
+
+        with ServingEngine(Broken(), batch_limit=4,
+                           registry=MetricsRegistry()) as eng:
+            f1 = eng.submit(np.zeros((1, N_IN), np.float32))
+            f2 = eng.submit(np.zeros((1, N_IN), np.float32))
+            with pytest.raises(RuntimeError, match="boom"):
+                f1.result(timeout=5)
+            with pytest.raises(RuntimeError, match="boom"):
+                f2.result(timeout=5)
+
+    def test_multi_replica_mesh(self):
+        import jax
+        if len(jax.devices()) < 4:
+            pytest.skip("needs the 8-device CPU mesh")
+        m = _tiny_model()
+        rng = np.random.default_rng(4)
+        reg = MetricsRegistry()
+        with _engine(m, batch_limit=8, replicas=4,
+                     registry=reg, session_id="mr") as eng:
+            errs = []
+
+            def hammer(seed):
+                r = np.random.default_rng(seed)
+                try:
+                    for i in range(15):
+                        k = 1 + i % 8
+                        x = r.normal(size=(k, N_IN)).astype(np.float32)
+                        got = eng.output(x)
+                        if not np.array_equal(got,
+                                              np.asarray(m.output(x))):
+                            raise AssertionError(f"size {k} diverged")
+                except Exception as e:
+                    errs.append(e)
+            threads = [threading.Thread(target=hammer, args=(s,))
+                       for s in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errs, errs
+            eng.assert_warm()
+            rendered = reg.render()
+            # full buckets went data-parallel over the mesh, partials
+            # round-robined over the replicas
+            assert 'replica="mesh"' in rendered
+            assert 'replica="0"' in rendered
+
+    def test_metrics_and_stats_published(self):
+        m = _tiny_model()
+        reg = MetricsRegistry()
+        with _engine(m, registry=reg) as eng:
+            for _ in range(4):
+                eng.output(np.zeros((2, N_IN), np.float32))
+            stats = eng.stats()
+            assert stats["requests"] == 4
+            assert stats["inflight"] == 0
+            assert stats["recompiles_after_warmup"] == 0
+            assert set(stats["latency_ms"]) == {"p50", "p95", "p99"}
+            rendered = reg.render()
+            for series in ("dl4j_serving_requests_total",
+                           "dl4j_serving_batches_total",
+                           "dl4j_serving_inflight",
+                           "dl4j_serving_queue_depth",
+                           "dl4j_serving_batch_occupancy",
+                           "dl4j_serving_latency_ms"):
+                assert series in rendered, series
+
+    def test_serve_spans_traced(self):
+        from deeplearning4j_tpu.observe import SpanTracer
+        m = _tiny_model()
+        tracer = SpanTracer()
+        with _engine(m, tracer=tracer) as eng:
+            eng.output(np.zeros((2, N_IN), np.float32))
+            names = {e["name"] for e in tracer._events}
+        for required in ("queue_wait", "batch_form", "dispatch",
+                         "device", "fetch", "serve_warmup"):
+            assert required in names, required
+
+    def test_bf16_params(self):
+        m = _tiny_model()
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(3, N_IN)).astype(np.float32)
+        with _engine(m, bf16=True) as eng:
+            got = eng.output(x)
+        # bf16 serving approximates the f32 forward, never replaces it
+        np.testing.assert_allclose(
+            got, np.asarray(m.output(x)), atol=0.05)
+
+
+class TestLatencyRing:
+    def test_quantiles_nearest_rank(self):
+        ring = LatencyRing(capacity=100)
+        for v in range(1, 101):                 # 1..100 ms
+            ring.record(v / 1e3)
+        q = ring.quantiles()
+        assert q[0.5] == pytest.approx(0.050)
+        assert q[0.95] == pytest.approx(0.095)
+        assert q[0.99] == pytest.approx(0.099)
+
+    def test_window_wraps(self):
+        ring = LatencyRing(capacity=4)
+        for v in (1.0, 2.0, 3.0, 4.0, 5.0, 6.0):
+            ring.record(v)
+        assert ring.count == 6
+        assert sorted(ring.snapshot()) == [3.0, 4.0, 5.0, 6.0]
+
+
+class TestParallelInferenceFacade:
+    def test_batched_delegates_to_engine(self):
+        m = _tiny_model()
+        with ParallelInference(m, InferenceMode.BATCHED,
+                               batch_limit=8,
+                               registry=MetricsRegistry()) as pi:
+            assert isinstance(pi.engine, ServingEngine)
+            x = np.zeros((3, N_IN), np.float32)
+            assert np.array_equal(pi.output(x),
+                                  np.asarray(m.output(x)))
+
+    def test_inplace_rejects_empty(self):
+        m = _tiny_model()
+        pi = ParallelInference(m, InferenceMode.INPLACE)
+        with pytest.raises(ValueError, match="non-empty"):
+            pi.output(np.zeros((0, N_IN), np.float32))
+
+    def test_batched_rejects_empty(self):
+        m = _tiny_model()
+        with ParallelInference(m, InferenceMode.BATCHED,
+                               registry=MetricsRegistry()) as pi:
+            with pytest.raises(ValueError, match="non-empty"):
+                pi.output(np.zeros((0, N_IN), np.float32))
+
+    def test_inplace_oversized_clamps_and_splits(self):
+        class Recorder:
+            def __init__(self, inner):
+                self.inner = inner
+                self.sizes = []
+
+            def output(self, x):
+                self.sizes.append(x.shape[0])
+                return self.inner.output(x)
+
+        m = _tiny_model()
+        rec = Recorder(m)
+        pi = ParallelInference(rec, InferenceMode.INPLACE,
+                               batch_limit=4)
+        x = np.random.default_rng(6).normal(
+            size=(11, N_IN)).astype(np.float32)
+        got = pi.output(x)
+        assert got.shape == (11, 3)
+        np.testing.assert_allclose(got, np.asarray(m.output(x)),
+                                   rtol=1e-5, atol=1e-6)
+        # every dispatched chunk stayed on the bounded ladder
+        assert max(rec.sizes) <= 4
+
+
+class TestServeCLI:
+    def test_serve_in_process(self, tmp_path):
+        from deeplearning4j_tpu.__main__ import _build_parser, cmd_serve
+        from deeplearning4j_tpu.models.serialization import save_model
+
+        m = _tiny_model()
+        path = str(tmp_path / "model.zip")
+        save_model(m, path)
+        args = _build_parser().parse_args(
+            ["serve", "--model", path, "--ui-port", "0",
+             "--batch-limit", "8", "--warmup-shape", str(N_IN)])
+        pi, server = cmd_serve(args, block=False)
+        try:
+            body = json.dumps(
+                {"features": np.zeros((2, N_IN)).tolist()}).encode()
+            req = urllib.request.Request(
+                f"{server.url}/api/predict", data=body,
+                headers={"Content-Type": "application/json"})
+            out = json.loads(urllib.request.urlopen(req).read())
+            assert np.asarray(out["output"]).shape == (2, 3)
+            want = np.asarray(m.output(np.zeros((2, N_IN), np.float32)))
+            assert np.array_equal(np.asarray(out["output"],
+                                             np.float32), want)
+            stats = json.loads(urllib.request.urlopen(
+                f"{server.url}/api/serving/stats").read())
+            assert stats["recompiles_after_warmup"] == 0
+            metrics = urllib.request.urlopen(
+                f"{server.url}/metrics").read().decode()
+            assert "dl4j_serving_requests_total" in metrics
+            health = urllib.request.urlopen(
+                f"{server.url}/healthz").read()
+            assert json.loads(health)["status"] == "ok"
+        finally:
+            pi.shutdown()
+            server.stop()

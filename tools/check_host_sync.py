@@ -37,10 +37,17 @@ DEFAULT_PATHS = (
     "deeplearning4j_tpu/ops",
     "deeplearning4j_tpu/optimize/solver.py",
     "deeplearning4j_tpu/models",
+    # parallel/ includes the serving engine (parallel/serving.py): its
+    # ONLY legitimate fetch is the completion-thread block/asarray pair
+    # (pragma'd there); a sync on the dispatch path would re-serialize
+    # the request pipeline the engine exists to overlap
     "deeplearning4j_tpu/parallel",
     # the input-feeder hot path: a stray per-batch host sync here would
     # serialize ETL back onto the step loop the feeder exists to unblock
     "deeplearning4j_tpu/datasets",
+    # serving's HTTP ingress: request decode / response encode are the
+    # pragma'd host boundaries; anything else must stay async
+    "deeplearning4j_tpu/ui/serving_module.py",
 )
 
 PRAGMA = "# host-sync-ok"
