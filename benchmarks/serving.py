@@ -21,19 +21,41 @@ Two load shapes:
 Arms alternate per round (A/B interleaved, like input_pipeline.py) so
 machine-load drift hits both equally.
 
+PR 6 adds two multi-process modes:
+
+- **--cold-start**: subprocess A/B of cold-start-to-``assert_warm()``
+  with and without the persisted AOT executable cache
+  (parallel/aot_cache.py). Each arm is a FRESH python process (the only
+  honest way to measure a cold start); the cached arm must also produce
+  bitwise-identical outputs to the uncached arm.
+- **--smoke-fleet / --soak-fleet**: open-loop soak against the fleet
+  front door (parallel/fleet.py). The parent hosts a warmed FleetRouter
+  behind the UI HTTP surface; worker SUBPROCESSES drive Poisson
+  arrivals at a target aggregate QPS through ``POST /api/predict`` and
+  count ok / shed (HTTP 503) / error. Gates: zero post-warmup
+  recompiles (watchdog-asserted), shed rate < 100%, served p99 under a
+  CPU-calibrated bound, achieved arrival rate near target.
+
 Usage:
     python benchmarks/serving.py                   # timed A/B + curve
     python benchmarks/serving.py --rate 500        # open-loop point
     python benchmarks/serving.py --smoke           # CI gate: bitwise vs
         # direct model.output, zero recompiles after warmup, pipelined
         # >= 1.3x blocking closed-loop
+    python benchmarks/serving.py --cold-start      # cached vs uncached
+    python benchmarks/serving.py --smoke-fleet     # CI fleet gate
+    python benchmarks/serving.py --soak-fleet --rate 150 --duration 10
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import os
 import random
 import statistics
+import subprocess
+import sys
 import threading
 import time
 
@@ -65,13 +87,14 @@ def build_model(seed: int = 7, width: int = 1024):
 
 def make_engine(model, *, pipelined: bool, session: str,
                 batch_limit: int = 32, timeout_ms: float = 5.0,
-                replicas=1) -> ServingEngine:
+                replicas=1, aot_cache_dir=None) -> ServingEngine:
     # isolated registry per arm: the A/B must not share counters
     return ServingEngine(
         model, batch_limit=batch_limit, timeout_ms=timeout_ms,
         pipelined=pipelined, replicas=replicas,
         feature_shape=(FEATURES,), registry=MetricsRegistry(),
-        session_id=session)
+        session_id=session, aot_cache_dir=aot_cache_dir,
+        model_version="bench")
 
 
 def closed_loop(engine: ServingEngine, n_clients: int, n_requests: int,
@@ -247,6 +270,249 @@ def run_smoke(args) -> int:
     return 0
 
 
+# ---- cold start: persisted AOT cache A/B (subprocess arms) ---------------
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_child(extra, timeout=600):
+    """Run this benchmark in a fresh process, parse the last stdout line
+    as JSON (child modes print exactly one JSON line)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "benchmarks.serving"] + extra,
+        cwd=_ROOT, capture_output=True, text=True, timeout=timeout)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"child {extra[:2]} failed rc={proc.returncode}:\n"
+            f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def run_cold_child(args) -> int:
+    """One cold-start arm: fresh process builds the model, stands up a
+    warmed engine (optionally against a persisted cache), and reports
+    the warmup-sweep seconds + an output digest for bitwise comparison.
+    Prints exactly one JSON line."""
+    import hashlib
+    model = build_model(width=args.width)
+    t0 = time.perf_counter()
+    eng = make_engine(model, pipelined=True, session="cold",
+                      batch_limit=16, aot_cache_dir=args.aot_cache_dir)
+    build_s = time.perf_counter() - t0
+    try:
+        eng.assert_warm()
+        rng = np.random.default_rng(123)
+        x = rng.normal(size=(5, FEATURES)).astype(np.float32)
+        out = eng.output(x)
+        digest = hashlib.sha256(
+            np.ascontiguousarray(out).tobytes()).hexdigest()
+        stats = eng.stats()
+    finally:
+        eng.shutdown()
+    print(json.dumps({
+        "warmup_s": stats["warmup_s"], "build_s": build_s,
+        "out_sha256": digest,
+        "aot": stats.get("aot_cache"),
+        "recompiles": stats["recompiles_after_warmup"]}))
+    return 0
+
+
+def run_cold_start(args) -> int:
+    """Cold-start-to-``assert_warm()``: median over ``--cold-runs``
+    fresh processes, uncached vs persisted-cache-warm. The first cached
+    process pays the save (reported separately); every later one loads.
+    Outputs must be bitwise-identical across every arm."""
+    import shutil
+    import tempfile
+    cache = args.aot_cache_dir or tempfile.mkdtemp(prefix="dl4j-aot-")
+    owned = args.aot_cache_dir is None
+    base = ["--cold-start-child", "--width", str(args.width)]
+    try:
+        uncached = [_run_child(base) for _ in range(args.cold_runs)]
+        # seed process: state "cold" -> warms live, saves the cache
+        seed_run = _run_child(base + ["--aot-cache-dir", cache])
+        cached = [_run_child(base + ["--aot-cache-dir", cache])
+                  for _ in range(args.cold_runs)]
+    finally:
+        if owned:
+            shutil.rmtree(cache, ignore_errors=True)
+
+    digests = {r["out_sha256"] for r in uncached + [seed_run] + cached}
+    med_un = statistics.median(r["warmup_s"] for r in uncached)
+    med_ca = statistics.median(r["warmup_s"] for r in cached)
+    speedup = med_un / med_ca if med_ca > 0 else float("inf")
+    states = [r["aot"]["state"] if r["aot"] else "?" for r in cached]
+    print(f"cold start to assert_warm(), width={args.width}, median of "
+          f"{args.cold_runs} fresh processes:")
+    print(f"  uncached       {med_un * 1e3:8.1f} ms")
+    print(f"  cache save     {seed_run['warmup_s'] * 1e3:8.1f} ms "
+          "(first process: live warmup + export)")
+    print(f"  cache warm     {med_ca * 1e3:8.1f} ms   "
+          f"states={states}")
+    print(f"  speedup        {speedup:8.2f}x   bitwise-equal outputs: "
+          f"{len(digests) == 1}")
+    if len(digests) != 1:
+        print("FAIL: cached arm output diverged from uncached")
+        return 1
+    if any(s != "warm" for s in states):
+        print("FAIL: a cached arm did not load the persisted table")
+        return 1
+    if args.assert_cold_speedup and speedup < args.assert_cold_speedup:
+        print(f"FAIL: cached cold-start speedup {speedup:.2f}x below "
+              f"the {args.assert_cold_speedup:.2f}x floor")
+        return 1
+    return 0
+
+
+# ---- fleet soak: multi-process open loop against the front door ----------
+
+def run_soak_worker(args) -> int:
+    """One load-generating subprocess: Poisson arrivals at ``--rate``
+    against ``--url``/api/predict for ``--duration`` seconds, open-loop
+    (arrivals never wait for completions). Prints one JSON line with
+    ok/shed/error counts and served latencies."""
+    import urllib.error
+    import urllib.request
+    from concurrent.futures import ThreadPoolExecutor
+    rng = np.random.default_rng(args.seed)
+    arrival = random.Random(args.seed)
+    x = rng.normal(size=(args.req_size, FEATURES)).astype(np.float32)
+    body = json.dumps({"features": x.tolist()}).encode()
+    url = args.url.rstrip("/") + "/api/predict"
+    counts = {"ok": 0, "shed": 0, "error": 0}
+    lat = []
+    lock = threading.Lock()
+
+    def one():
+        t0 = time.perf_counter()
+        try:
+            req = urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                r.read()
+            dt = time.perf_counter() - t0
+            with lock:
+                counts["ok"] += 1
+                lat.append(dt)
+        except urllib.error.HTTPError as e:
+            e.read()
+            with lock:
+                counts["shed" if e.code == 503 else "error"] += 1
+        except Exception:
+            with lock:
+                counts["error"] += 1
+
+    attempts = 0
+    t_start = time.perf_counter()
+    deadline = t_start + args.duration
+    with ThreadPoolExecutor(max_workers=64) as pool:
+        futs = []
+        while time.perf_counter() < deadline:
+            futs.append(pool.submit(one))
+            attempts += 1
+            time.sleep(arrival.expovariate(args.rate))
+        for f in futs:
+            f.result()
+    wall = time.perf_counter() - t_start
+    print(json.dumps({
+        "attempts": attempts, "wall_s": wall,
+        "latencies_ms": [round(v * 1e3, 3) for v in lat], **counts}))
+    return 0
+
+
+def run_fleet(args, smoke: bool) -> int:
+    """Parent of the multi-process soak: host a warmed FleetRouter
+    behind the UI HTTP surface, fan ``--workers`` load-generating
+    subprocesses at it, aggregate, gate."""
+    from deeplearning4j_tpu.parallel.fleet import FleetRouter
+    from deeplearning4j_tpu.ui.server import UIServer
+    from deeplearning4j_tpu.ui.serving_module import FleetModule
+    from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+    width = 64 if smoke else args.width
+    rate = args.rate or (60.0 if smoke else 150.0)
+    duration = args.duration
+    model = build_model(width=width)
+    fleet = FleetRouter(slo_ms=args.slo_ms, window_s=0.5)
+    fleet.add_pool("bench", model, pool_size=args.pool_size,
+                   batch_limit=16, feature_shape=(FEATURES,),
+                   aot_cache_dir=args.aot_cache_dir)
+    server = UIServer(port=0)
+    server.attach(InMemoryStatsStorage())
+    server.register_module(FleetModule(fleet))
+    server.start()
+    try:
+        fleet.assert_warm()         # warm BEFORE traffic
+        per_worker = rate / args.workers
+        cmd = [sys.executable, "-m", "benchmarks.serving",
+               "--soak-worker", "--url", server.url,
+               "--rate", str(per_worker),
+               "--duration", str(duration),
+               "--req-size", str(args.req_size)]
+        procs = [subprocess.Popen(cmd + ["--seed", str(i)], cwd=_ROOT,
+                                  stdout=subprocess.PIPE,
+                                  stderr=subprocess.PIPE, text=True)
+                 for i in range(args.workers)]
+        results = []
+        for p in procs:
+            out, err = p.communicate(timeout=duration * 10 + 120)
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"soak worker rc={p.returncode}:\n{err[-2000:]}")
+            results.append(json.loads(out.strip().splitlines()[-1]))
+
+        ok = sum(r["ok"] for r in results)
+        shed = sum(r["shed"] for r in results)
+        errors = sum(r["error"] for r in results)
+        attempts = sum(r["attempts"] for r in results)
+        lat = sorted(v for r in results for v in r["latencies_ms"])
+        wall = max(r["wall_s"] for r in results)
+        achieved = attempts / wall
+
+        def q(p):
+            return lat[min(len(lat) - 1,
+                           int(np.ceil(p * len(lat))) - 1)] if lat else 0
+
+        shed_rate = shed / attempts if attempts else 1.0
+        pst = fleet.stats()["pools"]["bench"]
+        import urllib.request
+        with urllib.request.urlopen(server.url + "/metrics") as r:
+            server_metrics = r.read().decode()
+        fleet.assert_warm()         # zero recompiles under traffic
+        print(f"fleet soak: {args.workers} worker processes, Poisson "
+              f"{rate:.0f} req/s aggregate target x {duration:.0f}s, "
+              f"slo={args.slo_ms:.0f}ms, pool_size={args.pool_size}:")
+        print(f"  attempts={attempts} ({achieved:.1f} req/s achieved)  "
+              f"ok={ok}  shed={shed} ({shed_rate * 100:.1f}%)  "
+              f"errors={errors}")
+        if lat:
+            print(f"  served: p50={q(.5):7.2f}ms  p95={q(.95):7.2f}ms  "
+                  f"p99={q(.99):7.2f}ms")
+        print(f"  router: shed_fraction={pst['shed_fraction']:.3f}  "
+              f"windowed_p99={pst['windowed_p99_ms']:.1f}ms  "
+              "post-warmup recompiles=0 (watchdog-asserted)")
+        failures = []
+        if errors:
+            failures.append(f"{errors} worker errors (non-shed)")
+        if shed_rate >= 1.0:
+            failures.append("every request shed")
+        if lat and q(.99) > args.fleet_p99_ms:
+            failures.append(f"served p99 {q(.99):.1f}ms over the "
+                            f"{args.fleet_p99_ms:.0f}ms bound")
+        if achieved < 0.5 * rate:
+            failures.append(f"achieved arrival rate {achieved:.1f} "
+                            f"req/s under half the {rate:.0f} target")
+        if "dl4j_fleet_admitted_total" not in server_metrics:
+            failures.append("dl4j_fleet_* series missing from /metrics")
+        for f in failures:
+            print(f"FAIL: {f}")
+        return 1 if failures else 0
+    finally:
+        server.stop()
+        fleet.shutdown()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--clients", type=int, default=8,
@@ -275,9 +541,52 @@ def main(argv=None) -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="CI gate: bitwise outputs, zero post-warmup "
                     "recompiles, >=1.3x closed-loop")
+    # cold start (persisted AOT cache A/B)
+    ap.add_argument("--cold-start", action="store_true",
+                    help="subprocess A/B: cold-start-to-assert_warm "
+                    "with vs without the persisted AOT cache")
+    ap.add_argument("--cold-runs", type=int, default=3,
+                    help="fresh processes per cold-start arm (median)")
+    ap.add_argument("--assert-cold-speedup", type=float, default=None,
+                    help="exit 1 when cached/uncached cold-start falls "
+                    "below this ratio")
+    ap.add_argument("--aot-cache-dir", default=None,
+                    help="persisted AOT cache location (default: a "
+                    "temp dir, removed afterwards)")
+    # fleet soak (multi-process open loop)
+    ap.add_argument("--smoke-fleet", action="store_true",
+                    help="CI gate: short multi-process Poisson soak "
+                    "through the fleet front door")
+    ap.add_argument("--soak-fleet", action="store_true",
+                    help="longer fleet soak at --rate/--duration")
+    ap.add_argument("--workers", type=int, default=2,
+                    help="load-generating worker subprocesses")
+    ap.add_argument("--duration", type=float, default=3.0,
+                    help="soak measurement window, seconds")
+    ap.add_argument("--slo-ms", type=float, default=1000.0,
+                    help="router p99 SLO for the soak")
+    ap.add_argument("--fleet-p99-ms", type=float, default=750.0,
+                    help="served-p99 gate for the soak (CPU-calibrated)")
+    ap.add_argument("--pool-size", type=int, default=1,
+                    help="engines in the soak's replica pool")
+    ap.add_argument("--seed", type=int, default=0)
+    # internal child modes (spawned by --cold-start / --*-fleet)
+    ap.add_argument("--cold-start-child", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--soak-worker", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--url", default=None, help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
     if args.replicas != "auto":
         args.replicas = int(args.replicas)
+    if args.soak_worker:
+        return run_soak_worker(args)
+    if args.cold_start_child:
+        return run_cold_child(args)
+    if args.cold_start:
+        return run_cold_start(args)
+    if args.smoke_fleet or args.soak_fleet:
+        return run_fleet(args, smoke=args.smoke_fleet)
     return run_smoke(args) if args.smoke else run_timed(args)
 
 

@@ -61,6 +61,18 @@ def _build_parser() -> argparse.ArgumentParser:
                    "request pays a compile")
     s.add_argument("--dtype", default="float32",
                    help="request feature dtype")
+    s.add_argument("--aot-cache-dir", default=None, metavar="DIR",
+                   help="persist the warmed AOT executable table here; "
+                   "a fresh process reaches assert_warm() in a "
+                   "fraction of the warmup sweep (falls through to "
+                   "live compile on any fingerprint mismatch)")
+    s.add_argument("--slo-ms", type=float, default=None, metavar="MS",
+                   help="serve behind the fleet front door with this "
+                   "p99 SLO: admission control + windowed-p99 shedding "
+                   "(503 on shed) + hot version swap/rollback routes")
+    s.add_argument("--model-version", default="v1",
+                   help="version label for the fleet pool / AOT cache "
+                   "fingerprint")
     s.add_argument("--ui-port", type=int, default=9000,
                    help="UI/metrics port (0 picks a free one)")
     s.add_argument("--duration", type=float, default=None,
@@ -71,14 +83,20 @@ def _build_parser() -> argparse.ArgumentParser:
 
 def cmd_serve(args, block: bool = True):
     """Start engine + UI server. ``block=False`` returns
-    ``(engine, server)`` for in-process use (tests, notebooks)."""
+    ``(front, server)`` for in-process use (tests, notebooks) — front
+    is the ParallelInference facade, or the FleetRouter when
+    ``--slo-ms`` puts the fleet front door up. Both expose
+    ``shutdown()``."""
+    import os
+
     import numpy as np
 
     from deeplearning4j_tpu.models.serialization import restore_model
     from deeplearning4j_tpu.parallel.inference import (
         InferenceMode, ParallelInference)
     from deeplearning4j_tpu.ui.server import UIServer
-    from deeplearning4j_tpu.ui.serving_module import ServingModule
+    from deeplearning4j_tpu.ui.serving_module import (
+        FleetModule, ServingModule)
     from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
 
     model = restore_model(args.model)
@@ -91,29 +109,58 @@ def cmd_serve(args, block: bool = True):
             replicas=replicas, depth=args.depth,
             pipelined=not args.no_pipeline, bf16=args.bf16,
             dtype=np.dtype(args.dtype),
+            aot_cache_dir=args.aot_cache_dir,
             feature_shape=(tuple(args.warmup_shape)
                            if args.warmup_shape else None))
-    pi = ParallelInference(
-        model, inference_mode=mode, batch_limit=args.batch_limit,
-        queue_limit=args.queue_limit, timeout_ms=args.timeout_ms,
-        **kwargs)
+
+    fleet = None
+    engine = None
+    if args.slo_ms is not None and mode == InferenceMode.BATCHED:
+        # fleet front door: admission control + SLO shedding wrap the
+        # engine; the pool is named after the model file
+        from deeplearning4j_tpu.parallel.fleet import FleetRouter
+        name = os.path.splitext(os.path.basename(args.model))[0] \
+            or "default"
+        fleet = FleetRouter(slo_ms=args.slo_ms)
+        fleet.add_pool(
+            name, model, version=args.model_version,
+            batch_limit=args.batch_limit, queue_limit=args.queue_limit,
+            timeout_ms=args.timeout_ms, **kwargs)
+        engine = fleet.pool(name).engines[0]
+        front = fleet
+    else:
+        front = ParallelInference(
+            model, inference_mode=mode, batch_limit=args.batch_limit,
+            queue_limit=args.queue_limit, timeout_ms=args.timeout_ms,
+            **kwargs)
+        engine = front.engine
 
     server = UIServer(port=args.ui_port)
     server.attach(InMemoryStatsStorage())
-    if pi.engine is not None:
-        server.register_module(ServingModule(pi.engine))
+    if fleet is not None:
+        # FleetModule first: its admission-controlled /api/predict wins
+        # the route merge; ServingModule keeps /api/serving/stats live
+        server.register_module(FleetModule(fleet))
+    if engine is not None:
+        server.register_module(ServingModule(engine))
     server.start()
     print(f"serving {args.model} at {server.url} "
           f"(mode={mode.value}, replicas={replicas}, "
-          f"batch_limit={args.batch_limit})")
+          f"batch_limit={args.batch_limit}"
+          + (f", slo={args.slo_ms}ms" if fleet is not None else "")
+          + (f", aot_cache={args.aot_cache_dir}"
+             if args.aot_cache_dir else "") + ")")
     print(f"  metrics:  {server.url}/metrics")
     print(f"  health:   {server.url}/healthz")
-    if pi.engine is not None:
+    if engine is not None:
         print(f"  predict:  POST {server.url}/api/predict "
               '{"features": [[...], ...]}')
         print(f"  stats:    GET  {server.url}/api/serving/stats")
+    if fleet is not None:
+        print(f"  fleet:    GET  {server.url}/api/fleet/stats, "
+              f"POST {server.url}/api/fleet/swap|rollback")
     if not block:
-        return pi, server
+        return front, server
     try:
         if args.duration is not None:
             time.sleep(args.duration)
@@ -123,7 +170,7 @@ def cmd_serve(args, block: bool = True):
     except KeyboardInterrupt:
         pass
     finally:
-        pi.shutdown()
+        front.shutdown()
         server.stop()
     return 0
 

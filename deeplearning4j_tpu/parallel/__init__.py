@@ -1,5 +1,7 @@
-"""Multi-device training and serving (mesh, wrappers, serving engine)."""
+"""Multi-device training and serving (mesh, wrappers, serving engine,
+fleet router, persisted AOT executable cache)."""
 
+from deeplearning4j_tpu.parallel.fleet import FleetRouter, ShedError
 from deeplearning4j_tpu.parallel.inference import (
     InferenceMode,
     ParallelInference,
@@ -7,7 +9,9 @@ from deeplearning4j_tpu.parallel.inference import (
 from deeplearning4j_tpu.parallel.serving import ServingEngine
 
 __all__ = [
+    "FleetRouter",
     "InferenceMode",
     "ParallelInference",
     "ServingEngine",
+    "ShedError",
 ]

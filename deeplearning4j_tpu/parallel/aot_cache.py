@@ -1,0 +1,256 @@
+"""Persisted AOT executable cache for the serving engine.
+
+PR 5's warmup sweep means no live request ever pays a compile — but
+every fresh process pays the WHOLE sweep before ``assert_warm()``. For
+scale-to-zero, fleet rollouts and version swaps that is the cold-start
+bill: tracing the model's Python forward once per ladder bucket plus an
+XLA compile per (bucket, target). This module persists both halves:
+
+1. **StableHLO blobs** (``jax.export``): one serialized exported module
+   per ladder bucket. Loading one skips re-tracing the model's Python
+   layer stack — ``export.deserialize(blob).call`` is a thin wrapper
+   whose own trace is O(1) in model depth.
+2. **XLA executable cache**: the JAX persistent compilation cache is
+   pointed at ``<cache_dir>/xla`` so the backend compile of each bucket
+   (including the blob-wrapper's signature, which is primed at save
+   time) is a disk hit in later processes. Its entries are keyed by the
+   computation fingerprint + jaxlib version + backend, so a stale entry
+   can never be served — it just misses.
+
+A ``manifest.json`` fingerprints what the blobs were exported from:
+model version + weights digest, parameter tree spec, jax/jaxlib
+versions, backend platform/device kind, the serving contract
+(feature_shape, dtype, ladder, bf16). ``try_load`` compares field by
+field and falls through to live compile on ANY mismatch (recording
+which field diverged) — a cache can make a cold start fast, never
+wrong. Mesh-sharded (multi-replica full-bucket) executables are not
+exported; they fall through to live compile and still benefit from the
+XLA cache half.
+
+Layout on disk::
+
+    <cache_dir>/manifest.json      fingerprint + entry list
+    <cache_dir>/bucket_<N>.stablehlo   one exported module per bucket
+    <cache_dir>/xla/...            JAX persistent compilation cache
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+MANIFEST = "manifest.json"
+FORMAT_VERSION = 1
+
+_xla_cache_lock = threading.Lock()
+_xla_cache_dir: Optional[str] = None
+
+
+def enable_xla_cache(path: str) -> bool:
+    """Point the process-wide JAX persistent compilation cache at
+    ``path`` (idempotent; the setting is global — first engine wins and
+    later engines reuse it). Returns False when this jax version has no
+    persistent cache support; the blob half still works."""
+    global _xla_cache_dir
+    import jax
+    with _xla_cache_lock:
+        if _xla_cache_dir is not None:
+            return True
+        try:
+            os.makedirs(path, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", path)
+            # serving sweeps are many small compiles: cache all of them,
+            # not just the >1s ones the training-oriented default keeps
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              -1)
+            # a compile that ran before the dir was configured pins the
+            # cache "initialized but disabled" — force re-init so the
+            # new dir takes effect mid-process (e.g. after model load)
+            try:
+                from jax.experimental.compilation_cache import (
+                    compilation_cache as _cc)
+            except ImportError:
+                from jax._src import compilation_cache as _cc
+            if hasattr(_cc, "reset_cache"):
+                _cc.reset_cache()
+        except Exception:
+            return False
+        _xla_cache_dir = path
+        return True
+
+
+def _tree_spec(params) -> list:
+    """Stable description of a pytree's structure + leaf shapes/dtypes
+    (metadata only — no device reads)."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    spec = []
+    for a in leaves:
+        dt = getattr(a, "dtype", None)
+        spec.append([list(np.shape(a)),
+                     str(dt) if dt is not None else type(a).__name__])
+    return [str(treedef), spec]
+
+
+def weights_digest(params) -> str:
+    """sha256 over every leaf's bytes — the model-version key. One-time
+    device→host read at engine start (cache setup), not a hot path."""
+    import jax
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(params):
+        a = np.asarray(leaf)  # host-sync-ok: one-time startup fingerprint fetch, pre-traffic
+        h.update(str(a.shape).encode())
+        h.update(str(a.dtype).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def fingerprint(params, mstate, *, feature_shape, dtype, ladder,
+                bf16: bool, model_version: Optional[str] = None) -> Dict:
+    """Everything a loaded executable's validity depends on."""
+    import jax
+    import jaxlib
+    dev = jax.devices()[0]
+    return {
+        "format_version": FORMAT_VERSION,
+        "model_version": model_version,
+        "weights_sha256": weights_digest(params),
+        "params_spec": _tree_spec(params),
+        "model_state_spec": _tree_spec(mstate),
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "backend": {"platform": dev.platform,
+                    "device_kind": dev.device_kind},
+        "serving": {"feature_shape": list(feature_shape),
+                    "dtype": str(np.dtype(dtype)),
+                    "ladder": list(ladder),
+                    "bf16": bool(bf16)},
+    }
+
+
+def _first_mismatch(want: Dict, got: Dict, prefix: str = "") -> Optional[str]:
+    for k in want:
+        w, g = want[k], got.get(k)
+        if isinstance(w, dict) and isinstance(g, dict):
+            sub = _first_mismatch(w, g, f"{prefix}{k}.")
+            if sub:
+                return sub
+        elif w != g:
+            return f"{prefix}{k}"
+    return None
+
+
+class AOTExecutableCache:
+    """One serving engine's view of a persisted executable table.
+
+    ``state`` after construction + ``try_load``:
+
+    - ``"warm"``      manifest matched; blobs deserialized and in use
+    - ``"cold"``      no manifest yet (first process; ``save`` fills it)
+    - ``"mismatch"``  manifest found but the fingerprint diverged —
+      ``reason`` names the first differing field; live compile is used
+      (and ``save`` rewrites the cache for the new fingerprint)
+    - ``"disabled"``  jax.export unavailable; only the XLA cache half runs
+    """
+
+    def __init__(self, cache_dir: str):
+        self.dir = Path(cache_dir)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.state = "cold"
+        self.reason: Optional[str] = None
+        self.hits = 0            # buckets served from a loaded blob
+        self.misses = 0          # buckets that fell through to live trace
+        self.xla_cache_enabled = enable_xla_cache(str(self.dir / "xla"))
+        try:
+            from jax import export  # noqa: F401  (jax >= 0.4.34)
+            self._export = export
+        except ImportError:
+            try:
+                from jax.experimental import export  # older spelling
+                self._export = export
+            except ImportError:
+                self._export = None
+                self.state = "disabled"
+                self.reason = "jax.export unavailable"
+
+    # ---- load ------------------------------------------------------------
+    def try_load(self, fp: Dict) -> Dict[int, Any]:
+        """Deserialized ``Exported`` per bucket when the manifest
+        matches ``fp``; {} otherwise (state/reason record why)."""
+        if self._export is None:
+            return {}
+        path = self.dir / MANIFEST
+        if not path.exists():
+            self.state = "cold"
+            return {}
+        try:
+            manifest = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            self.state = "mismatch"
+            self.reason = f"unreadable manifest: {e}"
+            return {}
+        diff = _first_mismatch(fp, manifest.get("fingerprint", {}))
+        if diff is not None:
+            self.state = "mismatch"
+            self.reason = f"fingerprint field {diff!r} diverged"
+            return {}
+        loaded: Dict[int, Any] = {}
+        for bucket in manifest.get("buckets", []):
+            blob_path = self.dir / f"bucket_{bucket}.stablehlo"
+            try:
+                blob = bytearray(blob_path.read_bytes())
+                loaded[int(bucket)] = self._export.deserialize(blob)
+            except Exception as e:
+                # one bad blob falls through to live compile; the rest
+                # of the table still loads
+                self.misses += 1
+                self.reason = f"bucket {bucket}: {type(e).__name__}"
+        self.state = "warm" if loaded else "mismatch"
+        return loaded
+
+    # ---- save ------------------------------------------------------------
+    def save(self, jit_fn, committed, fp: Dict, ladder, example) -> int:
+        """Export + serialize one module per ladder bucket and prime the
+        XLA cache under the blob-wrapper's compile key, then write the
+        manifest (atomically, last — a crash mid-save leaves a cache
+        that simply misses). Returns the number of buckets saved."""
+        if self._export is None:
+            return 0
+        import jax
+        params, mstate = committed
+        saved = []
+        for bucket in ladder:
+            x = np.zeros((int(bucket),) + tuple(example.shape[1:]),
+                         example.dtype)
+            try:
+                exp = self._export.export(jit_fn)(params, mstate, x)
+                blob = exp.serialize()
+                (self.dir / f"bucket_{bucket}.stablehlo").write_bytes(
+                    bytes(blob))
+                # prime: the loading process compiles jit(exp.call), a
+                # different cache key than jit_fn's — pay it here, once,
+                # so the fresh process's compile is a disk hit
+                jax.jit(exp.call).lower(params, mstate, x).compile()
+                saved.append(int(bucket))
+            except Exception:
+                continue        # that bucket warms live on load; rest save
+        if saved:
+            tmp = self.dir / (MANIFEST + ".tmp")
+            tmp.write_text(json.dumps(
+                {"fingerprint": fp, "buckets": saved}, indent=2))
+            os.replace(tmp, self.dir / MANIFEST)
+        return len(saved)
+
+    def stats(self) -> Dict[str, Any]:
+        return {"state": self.state, "reason": self.reason,
+                "hits": self.hits, "misses": self.misses,
+                "dir": str(self.dir),
+                "xla_cache": self.xla_cache_enabled}

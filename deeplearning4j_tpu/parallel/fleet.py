@@ -1,0 +1,420 @@
+"""FleetRouter: SLO-aware front door over per-model ServingEngine pools.
+
+PR 5's ServingEngine is one process serving one model version; this is
+the layer that makes a fleet of them operable (the serving analog of
+DL4J's L7 frontends over ParallelInference — PAPER.md §1 layer map,
+grown past the reference):
+
+- **Admission control.** Every request passes ``admit()`` before it can
+  touch an engine queue. A pool whose pending count (submitted, not yet
+  answered) is at its bound sheds immediately — the caller gets a
+  ``ShedError`` synchronously, never a Future that hangs behind a full
+  queue.
+- **SLO-aware shedding.** Each pool runs an AIMD controller over the
+  *windowed* p99 from ``LatencyRing.delta_quantiles()`` (observations
+  since the last tick only — the full 4096-sample ring would take
+  minutes to forget a spike). p99 over the SLO → shed fraction steps up
+  additively; back under → it decays multiplicatively. The fraction is
+  capped below 1.0 so a recovering pool always sees enough traffic to
+  measure itself.
+- **Per-model pools, least-loaded dispatch.** A pool holds N engines of
+  the active version; each request goes to the engine with the fewest
+  in-flight requests.
+- **Hot version swap + rollback.** ``swap()`` builds and *warms* the new
+  version's engines first (with a persisted AOT cache this takes a
+  fraction of a sweep — parallel/aot_cache.py), then switches the active
+  pointer atomically and keeps the previous version warm as the rollback
+  standby. ``rollback()`` switches back instantly. The zoo is a first-
+  class model source: pools accept a built model, a ZooModel
+  instance/class, a zoo entry name ("LeNet"), or a factory callable.
+
+Environment knobs (all read at router construction; OBSERVABILITY.md):
+
+- ``DL4J_FLEET_WINDOW_S``     controller tick period, s (default 1.0)
+- ``DL4J_FLEET_SHED_STEP``    additive shed-fraction step (default 0.2)
+- ``DL4J_FLEET_SHED_DECAY``   multiplicative decay under SLO (default 0.5)
+- ``DL4J_FLEET_SHED_MAX``     shed-fraction cap < 1 (default 0.95)
+- ``DL4J_FLEET_MAX_PENDING``  per-pool pending bound (default 256)
+
+Prometheus series (rides the PR 2 registry, scraped at ``/metrics``):
+``dl4j_fleet_admitted_total{model}``, ``dl4j_fleet_shed_total{model,
+reason=queue|slo}``, ``dl4j_fleet_swap_total{model, event=swap|
+rollback}``, ``dl4j_fleet_pool_depth{model}``,
+``dl4j_fleet_shed_fraction{model}``, ``dl4j_fleet_p99_ms{model}``,
+``dl4j_fleet_pool_engines{model}``.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Tuple
+
+from deeplearning4j_tpu.observe.latency import LatencyRing
+from deeplearning4j_tpu.observe.registry import default_registry
+from deeplearning4j_tpu.parallel.serving import ServingEngine
+
+
+class ShedError(RuntimeError):
+    """Request refused by admission control — raised synchronously from
+    ``submit``/``output`` so a shed caller fails fast instead of holding
+    a Future that will never resolve. ``reason`` is ``"queue"`` (pool
+    pending bound hit) or ``"slo"`` (p99-over-SLO shedding)."""
+
+    def __init__(self, model: str, reason: str, detail: str):
+        super().__init__(
+            f"request shed by fleet admission control "
+            f"(model={model!r}, reason={reason}): {detail}")
+        self.model = model
+        self.reason = reason
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))  # host-sync-ok: env-var knob, trace-time constant
+    except ValueError:
+        return default
+
+
+def _materialize(model, name: str):
+    """Accept a built model, a ZooModel instance/class, a zoo entry
+    name, or a zero-arg factory; return a built, initialized model."""
+    if isinstance(model, str):
+        from deeplearning4j_tpu.zoo import models as zoo_models
+        cls = getattr(zoo_models, model, None)
+        if cls is None:
+            raise ValueError(f"pool {name!r}: no zoo model named "
+                             f"{model!r}")
+        model = cls
+    if isinstance(model, type):
+        model = model()
+    if hasattr(model, "init") and not hasattr(model, "output") \
+            and not hasattr(model, "build_inference_fn"):
+        model = model.init()            # ZooModel entry
+    elif callable(model) and not hasattr(model, "output") \
+            and not hasattr(model, "build_inference_fn"):
+        model = model()                 # factory
+    return model
+
+
+class ModelPool:
+    """One model's replica pool: N engines of the active version plus an
+    optional warm standby (the previous version, for rollback)."""
+
+    def __init__(self, name: str, router: "FleetRouter",
+                 engine_kwargs: Dict[str, Any], pool_size: int,
+                 slo_ms: Optional[float]):
+        self.name = name
+        self.router = router
+        self.engine_kwargs = dict(engine_kwargs)
+        self.pool_size = int(pool_size)
+        self.slo_ms = slo_ms
+        self.lock = threading.Lock()
+        self.engines: List[ServingEngine] = []
+        self.active_version: Optional[str] = None
+        self.standby: Optional[Tuple[str, List[ServingEngine]]] = None
+        self.ring = LatencyRing()
+        self.pending = 0
+        self.shed_fraction = 0.0
+        self.windowed_p99_ms: Optional[float] = None
+        self._last_tick = time.monotonic()
+        self._rand = random.Random()
+
+    # ---- admission -------------------------------------------------------
+    def _tick_controller(self, now: float):
+        """AIMD over the windowed p99 (caller holds ``self.lock``)."""
+        r = self.router
+        if now - self._last_tick < r.window_s:
+            return
+        self._last_tick = now
+        q = self.ring.delta_quantiles((0.99,))
+        if not q:
+            # no traffic this window: decay toward open admission so an
+            # idle (or fully-shed) pool can recover
+            self.shed_fraction *= r.shed_decay
+            if self.shed_fraction < 0.01:
+                self.shed_fraction = 0.0
+        else:
+            self.windowed_p99_ms = q[0.99] * 1e3
+            r._g_p99.set(self.windowed_p99_ms, model=self.name)
+            if self.slo_ms is not None \
+                    and self.windowed_p99_ms > self.slo_ms:
+                self.shed_fraction = min(
+                    r.shed_max, self.shed_fraction + r.shed_step)
+            else:
+                self.shed_fraction *= r.shed_decay
+                if self.shed_fraction < 0.01:
+                    self.shed_fraction = 0.0
+        r._g_shed_fraction.set(self.shed_fraction, model=self.name)
+
+    def admit(self):
+        """Raise ``ShedError`` or return (never blocks, never queues)."""
+        r = self.router
+        with self.lock:
+            self._tick_controller(time.monotonic())
+            if self.pending >= r.max_pending:
+                r._c_shed.inc(1.0, model=self.name, reason="queue")
+                raise ShedError(
+                    self.name, "queue",
+                    f"{self.pending} pending >= bound {r.max_pending}")
+            if self.shed_fraction > 0.0 \
+                    and self._rand.random() < self.shed_fraction:
+                r._c_shed.inc(1.0, model=self.name, reason="slo")
+                raise ShedError(
+                    self.name, "slo",
+                    f"windowed p99 {self.windowed_p99_ms:.1f} ms over "
+                    f"SLO {self.slo_ms:.1f} ms; shedding "
+                    f"{self.shed_fraction:.0%} of arrivals")
+            self.pending += 1
+            r._g_depth.set(self.pending, model=self.name)
+        r._c_admitted.inc(1.0, model=self.name)
+
+    # ---- dispatch --------------------------------------------------------
+    def least_loaded(self) -> ServingEngine:
+        with self.lock:
+            return min(self.engines, key=lambda e: e.inflight)
+
+    def submit(self, features) -> Future:
+        self.admit()
+        t0 = time.perf_counter()
+        try:
+            f = self.least_loaded().submit(features)
+        except BaseException:
+            with self.lock:
+                self.pending -= 1
+                self.router._g_depth.set(self.pending, model=self.name)
+            raise
+
+        def done(_f):
+            self.ring.record(time.perf_counter() - t0)
+            with self.lock:
+                self.pending -= 1
+                self.router._g_depth.set(self.pending, model=self.name)
+        f.add_done_callback(done)
+        return f
+
+    def stats(self) -> Dict[str, Any]:
+        with self.lock:
+            engines = list(self.engines)
+            out = {
+                "active_version": self.active_version,
+                "standby_version": self.standby[0] if self.standby
+                else None,
+                "pool_size": len(engines),
+                "pending": self.pending,
+                "shed_fraction": self.shed_fraction,
+                "windowed_p99_ms": self.windowed_p99_ms,
+                "slo_ms": self.slo_ms,
+            }
+        out["requests"] = self.ring.count
+        out["latency_ms"] = {f"p{int(k * 100)}": v * 1e3
+                             for k, v in self.ring.quantiles().items()}
+        out["engines"] = [{"session": e.session_id,
+                           "inflight": e.inflight,
+                           "recompiles_after_warmup":
+                               e.recompiles_after_warmup,
+                           "warmup_s": e.warmup_seconds}
+                          for e in engines]
+        return out
+
+
+class FleetRouter:
+    """Front door over named ModelPools. Thread-safe."""
+
+    def __init__(self, *, slo_ms: Optional[float] = None,
+                 max_pending: Optional[int] = None,
+                 window_s: Optional[float] = None,
+                 aot_cache_dir: Optional[str] = None,
+                 registry=None, session_id: str = "fleet"):
+        self.slo_ms = slo_ms
+        self.session_id = session_id
+        self.aot_cache_dir = aot_cache_dir
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self.window_s = window_s if window_s is not None \
+            else _env_float("DL4J_FLEET_WINDOW_S", 1.0)
+        self.shed_step = _env_float("DL4J_FLEET_SHED_STEP", 0.2)
+        self.shed_decay = _env_float("DL4J_FLEET_SHED_DECAY", 0.5)
+        self.shed_max = min(0.999,
+                            _env_float("DL4J_FLEET_SHED_MAX", 0.95))
+        self.max_pending = int(max_pending) if max_pending is not None \
+            else int(_env_float("DL4J_FLEET_MAX_PENDING", 256))
+        self._pools: Dict[str, ModelPool] = {}
+        self._pools_lock = threading.Lock()
+        self._shutdown = False
+
+        reg = self.registry
+        self._c_admitted = reg.counter(
+            "dl4j_fleet_admitted_total",
+            "requests admitted past the fleet front door, per model")
+        self._c_shed = reg.counter(
+            "dl4j_fleet_shed_total",
+            "requests shed by admission control, per model; reason="
+            "queue (pending bound) | slo (p99-over-SLO shedding)")
+        self._c_swap = reg.counter(
+            "dl4j_fleet_swap_total",
+            "model-version swaps, per model; event=swap|rollback")
+        self._g_depth = reg.gauge(
+            "dl4j_fleet_pool_depth",
+            "requests submitted to a pool and not yet answered")
+        self._g_shed_fraction = reg.gauge(
+            "dl4j_fleet_shed_fraction",
+            "current SLO-shedding fraction of the pool's arrivals")
+        self._g_p99 = reg.gauge(
+            "dl4j_fleet_p99_ms",
+            "windowed p99 over the last controller tick's completions")
+        self._g_engines = reg.gauge(
+            "dl4j_fleet_pool_engines",
+            "engines in the pool's active version")
+
+    # ---- pool management -------------------------------------------------
+    def _build_engines(self, name: str, model, version: str,
+                       engine_kwargs: Dict[str, Any],
+                       pool_size: int) -> List[ServingEngine]:
+        model = _materialize(model, name)
+        engines = []
+        kw = dict(engine_kwargs)
+        if self.aot_cache_dir is not None:
+            kw.setdefault("aot_cache_dir",
+                          os.path.join(self.aot_cache_dir, name))
+        kw.setdefault("registry", self.registry)
+        for i in range(pool_size):
+            engines.append(ServingEngine(
+                model, model_version=version,
+                session_id=f"{self.session_id}-{name}-{version}-{i}",
+                **kw))
+        return engines
+
+    def add_pool(self, name: str, model, *, version: str = "v1",
+                 pool_size: int = 1, slo_ms: Optional[float] = None,
+                 **engine_kwargs) -> ModelPool:
+        """Create and warm a pool. ``model`` may be a built model, a
+        ZooModel instance/class, a zoo entry name, or a factory."""
+        if pool_size < 1:
+            raise ValueError("pool_size must be >= 1")
+        with self._pools_lock:
+            if name in self._pools:
+                raise ValueError(f"pool {name!r} already exists")
+        pool = ModelPool(name, self, engine_kwargs, pool_size,
+                         slo_ms if slo_ms is not None else self.slo_ms)
+        pool.engines = self._build_engines(name, model, version,
+                                           engine_kwargs, pool_size)
+        pool.active_version = version
+        with self._pools_lock:
+            self._pools[name] = pool
+        self._g_engines.set(pool_size, model=name)
+        self._g_depth.set(0.0, model=name)
+        self._c_admitted.inc(0.0, model=name)
+        return pool
+
+    def pool(self, name: Optional[str] = None) -> ModelPool:
+        with self._pools_lock:
+            if name is None:
+                if len(self._pools) != 1:
+                    raise ValueError(
+                        "model name required: the router serves "
+                        f"{sorted(self._pools)}")
+                return next(iter(self._pools.values()))
+            p = self._pools.get(name)
+        if p is None:
+            raise ValueError(f"no pool named {name!r}; have "
+                             f"{sorted(self._pools)}")
+        return p
+
+    @property
+    def pools(self) -> Dict[str, ModelPool]:
+        with self._pools_lock:
+            return dict(self._pools)
+
+    # ---- serving ---------------------------------------------------------
+    def submit(self, features, model: Optional[str] = None) -> Future:
+        if self._shutdown:
+            raise RuntimeError("FleetRouter is shut down")
+        return self.pool(model).submit(features)
+
+    def output(self, features, model: Optional[str] = None):
+        return self.submit(features, model=model).result()
+
+    # ---- version lifecycle -----------------------------------------------
+    def swap(self, name: str, model, version: str) -> ModelPool:
+        """A/B weight swap: build + warm ``version``'s engines, switch
+        the active pointer atomically, keep the previous version warm as
+        the rollback standby, and shut down anything older. In-flight
+        requests on the old version complete normally."""
+        pool = self.pool(name)
+        new_engines = self._build_engines(name, model, version,
+                                          pool.engine_kwargs,
+                                          pool.pool_size)
+        with pool.lock:
+            retired = pool.standby
+            pool.standby = (pool.active_version, pool.engines)
+            pool.engines = new_engines
+            pool.active_version = version
+            # stale latencies must not drive the new version's shedding
+            pool.ring.reset()
+        self._c_swap.inc(1.0, model=name, event="swap")
+        self._g_engines.set(len(new_engines), model=name)
+        if retired is not None:
+            for e in retired[1]:
+                e.shutdown()
+        return pool
+
+    def rollback(self, name: str) -> ModelPool:
+        """Switch back to the standby version (the one ``swap`` retired
+        to warm standby). The rolled-back-from version becomes the new
+        standby, so a flapping rollout can flip repeatedly."""
+        pool = self.pool(name)
+        with pool.lock:
+            if pool.standby is None:
+                raise RuntimeError(
+                    f"pool {name!r} has no standby version to roll "
+                    "back to")
+            (pool.active_version, pool.engines), pool.standby = \
+                pool.standby, (pool.active_version, pool.engines)
+            pool.ring.reset()
+        self._c_swap.inc(1.0, model=name, event="rollback")
+        self._g_engines.set(len(pool.engines), model=name)
+        return pool
+
+    # ---- introspection ---------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "session": self.session_id,
+            "slo_ms": self.slo_ms,
+            "max_pending": self.max_pending,
+            "window_s": self.window_s,
+            "pools": {name: p.stats()
+                      for name, p in self.pools.items()},
+        }
+
+    def assert_warm(self):
+        """Every engine in every pool (active + standby) holds the
+        zero-live-compile contract."""
+        for pool in self.pools.values():
+            with pool.lock:
+                engines = list(pool.engines)
+                if pool.standby is not None:
+                    engines += list(pool.standby[1])
+            for e in engines:
+                e.assert_warm()
+
+    # ---- lifecycle -------------------------------------------------------
+    def shutdown(self):
+        self._shutdown = True
+        for pool in self.pools.values():
+            with pool.lock:
+                engines = list(pool.engines)
+                if pool.standby is not None:
+                    engines += list(pool.standby[1])
+                pool.standby = None
+            for e in engines:
+                e.shutdown()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()

@@ -113,6 +113,15 @@ class ServingEngine:
         the model's train_state is untouched)
     warmup : compile the whole bucket ladder at start (default: True
         when ``feature_shape`` is known)
+    aot_cache_dir : persist the warmed executable table here
+        (parallel/aot_cache.py): the first process exports + saves the
+        ladder after its sweep; later processes reach ``assert_warm()``
+        in a fraction of the sweep time by deserializing StableHLO blobs
+        and hitting the XLA persistent compilation cache. Any
+        fingerprint mismatch (weights, jaxlib, backend, shapes) falls
+        through to live compile.
+    model_version : opaque version string folded into the cache
+        fingerprint (the fleet router's swap path sets it)
     """
 
     def __init__(self, model, *, batch_limit: int = 32,
@@ -123,6 +132,8 @@ class ServingEngine:
                  feature_shape: Optional[Tuple[int, ...]] = None,
                  dtype: Any = np.float32, bf16: bool = False,
                  warmup: Optional[bool] = None,
+                 aot_cache_dir: Optional[str] = None,
+                 model_version: Optional[str] = None,
                  tracer=None, registry=None, watchdog=None,
                  session_id: str = "serve"):
         import jax
@@ -242,6 +253,28 @@ class ServingEngine:
                 "build_inference_fn (committed per-replica params); "
                 f"{type(model).__name__} only has .output")
 
+        # ---- persisted AOT executable cache ------------------------------
+        self.aot_cache = None
+        self.model_version = model_version
+        self._loaded_exports: Dict[int, Any] = {}
+        self._cache_fp = None
+        self._c_aot = reg.counter(
+            "dl4j_serving_aot_cache_total",
+            "persisted AOT executable cache events: hit = bucket "
+            "loaded from a StableHLO blob, miss = fell through to live "
+            "trace, save = bucket persisted after warmup")
+        if aot_cache_dir is not None and self._jit is not None \
+                and self.feature_shape is not None:
+            from deeplearning4j_tpu.parallel.aot_cache import (
+                AOTExecutableCache, fingerprint)
+            self.aot_cache = AOTExecutableCache(aot_cache_dir)
+            params0, mstate0 = self._committed[0]
+            self._cache_fp = fingerprint(
+                params0, mstate0, feature_shape=self.feature_shape,
+                dtype=self.dtype, ladder=self.ladder, bf16=self.bf16,
+                model_version=model_version)
+            self._loaded_exports = self.aot_cache.try_load(self._cache_fp)
+
         # ---- dispatch machinery ------------------------------------------
         self._exe: Dict[Tuple[int, Union[int, str]], Any] = {}
         self._exe_lock = threading.Lock()
@@ -267,10 +300,17 @@ class ServingEngine:
 
         do_warmup = (self.feature_shape is not None if warmup is None
                      else bool(warmup))
+        self.warmup_seconds = 0.0
+        self.cache_save_seconds = 0.0
         if do_warmup:
             if self.feature_shape is None:
                 raise ValueError("warmup needs feature_shape (and dtype)")
+            t0 = time.perf_counter()
             self._warmup_sweep()
+            self.warmup_seconds = time.perf_counter() - t0
+            if (self.aot_cache is not None
+                    and self.aot_cache.state in ("cold", "mismatch")):
+                self.save_aot_cache()
         self._warmed = True
         self._dispatcher.start()
         if self._completer is not None:
@@ -311,15 +351,35 @@ class ServingEngine:
             exe = self._exe.get(key)
             if exe is not None:
                 return exe
+            import jax
             params, mstate = self._committed[where]
             x = self._place(np.zeros((bucket,) + self.feature_shape,
                                      self.dtype), where)
-            try:
-                exe = self._jit.lower(params, mstate, x).compile()
-            except Exception:
-                # AOT unavailable (older jax / exotic shardings): the
-                # jitted call still caches one executable per signature
-                exe = self._jit
+            exe = None
+            exp = (self._loaded_exports.get(bucket)
+                   if where != MESH else None)
+            if exp is not None:
+                # persisted-cache path: compile the deserialized
+                # StableHLO wrapper (no model re-trace; the XLA compile
+                # itself is a persistent-cache disk hit, primed at save)
+                try:
+                    exe = jax.jit(exp.call).lower(params, mstate,
+                                                  x).compile()
+                    self.aot_cache.hits += 1
+                    self._c_aot.inc(1.0, session=self.session_id,
+                                    event="hit")
+                except Exception:
+                    self.aot_cache.misses += 1
+                    self._c_aot.inc(1.0, session=self.session_id,
+                                    event="miss")
+            if exe is None:
+                try:
+                    exe = self._jit.lower(params, mstate, x).compile()
+                except Exception:
+                    # AOT unavailable (older jax / exotic shardings):
+                    # the jitted call still caches one executable per
+                    # signature
+                    exe = self._jit
             self._exe[key] = exe
             phase = "warmup" if not self._warmed else "live"
             if self._warmed:
@@ -454,21 +514,53 @@ class ServingEngine:
             p.add_done_callback(on_done)
         return outer
 
+    @property
+    def inflight(self) -> int:
+        """Requests accepted but not yet answered (the fleet router's
+        least-loaded dispatch key)."""
+        return self._inflight_count
+
     def stats(self) -> Dict[str, Any]:
         """Point-in-time snapshot for the CLI / UI module."""
         q = self.latency.quantiles()
-        return {
+        out = {
             "session": self.session_id,
             "replicas": self.n_replicas,
             "ladder": list(self.ladder),
             "pipelined": self.pipelined,
             "requests": self.latency.count,
             "inflight": self._inflight_count,
-            "queue_depth": self._queue.qsize(),
+            # a carried-over request parked in self._carry is waiting
+            # for the dispatcher exactly like a queued one — count it
+            "queue_depth": self._queue.qsize()
+            + (1 if self._carry is not None else 0),
             "recompiles_after_warmup": self._post_warmup_compiles,
+            "warmup_s": self.warmup_seconds,
             "latency_ms": {f"p{int(k * 100)}": v * 1e3
                            for k, v in q.items()},
         }
+        if self.aot_cache is not None:
+            out["aot_cache"] = self.aot_cache.stats()
+        return out
+
+    def save_aot_cache(self) -> int:
+        """Export + persist the warmed executable table (called
+        automatically after the warmup sweep when the cache was cold or
+        stale; callable explicitly after e.g. a weight update). Returns
+        the number of buckets saved."""
+        if (self.aot_cache is None or self._jit is None
+                or self.feature_shape is None):
+            return 0
+        t0 = time.perf_counter()
+        example = np.zeros((1,) + self.feature_shape, self.dtype)
+        n = self.aot_cache.save(self._jit, self._committed[0],
+                                self._cache_fp, self.ladder, example)
+        self.cache_save_seconds = time.perf_counter() - t0
+        if n:
+            self._c_aot.inc(float(n),  # host-sync-ok: python int bucket count, not a device value
+                            session=self.session_id,
+                            event="save")
+        return n
 
     @property
     def recompiles_after_warmup(self) -> int:

@@ -530,10 +530,19 @@ class _Handler(BaseHTTPRequestHandler):
         from deeplearning4j_tpu.ui.modules import UIModuleContext
         q = {k: v[0] for k, v in parse_qs(u.query).items()}
         ctx = UIModuleContext(storage=self.storage, server=self.server)
+        status = 200
         try:
             out = route.handler(ctx, q, body)
-            if isinstance(out, tuple):
-                payload, ctype = out
+            if isinstance(out, tuple) and len(out) == 3 \
+                    and isinstance(out[0], dict):
+                # (dict, None, status): JSON with an explicit HTTP
+                # status — the fleet router's 503-on-shed path
+                out, _, status = out
+                payload, ctype = None, None
+            elif isinstance(out, tuple):
+                payload, ctype = out[:2]
+                if len(out) == 3:
+                    status = int(out[2])
                 if isinstance(payload, str):
                     payload = payload.encode("utf-8")
                 payload = bytes(payload)
@@ -555,13 +564,13 @@ class _Handler(BaseHTTPRequestHandler):
                                  f"{type(e).__name__}"}, 500)
             return
         if payload is not None:
-            self.send_response(200)
+            self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(payload)))
             self.end_headers()
             self.wfile.write(payload)
         else:
-            self._json(out)
+            self._json(out, status)
 
     def _session(self, u) -> Optional[str]:
         q = parse_qs(u.query)

@@ -1,8 +1,10 @@
-"""ServingModule: UI routes for a live ServingEngine.
+"""ServingModule / FleetModule: UI routes for live serving.
 
-Plugs the serving engine (parallel/serving.py) into the dashboard via
-the UIModule SPI — the same extension point custom reference modules
-use (UIModule.java). Two routes:
+Plugs the serving engine (parallel/serving.py) and the fleet router
+(parallel/fleet.py) into the dashboard via the UIModule SPI — the same
+extension point custom reference modules use (UIModule.java).
+
+``ServingModule`` (one engine):
 
 - ``POST /api/predict``   {"features": [[...], ...]} -> {"output": ...}
   A convenience ingress for smoke tests and the CLI demo; production
@@ -12,8 +14,23 @@ use (UIModule.java). Two routes:
 - ``GET /api/serving/stats``  engine snapshot: streaming p50/p95/p99,
   in-flight depth, queue depth, ladder, recompiles-after-warmup.
 
-The Prometheus series the engine publishes (``dl4j_serving_*``) are
-scraped from the server's existing ``/metrics``; this module only adds
+``FleetModule`` (a FleetRouter in front of one or more pools) replaces
+the predict route with the admission-controlled one and adds the fleet
+lifecycle surface:
+
+- ``POST /api/predict``  {"features": ..., "model": optional} — passes
+  through the router's admission control; a shed request answers **HTTP
+  503** with ``{"error": "shed", "model": ..., "reason": "queue"|"slo"}``
+  so external load balancers can react (retry-after, spillover).
+- ``GET /api/fleet/stats``  router snapshot: per-pool active/standby
+  version, pending depth, shed fraction, windowed p99.
+- ``POST /api/fleet/swap``  {"model": name, "version": v, "path": zip}
+  restores the weights at ``path`` and hot-swaps them in (warm-first,
+  atomic switch, previous version kept as standby).
+- ``POST /api/fleet/rollback``  {"model": name} — back to the standby.
+
+The Prometheus series (``dl4j_serving_*``, ``dl4j_fleet_*``) are
+scraped from the server's existing ``/metrics``; these modules only add
 the JSON/ingress surface.
 """
 
@@ -47,3 +64,56 @@ class ServingModule(UIModule):
 
     def _stats(self, ctx, query, body):
         return self.engine.stats()
+
+
+class FleetModule(UIModule):
+    """Routes for a FleetRouter front door (see module docstring)."""
+
+    def __init__(self, router):
+        self.router = router
+
+    def get_routes(self) -> List[Route]:
+        return [
+            Route("POST", "/api/predict", self._predict),
+            Route("GET", "/api/fleet/stats", self._stats),
+            Route("POST", "/api/fleet/swap", self._swap),
+            Route("POST", "/api/fleet/rollback", self._rollback),
+        ]
+
+    def _predict(self, ctx, query, body):
+        from deeplearning4j_tpu.parallel.fleet import ShedError
+        if not isinstance(body, dict) or "features" not in body:
+            raise ValueError('expected {"features": [[...], ...]}')
+        x = np.asarray(body["features"], dtype=np.float32)  # host-sync-ok: decoding the JSON request body, already host data
+        try:
+            out = self.router.output(x, model=body.get("model"))
+        except ShedError as e:
+            # 503 = "overloaded, retry elsewhere/later" — distinct from
+            # a 500 module bug, and the worker/soak driver counts it
+            return ({"error": "shed", "model": e.model,
+                     "reason": e.reason}, None, 503)
+        return {"output": np.asarray(out).tolist(),  # host-sync-ok: HTTP response must be host JSON
+                "n": int(x.shape[0])}
+
+    def _stats(self, ctx, query, body):
+        return self.router.stats()
+
+    def _swap(self, ctx, query, body):
+        if not isinstance(body, dict) or "version" not in body \
+                or "path" not in body:
+            raise ValueError(
+                'expected {"model": name?, "version": v, "path": zip}')
+        from deeplearning4j_tpu.models.serialization import restore_model
+        name = body.get("model") or self.router.pool().name
+        model = restore_model(body["path"])
+        pool = self.router.swap(name, model, str(body["version"]))
+        return {"model": name, "active_version": pool.active_version,
+                "standby_version": pool.standby[0] if pool.standby
+                else None}
+
+    def _rollback(self, ctx, query, body):
+        name = (body or {}).get("model") or self.router.pool().name
+        pool = self.router.rollback(name)
+        return {"model": name, "active_version": pool.active_version,
+                "standby_version": pool.standby[0] if pool.standby
+                else None}

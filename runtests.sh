@@ -26,4 +26,8 @@ JAX_PLATFORMS=cpu python -m benchmarks.input_pipeline --smoke
 # zero recompiles after the warmup sweep (watchdog-asserted), and
 # pipelined dispatch >=1.3x the blocking dispatcher closed-loop
 JAX_PLATFORMS=cpu python -m benchmarks.serving --smoke
+# fleet tier: multi-process Poisson soak through the front-door router
+# (admission control + SLO shedding) — zero post-warmup recompiles,
+# shed rate < 100%, served p99 under the CPU-calibrated bound
+JAX_PLATFORMS=cpu python -m benchmarks.serving --smoke-fleet
 exec python -m pytest tests/ -q "$@"

@@ -1,0 +1,217 @@
+"""Persisted AOT executable cache tests (PR 6, parallel/aot_cache.py).
+
+The cache contract: a fresh PROCESS that points at a saved cache reaches
+``assert_warm()`` with zero live compiles and produces outputs bitwise
+equal to an uncached engine; ANY fingerprint divergence (weights, shapes,
+serving contract, versions) falls through to live compile — the cache can
+make a cold start fast, never wrong.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.observe.registry import MetricsRegistry
+from deeplearning4j_tpu.parallel.aot_cache import (
+    AOTExecutableCache,
+    enable_xla_cache,
+    fingerprint,
+)
+from deeplearning4j_tpu.parallel.serving import ServingEngine
+
+N_IN = 5
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tiny_model(seed: int = 1):
+    from deeplearning4j_tpu.models.multi_layer_network import (
+        MultiLayerNetwork)
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+    from deeplearning4j_tpu.ops.losses import LossFunction
+    from deeplearning4j_tpu.optimize.updaters import Adam
+    conf = (NeuralNetConfiguration.Builder().seed(seed)
+            .updater(Adam(1e-2)).list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT))
+            .set_input_type(InputType.feed_forward(N_IN)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _engine(model, cache_dir, **kw):
+    kw.setdefault("batch_limit", 4)
+    kw.setdefault("feature_shape", (N_IN,))
+    kw.setdefault("registry", MetricsRegistry())
+    return ServingEngine(model, aot_cache_dir=cache_dir,
+                         model_version="t1", **kw)
+
+
+# child script: load the cache in a FRESH process (the only honest test
+# of a cold start), prove zero live compiles + bitwise-equal output
+_CHILD = """
+import json, sys
+import numpy as np
+sys.path.insert(0, {root!r})
+from tests.test_aot_cache import _tiny_model, _engine
+from deeplearning4j_tpu.observe.registry import MetricsRegistry
+
+reg = MetricsRegistry()
+eng = _engine(_tiny_model(), {cache!r}, registry=reg)
+try:
+    eng.assert_warm()
+    x = np.asarray(json.loads({x!r}), np.float32)
+    out = eng.output(x)
+    stats = eng.stats()
+finally:
+    eng.shutdown()
+live = 0.0
+m = reg.get_metric("dl4j_serving_compiles_total")
+for key, v in m.series().items():
+    if ("phase", "live") in key:
+        live += v
+print(json.dumps({{"out": np.asarray(out).tolist(),
+                   "aot": stats["aot_cache"],
+                   "live_compiles": live,
+                   "recompiles": stats["recompiles_after_warmup"]}}))
+"""
+
+
+class TestRoundTrip:
+    def test_fresh_process_loads_warm_bitwise(self, tmp_path):
+        cache = str(tmp_path / "aot")
+        m = _tiny_model()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(3, N_IN)).astype(np.float32)
+        # process A: cold cache -> live warmup, auto-save
+        eng = _engine(m, cache)
+        try:
+            want = eng.output(x)
+            assert eng.aot_cache.state == "cold"       # saved from cold
+            assert os.path.exists(os.path.join(cache, "manifest.json"))
+        finally:
+            eng.shutdown()
+        # process B (fresh python): must load every bucket, compile
+        # nothing live, and reproduce process A's bytes exactly
+        child = _CHILD.format(root=_ROOT, cache=cache,
+                              x=json.dumps(x.tolist()))
+        proc = subprocess.run(
+            [sys.executable, "-c", child], cwd=_ROOT,
+            capture_output=True, text=True, timeout=300,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        got = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert got["aot"]["state"] == "warm"
+        assert got["aot"]["hits"] > 0
+        assert got["live_compiles"] == 0.0
+        assert got["recompiles"] == 0
+        assert np.array_equal(
+            np.asarray(got["out"], np.float32), want)
+
+    def test_same_process_second_engine_hits(self, tmp_path):
+        cache = str(tmp_path / "aot")
+        m = _tiny_model()
+        e1 = _engine(m, cache)
+        e1.shutdown()
+        e2 = _engine(m, cache)
+        try:
+            assert e2.aot_cache.state == "warm"
+            assert e2.aot_cache.hits > 0
+            e2.assert_warm()
+        finally:
+            e2.shutdown()
+
+
+class TestFingerprint:
+    def test_weights_divergence_misses(self, tmp_path):
+        cache = str(tmp_path / "aot")
+        e1 = _engine(_tiny_model(seed=1), cache)
+        e1.shutdown()
+        # different weights, same everything else -> mismatch, live path
+        e2 = _engine(_tiny_model(seed=2), cache)
+        try:
+            assert e2.aot_cache.state == "mismatch"
+            assert "weights_sha256" in e2.aot_cache.reason
+            e2.assert_warm()            # live warmup still ran
+            x = np.zeros((2, N_IN), np.float32)
+            e2.output(x)
+        finally:
+            e2.shutdown()
+
+    def test_contract_divergence_misses(self, tmp_path):
+        cache = str(tmp_path / "aot")
+        m = _tiny_model()
+        e1 = _engine(m, cache, batch_limit=4)
+        e1.shutdown()
+        # a different ladder is a different serving contract
+        e2 = _engine(m, cache, batch_limit=8)
+        try:
+            assert e2.aot_cache.state == "mismatch"
+            assert "serving" in e2.aot_cache.reason
+        finally:
+            e2.shutdown()
+
+    def test_corrupt_manifest_falls_through(self, tmp_path):
+        cache = str(tmp_path / "aot")
+        m = _tiny_model()
+        e1 = _engine(m, cache)
+        e1.shutdown()
+        with open(os.path.join(cache, "manifest.json"), "w") as f:
+            f.write("{not json")
+        e2 = _engine(m, cache)
+        try:
+            assert e2.aot_cache.state == "mismatch"
+            assert "manifest" in e2.aot_cache.reason
+            e2.assert_warm()
+        finally:
+            e2.shutdown()
+
+    def test_corrupt_blob_partial_load(self, tmp_path):
+        cache = str(tmp_path / "aot")
+        m = _tiny_model()
+        e1 = _engine(m, cache)
+        e1.shutdown()
+        with open(os.path.join(cache, "bucket_2.stablehlo"), "wb") as f:
+            f.write(b"garbage")
+        e2 = _engine(m, cache)
+        try:
+            # the other buckets still load; bucket 2 warms live
+            assert e2.aot_cache.state == "warm"
+            assert e2.aot_cache.misses >= 1
+            e2.assert_warm()
+            x = np.zeros((2, N_IN), np.float32)
+            assert np.array_equal(e2.output(x), np.asarray(m.output(x)))
+        finally:
+            e2.shutdown()
+
+    def test_fingerprint_covers_the_contract(self):
+        m = _tiny_model()
+        params = m.train_state.params
+        mstate = m.train_state.model_state
+        fp = fingerprint(params, mstate, feature_shape=(N_IN,),
+                         dtype=np.float32, ladder=(1, 2, 4),
+                         bf16=False, model_version="v1")
+        for key in ("weights_sha256", "params_spec", "jax", "jaxlib",
+                    "backend", "serving", "model_version"):
+            assert key in fp, key
+        assert fp["serving"]["ladder"] == [1, 2, 4]
+
+
+class TestXlaCacheConfig:
+    def test_enable_idempotent(self, tmp_path):
+        # process-global, first wins; later calls are True no-ops
+        assert enable_xla_cache(str(tmp_path / "x1")) is True
+        assert enable_xla_cache(str(tmp_path / "x2")) is True
+
+    def test_disabled_without_export(self, tmp_path, monkeypatch):
+        c = AOTExecutableCache(str(tmp_path / "a"))
+        # simulate a jax without usable export support
+        c._export = None
+        c.state = "disabled"
+        assert c.try_load({}) == {}
+        assert c.save(None, (None, None), {}, (1,), None) == 0

@@ -1,0 +1,273 @@
+"""FleetRouter tests (PR 6): admission control, SLO shedding, pools,
+hot version swap/rollback, and the persisted AOT executable cache.
+
+The fleet contract under test:
+
+- a shed request fails FAST with a distinct ``ShedError`` (reason
+  ``"queue"`` or ``"slo"``) raised synchronously from submit — a caller
+  never holds a Future that hangs behind a full queue;
+- dispatch goes to the least-loaded engine of the active version;
+- ``swap()`` warms the new version before switching, keeps the old one
+  as rollback standby, and ``rollback()`` flips back instantly — all
+  bitwise-faithful to the respective version's direct output;
+- the AIMD controller reacts to the WINDOWED p99 (delta_quantiles), so
+  one old spike cannot shed forever;
+- ``dl4j_fleet_*`` Prometheus series render.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.observe.registry import MetricsRegistry
+from deeplearning4j_tpu.parallel.fleet import (
+    FleetRouter,
+    ShedError,
+    _materialize,
+)
+
+N_IN = 5
+
+
+def _tiny_model(seed: int = 1):
+    from deeplearning4j_tpu.models.multi_layer_network import (
+        MultiLayerNetwork)
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+    from deeplearning4j_tpu.ops.losses import LossFunction
+    from deeplearning4j_tpu.optimize.updaters import Adam
+    conf = (NeuralNetConfiguration.Builder().seed(seed)
+            .updater(Adam(1e-2)).list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT))
+            .set_input_type(InputType.feed_forward(N_IN)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _router(**kw):
+    kw.setdefault("registry", MetricsRegistry())
+    kw.setdefault("window_s", 10.0)     # controller quiet unless asked
+    return FleetRouter(**kw)
+
+
+def _pool_kw():
+    return dict(batch_limit=8, feature_shape=(N_IN,))
+
+
+class Slow:
+    """Duck-typed model whose forward blocks — lets tests hold requests
+    in flight deterministically."""
+
+    def __init__(self, delay=0.2):
+        self.delay = delay
+
+    def output(self, x):
+        time.sleep(self.delay)
+        return np.zeros((x.shape[0], 3), np.float32)
+
+
+class TestAdmission:
+    def test_queue_shed_fails_fast_distinct_error(self):
+        with _router(max_pending=1) as r:
+            r.add_pool("slow", Slow(), batch_limit=2)
+            f1 = r.submit(np.zeros((1, N_IN), np.float32), model="slow")
+            t0 = time.perf_counter()
+            with pytest.raises(ShedError) as ei:
+                r.submit(np.zeros((1, N_IN), np.float32), model="slow")
+            # synchronous refusal, not a timeout: well under the 0.2s
+            # the in-flight request takes
+            assert time.perf_counter() - t0 < 0.1
+            assert ei.value.reason == "queue"
+            assert ei.value.model == "slow"
+            assert "shed by fleet admission control" in str(ei.value)
+            f1.result(timeout=5)        # the admitted one still lands
+
+    def test_slo_shed_reason_and_recovery(self):
+        reg = MetricsRegistry()
+        with _router(slo_ms=50.0, window_s=0.01, registry=reg) as r:
+            r.add_pool("m", _tiny_model(), **_pool_kw())
+            pool = r.pool("m")
+            # a window of over-SLO completions drives the AIMD up
+            for _ in range(20):
+                pool.ring.record(0.5)           # 500 ms >> 50 ms SLO
+            pool._last_tick = 0.0
+            with pool.lock:
+                pool._tick_controller(time.monotonic())
+            assert pool.shed_fraction == pytest.approx(r.shed_step)
+            # force the coin deterministically: always shed
+            pool._rand.random = lambda: 0.0
+            with pytest.raises(ShedError) as ei:
+                r.submit(np.zeros((1, N_IN), np.float32), model="m")
+            assert ei.value.reason == "slo"
+            # under-SLO windows decay the fraction back to open
+            pool._rand.random = lambda: 1.0
+            for _ in range(8):
+                for _ in range(20):
+                    pool.ring.record(0.001)
+                pool._last_tick = 0.0
+                with pool.lock:
+                    pool._tick_controller(time.monotonic())
+            assert pool.shed_fraction == 0.0
+            # and traffic flows again
+            r.output(np.zeros((1, N_IN), np.float32), model="m")
+            rendered = reg.render()
+            assert 'dl4j_fleet_shed_total' in rendered
+            assert 'reason="slo"' in rendered
+
+    def test_windowed_not_cumulative(self):
+        """The controller must react to the LAST window, not the whole
+        ring: after one spiky window, a clean window reads clean."""
+        with _router(slo_ms=50.0, window_s=0.01) as r:
+            r.add_pool("m", _tiny_model(), **_pool_kw())
+            pool = r.pool("m")
+            for _ in range(50):
+                pool.ring.record(0.5)
+            pool._last_tick = 0.0
+            with pool.lock:
+                pool._tick_controller(time.monotonic())
+            assert pool.windowed_p99_ms > 50.0
+            for _ in range(50):
+                pool.ring.record(0.001)
+            pool._last_tick = 0.0
+            with pool.lock:
+                pool._tick_controller(time.monotonic())
+            # full-ring p99 would still see the 500ms spike; the
+            # windowed read must not
+            assert pool.windowed_p99_ms < 50.0
+
+
+class TestDispatch:
+    def test_least_loaded(self):
+        with _router() as r:
+            r.add_pool("m", _tiny_model(), pool_size=2, **_pool_kw())
+            pool = r.pool("m")
+
+            class Fake:
+                def __init__(self, inflight):
+                    self.inflight = inflight
+            real = pool.engines
+            try:
+                a, b = Fake(3), Fake(1)
+                pool.engines = [a, b]
+                assert pool.least_loaded() is b
+                b.inflight = 5
+                assert pool.least_loaded() is a
+            finally:
+                pool.engines = real
+
+    def test_pool_serves_bitwise(self):
+        m = _tiny_model()
+        rng = np.random.default_rng(0)
+        with _router() as r:
+            r.add_pool("m", m, pool_size=2, **_pool_kw())
+            for n in (1, 3, 8):
+                x = rng.normal(size=(n, N_IN)).astype(np.float32)
+                assert np.array_equal(r.output(x),
+                                      np.asarray(m.output(x)))
+            r.assert_warm()
+
+    def test_default_pool_and_unknown_model(self):
+        with _router() as r:
+            r.add_pool("only", _tiny_model(), **_pool_kw())
+            r.output(np.zeros((1, N_IN), np.float32))   # no name needed
+            with pytest.raises(ValueError, match="no pool named"):
+                r.submit(np.zeros((1, N_IN), np.float32), model="nope")
+
+
+class TestSwapRollback:
+    def test_swap_bitwise_then_rollback(self):
+        reg = MetricsRegistry()
+        m1, m2 = _tiny_model(1), _tiny_model(2)
+        x = np.random.default_rng(3).normal(
+            size=(3, N_IN)).astype(np.float32)
+        with _router(registry=reg) as r:
+            r.add_pool("m", m1, version="v1", **_pool_kw())
+            assert np.array_equal(r.output(x), np.asarray(m1.output(x)))
+            pool = r.swap("m", m2, "v2")
+            assert pool.active_version == "v2"
+            assert pool.standby[0] == "v1"
+            assert np.array_equal(r.output(x), np.asarray(m2.output(x)))
+            r.assert_warm()             # standby stays warm too
+            r.rollback("m")
+            assert pool.active_version == "v1"
+            assert pool.standby[0] == "v2"
+            assert np.array_equal(r.output(x), np.asarray(m1.output(x)))
+            rendered = reg.render()
+            assert 'event="swap"' in rendered
+            assert 'event="rollback"' in rendered
+
+    def test_second_swap_retires_oldest(self):
+        m1, m2, m3 = _tiny_model(1), _tiny_model(2), _tiny_model(3)
+        with _router() as r:
+            r.add_pool("m", m1, version="v1", **_pool_kw())
+            r.swap("m", m2, "v2")
+            v1_engines = r.pool("m").standby[1]
+            r.swap("m", m3, "v3")
+            pool = r.pool("m")
+            assert pool.active_version == "v3"
+            assert pool.standby[0] == "v2"
+            # v1's engines were shut down, not leaked
+            for e in v1_engines:
+                with pytest.raises(RuntimeError, match="shut down"):
+                    e.submit(np.zeros((1, N_IN), np.float32))
+
+    def test_rollback_without_standby_raises(self):
+        with _router() as r:
+            r.add_pool("m", _tiny_model(), **_pool_kw())
+            with pytest.raises(RuntimeError, match="no standby"):
+                r.rollback("m")
+
+
+class TestMaterialize:
+    def test_factory_and_builtin(self):
+        m = _tiny_model()
+        assert _materialize(m, "p") is m
+        built = _materialize(lambda: m, "p")
+        assert built is m
+
+    def test_zoo_name(self, monkeypatch):
+        from deeplearning4j_tpu.zoo import models as zoo_models
+        m = _tiny_model()
+        monkeypatch.setattr(zoo_models, "TinyTestEntry", lambda: m,
+                            raising=False)
+        assert _materialize("TinyTestEntry", "p") is m
+        with pytest.raises(ValueError, match="no zoo model"):
+            _materialize("NoSuchZooModel", "p")
+
+
+class TestStatsAndMetrics:
+    def test_stats_and_series(self):
+        reg = MetricsRegistry()
+        with _router(registry=reg, slo_ms=100.0) as r:
+            r.add_pool("m", _tiny_model(), **_pool_kw())
+            for _ in range(3):
+                r.output(np.zeros((2, N_IN), np.float32))
+            st = r.stats()
+            p = st["pools"]["m"]
+            assert p["active_version"] == "v1"
+            assert p["pending"] == 0
+            assert p["requests"] == 3
+            assert st["slo_ms"] == 100.0
+            rendered = reg.render()
+            for series in ("dl4j_fleet_admitted_total",
+                           "dl4j_fleet_pool_depth",
+                           "dl4j_fleet_pool_engines"):
+                assert series in rendered, series
+
+    def test_shed_maps_to_http_503(self):
+        """FleetModule answers a ShedError with 503 + a machine-readable
+        body — never a hung request, never a generic 500."""
+        from deeplearning4j_tpu.ui.serving_module import FleetModule
+
+        class Refusing:
+            def output(self, features, model=None):
+                raise ShedError("m", "slo", "over SLO")
+        payload, ctype, status = FleetModule(Refusing())._predict(
+            None, {}, {"features": [[0.0] * N_IN]})
+        assert status == 503
+        assert payload == {"error": "shed", "model": "m",
+                           "reason": "slo"}

@@ -230,6 +230,23 @@ class TestServingEngine:
                          "device", "fetch", "serve_warmup"):
             assert required in names, required
 
+    def test_queue_depth_counts_carried_chunk(self):
+        """Regression (PR 6): a chunk the dispatcher pulled off the
+        queue but parked in ``_carry`` (it didn't fit the forming
+        batch) is still queued work — ``stats()`` must count it, or a
+        loaded engine reports one request less than it owes."""
+        m = _tiny_model()
+        with _engine(m) as eng:
+            assert eng.stats()["queue_depth"] == 0
+            # white-box: park a sentinel exactly where the dispatcher
+            # parks an overflow chunk
+            eng._carry = object()
+            try:
+                assert eng.stats()["queue_depth"] == 1
+            finally:
+                eng._carry = None
+            assert eng.stats()["queue_depth"] == 0
+
     def test_bf16_params(self):
         m = _tiny_model()
         rng = np.random.default_rng(5)
@@ -257,6 +274,55 @@ class TestLatencyRing:
             ring.record(v)
         assert ring.count == 6
         assert sorted(ring.snapshot()) == [3.0, 4.0, 5.0, 6.0]
+
+    def test_quantile_validation_before_sort(self):
+        ring = LatencyRing(capacity=8)
+        # must raise on an EMPTY ring too — validation happens before
+        # any window work
+        with pytest.raises(ValueError, match="out of range"):
+            ring.quantiles((1.5,))
+        with pytest.raises(ValueError, match="out of range"):
+            ring.delta_quantiles((-0.1,))
+        ring.record(1.0)
+        with pytest.raises(ValueError, match="out of range"):
+            ring.quantiles((0.5, 2.0))
+        # a doomed call must not consume the delta window
+        assert ring.delta_quantiles((0.5,)) == {0.5: 1.0}
+
+    def test_delta_quantiles_windowed(self):
+        ring = LatencyRing(capacity=100)
+        for v in (1.0, 2.0, 3.0):
+            ring.record(v)
+        q = ring.delta_quantiles((0.5,))
+        assert q[0.5] == 2.0
+        # nothing new since the last delta read
+        assert ring.delta_quantiles((0.5,)) == {}
+        # only the NEW observations count, not the whole ring
+        ring.record(10.0)
+        assert ring.delta_quantiles((0.5,)) == {0.5: 10.0}
+
+    def test_delta_quantiles_wraps_ring(self):
+        ring = LatencyRing(capacity=4)
+        ring.record(1.0)
+        ring.mark()
+        # 5 new observations through a capacity-4 ring: the delta
+        # window clamps to the newest 4
+        for v in (2.0, 3.0, 4.0, 5.0, 6.0):
+            ring.record(v)
+        q = ring.delta_quantiles((0.0, 1.0))
+        assert q[0.0] == 3.0 and q[1.0] == 6.0
+
+    def test_reset_empties_window_keeps_count(self):
+        ring = LatencyRing(capacity=8)
+        for v in (1.0, 2.0, 3.0):
+            ring.record(v)
+        ring.reset()
+        assert ring.snapshot() == []
+        assert ring.quantiles() == {}
+        assert ring.count == 3            # cumulative, monotonic
+        # pre-reset observations never leak into the next delta window
+        ring.record(7.0)
+        assert ring.delta_quantiles((0.5,)) == {0.5: 7.0}
 
 
 class TestParallelInferenceFacade:
@@ -341,4 +407,52 @@ class TestServeCLI:
             assert json.loads(health)["status"] == "ok"
         finally:
             pi.shutdown()
+            server.stop()
+
+    def test_serve_fleet_flags_round_trip(self, tmp_path):
+        """``--slo-ms`` + ``--aot-cache-dir`` (PR 6): serve goes up
+        behind the FleetRouter, /api/predict rides admission control,
+        the fleet stats/metrics surface is live, and the persisted AOT
+        cache is written next to the model."""
+        import os
+
+        from deeplearning4j_tpu.__main__ import _build_parser, cmd_serve
+        from deeplearning4j_tpu.models.serialization import save_model
+        from deeplearning4j_tpu.parallel.fleet import FleetRouter
+
+        m = _tiny_model()
+        path = str(tmp_path / "model.zip")
+        cache = str(tmp_path / "aot")
+        save_model(m, path)
+        args = _build_parser().parse_args(
+            ["serve", "--model", path, "--ui-port", "0",
+             "--batch-limit", "8", "--warmup-shape", str(N_IN),
+             "--slo-ms", "250", "--aot-cache-dir", cache,
+             "--model-version", "v7"])
+        front, server = cmd_serve(args, block=False)
+        try:
+            assert isinstance(front, FleetRouter)
+            body = json.dumps(
+                {"features": np.zeros((2, N_IN)).tolist()}).encode()
+            req = urllib.request.Request(
+                f"{server.url}/api/predict", data=body,
+                headers={"Content-Type": "application/json"})
+            out = json.loads(urllib.request.urlopen(req).read())
+            want = np.asarray(m.output(np.zeros((2, N_IN), np.float32)))
+            assert np.array_equal(
+                np.asarray(out["output"], np.float32), want)
+            st = json.loads(urllib.request.urlopen(
+                f"{server.url}/api/fleet/stats").read())
+            assert st["slo_ms"] == 250.0
+            pool = st["pools"]["model"]
+            assert pool["active_version"] == "v7"
+            assert pool["pending"] == 0
+            metrics = urllib.request.urlopen(
+                f"{server.url}/metrics").read().decode()
+            assert "dl4j_fleet_admitted_total" in metrics
+            # the persisted cache was saved during warmup
+            assert os.path.exists(os.path.join(cache, "manifest.json"))
+            front.assert_warm()
+        finally:
+            front.shutdown()
             server.stop()

@@ -37,10 +37,13 @@ DEFAULT_PATHS = (
     "deeplearning4j_tpu/ops",
     "deeplearning4j_tpu/optimize/solver.py",
     "deeplearning4j_tpu/models",
-    # parallel/ includes the serving engine (parallel/serving.py): its
-    # ONLY legitimate fetch is the completion-thread block/asarray pair
-    # (pragma'd there); a sync on the dispatch path would re-serialize
-    # the request pipeline the engine exists to overlap
+    # parallel/ includes the serving engine (parallel/serving.py), the
+    # fleet router (parallel/fleet.py) and the persisted AOT cache
+    # (parallel/aot_cache.py): the only legitimate fetches are the
+    # completion-thread block/asarray pair and the cache's one-time
+    # startup weights fingerprint (pragma'd there); a sync on the
+    # dispatch/admission path would re-serialize the request pipeline
+    # the engine exists to overlap
     "deeplearning4j_tpu/parallel",
     # the input-feeder hot path: a stray per-batch host sync here would
     # serialize ETL back onto the step loop the feeder exists to unblock
