@@ -113,10 +113,16 @@ class FlightRecorder:
 
     # ---- terminal events ------------------------------------------------
     def record_crash(self, model, reason: Optional[str] = None,
-                     exc: Optional[BaseException] = None
+                     exc: Optional[BaseException] = None,
+                     extra: Optional[Dict[str, Any]] = None
                      ) -> Optional[str]:
         """Write one post-mortem directory. Never raises — a crash
-        handler that crashes masks the original failure."""
+        handler that crashes masks the original failure.
+
+        ``extra`` is caller-supplied structured context (e.g. the
+        collective watchdog's dead-peer ranks and heartbeat ages) and
+        lands in a ``context.json`` section of the dump.
+        """
         try:
             if not self.enabled:
                 return None
@@ -127,7 +133,7 @@ class FlightRecorder:
                         len(self.dumps) >= self.max_dumps:
                     return None
                 self._dumped_reasons.add(reason)
-            path = self._write_dump(model, reason, exc)
+            path = self._write_dump(model, reason, exc, extra)
             if path is not None:
                 self.dumps.append(path)
                 log.error("flight recorder: %s — post-mortem dump "
@@ -146,7 +152,9 @@ class FlightRecorder:
 
     # ---- dump assembly --------------------------------------------------
     def _write_dump(self, model, reason: str,
-                    exc: Optional[BaseException]) -> Optional[str]:
+                    exc: Optional[BaseException],
+                    extra: Optional[Dict[str, Any]] = None
+                    ) -> Optional[str]:
         stamp = time.strftime("%Y%m%d_%H%M%S")
         path = os.path.join(self.dump_dir,
                             f"dump_{reason}_{stamp}_{os.getpid()}")
@@ -200,6 +208,8 @@ class FlightRecorder:
             trace = tracer.to_chrome_trace()
             trace["traceEvents"] = trace["traceEvents"][-500:]
             write("spans.json", trace)
+        if extra:
+            write("context.json", extra)
         write("environment.json", self._environment_section(model))
         self._write_report(path, model, reason, exc, sections)
         return path

@@ -15,6 +15,13 @@ Conditions (each tunable via environment):
 - ``dl4j_replica_divergence`` > ``DL4J_DIVERGENCE_THRESHOLD`` (default
   2.0, i.e. the per-replica grad-norm spread exceeds 2x its mean
   magnitude) — a data-parallel replica has drifted from the pack
+- any ``dl4j_elastic_peer_loss_total`` > 0 — the collective watchdog
+  declared a peer dead; this process (or a peer) wrote an emergency
+  checkpoint and a ``PEER_LOSS.json`` marker and should be relaunched
+- ``dl4j_elastic_staleness`` > ``DL4J_ELASTIC_STALENESS_LIMIT``
+  (default: the ASYNC_ELASTIC staleness bound, 3) — some worker has
+  been dropped from so many consecutive rounds its contributions can
+  no longer be merged
 """
 
 from __future__ import annotations
@@ -30,6 +37,7 @@ from deeplearning4j_tpu.observe.registry import (
 
 DEFAULT_RECOMPILE_STORM = 8
 DEFAULT_DIVERGENCE_THRESHOLD = 2.0
+DEFAULT_STALENESS_LIMIT = 3.0
 
 
 def _env_float(name: str, default: float) -> float:
@@ -76,6 +84,26 @@ def health_status(registry: Optional[MetricsRegistry] = None) -> Dict:
                 reasons.append(
                     f"replica_divergence: spread {v:g} > threshold "
                     f"{thresh:g} ({_labels_str(key)})")
+
+    m = r.get_metric("dl4j_elastic_peer_loss_total")
+    if m is not None:
+        for key, v in sorted(m.series().items()):
+            if v > 0:
+                reasons.append(
+                    f"peer_loss: {v:g} dead-peer event(s) — emergency "
+                    "checkpoint + PEER_LOSS marker written, relaunch to "
+                    f"resume ({_labels_str(key)})")
+
+    stale_limit = _env_float("DL4J_ELASTIC_STALENESS_LIMIT",
+                             DEFAULT_STALENESS_LIMIT)
+    m = r.get_metric("dl4j_elastic_staleness")
+    if m is not None:
+        for key, v in sorted(m.series().items()):
+            if v > stale_limit:
+                reasons.append(
+                    f"elastic_staleness: a worker has drifted {v:g} "
+                    f"rounds > limit {stale_limit:g} — its updates are "
+                    f"being discarded ({_labels_str(key)})")
 
     return {"status": "degraded" if reasons else "ok",
             "reasons": reasons}

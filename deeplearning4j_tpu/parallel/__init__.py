@@ -1,17 +1,26 @@
 """Multi-device training and serving (mesh, wrappers, serving engine,
-fleet router, persisted AOT executable cache)."""
+fleet router, persisted AOT executable cache, elastic fault
+tolerance)."""
 
+from deeplearning4j_tpu.parallel.cluster import (
+    PEER_LOSS_EXIT_CODE,
+    CollectiveWatchdog,
+)
 from deeplearning4j_tpu.parallel.fleet import FleetRouter, ShedError
 from deeplearning4j_tpu.parallel.inference import (
     InferenceMode,
     ParallelInference,
 )
 from deeplearning4j_tpu.parallel.serving import ServingEngine
+from deeplearning4j_tpu.parallel.wrapper import ElasticOptions
 
 __all__ = [
+    "CollectiveWatchdog",
+    "ElasticOptions",
     "FleetRouter",
     "InferenceMode",
     "ParallelInference",
+    "PEER_LOSS_EXIT_CODE",
     "ServingEngine",
     "ShedError",
 ]

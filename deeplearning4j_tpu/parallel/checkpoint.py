@@ -82,7 +82,8 @@ def _shard_starts(index, shape) -> list:
 
 
 def save_sharded(train_state: TrainState, directory: str,
-                 step: Optional[int] = None) -> str:
+                 step: Optional[int] = None,
+                 emergency: bool = False) -> str:
     """Write params/model_state/opt_state + iteration under ``directory``.
 
     Multihost-safe: every process writes ONLY its addressable shards (one
@@ -91,20 +92,41 @@ def save_sharded(train_state: TrainState, directory: str,
     one host checkpoints fine on a shared filesystem, orbax-style).
     Process 0 publishes the manifest + COMMITTED marker after a global
     barrier. Returns the checkpoint path (one subdir per step).
+
+    ``emergency=True`` is the peer-loss path: NO barriers (a dead peer
+    would hang them forever) — this process alone writes a complete,
+    committed checkpoint into ``step_XXXX.em{rank}``. Requires every
+    array leaf to be fully addressable from this process (true for
+    replicated data-parallel state); partially-sharded state raises
+    rather than committing a checkpoint with silent zero-filled holes.
     """
     it = int(train_state.iteration) if step is None else int(step)
-    path = os.path.join(directory, f"step_{it:010d}")
+    pidx = jax.process_index()
+    name = f"step_{it:010d}" + (f".em{pidx}" if emergency else "")
+    path = os.path.join(directory, name)
     if os.path.exists(os.path.join(path, "COMMITTED")):
         # this step is already durably saved; rewriting would open a
         # crash window that destroys the only committed copy
         return path
-    pidx = jax.process_index()
+    if emergency:
+        for group, tree in (("params", train_state.params),
+                            ("model_state", train_state.model_state),
+                            ("opt_state", train_state.opt_state)):
+            for k, v in _flatten(tree).items():
+                if isinstance(v, jax.Array) and \
+                        not v.is_fully_addressable:
+                    raise ValueError(
+                        f"emergency checkpoint: {group} leaf {k!r} is "
+                        "not fully addressable from this process — a "
+                        "solo save would commit a checkpoint with "
+                        "zero-filled holes")
     tmp = path + ".tmp"
-    if pidx == 0:
+    if pidx == 0 or emergency:
         if os.path.isdir(tmp):
             shutil.rmtree(tmp)
         os.makedirs(tmp)
-    _barrier(f"ckpt_mkdir_{it}")
+    if not emergency:
+        _barrier(f"ckpt_mkdir_{it}")
     manifest = {"format": 2, "iteration": it,
                 "process_count": jax.process_count(),
                 "groups": {}, "dtypes": {}, "shapes": {}}
@@ -125,9 +147,18 @@ def save_sharded(train_state: TrainState, directory: str,
             manifest["shapes"][f"{group}/{k}"] = list(np.shape(v))
             if isinstance(v, jax.Array) and hasattr(v, "addressable_shards"):
                 # replica_id==0 dedups replicated copies (exactly one
-                # process/device owns each piece of the global array)
+                # process/device owns each piece of the global array).
+                # Emergency saves can't rely on replica 0 being local
+                # (the dead peer may have owned it): dedup by shard
+                # index instead — full addressability was checked above.
+                seen = set()
                 for i, s in enumerate(v.addressable_shards):
-                    if s.replica_id != 0:
+                    if emergency:
+                        sig = str(s.index)
+                        if sig in seen:
+                            continue
+                        seen.add(sig)
+                    elif s.replica_id != 0:
                         continue
                     a = np.asarray(s.data)  # host-sync-ok: checkpoint save writes host shards by design
                     if is_bf16:
@@ -136,7 +167,7 @@ def save_sharded(train_state: TrainState, directory: str,
                     arrays[ent] = a
                     index[ent] = {"leaf": k, "dtype": str(a.dtype),
                                   "start": _shard_starts(s.index, v.shape)}
-            elif pidx == 0:  # plain numpy leaf: identical everywhere
+            elif pidx == 0 or emergency:  # plain numpy leaf: identical everywhere
                 a = np.asarray(v)  # host-sync-ok: checkpoint save writes host shards by design
                 if is_bf16:
                     a = a.view(np.uint16)
@@ -148,8 +179,12 @@ def save_sharded(train_state: TrainState, directory: str,
                   "w") as f:
             json.dump(index, f)
         manifest["groups"][group] = sorted(set(names))
-    _barrier(f"ckpt_written_{it}")
-    if pidx == 0:
+    if not emergency:
+        _barrier(f"ckpt_written_{it}")
+    if pidx == 0 or emergency:
+        if emergency:
+            manifest["process_count"] = 1
+            manifest["emergency"] = {"process_index": pidx}
         with open(os.path.join(tmp, "manifest.json"), "w") as f:
             json.dump(manifest, f)
         # completion marker inside the staged dir; the rename publishes it
@@ -159,7 +194,8 @@ def save_sharded(train_state: TrainState, directory: str,
         if os.path.isdir(path):  # uncommitted partial from a prior crash
             shutil.rmtree(path)
         os.rename(tmp, path)
-    _barrier(f"ckpt_commit_{it}")
+    if not emergency:
+        _barrier(f"ckpt_commit_{it}")
     return path
 
 
@@ -271,6 +307,19 @@ def mirror_opt_shardings(opt_state, params, param_shardings, replicated):
     return jax.tree_util.tree_unflatten(otree, out)
 
 
+def _unconsumed_msg(group: str, unconsumed) -> str:
+    """Warning text for checkpoint entries the model has no leaf for:
+    list up to 5, and say how many more there are ONLY when there are
+    more (the old text appended "..." even for a complete listing)."""
+    shown = sorted(unconsumed)[:5]
+    more = len(unconsumed) - len(shown)
+    msg = (f"checkpoint {group} entries not used by this model: "
+           f"{shown}")
+    if more > 0:
+        msg += f" (+{more} more)"
+    return msg
+
+
 def restore_sharded(model, path: str, mesh: Optional[Mesh] = None,
                     param_shardings=None) -> TrainState:
     """Restore a sharded checkpoint into ``model`` (already init()ed so
@@ -361,9 +410,8 @@ def restore_sharded(model, path: str, mesh: Optional[Mesh] = None,
                 leaves.append(leaf)  # non-array leaf (counts, None)
         unconsumed = stored_keys - consumed
         if unconsumed:
-            warnings.warn(
-                f"checkpoint {group} entries not used by this model: "
-                f"{sorted(unconsumed)[:5]}...", stacklevel=2)
+            warnings.warn(_unconsumed_msg(group, unconsumed),
+                          stacklevel=2)
         return jax.tree_util.tree_unflatten(treedef, leaves)
 
     params = rebuild("params", ts.params, param_sh)
@@ -375,6 +423,17 @@ def restore_sharded(model, path: str, mesh: Optional[Mesh] = None,
 
     new_ts = TrainState(params, mstate, opt, iteration)
     model.train_state = new_ts
+    try:
+        from deeplearning4j_tpu.observe.registry import default_registry
+        r = default_registry()
+        r.counter("dl4j_elastic_restore_total",
+                  "sharded-checkpoint restore events (elastic "
+                  "resume/reshape)").inc()
+        r.gauge("dl4j_elastic_restored_step",
+                "iteration of the most recent restored checkpoint"
+                ).set(manifest["iteration"])
+    except Exception:                          # pragma: no cover
+        pass  # observability must never fail a restore
     return new_ts
 
 
@@ -397,7 +456,16 @@ class ElasticTrainer:
 
     def _prune(self):
         """Retention (the CheckpointListener keep-last policy): drop the
-        oldest committed checkpoints beyond ``keep_last``."""
+        oldest committed checkpoints beyond ``keep_last``.
+
+        Multi-process: ONLY process 0 prunes, and only after the commit
+        barrier in ``save_sharded`` has completed (the caller's save
+        returned). Every process racing the same ``shutil.rmtree`` was a
+        crash window: a process could delete a victim another process
+        was still listing, and — worse — a slow process could observe a
+        half-deleted checkpoint as the 'latest' on resume."""
+        if jax.process_index() != 0:
+            return
         if self.keep_last is None or not os.path.isdir(self.directory):
             return
         steps = sorted(
@@ -448,6 +516,25 @@ class ElasticTrainer:
         m.add_listeners(saver)
         try:
             m.fit(iterator, epochs=epochs)
+        except BaseException:
+            # Best-effort emergency save: chaos resume then loses at
+            # most ``checkpoint_every`` steps, not the whole tail since
+            # the last periodic save. Never mask the original failure —
+            # the state may be garbage (donated buffers, poisoned
+            # arrays), in which case the save itself raises and is
+            # swallowed. Multi-process uses the barrier-free emergency
+            # path: a dead peer would hang the commit barrier forever.
+            try:
+                if m.train_state is not None:
+                    save_sharded(m.train_state, self.directory,
+                                 emergency=jax.process_count() > 1)
+                    self._prune()
+            except BaseException as save_err:
+                warnings.warn(
+                    "elastic trainer: emergency checkpoint failed "
+                    f"({type(save_err).__name__}: {save_err}); "
+                    "original exception propagates", stacklevel=2)
+            raise
         finally:
             m.listeners.remove(saver)
         if saver.last_saved != int(m.train_state.iteration):

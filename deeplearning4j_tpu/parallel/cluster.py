@@ -30,9 +30,13 @@ What survives from the reference design, faithfully:
 from __future__ import annotations
 
 import json
+import os
+import tempfile
+import threading
 import time
+from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Callable, Dict, List, Optional
 
 import jax
 import numpy as np
@@ -323,3 +327,336 @@ class DistributedNetwork:
 # Aliases mirroring the reference entry-point names.
 SparkDl4jMultiLayer = DistributedNetwork
 SparkComputationGraph = DistributedNetwork
+
+
+# --------------------------------------------------------------------------
+# Collective failure detection (heartbeat watchdog)
+# --------------------------------------------------------------------------
+
+#: Exit status a worker uses when it abandons a hung collective after
+#: detecting a dead peer. Distinct from ordinary crash codes so the
+#: relauncher can tell "peer died, resume me" from "I am the bug".
+PEER_LOSS_EXIT_CODE = 43
+
+#: Marker file the watchdog drops next to the checkpoints on peer loss.
+PEER_LOSS_MARKER = "PEER_LOSS.json"
+
+
+class CollectiveWatchdog:
+    """Heartbeat/deadline watchdog around the collective path.
+
+    XLA collectives have no per-op timeout on most backends: when a peer
+    process dies mid-all-reduce the survivors block in
+    ``block_until_ready`` forever (or until a transport-level error
+    surfaces minutes later). The reference stack sidesteps this with
+    Aeron session keepalives (PAPER.md §1 L5); here each process writes
+    a small heartbeat file (``hb_{rank}.json``: rank, wall time, host
+    iteration) to a shared directory every ``interval_s``, and a monitor
+    thread watches any collective the caller marks in-flight via
+    :meth:`guard`.
+
+    Classification — the whole point is telling a *dead* peer from a
+    *slow* one:
+
+    - in-flight past ``deadline_s`` AND some peer's heartbeat is older
+      than ``dead_after_s`` (or its file never appeared) -> **peer
+      loss**: best-effort emergency checkpoint
+      (:func:`~deeplearning4j_tpu.parallel.checkpoint.save_sharded`
+      with ``emergency=True`` — barrier-free, the dead peer can never
+      join a barrier again), a flight-recorder dump with reason
+      ``peer_loss`` (dead ranks + heartbeat ages in ``context.json``),
+      a resumable ``PEER_LOSS.json`` marker next to the checkpoints,
+      then ``os._exit(PEER_LOSS_EXIT_CODE)`` (unless
+      ``exit_on_loss=False``).
+    - in-flight past ``deadline_s`` but every peer is still beating ->
+      **straggler**: warn once, bump
+      ``dl4j_elastic_straggler_waits_total``, extend the deadline and
+      keep waiting — killing a job because one host hit a GC pause is
+      the failure mode this class exists to avoid.
+
+    The same classifier is exposed as :meth:`on_collective_error` for
+    backends whose transport *does* raise (gloo on CPU): the training
+    loop's except-path calls it to decide whether an exception is
+    peer loss (handled: marker + dump + emergency save, returns True)
+    or the caller's own bug (returns False).
+    """
+
+    def __init__(self, heartbeat_dir: str, *,
+                 rank: Optional[int] = None,
+                 n_ranks: Optional[int] = None,
+                 interval_s: float = 0.25,
+                 deadline_s: float = 60.0,
+                 dead_after_s: float = 2.0,
+                 model=None,
+                 checkpoint_dir: Optional[str] = None,
+                 on_peer_loss: Optional[Callable[[Dict], None]] = None,
+                 exit_on_loss: bool = True):
+        self.heartbeat_dir = heartbeat_dir
+        self.rank = jax.process_index() if rank is None else int(rank)
+        self.n_ranks = (jax.process_count() if n_ranks is None
+                        else int(n_ranks))
+        self.interval_s = float(interval_s)  # host-sync-ok: python config scalar
+        self.deadline_s = float(deadline_s)  # host-sync-ok: python config scalar
+        self.dead_after_s = float(dead_after_s)  # host-sync-ok: python config scalar
+        self.model = model
+        self.checkpoint_dir = checkpoint_dir
+        self.on_peer_loss = on_peer_loss
+        self.exit_on_loss = exit_on_loss
+        self.iteration = 0          # mirrored into the heartbeat file
+        self.straggler_waits = 0
+        self.peer_loss_event: Optional[Dict] = None
+        self._inflight_since: Optional[float] = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._beat_thread: Optional[threading.Thread] = None
+        self._mon_thread: Optional[threading.Thread] = None
+        self._warned_straggler = False
+        os.makedirs(heartbeat_dir, exist_ok=True)
+
+    # ---- lifecycle ------------------------------------------------------
+    def start(self) -> "CollectiveWatchdog":
+        if self._beat_thread is not None:
+            return self
+        self._stop.clear()
+        self._beat()                # first beat before anyone waits on us
+        self._beat_thread = threading.Thread(
+            target=self._beat_loop, name="dl4j-heartbeat", daemon=True)
+        self._mon_thread = threading.Thread(
+            target=self._monitor_loop, name="dl4j-collective-watchdog",
+            daemon=True)
+        self._beat_thread.start()
+        self._mon_thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        for t in (self._beat_thread, self._mon_thread):
+            if t is not None:
+                t.join(timeout=5 * self.interval_s + 1.0)
+        self._beat_thread = self._mon_thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc_info):
+        self.stop()
+
+    # ---- heartbeat writer ----------------------------------------------
+    def _beat_path(self, rank: int) -> str:
+        return os.path.join(self.heartbeat_dir, f"hb_{rank}.json")
+
+    def _beat(self):
+        payload = json.dumps({"rank": self.rank, "time": time.time(),
+                              "iteration": self.iteration})
+        try:
+            fd, tmp = tempfile.mkstemp(dir=self.heartbeat_dir,
+                                       prefix=f".hb_{self.rank}_")
+            with os.fdopen(fd, "w") as f:
+                f.write(payload)
+            os.replace(tmp, self._beat_path(self.rank))  # atomic
+        except OSError:
+            pass            # a full/slow disk must not kill the beat
+
+    def _beat_loop(self):
+        while not self._stop.wait(self.interval_s):
+            self._beat()
+
+    # ---- in-flight window ----------------------------------------------
+    @contextmanager
+    def guard(self, iteration: Optional[int] = None):
+        """Mark a blocking collective in-flight; the monitor thread only
+        arms while inside this window, so host-side work (ETL, logging)
+        can take arbitrarily long without tripping the deadline."""
+        if iteration is not None:
+            self.iteration = int(iteration)
+        with self._lock:
+            self._inflight_since = time.time()
+            self._warned_straggler = False
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._inflight_since = None
+
+    # ---- peer classification -------------------------------------------
+    def _peer_ages(self) -> Dict[int, Optional[float]]:
+        """Age of each peer's last heartbeat in seconds; None when the
+        file never appeared (process died before its first beat, or a
+        misconfigured heartbeat_dir)."""
+        now = time.time()
+        ages: Dict[int, Optional[float]] = {}
+        for r in range(self.n_ranks):
+            if r == self.rank:
+                continue
+            try:
+                with open(self._beat_path(r)) as f:
+                    ages[r] = now - float(json.load(f)["time"])  # host-sync-ok: heartbeat file timestamp
+            except (OSError, ValueError, KeyError):
+                ages[r] = None
+        return ages
+
+    def dead_peers(self) -> Dict[int, Optional[float]]:
+        """Peers whose heartbeat is stale past ``dead_after_s`` (or
+        missing entirely)."""
+        return {r: age for r, age in self._peer_ages().items()
+                if age is None or age > self.dead_after_s}
+
+    # ---- monitor --------------------------------------------------------
+    def _monitor_loop(self):
+        while not self._stop.wait(self.interval_s):
+            with self._lock:
+                since = self._inflight_since
+            if since is None:
+                continue
+            waited = time.time() - since
+            # A peer whose heartbeat WAS present and has gone stale is
+            # conclusively dead — classify after a couple of beats
+            # in-flight instead of waiting out the straggler deadline.
+            # External watchdogs race us here (the jax coordination
+            # service SIGABRTs survivors ~10 s after a peer dies), so
+            # late classification means no forensics at all. Peers with
+            # NO heartbeat file keep the full deadline: that can be a
+            # slow start, not a death.
+            if waited >= 2 * self.interval_s:
+                dead = {r: a for r, a in self.dead_peers().items()
+                        if a is not None}
+                if dead:
+                    self._handle_peer_loss(dead)
+                    return          # never reached when exit_on_loss
+            if waited < self.deadline_s:
+                continue
+            dead = self.dead_peers()
+            if dead:
+                self._handle_peer_loss(dead)
+                return              # never reached when exit_on_loss
+            # Everyone is alive -> straggler. Extend the window rather
+            # than spinning a warning per poll tick.
+            with self._lock:
+                self.straggler_waits += 1
+                self._inflight_since = time.time()
+                warn = not self._warned_straggler
+                self._warned_straggler = True
+            self._bump_counter("dl4j_elastic_straggler_waits_total")
+            if warn:
+                print(f"[rank {self.rank}] collective watchdog: "
+                      f"collective in-flight > {self.deadline_s:.1f}s "
+                      "but all peers are beating — straggler, "
+                      "extending deadline", flush=True)
+
+    # ---- peer-loss handling --------------------------------------------
+    def _handle_peer_loss(self, dead: Dict[int, Optional[float]],
+                          exc: Optional[BaseException] = None,
+                          exit_ok: bool = True):
+        event = {
+            "reason": "peer_loss",
+            "rank": self.rank,
+            "n_ranks": self.n_ranks,
+            "iteration": self.iteration,
+            "dead_ranks": sorted(dead),
+            "heartbeat_age_s": {str(r): a for r, a in dead.items()},
+            "time": time.time(),
+        }
+        self.peer_loss_event = event
+        self._bump_counter("dl4j_elastic_peer_loss_total")
+        ckpt = self._emergency_checkpoint()
+        if ckpt is not None:
+            event["emergency_checkpoint"] = ckpt
+        event["resume_from"] = self._latest_committed()
+        self._write_marker(event)
+        self._record_dump(event, exc)
+        if self.on_peer_loss is not None:
+            try:
+                self.on_peer_loss(event)
+            except Exception:
+                pass        # a hook bug must not mask the peer loss
+        will_exit = exit_ok and self.exit_on_loss
+        print(f"[rank {self.rank}] collective watchdog: peer(s) "
+              f"{sorted(dead)} lost (heartbeat stale) — emergency "
+              f"checkpoint {'written to ' + ckpt if ckpt else 'skipped'}"
+              + (f", exiting {PEER_LOSS_EXIT_CODE}" if will_exit
+                 else ""), flush=True)
+        if will_exit:
+            os._exit(PEER_LOSS_EXIT_CODE)
+
+    def _emergency_checkpoint(self) -> Optional[str]:
+        if self.checkpoint_dir is None or self.model is None:
+            return None
+        ts = getattr(self.model, "train_state", None)
+        if ts is None:
+            return None
+        try:
+            from deeplearning4j_tpu.parallel.checkpoint import \
+                save_sharded
+            return save_sharded(ts, self.checkpoint_dir, emergency=True)
+        except BaseException:
+            return None     # best-effort: state may be poisoned
+
+    def _latest_committed(self) -> Optional[str]:
+        if self.checkpoint_dir is None:
+            return None
+        try:
+            from deeplearning4j_tpu.parallel.checkpoint import \
+                latest_checkpoint
+            return latest_checkpoint(self.checkpoint_dir)
+        except Exception:
+            return None
+
+    def _write_marker(self, event: Dict):
+        where = self.checkpoint_dir or self.heartbeat_dir
+        try:
+            os.makedirs(where, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=where, prefix=".peer_loss_")
+            with os.fdopen(fd, "w") as f:
+                json.dump(event, f, indent=1)
+            os.replace(tmp, os.path.join(
+                where, f"{PEER_LOSS_MARKER}.{self.rank}"))
+        except OSError:
+            pass
+
+    def _record_dump(self, event: Dict,
+                     exc: Optional[BaseException] = None):
+        try:
+            rec = None
+            if self.model is not None and \
+                    hasattr(self.model, "_recorder"):
+                rec = self.model._recorder()
+            if rec is None:
+                from deeplearning4j_tpu.observe.flight_recorder import \
+                    default_flight_recorder
+                rec = default_flight_recorder()
+            if rec is not None:
+                rec.record_crash(self.model, reason="peer_loss",
+                                 exc=exc, extra=event)
+        except Exception:
+            pass
+
+    @staticmethod
+    def _bump_counter(name: str):
+        try:
+            from deeplearning4j_tpu.observe.registry import \
+                default_registry
+            default_registry().counter(
+                name, "collective watchdog events").inc()
+        except Exception:
+            pass
+
+    # ---- exception-path classifier -------------------------------------
+    def on_collective_error(self, exc: BaseException) -> bool:
+        """Classify an exception raised *out of* a collective (backends
+        like gloo on CPU fail fast instead of hanging). Returns True —
+        and runs the full peer-loss path (marker, dump, emergency save)
+        WITHOUT exiting, so the caller controls its exit code — when a
+        peer's heartbeat is stale; False when everyone is alive (the
+        error is the caller's own bug and should propagate untouched).
+        """
+        # Give a just-died peer's heartbeat time to go stale: the
+        # transport error typically races the dead_after_s horizon.
+        horizon = time.time() + self.dead_after_s + 2 * self.interval_s
+        while True:
+            dead = self.dead_peers()
+            if dead:
+                self._handle_peer_loss(dead, exc=exc, exit_ok=False)
+                return True
+            if time.time() >= horizon:
+                return False
+            time.sleep(self.interval_s)

@@ -61,6 +61,18 @@ def create_mesh(axes: Optional[Dict[str, int]] = None,
     return Mesh(dev_array, tuple(names))
 
 
+def create_3d_mesh(dp: int, tp: int, pp: int,
+                   devices: Optional[Sequence] = None) -> Mesh:
+    """dp×tp×pp mesh with the canonical axis order
+    ``(data, model, pipe)`` — the composed-parallelism layout the
+    PipelinedTransformerLM's ``param_shardings`` expects. Device order
+    is whatever ``devices`` (default: ``jax.devices()``) yields, so the
+    pipe axis varies fastest — stage-major placement, matching the
+    device-major stage stacking in ``restack_stages``."""
+    return create_mesh({DATA_AXIS: dp, MODEL_AXIS: tp, PIPE_AXIS: pp},
+                       devices)
+
+
 def local_device_count() -> int:
     return jax.local_device_count()
 
@@ -70,7 +82,18 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
                            process_id: Optional[int] = None):
     """Multi-host bring-up (replaces VoidParameterServer.init + Aeron mesh
     discovery, SharedTrainingWrapper.java:206-244). On TPU pods with the
-    standard runtime, argumentless initialize() autodetects everything."""
+    standard runtime, argumentless initialize() autodetects everything.
+
+    On the CPU backend, multiprocess computations need an explicit
+    collectives transport — without one every cross-process jit fails
+    with "Multiprocess computations aren't implemented on the CPU
+    backend". Select gloo before the backend client is created; the
+    knob is CPU-only so it is harmless on TPU/GPU, and absent on jax
+    versions where CPU collectives were on by default."""
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):
+        pass
     if coordinator_address is None:
         jax.distributed.initialize()
     else:

@@ -25,8 +25,10 @@ model's pure loss function.
 from __future__ import annotations
 
 import enum
+import os
 import time
-from typing import Optional
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -44,7 +46,56 @@ from deeplearning4j_tpu.parallel.mesh import (DATA_AXIS, compat_shard_map,
 class TrainingMode(enum.Enum):
     SHARED_GRADIENTS = "shared_gradients"   # sync allreduce DP
     AVERAGING = "averaging"                 # local SGD + periodic averaging
+    ASYNC_ELASTIC = "async_elastic"         # bounded-staleness PS rounds
     CUSTOM = "custom"
+
+
+def _default_divergence_threshold() -> float:
+    # mirrors observe/health.py: past this relative spread of per-replica
+    # grad norms the replicas are considered diverging
+    try:
+        return float(os.environ.get("DL4J_DIVERGENCE_THRESHOLD", "2.0"))  # host-sync-ok: env knob read once at options construction
+    except ValueError:
+        return 2.0
+
+
+@dataclass
+class ElasticOptions:
+    """Knobs for :attr:`TrainingMode.ASYNC_ELASTIC` — the
+    parameter-server analog of the reference's Aeron-backed
+    SharedTrainingMaster, recast as bounded-staleness rounds.
+
+    Each round every worker runs ``averaging_frequency`` local steps
+    from its last adopted server snapshot. Workers that report within
+    ``round_deadline_ms`` are *members* of the round: their parameter
+    deltas are merged into the server params, staleness-weighted by
+    ``staleness_decay ** (age - 1)`` where ``age`` counts the rounds
+    since the worker last adopted the server state. A contribution
+    older than ``staleness_bound`` rounds is discarded outright (merged
+    with weight 0 — the delta is against a hopelessly old base).
+    Members adopt the merged server state and reset their age; dropped
+    stragglers keep training on their divergent local params and age by
+    one.
+
+    The ``dl4j_replica_divergence`` gauge (relative spread of
+    per-worker grad norms) guards the whole scheme: past
+    ``divergence_threshold`` the next round is forced into a **hard
+    sync** — every worker contributes with weight 1 and every worker
+    adopts, collapsing the round to plain AVERAGING semantics.
+
+    ``straggler_policy`` exists for tests/benchmarks: a deterministic
+    ``(round_index, n_workers) -> per-worker delay in ms`` function
+    simulating slow workers. It MUST be deterministic in its arguments
+    — in multi-process runs every host evaluates it independently and
+    they must agree on the round's membership. None means nobody lags.
+    """
+    round_deadline_ms: float = 250.0
+    staleness_bound: int = 3
+    staleness_decay: float = 0.5
+    divergence_threshold: float = field(
+        default_factory=_default_divergence_threshold)
+    straggler_policy: Optional[
+        Callable[[int, int], Sequence[float]]] = None
 
 
 class ParallelWrapper:
@@ -62,13 +113,18 @@ class ParallelWrapper:
                  mode: TrainingMode = TrainingMode.SHARED_GRADIENTS,
                  averaging_frequency: int = 5,
                  average_updaters: bool = True,
-                 tensor_parallel: bool = False):
+                 tensor_parallel: bool = False,
+                 elastic_options: Optional[ElasticOptions] = None,
+                 watchdog=None):
         self.model = model
         self.mesh = mesh if mesh is not None else create_mesh()
         self.mode = mode
         self.averaging_frequency = averaging_frequency
         self.average_updaters = average_updaters
         self.tensor_parallel = tensor_parallel
+        self.elastic_options = (elastic_options if elastic_options
+                                is not None else ElasticOptions())
+        self._watchdog = watchdog
         if tensor_parallel and mode is not TrainingMode.SHARED_GRADIENTS:
             # AVERAGING runs per-device replicas inside shard_map — params
             # cannot simultaneously be model-axis sharded; silently
@@ -77,6 +133,7 @@ class ParallelWrapper:
                 f"tensor_parallel requires SHARED_GRADIENTS mode, not"
                 f" {mode.name}")
         self._step = None
+        self._elastic = None        # ASYNC_ELASTIC per-worker state
         if model.train_state is None:
             model.init()
 
@@ -89,6 +146,8 @@ class ParallelWrapper:
             self._avg_freq = 5
             self._avg_updaters = True
             self._tp = False
+            self._elastic_opts = None
+            self._wd = None
 
         def workers(self, n: int):
             devs = jax.devices()
@@ -122,10 +181,27 @@ class ParallelWrapper:
             self._tp = flag
             return self
 
+        def elastic_options(self, opts: "ElasticOptions"):
+            """Bounded-staleness knobs for ASYNC_ELASTIC mode."""
+            self._elastic_opts = opts
+            return self
+
+        def watchdog(self, wd):
+            """Attach a CollectiveWatchdog (parallel/cluster.py): the
+            wrapper marks every blocking collective wait in-flight via
+            ``wd.guard()`` and routes collective exceptions through
+            ``wd.on_collective_error`` so a dead peer produces an
+            emergency checkpoint + ``peer_loss`` forensics instead of a
+            hang or an unclassified crash."""
+            self._wd = wd
+            return self
+
         def build(self) -> "ParallelWrapper":
             return ParallelWrapper(self._model, self._mesh, self._mode,
                                    self._avg_freq, self._avg_updaters,
-                                   tensor_parallel=self._tp)
+                                   tensor_parallel=self._tp,
+                                   elastic_options=self._elastic_opts,
+                                   watchdog=self._wd)
 
     @staticmethod
     def builder(model) -> "ParallelWrapper.Builder":
@@ -305,6 +381,151 @@ class ParallelWrapper:
             check_vma=False)
         return jax.jit(wrapped, donate_argnums=(0,)), None
 
+    def _build_async_step(self):
+        """ASYNC_ELASTIC: bounded-staleness parameter-server rounds.
+
+        Server params live replicated in ``model.train_state``; each
+        worker additionally carries LOCAL params/updater-state plus the
+        server snapshot it last adopted (``base``), all stacked with a
+        leading worker dim sharded over the data axis. One round =
+        ``averaging_frequency`` local steps per worker (same scan as
+        AVERAGING), then a presence/staleness-weighted delta merge:
+
+            theta' = theta + sum_i(w_i * (local_i - base_i)) / sum_i(w_i)
+            w_i    = present_i * decay^(age_i)      (0 past the bound)
+
+        Members (present_i=1) adopt theta' and reset base; dropped
+        stragglers keep drifting on their local params. A hard-sync
+        round (``hard=1``) ignores staleness entirely: every worker
+        contributes with weight 1 and adopts — exactly an AVERAGING
+        round. With no stragglers every round IS a hard round
+        semantically (all ages 0, all weights 1), which is what makes
+        straggler-free ASYNC_ELASTIC converge like AVERAGING.
+
+        Presence/ages/hard are computed on the host (deterministic
+        straggler policy — see ElasticOptions) and fed as tiny arrays;
+        everything heavy stays on device.
+        """
+        loss_fn = self._loss_adapter()
+        tx = self.model._tx
+        mesh = self.mesh
+        k = self.averaging_frequency
+        avg_upd = self.average_updaters
+        opts = self.elastic_options
+        bound = float(opts.staleness_bound)  # host-sync-ok: trace-time config
+        decay = float(opts.staleness_decay)  # host-sync-ok: trace-time config
+        spec = self.model._telemetry_spec()
+        self._built_spec = spec
+        record_replicas = spec is not None and spec.replicas > 1
+
+        def unstack(t):
+            # inside shard_map each worker owns leading-dim slice [1, ...]
+            return jax.tree_util.tree_map(lambda a: a[0], t)
+
+        def restack(t):
+            return jax.tree_util.tree_map(lambda a: a[None], t)
+
+        def round_fn(ts: TrainState, local_p, local_o, base_p,
+                     feats, labels, fmask, lmask, rng,
+                     present, ages, hard):
+            widx = jax.lax.axis_index(DATA_AXIS)
+            lp, lo, bp = unstack(local_p), unstack(local_o), unstack(base_p)
+            rng_w = jax.random.fold_in(rng, widx)
+
+            def one(carry, xs):
+                lp, lo, ms = carry
+                f, l, fm, lm, i = xs
+                key = jax.random.fold_in(rng_w, i)
+
+                def lf(params):
+                    return loss_fn(params, ms, f, l, fm, lm, key,
+                                   ts.iteration + i)
+                (loss, new_ms), grads = jax.value_and_grad(
+                    lf, has_aux=True)(lp)
+                updates, new_lo = tx.update(grads, lo, lp)
+                new_lp = optax.apply_updates(lp, updates)
+                gnorm = jnp.sqrt(sum(
+                    (jnp.sum(jnp.square(g.astype(jnp.float32)))
+                     for g in jax.tree_util.tree_leaves(grads)),
+                    jnp.zeros((), jnp.float32)))
+                return (new_lp, new_lo, new_ms), (loss, gnorm)
+
+            (lp, lo, ms), (losses, gnorms) = jax.lax.scan(
+                one, (lp, lo, ts.model_state),
+                (feats, labels, fmask, lmask, jnp.arange(k)))
+
+            # ---- per-worker stats, gathered replicated ----------------
+            wl = jax.lax.all_gather(
+                jnp.mean(losses.astype(jnp.float32)), DATA_AXIS)
+            wg = jax.lax.all_gather(jnp.mean(gnorms), DATA_AXIS)
+            stats = jnp.stack([wl, wg], axis=-1)        # (n, 2)
+            buf = ts.telemetry
+            if record_replicas and has_buffer(buf):
+                buf = spec.record_replica(buf, values=stats,
+                                          iteration=ts.iteration + k - 1)
+
+            # ---- staleness-weighted delta merge -----------------------
+            pres = present[widx]
+            age1 = ages[widx] + 1.0     # rounds of drift incl. this one
+            w_soft = pres * jnp.where(age1 <= bound,
+                                      decay ** (age1 - 1.0), 0.0)
+            w = jnp.where(hard > 0, 1.0, w_soft)
+            den = jax.lax.psum(w, DATA_AXIS)
+            safe_den = jnp.maximum(den, 1e-12)
+
+            def merge_params(srv, l, b):
+                num = jax.lax.psum(w * (l - b), DATA_AXIS)
+                return jnp.where(den > 0, srv + num / safe_den, srv)
+            new_theta = jax.tree_util.tree_map(
+                merge_params, ts.params, lp, bp)
+
+            # model/opt state: adoption-weighted mean over members
+            # (integer leaves — updater step counts — keep the server's
+            # copy verbatim: a pmean would float-promote them)
+            a = jnp.where(hard > 0, 1.0, pres)
+            da = jax.lax.psum(a, DATA_AXIS)
+            safe_da = jnp.maximum(da, 1e-12)
+
+            def merge_state(srv, l):
+                if jnp.issubdtype(srv.dtype, jnp.integer):
+                    return srv
+                num = jax.lax.psum(a * l, DATA_AXIS)
+                return jnp.where(da > 0, num / safe_da, srv)
+            new_ms = jax.tree_util.tree_map(merge_state, ts.model_state,
+                                            ms)
+            new_opt = (jax.tree_util.tree_map(merge_state, ts.opt_state,
+                                              lo)
+                       if avg_upd else ts.opt_state)
+
+            # ---- worker adoption --------------------------------------
+            adopt = jnp.where(hard > 0, 1.0, pres)
+
+            def take(new, old):
+                if jnp.issubdtype(old.dtype, jnp.integer):
+                    return old          # counts advance locally
+                return jnp.where(adopt > 0, new, old)
+            lp2 = jax.tree_util.tree_map(take, new_theta, lp)
+            bp2 = jax.tree_util.tree_map(take, new_theta, bp)
+            lo2 = (jax.tree_util.tree_map(take, new_opt, lo)
+                   if avg_upd else lo)
+
+            new_ts = TrainState(new_theta, new_ms, new_opt,
+                                ts.iteration + k, buf)
+            loss_out = jax.lax.pmean(jnp.mean(losses), DATA_AXIS)
+            return (new_ts, restack(lp2), restack(lo2), restack(bp2),
+                    stats, loss_out)
+
+        pspec_batch = P(None, DATA_AXIS)
+        stacked = P(DATA_AXIS)          # leading worker dim
+        wrapped = compat_shard_map(
+            round_fn, mesh=mesh,
+            in_specs=(P(), stacked, stacked, stacked,
+                      pspec_batch, pspec_batch, pspec_batch, pspec_batch,
+                      P(), P(), P(), P()),
+            out_specs=(P(), stacked, stacked, stacked, P(), P()),
+            check_vma=False)
+        return jax.jit(wrapped, donate_argnums=(0, 1, 2, 3)), None
+
     # ---- fit ------------------------------------------------------------
     def fit(self, iterator: DataSetIterator, epochs: int = 1):
         """Train over the iterator.
@@ -322,7 +543,8 @@ class ParallelWrapper:
         value — the final batch legitimately may."""
         self._pending_uneven_per = None     # fresh fit: prior tail is fine
         if self.mode not in (TrainingMode.SHARED_GRADIENTS,
-                             TrainingMode.AVERAGING):
+                             TrainingMode.AVERAGING,
+                             TrainingMode.ASYNC_ELASTIC):
             raise ValueError(f"unsupported mode: {self.mode}")
         m = self.model
         # re-adopt the device iteration once per fit (BaseModel.fit does
@@ -332,8 +554,18 @@ class ParallelWrapper:
         try:
             if self.mode is TrainingMode.SHARED_GRADIENTS:
                 return self._fit_sync(iterator, epochs)
+            if self.mode is TrainingMode.ASYNC_ELASTIC:
+                return self._fit_async(iterator, epochs)
             return self._fit_averaging(iterator, epochs)
         except Exception as e:
+            # a collective that RAISES on peer death (fail-fast
+            # transports like gloo) goes through the watchdog's
+            # classifier first: peer loss gets the emergency checkpoint
+            # + peer_loss dump + resumable marker instead of a generic
+            # crash dump
+            wd = self._watchdog
+            if wd is not None and wd.on_collective_error(e):
+                raise
             # same crash-forensics contract as BaseModel.fit: dump, then
             # let the exception surface
             rec = m._recorder()
@@ -359,7 +591,8 @@ class ParallelWrapper:
         if tel is None or self.num_workers <= 1 or self.tensor_parallel:
             return
         metrics = (("loss", "grad_norm")
-                   if self.mode is TrainingMode.AVERAGING
+                   if self.mode in (TrainingMode.AVERAGING,
+                                    TrainingMode.ASYNC_ELASTIC)
                    else ("param_norm",))
         if tel.enable_replicas(self.num_workers, metrics):
             self._step = None
@@ -504,7 +737,8 @@ class ParallelWrapper:
                 and not isinstance(iterator, AsyncDataSetIterator)):
             source = AsyncDataSetIterator(iterator)
         tracer = get_tracer(self.model)
-        if self.mode is TrainingMode.AVERAGING:
+        if self.mode in (TrainingMode.AVERAGING,
+                         TrainingMode.ASYNC_ELASTIC):
             feeder = DeviceFeeder(
                 source, k_steps=self.averaging_frequency,
                 pad_ragged=False,
@@ -584,6 +818,7 @@ class ParallelWrapper:
             m.train_state = m._telemetry.ensure_buffer(m.train_state)
         m.train_state, loss = self._step(m.train_state, feats, labels,
                                          fmask, lmask, key)
+        self._guarded_wait(loss)
         # _post_step: host iteration mirror + telemetry flush
         # opportunity + flight-recorder poll — no per-batch
         # device sync (the old int(iteration) read was one)
@@ -602,6 +837,7 @@ class ParallelWrapper:
         m.train_state, loss = self._step(
             m.train_state, item.features, item.labels, item.features_mask,
             item.labels_mask, key)
+        self._guarded_wait(loss)
         it = m._post_step()
         for lst in m.listeners:
             lst.iteration_done(m, it, m.epoch_count, loss,
@@ -621,6 +857,22 @@ class ParallelWrapper:
     def _fit_averaging(self, iterator, epochs):
         if self._step is None:
             self._step, _ = self._build_averaging_step()
+        return self._fit_rounds(iterator, epochs,
+                                self._dispatch_averaging,
+                                self._run_averaging_round)
+
+    def _fit_async(self, iterator, epochs):
+        if self._step is None:
+            self._step, _ = self._build_async_step()
+        if self._elastic is None:
+            self._init_elastic_state()
+        return self._fit_rounds(iterator, epochs,
+                                self._dispatch_async,
+                                self._run_async_round)
+
+    def _fit_rounds(self, iterator, epochs, dispatch, run_round):
+        """Shared round loop for the k-local-steps modes (AVERAGING and
+        ASYNC_ELASTIC): group k batches per round, fed or legacy."""
         # (k, B, ...) rounds shard the batch dim over data; multi-host
         # staging assembles each process's slice (see _put_batch)
         self._avg_batch_sh = NamedSharding(self.mesh,
@@ -640,21 +892,22 @@ class ParallelWrapper:
                 for item in feeder:
                     if item.k == 0:
                         raise TypeError(
-                            "ParallelWrapper AVERAGING consumes DataSet "
-                            f"batches, got {type(item.raw).__name__}")
-                    self._dispatch_averaging(item)
+                            f"ParallelWrapper {self.mode.name} consumes "
+                            "DataSet batches, got "
+                            f"{type(item.raw).__name__}")
+                    dispatch(item)
             else:
                 pending = []
                 for batch in iterator:
                     pending.append(batch)
                     if len(pending) == k:
-                        self._run_averaging_round(pending)
+                        run_round(pending)
                         pending = []
                 if pending:
                     # pad the round reusing batches (keeps shapes static)
                     while len(pending) < k:
                         pending.append(pending[-1])
-                    self._run_averaging_round(pending)
+                    run_round(pending)
             source.reset()
             self._pending_uneven_per = None     # legal uneven tail round
             for lst in m.listeners:
@@ -705,6 +958,7 @@ class ParallelWrapper:
         m.train_state, loss = self._step(
             m.train_state, item.features, item.labels, item.features_mask,
             item.labels_mask, key)
+        self._guarded_wait(loss)
         it = m._post_step(item.k)
         for lst in m.listeners:
             lst.iteration_done(m, it, m.epoch_count, loss,
@@ -726,8 +980,168 @@ class ParallelWrapper:
             m.train_state = m._telemetry.ensure_buffer(m.train_state)
         m.train_state, loss = self._step(m.train_state, feats, labels,
                                          fmask, lmask, key)
+        self._guarded_wait(loss)
         # the round advanced the device iteration by k local steps
         it = m._post_step(len(batches))
         for lst in m.listeners:
             lst.iteration_done(m, it, m.epoch_count, loss, 0.0, n_real)
         m._last_loss = loss
+
+    # ---- ASYNC_ELASTIC --------------------------------------------------
+    def _init_elastic_state(self):
+        """Stack n copies of the server params/updater-state with a
+        leading worker dim sharded over the data axis — each worker's
+        local replica plus the base snapshot it diverges from."""
+        m = self.model
+        n = self.num_workers
+        stacked_sh = NamedSharding(self.mesh, P(DATA_AXIS))
+
+        stack_n = jax.jit(
+            lambda tree: jax.tree_util.tree_map(
+                lambda a: jnp.broadcast_to(a[None], (n,) + a.shape),
+                tree),
+            out_shardings=stacked_sh)
+        ts = m.train_state
+        self._elastic = {
+            "local_params": stack_n(ts.params),
+            "local_opt": stack_n(ts.opt_state),
+            "base_params": stack_n(ts.params),
+            "ages": np.zeros(n, dtype=np.float32),
+            "round": 0,
+            "hard_next": False,
+        }
+
+    def _dispatch_async(self, item):
+        self._async_round_core(item.features, item.labels,
+                               item.features_mask, item.labels_mask,
+                               item.k, item.queue_wait_ms,
+                               item.n_examples)
+
+    def _run_async_round(self, batches):
+        n_real = sum(b.num_examples() for b in batches)
+        arrays = self._avg_group_prepare(batches)
+        feats, labels, fmask, lmask = (
+            None if a is None else self._put_batch(
+                a, sharding=self._avg_batch_sh, batch_dim=1)
+            for a in arrays)
+        self._async_round_core(feats, labels, fmask, lmask,
+                               len(batches), 0.0, n_real)
+
+    def _async_round_core(self, feats, labels, fmask, lmask,
+                          k_real, wait_ms, n_real):
+        """One bounded-staleness round: host computes this round's
+        membership (deterministic straggler policy) and staleness ages,
+        the device step does the weighted merge, then the divergence
+        guard decides whether the NEXT round is a hard sync."""
+        m = self.model
+        el = self._elastic
+        opts = self.elastic_options
+        n = self.num_workers
+        round_idx = el["round"]
+        hard = bool(el["hard_next"])
+        if opts.straggler_policy is not None and not hard:
+            delays = np.asarray(  # host-sync-ok: host-side policy output, not device data
+                opts.straggler_policy(round_idx, n), dtype=np.float64)
+            if delays.shape != (n,):
+                raise ValueError(
+                    "straggler_policy must return one delay per worker "
+                    f"({n}), got shape {delays.shape}")
+            present = (delays <= opts.round_deadline_ms
+                       ).astype(np.float32)
+        else:
+            present = np.ones(n, dtype=np.float32)
+        ages = el["ages"]
+
+        m._rng, key = jax.random.split(m._rng)
+        if m._telemetry is not None:
+            m.train_state = m._telemetry.ensure_buffer(m.train_state)
+        (m.train_state, el["local_params"], el["local_opt"],
+         el["base_params"], stats, loss) = self._step(
+            m.train_state, el["local_params"], el["local_opt"],
+            el["base_params"], feats, labels, fmask, lmask, key,
+            jnp.asarray(present), jnp.asarray(ages),
+            jnp.float32(1.0 if hard else 0.0))
+        self._guarded_wait(loss)
+
+        # ---- host bookkeeping: ages, counters, divergence guard -------
+        age1 = ages + 1.0
+        adopted = np.ones(n, dtype=bool) if hard else present > 0
+        merged_stale = int(np.sum(adopted & (age1 > 1)
+                                  & (age1 <= opts.staleness_bound)))
+        discarded_stale = 0 if hard else int(
+            np.sum(adopted & (age1 > opts.staleness_bound)))
+        dropped = int(np.sum(~adopted))
+        el["ages"] = np.where(adopted, 0.0, age1).astype(np.float32)
+        el["round"] = round_idx + 1
+
+        # ONE small fetch per round (k steps amortize it) — the
+        # divergence guard needs the per-worker grad norms on host
+        arr = np.asarray(stats)  # host-sync-ok: per-round (k steps) fetch of the (n,2) stats row for the divergence guard
+        gnorms = arr[:, 1]
+        finite = gnorms[np.isfinite(gnorms)]
+        if finite.size < gnorms.size:
+            div = float("inf")      # host-sync-ok: a non-finite worker IS divergence
+        elif finite.size >= 2:
+            scale = float(np.mean(np.abs(finite)))  # host-sync-ok: np math on the already-fetched stats row
+            div = float((finite.max() - finite.min()) / (scale + 1e-12))  # host-sync-ok: np math on the already-fetched stats row
+        else:
+            div = 0.0
+        el["hard_next"] = div > opts.divergence_threshold
+        self._publish_elastic(n - dropped, dropped, merged_stale,
+                              discarded_stale, float(el["ages"].max()),  # host-sync-ok: host np bookkeeping
+                              div, hard)
+
+        it = m._post_step(k_real)
+        for lst in m.listeners:
+            lst.iteration_done(m, it, m.epoch_count, loss, wait_ms,
+                               n_real)
+        m._last_loss = loss
+
+    def _publish_elastic(self, members, dropped, merged_stale,
+                         discarded_stale, max_age, div, was_hard):
+        try:
+            from deeplearning4j_tpu.observe.registry import (
+                default_registry)
+            r = default_registry()
+        except Exception:
+            return
+        s = "elastic"
+        r.gauge("dl4j_elastic_round_members", "workers whose delta was "
+                "merged in the latest ASYNC_ELASTIC round").set(
+            members, session=s)
+        r.gauge("dl4j_elastic_staleness", "max rounds any worker has "
+                "drifted without adopting the server params").set(
+            max_age, session=s)
+        if dropped:
+            r.counter("dl4j_elastic_stragglers_dropped_total", "workers "
+                      "dropped from a round for missing the deadline"
+                      ).inc(dropped, session=s)
+        if merged_stale:
+            r.counter("dl4j_elastic_stale_merged_total", "late worker "
+                      "contributions merged staleness-weighted").inc(
+                merged_stale, session=s)
+        if discarded_stale:
+            r.counter("dl4j_elastic_stale_discarded_total", "late "
+                      "contributions discarded past the staleness bound"
+                      ).inc(discarded_stale, session=s)
+        if was_hard:
+            r.counter("dl4j_elastic_hard_syncs_total", "rounds forced "
+                      "into full synchronous averaging by the "
+                      "divergence guard").inc(session=s)
+        r.gauge("dl4j_replica_divergence", "relative max pairwise "
+                "spread of per-replica grad norms (0 = replicas in "
+                "sync)").set(div, session=s)
+
+    # ---- watchdog plumbing ----------------------------------------------
+    def _guarded_wait(self, x):
+        """Block on a dispatched step's output under the collective
+        watchdog's in-flight window, so a peer that died mid-collective
+        turns into a peer_loss exit instead of an infinite hang. No-op
+        without a watchdog — the usual async dispatch pipelining is then
+        preserved."""
+        wd = self._watchdog
+        if wd is None:
+            return
+        it = getattr(self.model, "_host_iteration", None)
+        with wd.guard(iteration=it if it is not None else 0):
+            jax.block_until_ready(x)

@@ -30,4 +30,8 @@ JAX_PLATFORMS=cpu python -m benchmarks.serving --smoke
 # (admission control + SLO shedding) — zero post-warmup recompiles,
 # shed rate < 100%, served p99 under the CPU-calibrated bound
 JAX_PLATFORMS=cpu python -m benchmarks.serving --smoke-fleet
+# elastic tier: with one straggler, bounded-staleness ASYNC_ELASTIC
+# sustains >=1.5x the SYNC round rate with divergence under the
+# hard-sync threshold, and reduces exactly to AVERAGING without one
+JAX_PLATFORMS=cpu python -m benchmarks.elastic --smoke
 exec python -m pytest tests/ -q "$@"

@@ -197,3 +197,81 @@ class TestBf16Checkpoint:
         np.testing.assert_allclose(np.asarray(m.output(x)),
                                    np.asarray(m2.output(x)),
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestRestoreFailureModes:
+    """restore_sharded beyond the happy path (ISSUE 7 satellite): the
+    legacy single-npz format 1, a checkpoint missing a leaf the model
+    needs, and the unconsumed-entries warning text."""
+
+    def _save(self, tmp_path):
+        m = MultiLayerNetwork(_conf()).init()
+        m.fit(IrisDataSetIterator(30))
+        return m, save_sharded(m.train_state, str(tmp_path))
+
+    def test_legacy_format1_roundtrip(self, tmp_path):
+        """A format-1 checkpoint (whole-leaf npz per group, no piece
+        index) restores through the same restore_sharded path."""
+        import json as _json
+
+        from deeplearning4j_tpu.parallel.checkpoint import _GroupReader
+
+        m, path = self._save(tmp_path)
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = _json.load(f)
+        # demote to format 1: assemble every leaf whole, write the
+        # single {group}.npz the old writer produced, drop the pieces
+        for group in ("params", "model_state", "opt_state"):
+            reader = _GroupReader(path, group, manifest)
+            whole = {k: np.asarray(reader.read(k)) for k in reader.keys()}
+            for f_ in os.listdir(path):
+                if f_.startswith(f"{group}.proc"):
+                    os.remove(os.path.join(path, f_))
+            np.savez(os.path.join(path, f"{group}.npz"), **whole)
+        manifest["format"] = 1
+        with open(os.path.join(path, "manifest.json"), "w") as f:
+            _json.dump(manifest, f)
+
+        m2 = MultiLayerNetwork(_conf(seed=99)).init()
+        restore_sharded(m2, path)
+        x = np.asarray(next(iter(IrisDataSetIterator(30))).features)
+        np.testing.assert_allclose(np.asarray(m.output(x)),
+                                   np.asarray(m2.output(x)), rtol=1e-6)
+        assert int(m2.train_state.iteration) == \
+            int(m.train_state.iteration)
+
+    def test_missing_leaf_raises_keyerror(self, tmp_path):
+        """A leaf the model expects but the checkpoint lacks must raise
+        (silently mixing restored and random weights is the failure the
+        reference's resume semantics forbid)."""
+        import json as _json
+
+        _, path = self._save(tmp_path)
+        victim = None
+        for f_ in sorted(os.listdir(path)):
+            if f_.startswith("params.proc") and f_.endswith(".idx.json"):
+                ip = os.path.join(path, f_)
+                with open(ip) as fh:
+                    idx = _json.load(fh)
+                if victim is None:
+                    victim = next(iter(idx.values()))["leaf"]
+                idx = {k: v for k, v in idx.items()
+                       if v["leaf"] != victim}
+                with open(ip, "w") as fh:
+                    _json.dump(idx, fh)
+        assert victim is not None
+        m2 = MultiLayerNetwork(_conf()).init()
+        with pytest.raises(KeyError, match="missing params leaf"):
+            restore_sharded(m2, path)
+
+    def test_unconsumed_msg_complete_listing(self):
+        from deeplearning4j_tpu.parallel.checkpoint import _unconsumed_msg
+        msg = _unconsumed_msg("params", {"a", "b", "c"})
+        assert "['a', 'b', 'c']" in msg
+        assert "more" not in msg and "..." not in msg
+
+    def test_unconsumed_msg_truncated_listing(self):
+        from deeplearning4j_tpu.parallel.checkpoint import _unconsumed_msg
+        keys = {f"k{i}" for i in range(9)}
+        msg = _unconsumed_msg("opt_state", keys)
+        assert "(+4 more)" in msg

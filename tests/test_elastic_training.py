@@ -1,0 +1,285 @@
+"""ASYNC_ELASTIC bounded-staleness training + the collective watchdog
+(ISSUE 7 tentpole): straggler-free equivalence to AVERAGING, straggler
+drop/merge/discard accounting, the divergence-guarded hard sync, and
+dead-peer vs slow-peer classification."""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.fetchers import IrisDataSetIterator
+from deeplearning4j_tpu.models.multi_layer_network import MultiLayerNetwork
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+from deeplearning4j_tpu.nn.layers.output import OutputLayer
+from deeplearning4j_tpu.ops.activations import Activation
+from deeplearning4j_tpu.optimize.updaters import Sgd
+from deeplearning4j_tpu.parallel.cluster import (
+    PEER_LOSS_EXIT_CODE, PEER_LOSS_MARKER, CollectiveWatchdog)
+from deeplearning4j_tpu.parallel.wrapper import (
+    ElasticOptions, ParallelWrapper, TrainingMode)
+
+
+@pytest.fixture(autouse=True)
+def _restore_default_registry():
+    """fit() and the watchdog publish dl4j_elastic_* series into the
+    process-global default registry; a peer-loss counter or a staleness
+    gauge left behind would flip /healthz to 503 for every LATER test
+    in the same pytest process. Snapshot the registry's series before
+    each test here and restore them after."""
+    from deeplearning4j_tpu.observe.registry import default_registry
+    r = default_registry()
+    with r._lock:
+        snap = {name: dict(m._series) for name, m in r._metrics.items()}
+    yield
+    with r._lock:
+        for name in list(r._metrics):
+            if name in snap:
+                r._metrics[name]._series = dict(snap[name])
+            else:
+                del r._metrics[name]
+
+
+def mlp_conf(seed=1, lr=0.05):
+    return (NeuralNetConfiguration.Builder().seed(seed).updater(Sgd(lr))
+            .list()
+            .layer(DenseLayer(n_out=16, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=3))
+            .set_input_type(InputType.feed_forward(4)).build())
+
+
+def _fit_elastic(policy=None, epochs=10, workers=4, k=4, opts=None):
+    model = MultiLayerNetwork(mlp_conf()).init()
+    if opts is None:
+        opts = ElasticOptions(straggler_policy=policy)
+    w = (ParallelWrapper.builder(model)
+         .training_mode(TrainingMode.ASYNC_ELASTIC)
+         .workers(workers).averaging_frequency(k)
+         .elastic_options(opts).build())
+    w.fit(IrisDataSetIterator(batch_size=32), epochs=epochs)
+    return model, w
+
+
+class TestAsyncElastic:
+    def test_straggler_free_matches_averaging(self):
+        """With every worker present every round, the delta merge
+        collapses to plain parameter averaging — the two modes must
+        converge to (numerically) the same params."""
+        ma = MultiLayerNetwork(mlp_conf()).init()
+        wa = (ParallelWrapper.builder(ma)
+              .training_mode(TrainingMode.AVERAGING)
+              .workers(4).averaging_frequency(4).build())
+        wa.fit(IrisDataSetIterator(batch_size=32), epochs=15)
+
+        me, _ = _fit_elastic(policy=None, epochs=15)
+        for a, b in zip(jax.tree_util.tree_leaves(ma.params),
+                        jax.tree_util.tree_leaves(me.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+        assert float(me._last_loss) == pytest.approx(
+            float(ma._last_loss), rel=1e-3)
+
+    def test_straggler_dropped_and_divergence_bounded(self):
+        """A worker missing every other round is dropped from those
+        rounds' averages; the run still converges and the divergence
+        gauge stays under the hard-sync threshold."""
+        def policy(rnd, n):
+            d = [0.0] * n
+            if rnd % 2 == 0:
+                d[1] = 1e9          # worker 1 misses even rounds
+            return d
+
+        model, w = _fit_elastic(policy=policy, epochs=10)
+        from deeplearning4j_tpu.observe.registry import default_registry
+        r = default_registry()
+        dropped = r.counter(
+            "dl4j_elastic_stragglers_dropped_total").get(session="elastic")
+        assert dropped and dropped > 0
+        merged = r.counter(
+            "dl4j_elastic_stale_merged_total").get(session="elastic")
+        assert merged and merged > 0   # it rejoins one round late
+        div = r.gauge("dl4j_replica_divergence").get(session="elastic")
+        assert div is not None and np.isfinite(div)
+        assert div < w.elastic_options.divergence_threshold
+        # training still works on the members
+        acc = model.evaluate(
+            IrisDataSetIterator(batch_size=150)).accuracy()
+        assert acc > 0.7, acc
+
+    def test_stale_contribution_discarded_past_bound(self):
+        """A worker absent longer than staleness_bound rounds has its
+        eventual contribution discarded (weight 0), not merged."""
+        def policy(rnd, n):
+            d = [0.0] * n
+            if 0 <= rnd < 5:
+                d[2] = 1e9          # worker 2 misses 5 straight rounds
+            return d
+
+        opts = ElasticOptions(staleness_bound=3, straggler_policy=policy)
+        _fit_elastic(epochs=8, opts=opts)
+        from deeplearning4j_tpu.observe.registry import default_registry
+        r = default_registry()
+        disc = r.counter(
+            "dl4j_elastic_stale_discarded_total").get(session="elastic")
+        assert disc and disc > 0
+
+    def test_divergence_forces_hard_sync(self):
+        """Divergence past the threshold forces the next round into a
+        hard sync: every worker adopts, staleness resets."""
+        def policy(rnd, n):
+            d = [0.0] * n
+            d[1] = 1e9              # worker 1 never reports...
+            return d
+
+        # threshold 0 => every round trips the guard => next round is
+        # hard => worker 1 is force-synced anyway => staleness stays 0
+        opts = ElasticOptions(divergence_threshold=0.0,
+                              straggler_policy=policy)
+        _fit_elastic(epochs=6, opts=opts)
+        from deeplearning4j_tpu.observe.registry import default_registry
+        r = default_registry()
+        hard = r.counter(
+            "dl4j_elastic_hard_syncs_total").get(session="elastic")
+        assert hard and hard > 0
+        # hard rounds adopt everyone: the perpetual straggler cannot
+        # accumulate unbounded staleness
+        stale = r.gauge("dl4j_elastic_staleness").get(session="elastic")
+        assert stale is not None and stale <= 2.0
+
+    def test_replicas_identical_after_straggler_free_round(self):
+        model, w = _fit_elastic(policy=None, epochs=1)
+        for leaf in jax.tree_util.tree_leaves(model.params):
+            assert leaf.sharding.is_fully_replicated
+
+    def test_bad_policy_shape_rejected(self):
+        with pytest.raises(ValueError, match="one delay per worker"):
+            _fit_elastic(policy=lambda rnd, n: [0.0], epochs=1)
+
+
+class TestCollectiveWatchdog:
+    def _beat_as(self, hb_dir, rank, stop):
+        def loop():
+            while not stop.wait(0.05):
+                with open(os.path.join(hb_dir, f"hb_{rank}.json"),
+                          "w") as f:
+                    json.dump({"rank": rank, "time": time.time(),
+                               "iteration": 0}, f)
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        return t
+
+    def test_dead_peer_detected(self, tmp_path):
+        """Peer 1 never heartbeats: an over-deadline collective is
+        classified as peer loss — marker + event, no exit (disarmed)."""
+        hb = str(tmp_path / "hb")
+        ck = str(tmp_path / "ckpt")
+        wd = CollectiveWatchdog(hb, rank=0, n_ranks=2, interval_s=0.05,
+                                deadline_s=0.3, dead_after_s=0.2,
+                                checkpoint_dir=ck, exit_on_loss=False)
+        events = []
+        wd.on_peer_loss = events.append
+        wd.start()
+        with wd.guard(iteration=7):
+            time.sleep(1.5)
+        wd.stop()
+        assert wd.peer_loss_event is not None
+        assert wd.peer_loss_event["dead_ranks"] == [1]
+        assert wd.peer_loss_event["iteration"] == 7
+        assert events and events[0]["reason"] == "peer_loss"
+        assert os.path.exists(
+            os.path.join(ck, f"{PEER_LOSS_MARKER}.0"))
+
+    def test_slow_peer_is_straggler_not_loss(self, tmp_path):
+        """A peer that keeps beating extends the deadline instead of
+        tripping peer loss — the dead-vs-slow distinction."""
+        hb = tmp_path / "hb"
+        hb.mkdir()
+        stop = threading.Event()
+        self._beat_as(str(hb), 1, stop)
+        wd = CollectiveWatchdog(str(hb), rank=0, n_ranks=2,
+                                interval_s=0.05, deadline_s=0.3,
+                                dead_after_s=10.0, exit_on_loss=False)
+        wd.start()
+        with wd.guard():
+            time.sleep(1.2)
+        stop.set()
+        wd.stop()
+        assert wd.peer_loss_event is None
+        assert wd.straggler_waits > 0
+
+    def test_collective_error_classified(self, tmp_path):
+        """An exception out of a collective with a stale peer heartbeat
+        is peer loss (True, full handling, no exit); with all peers
+        fresh it is the caller's own bug (False, untouched)."""
+        hb = tmp_path / "hb"
+        hb.mkdir()
+        # stale peer: one old heartbeat
+        with open(hb / "hb_1.json", "w") as f:
+            json.dump({"rank": 1, "time": time.time() - 60,
+                       "iteration": 3}, f)
+        wd = CollectiveWatchdog(str(hb), rank=0, n_ranks=2,
+                                interval_s=0.05, dead_after_s=0.5,
+                                checkpoint_dir=str(tmp_path / "ck"),
+                                exit_on_loss=True)   # must NOT exit here
+        assert wd.on_collective_error(RuntimeError("gloo reset")) is True
+        assert wd.peer_loss_event is not None
+
+        hb2 = tmp_path / "hb2"
+        hb2.mkdir()
+        stop = threading.Event()
+        self._beat_as(str(hb2), 1, stop)
+        time.sleep(0.2)
+        wd2 = CollectiveWatchdog(str(hb2), rank=0, n_ranks=2,
+                                 interval_s=0.05, dead_after_s=0.6,
+                                 exit_on_loss=False)
+        try:
+            assert wd2.on_collective_error(ValueError("my bug")) is False
+        finally:
+            stop.set()
+        assert wd2.peer_loss_event is None
+
+    def test_exit_code_constant(self):
+        # the relauncher contract: distinct, stable, not a shell code
+        assert PEER_LOSS_EXIT_CODE == 43
+
+    def test_peer_loss_counter_degrades_health(self, tmp_path):
+        from deeplearning4j_tpu.observe.health import health_status
+        from deeplearning4j_tpu.observe.registry import MetricsRegistry
+        r = MetricsRegistry()
+        r.counter("dl4j_elastic_peer_loss_total", "").inc(session="s")
+        st = health_status(r)
+        assert st["status"] == "degraded"
+        assert any("peer_loss" in x for x in st["reasons"])
+
+    def test_staleness_gauge_degrades_health(self):
+        from deeplearning4j_tpu.observe.health import health_status
+        from deeplearning4j_tpu.observe.registry import MetricsRegistry
+        r = MetricsRegistry()
+        r.gauge("dl4j_elastic_staleness", "").set(9.0, session="s")
+        st = health_status(r)
+        assert st["status"] == "degraded"
+        assert any("elastic_staleness" in x for x in st["reasons"])
+        r2 = MetricsRegistry()
+        r2.gauge("dl4j_elastic_staleness", "").set(1.0, session="s")
+        assert health_status(r2)["status"] == "ok"
+
+    def test_flight_recorder_context_section(self, tmp_path, monkeypatch):
+        """record_crash(extra=...) lands the watchdog's forensics in a
+        context.json section of the dump."""
+        monkeypatch.setenv("DL4J_CRASH_DUMP_DIR", str(tmp_path))
+        from deeplearning4j_tpu.observe.flight_recorder import (
+            FlightRecorder)
+        rec = FlightRecorder()
+        path = rec.record_crash(None, reason="peer_loss",
+                                extra={"dead_ranks": [2],
+                                       "iteration": 11})
+        assert path is not None
+        with open(os.path.join(path, "context.json")) as f:
+            ctx = json.load(f)
+        assert ctx["dead_ranks"] == [2] and ctx["iteration"] == 11

@@ -32,11 +32,11 @@ def _env():
 
 
 def _launch(rank, nprocs, port, outdir, devices_csv, die_rank=-1,
-            die_step=-1, epochs=3):
+            die_step=-1, epochs=3, mode="dp"):
     return subprocess.Popen(
         [sys.executable, WORKER, str(rank), str(nprocs), str(port),
          str(outdir), devices_csv, str(die_rank), str(die_step),
-         str(epochs)],
+         str(epochs), mode],
         env=_env(), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True)
 
@@ -125,5 +125,68 @@ def test_kill_worker_midfit_then_resume_smaller_mesh(tmp_path):
         # ...on the smaller mesh, and made progress past it
         assert r["n_devices"] == 2
         assert r["final_iteration"] > r["start_iteration"]
+    assert results[0]["param_sum"] == pytest.approx(
+        results[1]["param_sum"], rel=1e-6)
+
+
+@pytest.mark.slow
+def test_3d_chaos_kill_then_resume_reshaped_layout(tmp_path):
+    """The composed tentpole test: a dp×tp×pp PipelinedTransformerLM
+    job (2×2×1 over 2 processes × 2 devices) trains with COMMITTED
+    sharded checkpoints; rank 1 dies abruptly mid-fit. The survivor
+    classifies the failure through the CollectiveWatchdog (peer_loss
+    marker, not a hang past the collective deadline — the _join
+    timeout enforces that). Phase 2 relaunches on a RESHAPED 3D layout
+    (2×1×1 over 2 processes × 1 device), resumes from the last
+    COMMITTED step via restore_sharded's explicit param_shardings
+    path, and both survivors train to identical params (rel 1e-6)."""
+    port = _free_port()
+    procs = [_launch(r, 2, port, tmp_path, "2,2",
+                     die_rank=1, die_step=5, epochs=40, mode="3d:2x2x1")
+             for r in range(2)]
+    outs = _join(procs, timeout=600)
+    # the victim died with the abrupt-exit code
+    assert procs[1].returncode == 17, outs[1][-2000:]
+    # survivor: clean classified exit (0, wrote survivor json) or the
+    # watchdog's peer-loss exit — never a hang (join timeout above)
+    assert procs[0].returncode in (0, 43), outs[0][-3000:]
+
+    ckpt = tmp_path / "ckpt"
+    steps = sorted(d for d in os.listdir(ckpt) if d.startswith("step_")
+                   and (ckpt / d / "COMMITTED").exists())
+    assert steps, list(os.listdir(ckpt))
+    last_step = max(int(s.split("_")[1].split(".")[0]) for s in steps)
+    assert last_step >= 2
+
+    survivor = tmp_path / "survivor_0.json"
+    if survivor.exists():
+        with open(survivor) as f:
+            s = json.load(f)
+        assert s["detected"]
+        # the watchdog classified the raise as peer loss and dropped
+        # the forensics marker next to the checkpoints
+        assert s["peer_loss"], s
+        markers = [p for p in os.listdir(ckpt)
+                   if p.startswith("PEER_LOSS.json")]
+        assert markers, list(os.listdir(ckpt))
+
+    # ---- phase 2: same ckpt dir, RESHAPED layout 2×2×1 -> 2×1×1 ----
+    port2 = _free_port()
+    procs2 = [_launch(r, 2, port2, tmp_path, "1,1", epochs=6,
+                      mode="3d:2x1x1")
+              for r in range(2)]
+    outs2 = _join(procs2, timeout=600)
+    for p, out in zip(procs2, outs2):
+        assert p.returncode == 0, out[-3000:]
+    results = []
+    for r in range(2):
+        with open(tmp_path / f"result_{r}.json") as f:
+            results.append(json.load(f))
+    for r in results:
+        assert r["resumed"] is True
+        assert r["start_iteration"] == last_step
+        assert r["layout"] == [2, 1, 1]
+        assert r["final_iteration"] > r["start_iteration"]
+        assert r["loss"] is not None
     assert results[0]["param_sum"] == pytest.approx(
         results[1]["param_sum"], rel=1e-6)

@@ -51,6 +51,16 @@ DEFAULT_PATHS = (
     # serving's HTTP ingress: request decode / response encode are the
     # pragma'd host boundaries; anything else must stay async
     "deeplearning4j_tpu/ui/serving_module.py",
+    # the elastic straggler A/B: its only legitimate fetches are the
+    # once-per-arm wall-clock readouts after fit() returns (pragma'd);
+    # a per-round sync would hand the ASYNC arm the same barrier the
+    # benchmark exists to show it avoiding
+    "benchmarks/elastic.py",
+    # the chaos worker's training loop: every host read is either the
+    # watchdog-guarded per-step collective wait or a replicated-scalar
+    # bookkeeping read after it (pragma'd) — an unguarded fetch is a
+    # hang the watchdog cannot classify
+    "tests/multihost_chaos_worker.py",
 )
 
 PRAGMA = "# host-sync-ok"
