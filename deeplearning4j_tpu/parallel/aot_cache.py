@@ -238,7 +238,7 @@ class AOTExecutableCache:
                 # prime: the loading process compiles jit(exp.call), a
                 # different cache key than jit_fn's — pay it here, once,
                 # so the fresh process's compile is a disk hit
-                jax.jit(exp.call).lower(params, mstate, x).compile()
+                jax.jit(exp.call).lower(params, mstate, x).compile()  # graftlint: disable=recompile-hazard: one-time per-bucket cache-priming compile at save, not a live path
                 saved.append(int(bucket))
             except Exception:
                 continue        # that bucket warms live on load; rest save

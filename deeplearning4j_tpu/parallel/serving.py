@@ -285,7 +285,12 @@ class ServingEngine:
         self._count_lock = threading.Lock()
         self._queue: "queue.Queue[_Request]" = queue.Queue(
             maxsize=queue_limit)
-        self._carry: Optional[_Request] = None   # aggregation overflow
+        # aggregation overflow; shared between the dispatcher
+        # (_form_batch) and caller threads (_drain_queue via a shutdown
+        # race, stats) — every touch goes through _carry_lock or the
+        # parked request can be dropped or double-failed
+        self._carry: Optional[_Request] = None
+        self._carry_lock = threading.Lock()
         self._completions: "queue.Queue[Optional[_InFlight]]" = \
             queue.Queue(maxsize=self.depth)
         self._shutdown = threading.Event()
@@ -520,6 +525,10 @@ class ServingEngine:
         least-loaded dispatch key)."""
         return self._inflight_count
 
+    def _peek_carry(self) -> Optional[_Request]:
+        with self._carry_lock:
+            return self._carry
+
     def stats(self) -> Dict[str, Any]:
         """Point-in-time snapshot for the CLI / UI module."""
         q = self.latency.quantiles()
@@ -533,7 +542,7 @@ class ServingEngine:
             # a carried-over request parked in self._carry is waiting
             # for the dispatcher exactly like a queued one — count it
             "queue_depth": self._queue.qsize()
-            + (1 if self._carry is not None else 0),
+            + (1 if self._peek_carry() is not None else 0),
             "recompiles_after_warmup": self._post_warmup_compiles,
             "warmup_s": self.warmup_seconds,
             "latency_ms": {f"p{int(k * 100)}": v * 1e3
@@ -581,9 +590,9 @@ class ServingEngine:
 
     # ---- dispatcher ------------------------------------------------------
     def _form_batch(self) -> Optional[List[_Request]]:
-        if self._carry is not None:
+        with self._carry_lock:
             first, self._carry = self._carry, None
-        else:
+        if first is None:
             try:
                 first = self._queue.get(timeout=0.05)
             except queue.Empty:
@@ -620,7 +629,8 @@ class ServingEngine:
                 # doesn't fit: hold it for the next batch (the seed
                 # padded past the limit instead — minting an executable
                 # per overflow size)
-                self._carry = item
+                with self._carry_lock:
+                    self._carry = item
                 break
             batch.append(item)
             total += item.x.shape[0]
@@ -758,7 +768,8 @@ class ServingEngine:
 
     def _drain_queue(self):
         """Fail any still-queued request (post-shutdown)."""
-        carried, self._carry = self._carry, None
+        with self._carry_lock:
+            carried, self._carry = self._carry, None
         if carried is not None and not carried.future.done():
             carried.future.set_exception(
                 RuntimeError("ServingEngine shut down"))

@@ -10,9 +10,15 @@ if [[ "${1:-}" == "--examples" ]]; then
   shift
   exec python -m pytest tests/test_examples.py -q -m slow "$@"
 fi
-# lint tier: no hidden device syncs in the jit hot paths (ops/,
-# solver, models/, parallel/)
-python tools/check_host_sync.py
+# static-analysis tier (graftlint): host-sync patterns in the jit hot
+# paths PLUS donation-safety / recompile-hazard / thread-discipline /
+# tracer-leak over the whole package. Baseline-aware (the committed
+# triage backlog doesn't fail; any NEW finding does) with a hard 10 s
+# wall-clock budget so the pre-test tier stays fast.
+# tools/check_host_sync.py remains as a back-compat shim over the
+# host-sync rule.
+python -m tools.graftlint --baseline tools/graftlint/baseline.json \
+  --max-seconds 10
 # perf tier: compiled-in telemetry WITH in-step histograms (the flight
 # recorder's config) must stay within a 3% step-overhead budget on the
 # CPU path — the observe/ "one fetch per flush interval" claim

@@ -1,0 +1,729 @@
+"""graftlint analyzer tests: each rule catches its seeded bug shape
+(true positives — including the PR 1 use-after-donate and the PR 4
+reset-race patterns), the current in-repo code passes clean (false-
+positive guard), and pragmas/baselines round-trip.
+
+Fixture snippets are written to tmp_path; files outside the repo root
+run every rule regardless of its hot-path scoping, which is exactly
+what a fixture corpus wants.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tools.graftlint import (
+    REPO_ROOT, Finding, get_rules, load_baseline, scan, split_baselined,
+    write_baseline)
+from tools.graftlint.baseline import fingerprints
+from tools.graftlint.rules.host_sync import HostSyncRule
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+def lint(tmp_path: Path, source: str, rules=None, name="snippet.py"):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source), encoding="utf-8")
+    return scan([str(f)], rules=get_rules(rules) if rules else None)
+
+
+def rules_hit(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# donation-safety
+# ---------------------------------------------------------------------------
+
+PR1_SHAPE = """
+    import functools
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def skipgram_step(syn0, syn1, idx):
+        return syn0 * 2, syn1
+
+    def load_and_train(npz):
+        # the PR 1 test_nlp_cluster bug: numpy-owned buffers adopted
+        # zero-copy by the CPU backend, then donated -> use-after-free
+        syn0 = np.asarray(npz["syn0"])
+        syn1 = np.asarray(npz["syn1"])
+        syn0, syn1 = skipgram_step(syn0, syn1, 3)
+        return syn0, syn1
+"""
+
+
+class TestDonationSafety:
+    def test_pr1_numpy_into_donated_flagged(self, tmp_path):
+        findings = lint(tmp_path, PR1_SHAPE, rules=["donation-safety"])
+        assert len(findings) == 2           # syn0 AND syn1
+        assert all("numpy-backed" in f.message for f in findings)
+
+    def test_defensive_copy_is_clean(self, tmp_path):
+        findings = lint(tmp_path, """
+            import functools
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def step(syn0, idx):
+                return syn0 * 2
+
+            def ok(npz):
+                syn0 = jnp.array(np.asarray(npz["syn0"]))
+                syn0 = step(syn0, 3)
+                return syn0
+        """, rules=["donation-safety"])
+        assert findings == []
+
+    def test_use_after_donate_flagged(self, tmp_path):
+        findings = lint(tmp_path, """
+            import jax
+
+            def loss_fn(s, b):
+                return s, 0.0
+
+            step = jax.jit(loss_fn, donate_argnums=(0,))
+
+            def train(state, batch):
+                new_state, loss = step(state, batch)
+                return state, loss       # donated binding read again
+        """, rules=["donation-safety"])
+        assert len(findings) == 1
+        assert "was donated at line" in findings[0].message
+
+    def test_rebinding_is_clean(self, tmp_path):
+        findings = lint(tmp_path, """
+            import jax
+
+            def loss_fn(s, b):
+                return s, 0.0
+
+            step = jax.jit(loss_fn, donate_argnums=(0,))
+
+            def train(state, batches):
+                for b in batches:
+                    state, loss = step(state, b)
+                return state, loss
+        """, rules=["donation-safety"])
+        assert findings == []
+
+    def test_loop_without_rebinding_flagged(self, tmp_path):
+        findings = lint(tmp_path, """
+            import jax
+
+            def loss_fn(s, b):
+                return s, 0.0
+
+            step = jax.jit(loss_fn, donate_argnums=(0,))
+
+            def train(state, batches):
+                for b in batches:
+                    loss = step(state, b)   # iter N donates, N+1 reads
+                return loss
+        """, rules=["donation-safety"])
+        assert len(findings) == 1
+        assert "state" in findings[0].message
+
+    def test_branch_donation_merges_conservatively(self, tmp_path):
+        # donated on ONE branch only -> a later read must NOT be flagged
+        findings = lint(tmp_path, """
+            import jax
+
+            def f(s):
+                return s
+
+            step = jax.jit(f, donate_argnums=(0,))
+
+            def g(state, flag):
+                if flag:
+                    out = step(state)
+                else:
+                    out = state
+                return state        # alive on the else path
+        """, rules=["donation-safety"])
+        assert findings == []
+
+    def test_cross_module_donation_tracked(self, tmp_path):
+        (tmp_path / "kernels.py").write_text(textwrap.dedent("""
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def fused_step(w, grad):
+                return w
+        """), encoding="utf-8")
+        (tmp_path / "caller.py").write_text(textwrap.dedent("""
+            import numpy as np
+            from kernels import fused_step
+
+            def train(grad):
+                w = np.zeros((4, 4))
+                w2 = fused_step(w, grad)
+                return w2
+        """), encoding="utf-8")
+        # root=tmp_path so "from kernels import ..." resolves against
+        # the fixture corpus's own module namespace
+        findings = scan([str(tmp_path)], rules=get_rules(
+            ["donation-safety"]), root=tmp_path)
+        assert len(findings) == 1
+        assert findings[0].path.name == "caller.py"
+        assert "numpy-backed 'w'" in findings[0].message
+
+    def test_maker_convention_donates_arg0(self, tmp_path):
+        findings = lint(tmp_path, """
+            from deeplearning4j_tpu.optimize.solver import make_train_step
+
+            def train(model, state, batches):
+                step = make_train_step(model)
+                for b in batches:
+                    out = step(state, b)    # state never rebound
+                return out
+        """, rules=["donation-safety"])
+        assert len(findings) == 1
+
+    def test_non_literal_argnums_is_unknown_not_flagged(self, tmp_path):
+        findings = lint(tmp_path, """
+            import jax
+
+            def f(s):
+                return s
+
+            def build(donate):
+                step = jax.jit(f, donate_argnums=(0,) if donate else ())
+                return step
+
+            def train(state):
+                step = build(True)
+                out = step(state)
+                return state         # unknowable statically: no finding
+        """, rules=["donation-safety"])
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# recompile-hazard
+# ---------------------------------------------------------------------------
+
+class TestRecompileHazard:
+    def test_jit_in_loop_flagged(self, tmp_path):
+        findings = lint(tmp_path, """
+            import jax
+
+            def serve(batches):
+                outs = []
+                for b in batches:
+                    f = jax.jit(lambda a: a + 1)
+                    outs.append(f(b))
+                return outs
+        """, rules=["recompile-hazard"])
+        assert len(findings) == 1
+        assert "inside a loop" in findings[0].message
+
+    def test_immediately_invoked_jit_flagged(self, tmp_path):
+        findings = lint(tmp_path, """
+            import jax
+
+            def predict(model_fn, x):
+                return jax.jit(model_fn)(x)
+        """, rules=["recompile-hazard"])
+        assert len(findings) == 1
+        assert "invoked in one expression" in findings[0].message
+
+    def test_module_level_and_builder_jits_clean(self, tmp_path):
+        findings = lint(tmp_path, """
+            import functools
+            import jax
+
+            @jax.jit
+            def fwd(x):
+                return x * 2
+
+            class Engine:
+                def __init__(self, fn):
+                    self._jit = jax.jit(lambda p, x: fn(p, x))
+
+                def _build_train_step(self, fn):
+                    return jax.jit(fn, donate_argnums=(0,))
+
+            @functools.lru_cache(maxsize=4)
+            def _range_fn(devs):
+                return jax.jit(lambda a: (a.min(), a.max()))
+        """, rules=["recompile-hazard"])
+        assert findings == []
+
+    def test_data_dependent_static_arg_flagged(self, tmp_path):
+        findings = lint(tmp_path, """
+            import jax
+
+            def f(x, n):
+                return x[:n]
+
+            crop = jax.jit(f, static_argnums=(1,))
+
+            def serve(x, count):
+                return crop(x, int(count))     # runtime value as key
+        """, rules=["recompile-hazard"])
+        assert len(findings) == 1
+        assert "static_argnums" in findings[0].message
+
+    def test_shape_derived_static_arg_clean(self, tmp_path):
+        findings = lint(tmp_path, """
+            import jax
+
+            def f(x, n):
+                return x[:n]
+
+            crop = jax.jit(f, static_argnums=(1,))
+
+            def serve(x):
+                return crop(x, int(x.shape[0] // 2))  # trace-time math
+        """, rules=["recompile-hazard"])
+        assert findings == []
+
+    def test_traced_branch_flagged_static_param_exempt(self, tmp_path):
+        findings = lint(tmp_path, """
+            import functools
+            import jax
+
+            @jax.jit
+            def bad(x):
+                if x > 0:                     # traced-value branch
+                    return x
+                return -x
+
+            @functools.partial(jax.jit, static_argnums=(1,))
+            def ok(x, training):
+                if training:                  # static: branch is fine
+                    return x * 2
+                return x
+
+            @jax.jit
+            def shapes_ok(x):
+                if x.shape[0] > 1:            # trace-time constant
+                    return x[0]
+                return x
+        """, rules=["recompile-hazard"])
+        assert len(findings) == 1
+        assert "'x'" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# thread-discipline
+# ---------------------------------------------------------------------------
+
+PR4_SHAPE = """
+    import threading
+
+    class Prefetcher:
+        # the PR 4 AsyncDataSetIterator race shape: worker thread and
+        # caller both mutate shared state with no lock
+        def __init__(self, base):
+            self.base = base
+            self.depth = 0
+            self._worker = threading.Thread(target=self._run,
+                                            daemon=True)
+            self._worker.start()
+
+        def _run(self):
+            while True:
+                self.depth += 1      # thread side, no lock
+
+        def reset(self):
+            self.depth = 0           # caller side, no lock
+"""
+
+
+class TestThreadDiscipline:
+    def test_pr4_reset_race_flagged(self, tmp_path):
+        findings = lint(tmp_path, PR4_SHAPE, rules=["thread-discipline"])
+        assert len(findings) == 2          # both unlocked writers
+        assert all("self.depth" in f.snippet or "depth" in f.message
+                   for f in findings)
+
+    def test_common_lock_is_clean(self, tmp_path):
+        findings = lint(tmp_path, """
+            import threading
+
+            class Prefetcher:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.depth = 0
+                    t = threading.Thread(target=self._run, daemon=True)
+                    t.start()
+
+                def _run(self):
+                    while True:
+                        with self._lock:
+                            self.depth += 1
+
+                def reset(self):
+                    with self._lock:
+                        self.depth = 0
+        """, rules=["thread-discipline"])
+        assert findings == []
+
+    def test_thread_reached_via_self_call_chain(self, tmp_path):
+        # queue_depth-miss shape: the mutation happens two calls deep
+        # into the thread target
+        findings = lint(tmp_path, """
+            import threading
+
+            class Engine:
+                def __init__(self):
+                    self.carry = None
+                    t = threading.Thread(target=self._loop, daemon=True)
+                    t.start()
+
+                def _loop(self):
+                    while True:
+                        self._form()
+
+                def _form(self):
+                    self.carry = object()      # thread side (indirect)
+
+                def shutdown(self):
+                    self.carry = None          # caller side
+        """, rules=["thread-discipline"])
+        assert len(findings) == 2
+
+    def test_closure_thread_target(self, tmp_path):
+        findings = lint(tmp_path, """
+            import threading
+
+            class Listener:
+                def __init__(self):
+                    self.done = False
+
+                def start(self):
+                    def run():
+                        self.done = True       # thread side
+                    threading.Thread(target=run, daemon=True).start()
+
+                def cancel(self):
+                    self.done = True           # caller side
+        """, rules=["thread-discipline"])
+        assert len(findings) == 2
+
+    def test_no_threads_no_findings(self, tmp_path):
+        findings = lint(tmp_path, """
+            class Plain:
+                def a(self):
+                    self.x = 1
+
+                def b(self):
+                    self.x = 2
+        """, rules=["thread-discipline"])
+        assert findings == []
+
+    def test_lock_order_inversion_flagged(self, tmp_path):
+        findings = lint(tmp_path, """
+            import threading
+
+            class Broker:
+                def __init__(self):
+                    self._alock = threading.Lock()
+                    self._block = threading.Lock()
+                    threading.Thread(target=self.pump).start()
+
+                def pump(self):
+                    with self._alock:
+                        with self._block:
+                            pass
+
+                def drain(self):
+                    with self._block:
+                        with self._alock:
+                            pass
+        """, rules=["thread-discipline"])
+        inversions = [f for f in findings
+                      if "lock-order inversion" in f.message]
+        assert len(inversions) == 1
+
+
+# ---------------------------------------------------------------------------
+# tracer-leak
+# ---------------------------------------------------------------------------
+
+class TestTracerLeak:
+    def test_self_store_in_jitted_method_flagged(self, tmp_path):
+        findings = lint(tmp_path, """
+            import functools
+            import jax
+
+            class Model:
+                @functools.partial(jax.jit, donate_argnums=(0,))
+                def step(self, x):
+                    self.last_loss = x.sum()    # leaks the tracer
+                    return x * 2
+        """, rules=["tracer-leak"])
+        assert len(findings) == 1
+        assert "self.last_loss" in findings[0].message
+
+    def test_global_and_closure_stores_flagged(self, tmp_path):
+        findings = lint(tmp_path, """
+            import jax
+
+            STATS = {}
+            _count = 0
+
+            def make(fn):
+                cache = {}
+
+                def traced(x):
+                    global _count
+                    _count = _count + 1         # global store
+                    STATS["x"] = x              # closure subscript
+                    return fn(x)
+                return jax.jit(traced)
+        """, rules=["tracer-leak"])
+        assert len(findings) == 2
+
+    def test_pure_jitted_fn_clean(self, tmp_path):
+        findings = lint(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def step(state, batch):
+                out = {}
+                out["loss"] = jnp.sum(batch)    # local dict: fine
+                acc = 0.0
+                for i in range(3):
+                    acc = acc + i               # local rebind: fine
+                return state, out["loss"] + acc
+        """, rules=["tracer-leak"])
+        assert findings == []
+
+    def test_shard_mapped_fn_covered(self, tmp_path):
+        findings = lint(tmp_path, """
+            from jax.experimental.shard_map import shard_map
+
+            DIAG = []
+
+            def per_replica(x):
+                DIAG[0] = x          # closure store under trace
+                return x
+
+            def build(mesh, specs):
+                return shard_map(per_replica, mesh, in_specs=specs,
+                                 out_specs=specs)
+        """, rules=["tracer-leak"])
+        assert len(findings) == 1
+
+
+# ---------------------------------------------------------------------------
+# host-sync (ported rule + alias pragma)
+# ---------------------------------------------------------------------------
+
+class TestHostSync:
+    def test_patterns_flagged_and_alias_pragma_suppresses(self,
+                                                          tmp_path):
+        findings = lint(tmp_path, """
+            import numpy as np
+
+            def hot(loss, arr):
+                a = float(loss)
+                b = np.asarray(arr)
+                c = loss.item()     # host-sync-ok: test constant
+                return a, b, c
+        """, rules=["host-sync"])
+        assert len(findings) == 2
+        assert {f.line for f in findings} == {5, 6}
+
+    def test_comment_prose_not_flagged(self, tmp_path):
+        findings = lint(tmp_path, """
+            def hot(x):
+                # never call float(x) here
+                return x
+        """, rules=["host-sync"])
+        assert findings == []
+
+    def test_hot_path_scoping_inside_repo(self):
+        # the rule only applies to the curated hot paths: a ui/ module
+        # (off the hot-path list, full of legitimate host reads) must
+        # be skipped entirely
+        rule = HostSyncRule()
+        findings = scan(["deeplearning4j_tpu/ui/stats.py"],
+                        rules=[rule])
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# pragmas, baseline, reports, CLI
+# ---------------------------------------------------------------------------
+
+class TestPragmasAndBaseline:
+    def test_graftlint_pragma_suppresses_named_rule(self, tmp_path):
+        findings = lint(tmp_path, """
+            import jax
+
+            def serve(batches):
+                for b in batches:
+                    f = jax.jit(lambda a: a + 1)  # graftlint: disable=recompile-hazard: test
+                    yield f(b)
+        """, rules=["recompile-hazard"])
+        assert findings == []
+
+    def test_bare_disable_suppresses_all_rules(self, tmp_path):
+        findings = lint(tmp_path, """
+            import numpy as np
+
+            def hot(loss):
+                return float(loss)  # graftlint: disable
+        """, rules=["host-sync"])
+        assert findings == []
+
+    def test_pragma_for_other_rule_does_not_suppress(self, tmp_path):
+        findings = lint(tmp_path, """
+            def hot(loss):
+                return float(loss)  # graftlint: disable=tracer-leak
+        """, rules=["host-sync"])
+        assert len(findings) == 1
+
+    def test_baseline_round_trip(self, tmp_path):
+        src = tmp_path / "mod.py"
+        src.write_text(textwrap.dedent("""
+            def hot(loss):
+                a = float(loss)
+                return a
+        """), encoding="utf-8")
+        findings = scan([str(src)], rules=get_rules(["host-sync"]))
+        assert len(findings) == 1
+
+        bl_path = tmp_path / "baseline.json"
+        write_baseline(findings, bl_path)
+        baseline = load_baseline(bl_path)
+        new, old, stale = split_baselined(findings, baseline)
+        assert new == [] and len(old) == 1 and stale == []
+
+        # a NEW finding is not masked by the committed baseline
+        src.write_text(textwrap.dedent("""
+            def hot(loss, x):
+                a = float(loss)
+                b = x.item()
+                return a, b
+        """), encoding="utf-8")
+        findings2 = scan([str(src)], rules=get_rules(["host-sync"]))
+        new2, old2, _ = split_baselined(findings2, baseline)
+        assert len(old2) == 1 and len(new2) == 1
+        assert ".item()" in new2[0].snippet
+
+    def test_fingerprint_survives_line_moves(self, tmp_path):
+        src = tmp_path / "mod.py"
+        src.write_text("def hot(loss):\n    return float(loss)\n",
+                       encoding="utf-8")
+        [f1] = scan([str(src)], rules=get_rules(["host-sync"]))
+        src.write_text("import os\n\n\ndef hot(loss):\n"
+                       "    return float(loss)\n", encoding="utf-8")
+        [f2] = scan([str(src)], rules=get_rules(["host-sync"]))
+        assert f1.line != f2.line
+        assert fingerprints([f1]) == fingerprints([f2])
+
+    def test_identical_lines_fingerprint_distinctly(self, tmp_path):
+        src = tmp_path / "mod.py"
+        src.write_text("def hot(a, b):\n"
+                       "    x = float(a)\n"
+                       "    x = float(a)\n"
+                       "    return x\n", encoding="utf-8")
+        findings = scan([str(src)], rules=get_rules(["host-sync"]))
+        assert len(findings) == 2
+        fps = fingerprints(findings)
+        assert len(set(fps)) == 2
+
+
+class TestCLI:
+    def run_cli(self, *args):
+        return subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", *args],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+
+    def test_json_format_and_exit_codes(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("def hot(loss):\n    return float(loss)\n",
+                       encoding="utf-8")
+        r = self.run_cli(str(bad), "--format", "json")
+        assert r.returncode == 1
+        doc = json.loads(r.stdout)
+        assert doc["summary"]["new"] == 1
+        assert doc["findings"][0]["rule"] == "host-sync"
+        assert doc["findings"][0]["fingerprint"]
+
+        (tmp_path / "ok.py").write_text("x = 1\n", encoding="utf-8")
+        r2 = self.run_cli(str(tmp_path / "ok.py"))
+        assert r2.returncode == 0
+
+    def test_write_then_check_baseline(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("def hot(loss):\n    return float(loss)\n",
+                       encoding="utf-8")
+        bl = tmp_path / "bl.json"
+        r = self.run_cli(str(bad), "--baseline", str(bl),
+                         "--write-baseline")
+        assert r.returncode == 0, r.stderr
+        r2 = self.run_cli(str(bad), "--baseline", str(bl))
+        assert r2.returncode == 0, r2.stderr
+        assert "1 baselined" in r2.stderr
+
+    def test_list_rules(self):
+        r = self.run_cli("--list-rules")
+        assert r.returncode == 0
+        for rule in ("host-sync", "donation-safety", "recompile-hazard",
+                     "thread-discipline", "tracer-leak"):
+            assert rule in r.stdout
+
+    def test_unknown_rule_is_usage_error(self):
+        r = self.run_cli("--rules", "no-such-rule")
+        assert r.returncode == 2
+
+    def test_shim_cli(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("def hot(loss):\n    return float(loss)\n",
+                       encoding="utf-8")
+        r = subprocess.run(
+            [sys.executable, "tools/check_host_sync.py", "--paths",
+             str(bad)], cwd=REPO_ROOT, capture_output=True, text=True,
+            timeout=120)
+        assert r.returncode == 1
+        assert "float() blocks" in r.stderr
+        r2 = subprocess.run(
+            [sys.executable, "tools/check_host_sync.py"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+        assert r2.returncode == 0, r2.stderr + r2.stdout
+
+
+# ---------------------------------------------------------------------------
+# the false-positive guard: the repo's own (fixed) code passes clean
+# ---------------------------------------------------------------------------
+
+class TestTreeIsClean:
+    def test_full_default_scan_is_baseline_clean(self):
+        findings = scan(["deeplearning4j_tpu", "benchmarks/elastic.py",
+                         "tests/multihost_chaos_worker.py"])
+        baseline = load_baseline(
+            REPO_ROOT / "tools" / "graftlint" / "baseline.json")
+        new, _old, _stale = split_baselined(findings, baseline)
+        assert new == [], "\n".join(
+            f"{f.rel}:{f.line}: [{f.rule}] {f.message}" for f in new)
+
+    def test_fixed_pr1_and_pr4_sites_stay_clean(self):
+        # the exact modules whose historical bugs seeded the rules
+        findings = scan([
+            "deeplearning4j_tpu/nlp/cluster.py",       # PR 1 fix site
+            "deeplearning4j_tpu/nlp/glove.py",
+            "deeplearning4j_tpu/nlp/sequence_vectors.py",
+            "deeplearning4j_tpu/datasets/iterators.py",  # PR 4 fix site
+            "deeplearning4j_tpu/parallel/serving.py",    # PR 6 + carry
+        ])
+        assert findings == [], "\n".join(
+            f"{f.rel}:{f.line}: [{f.rule}] {f.message}"
+            for f in findings)

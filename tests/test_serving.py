@@ -247,6 +247,52 @@ class TestServingEngine:
                 eng._carry = None
             assert eng.stats()["queue_depth"] == 0
 
+    def test_carried_chunk_claimed_exactly_once_under_race(self):
+        """Regression (PR 8, found by graftlint thread-discipline):
+        ``self._carry`` is shared between the dispatcher thread
+        (``_form_batch`` parks/reclaims overflow chunks) and caller
+        threads (``_drain_queue`` on the submit/shutdown race,
+        ``stats``). The original unlocked read-then-clear let two
+        racing consumers both take the same parked request (waiter
+        failed AND re-dispatched) or lose the park (waiter hangs).
+        Hammer both consumers over a parked sentinel: every round,
+        exactly one side may claim it."""
+        from concurrent.futures import Future
+
+        from deeplearning4j_tpu.parallel.serving import _Request
+
+        m = _tiny_model()
+        eng = _engine(m, timeout_ms=1.0)
+        eng.shutdown()          # stop the real dispatcher; we drive
+        for _ in range(40):     # _form_batch/_drain_queue by hand
+            req = _Request(x=np.zeros((1, N_IN), np.float32),
+                           future=Future(),
+                           t_enqueue=time.perf_counter())
+            with eng._carry_lock:
+                eng._carry = req
+            claims = []
+            barrier = threading.Barrier(2)
+
+            def form():
+                barrier.wait()
+                batch = eng._form_batch()
+                if batch and batch[0] is req:
+                    claims.append("dispatcher")
+
+            def drain():
+                barrier.wait()
+                eng._drain_queue()
+
+            threads = [threading.Thread(target=form),
+                       threading.Thread(target=drain)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if req.future.done() and req.future.exception() is not None:
+                claims.append("drain")
+            assert len(claims) == 1, claims
+
     def test_bf16_params(self):
         m = _tiny_model()
         rng = np.random.default_rng(5)

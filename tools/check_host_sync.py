@@ -1,124 +1,56 @@
 #!/usr/bin/env python
 """Static checker for host-sync patterns in jit-traced hot paths.
 
-``float(x)``, ``np.asarray(x)`` and ``x.item()`` on a traced jax value
-force a device->host transfer (and, inside a jit trace, a
-ConcretizationTypeError at best or a silent per-step sync at worst).
-The telemetry design (observe/) exists so the train loop does exactly
-ONE device fetch per flush interval; a stray ``float(loss)`` in ops/
-or the solver undoes that.
+Back-compat CLI shim: the checker itself now lives in
+``tools/graftlint`` as the ``host-sync`` rule (one of five — see
+``python -m tools.graftlint --list-rules``). This entry point keeps the
+historical interface working unchanged:
 
-This tool greps the hot-path modules -- ``deeplearning4j_tpu/ops/`` and
-``deeplearning4j_tpu/optimize/solver.py`` -- for those patterns and
-fails if any line matches without an explicit ``# host-sync-ok``
-pragma. Trace-time constants (Python ints/floats computed from shapes
-or env vars before tracing) are legitimate: annotate them with the
-pragma plus a short reason.
+- ``python tools/check_host_sync.py`` checks the same default hot-path
+  set (now ``tools.graftlint.rules.host_sync.HOT_PATHS``),
+- ``--paths a.py dir/`` overrides it,
+- ``# host-sync-ok`` pragmas keep suppressing (graftlint treats the
+  pragma as an alias of ``# graftlint: disable=host-sync``),
+- exit status 0 when clean, 1 when unallowed hits are found.
 
-Usage:
-    python tools/check_host_sync.py            # check the default paths
-    python tools/check_host_sync.py --paths a.py dir/   # explicit set
-
-Exit status: 0 when clean, 1 when unallowed hits are found.
+New code should prefer ``python -m tools.graftlint`` (runtests.sh
+already does), which adds the donation-safety / recompile-hazard /
+thread-discipline / tracer-leak rules and the baseline workflow.
 """
 
 from __future__ import annotations
 
 import argparse
-import re
 import sys
 from pathlib import Path
 
+# runnable both as `python tools/check_host_sync.py` (script: repo root
+# not on sys.path) and as `python -m tools.check_host_sync`
 REPO_ROOT = Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
 
-# hot paths: everything here runs inside (or builds) jitted step
-# functions, where a hidden sync is a per-iteration cost
-DEFAULT_PATHS = (
-    "deeplearning4j_tpu/ops",
-    "deeplearning4j_tpu/optimize/solver.py",
-    "deeplearning4j_tpu/models",
-    # parallel/ includes the serving engine (parallel/serving.py), the
-    # fleet router (parallel/fleet.py) and the persisted AOT cache
-    # (parallel/aot_cache.py): the only legitimate fetches are the
-    # completion-thread block/asarray pair and the cache's one-time
-    # startup weights fingerprint (pragma'd there); a sync on the
-    # dispatch/admission path would re-serialize the request pipeline
-    # the engine exists to overlap
-    "deeplearning4j_tpu/parallel",
-    # the input-feeder hot path: a stray per-batch host sync here would
-    # serialize ETL back onto the step loop the feeder exists to unblock
-    "deeplearning4j_tpu/datasets",
-    # serving's HTTP ingress: request decode / response encode are the
-    # pragma'd host boundaries; anything else must stay async
-    "deeplearning4j_tpu/ui/serving_module.py",
-    # the elastic straggler A/B: its only legitimate fetches are the
-    # once-per-arm wall-clock readouts after fit() returns (pragma'd);
-    # a per-round sync would hand the ASYNC arm the same barrier the
-    # benchmark exists to show it avoiding
-    "benchmarks/elastic.py",
-    # the chaos worker's training loop: every host read is either the
-    # watchdog-guarded per-step collective wait or a replicated-scalar
-    # bookkeeping read after it (pragma'd) — an unguarded fetch is a
-    # hang the watchdog cannot classify
-    "tests/multihost_chaos_worker.py",
-)
+from tools.graftlint.engine import scan                    # noqa: E402
+from tools.graftlint.rules.host_sync import (              # noqa: E402
+    HOT_PATHS, HostSyncRule, PATTERNS)
 
 PRAGMA = "# host-sync-ok"
-
-# pattern -> what it does on a device value
-PATTERNS = (
-    (re.compile(r"\bfloat\("), "float() blocks on a device value"),
-    (re.compile(r"\bnp\.asarray\("),
-     "np.asarray() copies device->host (jnp.asarray stays on device)"),
-    (re.compile(r"\.item\(\)"), ".item() blocks on a device value"),
-)
-
-
-def iter_files(paths):
-    for p in paths:
-        path = Path(p)
-        if not path.is_absolute():
-            path = REPO_ROOT / path
-        if path.is_dir():
-            yield from sorted(path.rglob("*.py"))
-        elif path.suffix == ".py":
-            yield path
-
-
-def check_file(path: Path):
-    """Yield (lineno, line, reason) for each unallowed hit."""
-    try:
-        text = path.read_text(encoding="utf-8")
-    except OSError as e:
-        print(f"warning: cannot read {path}: {e}", file=sys.stderr)
-        return
-    for lineno, line in enumerate(text.splitlines(), 1):
-        stripped = line.strip()
-        if stripped.startswith("#"):        # comment-only line
-            continue
-        if PRAGMA in line:                  # explicit allowlist
-            continue
-        # ignore the trailing comment: a pattern named in prose
-        # ("avoid float(x) here") is not a hit
-        code = line.split("#", 1)[0] if '"#"' not in line \
-            and "'#'" not in line else line
-        for rx, reason in PATTERNS:
-            if rx.search(code):
-                yield lineno, line.rstrip(), reason
-                break
+DEFAULT_PATHS = HOT_PATHS
 
 
 def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap = argparse.ArgumentParser(
+        description="host-sync patterns in jit hot paths "
+                    "(shim over tools.graftlint)")
     ap.add_argument("--paths", nargs="+", default=list(DEFAULT_PATHS),
                     help="files/directories to scan (default: the "
                          "jit hot paths)")
     args = ap.parse_args(argv)
 
-    hits = []
-    for path in iter_files(args.paths):
-        for lineno, line, reason in check_file(path):
-            hits.append((path, lineno, line, reason))
+    # an explicit --paths set means "check exactly these", so the rule's
+    # own hot-path scoping is overridden with the requested set
+    rule = HostSyncRule(paths=args.paths)
+    hits = scan(args.paths, rules=[rule])
 
     if not hits:
         print("check_host_sync: clean "
@@ -127,12 +59,8 @@ def main(argv=None) -> int:
     print("check_host_sync: host-sync patterns in jit hot paths "
           f"({len(hits)} hit{'s' if len(hits) != 1 else ''}):\n",
           file=sys.stderr)
-    for path, lineno, line, reason in hits:
-        try:
-            rel = path.relative_to(REPO_ROOT)
-        except ValueError:
-            rel = path
-        print(f"  {rel}:{lineno}: {reason}\n    {line.strip()}",
+    for f in hits:
+        print(f"  {f.rel}:{f.line}: {f.message}\n    {f.snippet}",
               file=sys.stderr)
     print("\nIf the value is a trace-time Python constant (shape math, "
           "env var), annotate the line with\n"

@@ -1,0 +1,24 @@
+"""graftlint: JAX-aware static analysis for the tpu-dl4j codebase.
+
+An AST-based, rule-pluggable analyzer that generalizes the old
+``tools/check_host_sync.py`` grep into a framework. Five rules ship:
+
+- ``host-sync``         hidden device->host syncs in jit hot paths
+- ``donation-safety``   use-after-donate and numpy buffers reaching
+                        ``donate_argnums`` parameters (the PR 1 bug)
+- ``recompile-hazard``  jit construction in loops / per-call paths,
+                        data-dependent static args, traced branching
+- ``thread-discipline`` cross-thread attribute writes without a common
+                        lock (the PR 4 / PR 6 bug), lock-order inversion
+- ``tracer-leak``       traced values stored on self/globals/closures
+                        from inside jitted functions
+
+See tools/graftlint/README.md for the rule catalog, pragma syntax and
+the baseline workflow. Entry point: ``python -m tools.graftlint``.
+"""
+
+from tools.graftlint.engine import (  # noqa: F401
+    Finding, ModuleContext, Project, scan, REPO_ROOT)
+from tools.graftlint.baseline import (  # noqa: F401
+    fingerprint, load_baseline, write_baseline, split_baselined)
+from tools.graftlint.rules import ALL_RULES, get_rules  # noqa: F401

@@ -1,0 +1,112 @@
+"""graftlint CLI.
+
+Usage::
+
+    python -m tools.graftlint                       # default path set
+    python -m tools.graftlint deeplearning4j_tpu/ops tests/foo.py
+    python -m tools.graftlint --rules host-sync,donation-safety
+    python -m tools.graftlint --baseline tools/graftlint/baseline.json
+    python -m tools.graftlint --write-baseline      # triage snapshot
+    python -m tools.graftlint --format json
+    python -m tools.graftlint --list-rules
+
+Exit status: 0 clean (baselined findings don't fail), 1 when
+non-baselined findings exist (or --max-seconds is exceeded), 2 on
+usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+from tools.graftlint.baseline import (
+    DEFAULT_BASELINE, load_baseline, split_baselined, write_baseline)
+from tools.graftlint.engine import REPO_ROOT, iter_files, scan
+from tools.graftlint.report import render_human, render_json
+from tools.graftlint.rules import ALL_RULES, get_rules
+from tools.graftlint.rules.host_sync import HOT_PATHS
+
+# the package plus the out-of-package files the host-sync rule covers
+# (benchmark/worker hot loops) — everything CI lints by default
+DEFAULT_PATHS = ("deeplearning4j_tpu",) + tuple(
+    p for p in HOT_PATHS if not p.startswith("deeplearning4j_tpu"))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="JAX-aware static analysis "
+                    "(tools/graftlint/README.md)")
+    ap.add_argument("paths", nargs="*", default=list(DEFAULT_PATHS),
+                    help="files/directories to scan (default: "
+                         "deeplearning4j_tpu/ + the hot-path extras)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset (default: all)")
+    ap.add_argument("--baseline", type=Path, default=None,
+                    help="baseline JSON: findings listed there are "
+                         "reported but do not fail")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current findings to the baseline "
+                         "file (default tools/graftlint/baseline.json, "
+                         "or --baseline's path) and exit 0")
+    ap.add_argument("--format", choices=("human", "json"),
+                    default="human")
+    ap.add_argument("--max-seconds", type=float, default=None,
+                    help="fail if the scan takes longer than this "
+                         "(the CI wall-clock budget)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for cls in ALL_RULES:
+            print(f"{cls.name:18} {cls.description}")
+        return 0
+
+    try:
+        rules = get_rules(args.rules.split(",")
+                          if args.rules else None)
+    except ValueError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+    t0 = time.perf_counter()
+    findings = scan(args.paths, rules)
+    n_files = len(iter_files(args.paths))
+    seconds = time.perf_counter() - t0
+
+    if args.write_baseline:
+        path = args.baseline if args.baseline is not None \
+            else DEFAULT_BASELINE
+        n = write_baseline(findings, path)
+        print(f"graftlint: wrote {n} finding"
+              f"{'s' if n != 1 else ''} to {path}")
+        return 0
+
+    baseline = {}
+    if args.baseline is not None:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError) as e:
+            print(f"graftlint: cannot load baseline: {e}",
+                  file=sys.stderr)
+            return 2
+    new, baselined, stale = split_baselined(findings, baseline)
+
+    if args.format == "json":
+        render_json(new, baselined, stale, n_files, seconds)
+    else:
+        render_human(new, baselined, stale, n_files, seconds)
+
+    if args.max_seconds is not None and seconds > args.max_seconds:
+        print(f"graftlint: scan took {seconds:.2f}s, over the "
+              f"--max-seconds {args.max_seconds:.0f}s budget",
+              file=sys.stderr)
+        return 1
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

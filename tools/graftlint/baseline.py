@@ -1,0 +1,89 @@
+"""Baseline file: the triaged-backlog mechanism.
+
+A finding's identity must survive unrelated edits, so the fingerprint
+hashes (rule, repo-relative path, stripped source line, occurrence
+index among identical lines in that file) — NOT the line number. Moving
+code within a file keeps its baseline entry; editing the flagged line
+(or fixing it) invalidates the entry, which is exactly the trigger for
+a re-triage.
+
+Workflow::
+
+    python -m tools.graftlint --write-baseline          # triage snapshot
+    python -m tools.graftlint --baseline tools/graftlint/baseline.json
+
+CI runs the second form: any finding not in the committed baseline
+fails the build; baselined findings are reported but don't fail.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from pathlib import Path
+from typing import Dict, List, Sequence, Tuple
+
+from tools.graftlint.engine import Finding
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+def _occurrence_indices(findings: Sequence[Finding]) -> List[int]:
+    """For each finding, its index among same-(rule, rel, snippet)
+    findings seen so far — disambiguates identical lines in one file."""
+    counts: Dict[Tuple[str, str, str], int] = {}
+    out = []
+    for f in findings:
+        key = (f.rule, f.rel, f.snippet)
+        out.append(counts.get(key, 0))
+        counts[key] = counts.get(key, 0) + 1
+    return out
+
+
+def fingerprint(f: Finding, occurrence: int = 0) -> str:
+    payload = f"{f.rule}|{f.rel}|{f.snippet}|{occurrence}"
+    return hashlib.sha1(payload.encode("utf-8")).hexdigest()[:16]
+
+
+def fingerprints(findings: Sequence[Finding]) -> List[str]:
+    return [fingerprint(f, occ) for f, occ in
+            zip(findings, _occurrence_indices(findings))]
+
+
+def write_baseline(findings: Sequence[Finding], path: Path) -> int:
+    entries = {}
+    for f, fp in zip(findings, fingerprints(findings)):
+        entries[fp] = {"rule": f.rule, "path": f.rel, "line": f.line,
+                       "message": f.message, "snippet": f.snippet}
+    path.write_text(json.dumps(
+        {"version": BASELINE_VERSION, "findings": entries},
+        indent=2, sort_keys=True) + "\n", encoding="utf-8")
+    return len(entries)
+
+
+def load_baseline(path: Path) -> Dict[str, dict]:
+    data = json.loads(path.read_text(encoding="utf-8"))
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path}: unsupported version "
+            f"{data.get('version')!r} (want {BASELINE_VERSION})")
+    return dict(data.get("findings", {}))
+
+
+def split_baselined(findings: Sequence[Finding],
+                    baseline: Dict[str, dict]
+                    ) -> Tuple[List[Finding], List[Finding], List[str]]:
+    """-> (new, baselined, stale_fingerprints). Stale entries are
+    baseline lines that no current finding matches — fixed or edited
+    code whose entry should be pruned at the next --write-baseline."""
+    new, old = [], []
+    seen = set()
+    for f, fp in zip(findings, fingerprints(findings)):
+        if fp in baseline:
+            old.append(f)
+            seen.add(fp)
+        else:
+            new.append(f)
+    stale = [fp for fp in baseline if fp not in seen]
+    return new, old, stale

@@ -1,0 +1,290 @@
+"""graftlint core: module contexts, pragma handling, the scan driver.
+
+The engine owns everything rule-independent: walking the path set,
+parsing each module once (source text, line table, AST), computing the
+per-line pragma suppressions, running every rule's project-wide
+``prepare`` pass (cross-module facts like "which imported names donate")
+and then its per-module ``check`` pass, and filtering the findings
+through the pragmas.
+
+Pragma syntax (line-level, on the offending line)::
+
+    x = float(loss)   # graftlint: disable=host-sync
+    y = step(y, b)    # graftlint: disable=donation-safety,tracer-leak
+    z = risky()       # graftlint: disable          (all rules)
+
+``# host-sync-ok`` is a back-compat alias for
+``# graftlint: disable=host-sync`` — every pragma the old
+``check_host_sync.py`` tool accepted keeps working unchanged.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+
+# matches "# graftlint: disable=a,b" / "# graftlint:disable" anywhere in
+# the line; the rule list is optional (absent = suppress every rule)
+_PRAGMA_RX = re.compile(
+    r"#\s*graftlint:\s*disable(?:\s*=\s*([\w\-, ]+))?")
+_ALIAS_RX = re.compile(r"#\s*host-sync-ok")
+
+ALL = "*"          # sentinel: every rule suppressed on this line
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analyzer hit. ``line`` is 1-indexed; ``snippet`` is the
+    stripped source line (also the baseline identity — see
+    baseline.fingerprint)."""
+    rule: str
+    path: Path              # absolute
+    line: int
+    message: str
+    snippet: str
+
+    @property
+    def rel(self) -> str:
+        try:
+            return str(self.path.relative_to(REPO_ROOT))
+        except ValueError:
+            return str(self.path)
+
+
+class ModuleContext:
+    """One parsed module: text, line table, AST, pragma map."""
+
+    def __init__(self, path: Path, root: Path = REPO_ROOT):
+        self.path = path
+        self.root = root
+        try:
+            self.rel = str(path.relative_to(root))
+        except ValueError:
+            self.rel = str(path)
+        self.text = path.read_text(encoding="utf-8")
+        self.lines: List[str] = self.text.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(self.text, filename=str(path))
+        except SyntaxError as e:
+            self.parse_error = e
+        self._disabled: Dict[int, Set[str]] = self._pragmas()
+
+    def _pragmas(self) -> Dict[int, Set[str]]:
+        out: Dict[int, Set[str]] = {}
+        for i, line in enumerate(self.lines, 1):
+            if "#" not in line:
+                continue
+            m = _PRAGMA_RX.search(line)
+            if m:
+                rules = m.group(1)
+                if rules is None:
+                    out.setdefault(i, set()).add(ALL)
+                else:
+                    out.setdefault(i, set()).update(
+                        r.strip() for r in rules.split(",") if r.strip())
+            if _ALIAS_RX.search(line):
+                out.setdefault(i, set()).add("host-sync")
+        return out
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        d = self._disabled.get(line)
+        return bool(d) and (ALL in d or rule in d)
+
+    def line_at(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, rule: str, lineno: int, message: str) -> Finding:
+        return Finding(rule=rule, path=self.path, line=lineno,
+                       message=message, snippet=self.line_at(lineno))
+
+
+class Project:
+    """Cross-module facts shared between rules' prepare/check passes.
+
+    ``modules`` maps repo-relative dotted module names
+    (``deeplearning4j_tpu.nlp.skipgram``) to their contexts so rules can
+    resolve imports; rules stash their own project-wide tables in
+    ``facts[rule_name]``.
+    """
+
+    def __init__(self, contexts: Sequence[ModuleContext],
+                 root: Path = REPO_ROOT):
+        self.root = root
+        self.contexts = list(contexts)
+        self.modules: Dict[str, ModuleContext] = {}
+        for ctx in self.contexts:
+            name = module_name_of(ctx.rel)
+            if name:
+                self.modules[name] = ctx
+        self.facts: Dict[str, object] = {}
+
+
+def module_name_of(rel: str) -> Optional[str]:
+    """``deeplearning4j_tpu/nlp/skipgram.py`` ->
+    ``deeplearning4j_tpu.nlp.skipgram`` (packages keep their
+    ``__init__`` suffix stripped)."""
+    if not rel.endswith(".py"):
+        return None
+    parts = rel[:-3].replace("\\", "/").split("/")
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    if not parts:
+        return None
+    return ".".join(parts)
+
+
+def iter_files(paths: Iterable[str], root: Path = REPO_ROOT
+               ) -> List[Path]:
+    seen: Set[Path] = set()
+    out: List[Path] = []
+    for p in paths:
+        path = Path(p)
+        if not path.is_absolute():
+            path = root / path
+        if path.is_dir():
+            found = sorted(q for q in path.rglob("*.py")
+                           if "__pycache__" not in q.parts)
+        elif path.suffix == ".py" and path.exists():
+            found = [path]
+        else:
+            if not path.exists():
+                print(f"graftlint: warning: no such path: {p}",
+                      file=sys.stderr)
+            found = []
+        for f in found:
+            if f not in seen:
+                seen.add(f)
+                out.append(f)
+    return out
+
+
+def scan(paths: Iterable[str], rules: Sequence = None,
+         root: Path = REPO_ROOT) -> List[Finding]:
+    """Run ``rules`` (default: every registered rule) over ``paths``;
+    returns pragma-filtered findings sorted by (path, line, rule)."""
+    from tools.graftlint.rules import get_rules
+    if rules is None:
+        rules = get_rules()
+    contexts = []
+    for f in iter_files(paths, root):
+        try:
+            contexts.append(ModuleContext(f, root))
+        except OSError as e:
+            print(f"graftlint: warning: cannot read {f}: {e}",
+                  file=sys.stderr)
+    project = Project(contexts, root)
+    for rule in rules:
+        prepare = getattr(rule, "prepare", None)
+        if prepare is not None:
+            prepare(project)
+    findings: List[Finding] = []
+    for ctx in contexts:
+        for rule in rules:
+            if not rule.applies(ctx):
+                continue
+            for f in rule.check(ctx, project):
+                if not ctx.suppressed(f.rule, f.line):
+                    findings.append(f)
+    findings.sort(key=lambda f: (str(f.path), f.line, f.rule))
+    return findings
+
+
+class Rule:
+    """Base class. ``name`` is the pragma / CLI identifier; ``paths``
+    (optional) restricts the rule to repo-relative prefixes — rules
+    without one run on every scanned file."""
+
+    name = "base"
+    description = ""
+    paths: Optional[Sequence[str]] = None
+
+    def applies(self, ctx: ModuleContext) -> bool:
+        if self.paths is None:
+            return True
+        rel = ctx.rel.replace("\\", "/")
+        if Path(rel).is_absolute() or ctx.root != REPO_ROOT:
+            # outside the repo root (fixture corpora, ad-hoc scans —
+            # whether reached by absolute path or a custom scan root):
+            # path scoping is a repo-layout concept, run everywhere
+            return True
+        for p in self.paths:
+            p = p.rstrip("/")
+            if rel == p or rel.startswith(p + "/"):
+                return True
+        return False
+
+    def prepare(self, project: Project) -> None:   # optional pre-pass
+        pass
+
+    def check(self, ctx: ModuleContext,
+              project: Project) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+# ---- shared AST helpers (used by several rules) -------------------------
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``jax.experimental.pjit.pjit`` -> that string; None for
+    non-name/attribute chains."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def is_jit_callable(node: ast.AST, jit_aliases: Set[str]) -> bool:
+    """True when ``node`` (a Call.func) names jax.jit / pjit (including
+    ``from jax import jit`` aliases collected per-module)."""
+    name = dotted_name(node)
+    if name is None:
+        return False
+    if name in jit_aliases:
+        return True
+    return name in ("jax.jit", "jax.pjit", "pjit.pjit",
+                    "jax.experimental.pjit.pjit")
+
+
+def collect_jit_aliases(tree: ast.Module) -> Set[str]:
+    """Names under which jax.jit/pjit are imported in this module."""
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            if node.module == "jax" or node.module.endswith(".pjit"):
+                for a in node.names:
+                    if a.name in ("jit", "pjit"):
+                        aliases.add(a.asname or a.name)
+    return aliases
+
+
+def literal_argnums(node: ast.AST) -> Optional[List[int]]:
+    """Parse a literal donate_argnums/static_argnums value: int or
+    tuple/list of ints. None when non-literal (conditional expressions,
+    names) — callers must treat that as unknown, not empty."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) \
+                    and isinstance(elt.value, int) \
+                    and not isinstance(elt.value, bool):
+                out.append(elt.value)
+            else:
+                return None
+        return out
+    return None

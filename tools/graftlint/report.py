@@ -1,0 +1,71 @@
+"""Human and JSON report rendering for graftlint findings."""
+
+from __future__ import annotations
+
+import json
+import sys
+from collections import Counter
+from typing import Dict, List, Optional, Sequence
+
+from tools.graftlint.engine import Finding
+from tools.graftlint.baseline import fingerprints
+
+
+def render_human(new: Sequence[Finding], baselined: Sequence[Finding],
+                 stale: Sequence[str], n_files: int, seconds: float,
+                 stream=None) -> None:
+    stream = stream if stream is not None else sys.stderr
+    for f in new:
+        print(f"{f.rel}:{f.line}: [{f.rule}] {f.message}", file=stream)
+        if f.snippet:
+            print(f"    {f.snippet}", file=stream)
+    by_rule = Counter(f.rule for f in new)
+    parts = [f"{n} {r}" for r, n in sorted(by_rule.items())]
+    status = "clean" if not new else \
+        f"{len(new)} finding{'s' if len(new) != 1 else ''}" \
+        + (f" ({', '.join(parts)})" if parts else "")
+    extra = []
+    if baselined:
+        extra.append(f"{len(baselined)} baselined")
+    if stale:
+        extra.append(f"{len(stale)} stale baseline "
+                     f"entr{'ies' if len(stale) != 1 else 'y'} "
+                     "(re-run --write-baseline to prune)")
+    suffix = f" [{'; '.join(extra)}]" if extra else ""
+    print(f"graftlint: {status} — {n_files} files in {seconds:.2f}s"
+          f"{suffix}", file=stream)
+    if new:
+        print(
+            "\nSuppress a deliberate pattern with a line pragma\n"
+            "  `# graftlint: disable=<rule>: <reason>`\n"
+            "or triage it into the baseline with --write-baseline "
+            "(tools/graftlint/README.md).", file=stream)
+
+
+def render_json(new: Sequence[Finding], baselined: Sequence[Finding],
+                stale: Sequence[str], n_files: int, seconds: float,
+                stream=None) -> None:
+    stream = stream if stream is not None else sys.stdout
+
+    def rows(findings: Sequence[Finding], is_baselined: bool
+             ) -> List[Dict]:
+        fps = fingerprints(findings)
+        return [{"rule": f.rule, "path": f.rel, "line": f.line,
+                 "message": f.message, "snippet": f.snippet,
+                 "fingerprint": fp, "baselined": is_baselined}
+                for f, fp in zip(findings, fps)]
+
+    doc = {
+        "version": 1,
+        "findings": rows(new, False) + rows(baselined, True),
+        "summary": {
+            "new": len(new),
+            "baselined": len(baselined),
+            "stale_baseline_entries": len(stale),
+            "files": n_files,
+            "seconds": round(seconds, 3),
+            "by_rule": dict(Counter(f.rule for f in new)),
+        },
+    }
+    json.dump(doc, stream, indent=2, sort_keys=True)
+    stream.write("\n")

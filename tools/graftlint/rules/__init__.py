@@ -1,0 +1,34 @@
+"""Rule registry. Adding a rule = write a module exposing a Rule
+subclass and list it here; the CLI, pragma parser and baseline pick it
+up automatically."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from tools.graftlint.rules.host_sync import HostSyncRule
+from tools.graftlint.rules.donation_safety import DonationSafetyRule
+from tools.graftlint.rules.recompile_hazard import RecompileHazardRule
+from tools.graftlint.rules.thread_discipline import ThreadDisciplineRule
+from tools.graftlint.rules.tracer_leak import TracerLeakRule
+
+ALL_RULES = (HostSyncRule, DonationSafetyRule, RecompileHazardRule,
+             ThreadDisciplineRule, TracerLeakRule)
+
+RULES_BY_NAME: Dict[str, type] = {r.name: r for r in ALL_RULES}
+
+
+def get_rules(names: Optional[Sequence[str]] = None) -> List:
+    """Instantiate the named rules (default: all), preserving registry
+    order; unknown names raise with the valid set."""
+    if names is None:
+        return [cls() for cls in ALL_RULES]
+    out = []
+    for n in names:
+        cls = RULES_BY_NAME.get(n)
+        if cls is None:
+            raise ValueError(
+                f"unknown rule {n!r}; available: "
+                f"{', '.join(sorted(RULES_BY_NAME))}")
+        out.append(cls())
+    return out

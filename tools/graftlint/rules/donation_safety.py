@@ -1,0 +1,432 @@
+"""donation-safety: the PR 1 bug class, statically.
+
+``donate_argnums`` hands a buffer to XLA: after the donating call the
+caller's binding is invalid (jax raises on *device* reuse — but a
+donated *numpy* buffer adopted zero-copy by the CPU backend is freed
+out from under live device state: a silent use-after-free, the exact
+PR 1 ``test_nlp_cluster`` NaN). Two findings:
+
+- **numpy-into-donated** — a numpy-backed value (``np.asarray``/
+  ``np.array``/any ``np.*`` constructor, ``.numpy()``) reaches a
+  donated parameter position without a defensive ``jnp.array``/
+  ``jnp.asarray``/``jax.device_put`` copy.
+- **use-after-donate** — a binding passed at a donated position is
+  read again after the donating call without being rebound; the loop
+  body is analyzed twice so ``for b in it: loss = step(state, b)``
+  (state never rebound) is caught as a loop-carried use.
+
+Donating callables are recognized across modules: module-level
+``@partial(jax.jit, donate_argnums=...)`` decorations, ``name =
+jax.jit(fn, donate_argnums=...)`` / ``partial(jax.jit, ...)(fn)``
+assignments, ``from x import donating_fn`` / ``import x as y`` +
+``y.donating_fn`` call sites, plus the repo's train-step makers
+(``make_train_step``/``make_scan_train_step``/``build_train_step``,
+which donate arg 0 unless called with ``donate=False``). Non-literal
+``donate_argnums`` expressions are treated as unknown (no finding) —
+we only flag what we can prove.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.graftlint.engine import (
+    Finding, ModuleContext, Project, Rule, collect_jit_aliases,
+    dotted_name, is_jit_callable, literal_argnums, module_name_of)
+
+RULE = "donation-safety"
+
+# repo convention: the solver's step factories return a jitted step
+# donating its TrainState (arg 0) unless built with donate=False
+_MAKER_RX = re.compile(
+    r"(?:^|\.)(?:make_(?:scan_)?train_step|_?build_(?:scan_)?train_step)$")
+
+_NUMPY_MODULES = ("np", "numpy", "onp")
+# jnp/jax wrappers that take ownership with a device copy
+_CLEANSERS = {"jnp.array", "jnp.asarray", "jnp.copy", "jax.device_put",
+              "jax.numpy.array", "jax.numpy.asarray"}
+
+
+def _is_partial(node: ast.AST) -> bool:
+    return dotted_name(node) in ("functools.partial", "partial")
+
+
+def _donating_positions(call: ast.Call,
+                        jit_aliases: Set[str]) -> Optional[List[int]]:
+    """Positions donated by the callable this Call builds, or None."""
+    # jax.jit(fn, donate_argnums=...)
+    if is_jit_callable(call.func, jit_aliases):
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                return literal_argnums(kw.value)
+        return None
+    # functools.partial(jax.jit, donate_argnums=...)  (decorator or
+    # applied immediately to a function)
+    if _is_partial(call.func) and call.args \
+            and is_jit_callable(call.args[0], jit_aliases):
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                return literal_argnums(kw.value)
+    return None
+
+
+def _maker_positions(call: ast.Call) -> Optional[List[int]]:
+    """Train-step factory convention: donates arg 0 unless
+    donate=False is passed explicitly."""
+    name = dotted_name(call.func)
+    if name is None or not _MAKER_RX.search(name):
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate":
+            if isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is False:
+                return None
+            if not (isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True):
+                return None        # donate=<expr>: unknown
+    return [0]
+
+
+def module_donators(ctx: ModuleContext) -> Dict[str, List[int]]:
+    """Module-level names in ``ctx`` that donate, -> positions."""
+    out: Dict[str, List[int]] = {}
+    if ctx.tree is None:
+        return out
+    aliases = collect_jit_aliases(ctx.tree)
+    for node in ctx.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    pos = _donating_positions(dec, aliases)
+                    if pos:
+                        out[node.name] = pos
+        elif isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call):
+            pos = _assigned_donation(node.value, aliases)
+            if pos:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = pos
+    return out
+
+
+def _assigned_donation(call: ast.Call,
+                       aliases: Set[str]) -> Optional[List[int]]:
+    pos = _donating_positions(call, aliases)
+    if pos:
+        return pos
+    # partial(jax.jit, donate_argnums=...)(fn): outer call over a
+    # donation-building inner call
+    if isinstance(call.func, ast.Call):
+        return _donating_positions(call.func, aliases)
+    return None
+
+
+def _is_numpy_call(node: ast.AST) -> bool:
+    """A call that yields a host numpy array: np.<anything>(...) or
+    x.numpy()."""
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func)
+    if name is not None:
+        head = name.split(".", 1)[0]
+        if head in _NUMPY_MODULES and "." in name:
+            return name not in _CLEANSERS
+    if isinstance(node.func, ast.Attribute) and node.func.attr == "numpy":
+        return True
+    return False
+
+
+def _is_cleansing_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) \
+        and dotted_name(node.func) in _CLEANSERS
+
+
+class _Scope:
+    """Mutable dataflow state for one linear pass."""
+
+    def __init__(self):
+        self.tainted: Set[str] = set()      # numpy-backed bindings
+        self.dead: Dict[str, int] = {}      # donated binding -> line
+
+    def copy(self) -> "_Scope":
+        s = _Scope()
+        s.tainted = set(self.tainted)
+        s.dead = dict(self.dead)
+        return s
+
+    def merge_branches(self, a: "_Scope", b: "_Scope"):
+        # dead only when dead on every path (no false positives from
+        # "the other branch donated"); tainted on any path
+        self.tainted = a.tainted | b.tainted
+        self.dead = {k: v for k, v in a.dead.items() if k in b.dead}
+
+
+class _FunctionAnalyzer:
+    """Linear abstract interpretation of one function (or the module
+    top level). Loop bodies run twice so loop-carried donations — the
+    ``for b: loss = step(state, b)`` shape — surface on the second
+    pass."""
+
+    def __init__(self, rule: "DonationSafetyRule", ctx: ModuleContext,
+                 donators: Dict[str, List[int]],
+                 jit_aliases: Set[str]):
+        self.rule = rule
+        self.ctx = ctx
+        self.donators = dict(donators)      # callable name -> positions
+        self.jit_aliases = jit_aliases
+        self.scope = _Scope()
+        self.findings: Dict[Tuple[int, str, str], Finding] = {}
+
+    # ---- reporting -------------------------------------------------------
+    def _report(self, line: int, kind: str, name: str, message: str):
+        key = (line, kind, name)
+        if key not in self.findings:
+            self.findings[key] = self.ctx.finding(RULE, line, message)
+
+    # ---- expression walk -------------------------------------------------
+    def visit_expr(self, node: Optional[ast.AST]):
+        """Detect loads of dead names and donating calls, inside out."""
+        if node is None:
+            return
+        for child in ast.walk(node):
+            if isinstance(child, ast.Name) \
+                    and isinstance(child.ctx, ast.Load) \
+                    and child.id in self.scope.dead:
+                donated_at = self.scope.dead.pop(child.id)
+                self._report(
+                    child.lineno, "use-after-donate", child.id,
+                    f"'{child.id}' was donated at line {donated_at} "
+                    "(donate_argnums) and is read again here; its "
+                    "buffer belongs to XLA now — rebind the result "
+                    "or drop donation")
+        # donating calls processed after their argument loads (the
+        # donating call itself may legally read the binding)
+        for child in ast.walk(node):
+            if isinstance(child, ast.Call):
+                self._handle_call(child)
+
+    def _handle_call(self, call: ast.Call):
+        positions = self._callee_positions(call)
+        if positions is None:
+            return
+        for p in positions:
+            if p >= len(call.args):
+                continue
+            arg = call.args[p]
+            if isinstance(arg, ast.Name):
+                if arg.id in self.scope.tainted:
+                    self._report(
+                        call.lineno, "numpy-into-donated", arg.id,
+                        f"numpy-backed '{arg.id}' reaches donated "
+                        f"parameter {p} of "
+                        f"'{dotted_name(call.func) or '<call>'}'; the "
+                        "CPU backend zero-copy adopts numpy buffers, "
+                        "so donation frees host memory still in use — "
+                        "copy with jnp.array(...) first")
+                self.scope.dead[arg.id] = call.lineno
+            elif _is_numpy_call(arg) and not _is_cleansing_call(arg):
+                self._report(
+                    call.lineno, "numpy-into-donated",
+                    dotted_name(arg.func) or "<numpy temp>",
+                    f"numpy temp from "
+                    f"'{dotted_name(arg.func) or 'np call'}' flows "
+                    f"straight into donated parameter {p} of "
+                    f"'{dotted_name(call.func) or '<call>'}' — wrap "
+                    "it in jnp.array(...) so the donated buffer is "
+                    "device-owned")
+
+    def _callee_positions(self, call: ast.Call) -> Optional[List[int]]:
+        name = dotted_name(call.func)
+        if name is not None and name in self.donators:
+            return self.donators[name]
+        # immediately-invoked donating jit: jax.jit(f, donate...)(args)
+        if isinstance(call.func, ast.Call):
+            return _donating_positions(call.func, self.jit_aliases)
+        return None
+
+    # ---- statement walk --------------------------------------------------
+    def _bind(self, target: ast.AST, value: Optional[ast.AST]):
+        """Assignment target: revive donated names, track numpy taint."""
+        if isinstance(target, ast.Name):
+            self.scope.dead.pop(target.id, None)
+            if value is not None and _is_numpy_call(value):
+                self.scope.tainted.add(target.id)
+            elif value is not None and isinstance(value, ast.Name) \
+                    and value.id in self.scope.tainted:
+                self.scope.tainted.add(target.id)
+            else:
+                self.scope.tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt, None)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, None)
+        # attribute/subscript targets: no binding tracked
+
+    def run_body(self, body: List[ast.stmt]):
+        for stmt in body:
+            self.visit_stmt(stmt)
+
+    def visit_stmt(self, stmt: ast.stmt):
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            self.visit_expr(getattr(stmt, "value", None))
+            # locally-built donating callables become known callees
+            if isinstance(stmt, ast.Assign) \
+                    and isinstance(stmt.value, ast.Call):
+                pos = _assigned_donation(stmt.value, self.jit_aliases) \
+                    or _maker_positions(stmt.value)
+                if pos:
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            self.donators[t.id] = pos
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    self._bind(t, stmt.value)
+            elif isinstance(stmt, ast.AnnAssign) \
+                    and stmt.target is not None:
+                self._bind(stmt.target, stmt.value)
+            else:                                     # AugAssign
+                self.visit_expr(stmt.target)
+                self._bind(stmt.target, None)
+        elif isinstance(stmt, ast.Expr):
+            self.visit_expr(stmt.value)
+        elif isinstance(stmt, ast.Return):
+            self.visit_expr(stmt.value)
+        elif isinstance(stmt, ast.If):
+            self.visit_expr(stmt.test)
+            before = self.scope.copy()
+            self.run_body(stmt.body)
+            after_body = self.scope
+            self.scope = before.copy()
+            self.run_body(stmt.orelse)
+            merged = _Scope()
+            merged.merge_branches(after_body, self.scope)
+            self.scope = merged
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self.visit_expr(stmt.iter)
+            self._run_loop(stmt.body, rebinds=stmt.target)
+            self.run_body(stmt.orelse)
+        elif isinstance(stmt, ast.While):
+            self.visit_expr(stmt.test)
+            self._run_loop(stmt.body)
+            self.run_body(stmt.orelse)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self.visit_expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars, None)
+            self.run_body(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            self.run_body(stmt.body)
+            for h in stmt.handlers:
+                self.run_body(h.body)
+            self.run_body(stmt.orelse)
+            self.run_body(stmt.finalbody)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            pass        # nested scopes are analyzed separately
+        elif isinstance(stmt, (ast.Raise, ast.Assert, ast.Delete)):
+            for field_val in ast.iter_child_nodes(stmt):
+                self.visit_expr(field_val)
+            if isinstance(stmt, ast.Delete):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        self.scope.dead.pop(t.id, None)
+                        self.scope.tainted.discard(t.id)
+        else:
+            for field_val in ast.iter_child_nodes(stmt):
+                if isinstance(field_val, ast.expr):
+                    self.visit_expr(field_val)
+
+    def _run_loop(self, body: List[ast.stmt],
+                  rebinds: Optional[ast.AST] = None):
+        """Two passes: the second starts from the first's exit state, so
+        a name donated on iteration N and read (even by the donating
+        call itself) on iteration N+1 without a rebind is flagged. The
+        for-loop target rebinds fresh at the top of every iteration."""
+        for _pass in range(2):
+            if rebinds is not None:
+                self._bind(rebinds, None)
+            self.run_body(body)
+
+
+class DonationSafetyRule(Rule):
+    name = RULE
+    description = ("use-after-donate and numpy buffers reaching "
+                   "donate_argnums parameters")
+    paths = ("deeplearning4j_tpu",)
+
+    def prepare(self, project: Project) -> None:
+        tables: Dict[str, Dict[str, List[int]]] = {}
+        for ctx in project.contexts:
+            mod = module_name_of(ctx.rel)
+            if mod:
+                tables[mod] = module_donators(ctx)
+        project.facts[RULE] = tables
+
+    # ---- import resolution -----------------------------------------------
+    def _imported_donators(self, ctx: ModuleContext,
+                           project: Project) -> Dict[str, List[int]]:
+        tables = project.facts.get(RULE, {})
+        out: Dict[str, List[int]] = {}
+        mod = module_name_of(ctx.rel) or ""
+        pkg_parts = mod.split(".")
+        is_pkg = ctx.rel.endswith("__init__.py")
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom):
+                target = self._resolve_from(node, pkg_parts, is_pkg)
+                if target is None:
+                    continue
+                for a in node.names:
+                    # from mod import donating_fn
+                    fn_table = tables.get(target, {})
+                    if a.name in fn_table:
+                        out[a.asname or a.name] = fn_table[a.name]
+                    # from pkg import submodule
+                    sub = f"{target}.{a.name}"
+                    for fn, pos in tables.get(sub, {}).items():
+                        out[f"{a.asname or a.name}.{fn}"] = pos
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    for fn, pos in tables.get(a.name, {}).items():
+                        head = a.asname or a.name
+                        out[f"{head}.{fn}"] = pos
+        return out
+
+    @staticmethod
+    def _resolve_from(node: ast.ImportFrom, pkg_parts: List[str],
+                      is_pkg: bool) -> Optional[str]:
+        if node.level == 0:
+            return node.module
+        # relative import: level 1 = current package
+        base = pkg_parts if is_pkg else pkg_parts[:-1]
+        up = node.level - 1
+        if up > len(base):
+            return None
+        base = base[:len(base) - up] if up else base
+        if node.module:
+            return ".".join(base + node.module.split("."))
+        return ".".join(base) if base else None
+
+    # ---- per-module check ------------------------------------------------
+    def check(self, ctx: ModuleContext,
+              project: Project) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return
+        aliases = collect_jit_aliases(ctx.tree)
+        donators = dict(module_donators(ctx))
+        donators.update(self._imported_donators(ctx, project))
+
+        # module top level + every function/method body, each its own
+        # linear scope
+        scopes: List[List[ast.stmt]] = [ctx.tree.body]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(node.body)
+        for body in scopes:
+            an = _FunctionAnalyzer(self, ctx, donators, aliases)
+            an.run_body(body)
+            yield from an.findings.values()

@@ -1,0 +1,98 @@
+"""host-sync: hidden device->host transfers in jit hot paths.
+
+The direct port of ``tools/check_host_sync.py`` (PR 2). ``float(x)``,
+``np.asarray(x)`` and ``x.item()`` on a traced jax value force a
+device->host sync (inside a trace, a ConcretizationTypeError at best;
+on the dispatch path, a per-step stall at worst). The telemetry design
+(observe/) exists so the train loop does exactly ONE device fetch per
+flush interval; a stray ``float(loss)`` in ops/ or the solver undoes
+that.
+
+Scope: only the jit hot paths listed in ``HOT_PATHS`` — host-side code
+is allowed (expected!) to touch host values. Trace-time Python
+constants (shape math, env vars) are legitimate inside the hot paths
+too: annotate them with ``# host-sync-ok: <reason>`` (the historical
+pragma, kept as an alias of ``# graftlint: disable=host-sync``).
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Iterable
+
+from tools.graftlint.engine import Finding, ModuleContext, Project, Rule
+
+# hot paths: everything here runs inside (or builds/dispatches) jitted
+# step functions, where a hidden sync is a per-iteration cost. This is
+# the accumulated PR 2..7 list from check_host_sync.py, unchanged.
+HOT_PATHS = (
+    "deeplearning4j_tpu/ops",
+    "deeplearning4j_tpu/optimize/solver.py",
+    "deeplearning4j_tpu/models",
+    # parallel/ includes the serving engine, the fleet router and the
+    # persisted AOT cache: the only legitimate fetches are the
+    # completion-thread block/asarray pair and the cache's one-time
+    # startup weights fingerprint (pragma'd there)
+    "deeplearning4j_tpu/parallel",
+    # the input-feeder hot path: a stray per-batch host sync here would
+    # serialize ETL back onto the step loop the feeder exists to unblock
+    "deeplearning4j_tpu/datasets",
+    # serving's HTTP ingress: request decode / response encode are the
+    # pragma'd host boundaries; anything else must stay async
+    "deeplearning4j_tpu/ui/serving_module.py",
+    # the elastic straggler A/B: only the once-per-arm wall-clock
+    # readouts after fit() returns are legitimate (pragma'd)
+    "benchmarks/elastic.py",
+    # the chaos worker's training loop: every host read is either the
+    # watchdog-guarded collective wait or a replicated-scalar
+    # bookkeeping read after it (pragma'd)
+    "tests/multihost_chaos_worker.py",
+)
+
+PATTERNS = (
+    (re.compile(r"\bfloat\("), "float() blocks on a device value"),
+    (re.compile(r"\bnp\.asarray\("),
+     "np.asarray() copies device->host (jnp.asarray stays on device)"),
+    (re.compile(r"\.item\(\)"), ".item() blocks on a device value"),
+)
+
+
+class HostSyncRule(Rule):
+    name = "host-sync"
+    description = ("device->host sync patterns (float()/np.asarray()/"
+                   ".item()) in jit hot paths")
+    paths = HOT_PATHS
+
+    def __init__(self, paths=None):
+        # the back-compat CLI shim passes an explicit path set; the
+        # default is the curated hot-path list. Absolute entries under
+        # the repo root are normalized so they match the repo-relative
+        # module contexts.
+        if paths is not None:
+            from tools.graftlint.engine import REPO_ROOT
+            norm = []
+            for p in paths:
+                pp = Path(p)
+                if pp.is_absolute():
+                    try:
+                        p = str(pp.resolve().relative_to(REPO_ROOT))
+                    except ValueError:
+                        p = str(pp)
+                norm.append(str(p))
+            self.paths = tuple(norm)
+
+    def check(self, ctx: ModuleContext,
+              project: Project) -> Iterable[Finding]:
+        for lineno, line in enumerate(ctx.lines, 1):
+            stripped = line.strip()
+            if stripped.startswith("#"):         # comment-only line
+                continue
+            # ignore the trailing comment: a pattern named in prose
+            # ("avoid float(x) here") is not a hit
+            code = line.split("#", 1)[0] if '"#"' not in line \
+                and "'#'" not in line else line
+            for rx, reason in PATTERNS:
+                if rx.search(code):
+                    yield ctx.finding(self.name, lineno, reason)
+                    break

@@ -1,0 +1,310 @@
+"""recompile-hazard: jit constructions that defeat the trace cache.
+
+The feeder's bucket ladder and the serving engine's warmed AOT table
+both depend on a *stable* set of (function, signature) keys. Each of
+these shapes silently mints new executables instead:
+
+- **jit-in-loop** — ``jax.jit(...)`` constructed inside a for/while
+  body: every iteration builds a fresh wrapper with its own empty trace
+  cache, so every iteration recompiles.
+- **jit-per-call** — ``jax.jit(f)(x)`` immediately invoked, or
+  ``jax.jit`` applied to a ``lambda`` inside a function body that is
+  not a one-time builder: the wrapper (and for a lambda, the function
+  identity itself) is fresh per call, so the compile cache can never
+  hit. One-time builders (``__init__``, ``build_*``/``make_*``/
+  ``_warmup*`` and module level) are exempt — constructing a jit once
+  per object is the intended pattern.
+- **data-dependent-static** — ``int(x)``/``float(x)``/``x.item()``
+  results passed at a ``static_argnums`` position of a jitted callable
+  defined in the same module: every distinct runtime value is a new
+  cache key (plus a host sync to read it).
+- **traced-branch** — a Python ``if``/``while`` testing a bare
+  parameter of a jit-decorated function: the test either raises a
+  ConcretizationTypeError or, with that parameter made static, turns
+  every distinct value into a recompile. ``.shape``/``.dtype``/
+  ``.ndim``/``len()`` uses are trace-time constants and stay exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set
+
+from tools.graftlint.engine import (
+    Finding, ModuleContext, Project, Rule, collect_jit_aliases,
+    dotted_name, is_jit_callable, literal_argnums)
+
+RULE = "recompile-hazard"
+
+# function names allowed to construct jits per call: one-time builders
+# and warmup paths, where construction is the *point*
+_BUILDER_RX = re.compile(
+    r"^(?:__init__|_?build_\w*|_?make_\w*|_?create_\w*|_?compile\w*|"
+    r"_?warmup\w*|_?get_exe\w*|_?init\w*|setup\w*)$")
+
+_SYNC_READ_RX = ("int", "float")
+
+
+def _is_partial_jit(call: ast.Call, aliases: Set[str]) -> bool:
+    return dotted_name(call.func) in ("functools.partial", "partial") \
+        and bool(call.args) and is_jit_callable(call.args[0], aliases)
+
+
+def _static_positions(call: ast.Call,
+                      aliases: Set[str]) -> Optional[List[int]]:
+    if not (is_jit_callable(call.func, aliases)
+            or _is_partial_jit(call, aliases)):
+        return None
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            return literal_argnums(kw.value)
+    return None
+
+
+def _is_sync_read(node: ast.AST) -> bool:
+    """int(x)/float(x)/x.item(): a host read of a (potentially) device
+    value — as a static arg it keys the cache on runtime data."""
+    if not isinstance(node, ast.Call):
+        return False
+    if isinstance(node.func, ast.Name) \
+            and node.func.id in _SYNC_READ_RX and node.args:
+        # int(x.shape[0]) and friends are trace-time: exempt shape math
+        inner = node.args[0]
+        for sub in ast.walk(inner):
+            if isinstance(sub, ast.Attribute) \
+                    and sub.attr in ("shape", "ndim", "size", "dtype"):
+                return False
+        return True
+    if isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+        return True
+    return False
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, rule: "RecompileHazardRule", ctx: ModuleContext,
+                 aliases: Set[str],
+                 static_table: Dict[str, List[int]]):
+        self.rule = rule
+        self.ctx = ctx
+        self.aliases = aliases
+        self.static_table = static_table
+        self.loop_depth = 0
+        self.func_stack: List[str] = []       # enclosing function names
+        self.findings: List[Finding] = []
+
+    # ---- scope bookkeeping ---------------------------------------------
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self._enter_function(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+        self._enter_function(node)
+
+    def _enter_function(self, node):
+        for dec in node.decorator_list:
+            self.visit(dec)
+        name = node.name
+        if any(self._is_memoizer(d) for d in node.decorator_list):
+            # lru_cache/cache-decorated: the body runs once per key, so
+            # jit construction inside IS the construct-once pattern
+            name = "__memoized_builder__"
+        self.func_stack.append(name)
+        outer_loop, self.loop_depth = self.loop_depth, 0
+        for stmt in node.body:
+            self.visit(stmt)
+        self.loop_depth = outer_loop
+        self.func_stack.pop()
+
+    def visit_For(self, node):
+        self._loop(node)
+
+    def visit_AsyncFor(self, node):
+        self._loop(node)
+
+    def visit_While(self, node):
+        self._loop(node)
+
+    def _loop(self, node):
+        for value in ast.iter_child_nodes(node):
+            if isinstance(value, ast.expr):
+                self.visit(value)
+        self.loop_depth += 1
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+        self.loop_depth -= 1
+
+    # ---- the checks -----------------------------------------------------
+    @staticmethod
+    def _is_memoizer(dec: ast.AST) -> bool:
+        if isinstance(dec, ast.Call):
+            dec = dec.func
+        return dotted_name(dec) in (
+            "functools.lru_cache", "functools.cache", "lru_cache",
+            "cache")
+
+    def _in_builder(self) -> bool:
+        return any(n == "__memoized_builder__" or _BUILDER_RX.match(n)
+                   for n in self.func_stack)
+
+    def visit_Call(self, node: ast.Call):
+        jitty = is_jit_callable(node.func, self.aliases) \
+            or _is_partial_jit(node, self.aliases)
+        if jitty and self.loop_depth > 0:
+            self.findings.append(self.ctx.finding(
+                RULE, node.lineno,
+                "jax.jit constructed inside a loop: each iteration "
+                "builds a fresh wrapper with an empty trace cache "
+                "(recompiles every pass) — hoist the jit out of the "
+                "loop"))
+        elif jitty and self.func_stack and not self._in_builder():
+            # inside a per-call method: flag fresh-identity wrapping
+            wrapped = node.args[0] if node.args else None
+            if isinstance(wrapped, ast.Lambda):
+                self.findings.append(self.ctx.finding(
+                    RULE, node.lineno,
+                    f"jax.jit(lambda ...) inside "
+                    f"'{self.func_stack[-1]}()': the lambda is a fresh "
+                    "function identity per call, so this recompiles "
+                    "every invocation — build it once in __init__/a "
+                    "builder and reuse"))
+        # jax.jit(...)(args): immediately-invoked wrapper — fresh trace
+        # cache per call regardless of what it wraps
+        if isinstance(node.func, ast.Call) \
+                and (is_jit_callable(node.func.func, self.aliases)
+                     or _is_partial_jit(node.func, self.aliases)) \
+                and self.func_stack and not self._in_builder():
+            self.findings.append(self.ctx.finding(
+                RULE, node.lineno,
+                "jax.jit(...) constructed and invoked in one "
+                "expression: the wrapper's compile cache dies with the "
+                "expression, so every call recompiles — bind the "
+                "jitted callable once and reuse it"))
+        # data-dependent static args on calls to known static-jitted fns
+        callee = dotted_name(node.func)
+        if callee in self.static_table:
+            for p in self.static_table[callee]:
+                if p < len(node.args) and _is_sync_read(node.args[p]):
+                    self.findings.append(self.ctx.finding(
+                        RULE, node.lineno,
+                        f"data-dependent value at static_argnums "
+                        f"position {p} of '{callee}': every distinct "
+                        "runtime value recompiles (and the int()/"
+                        "float()/.item() read syncs the host) — pass "
+                        "it traced, or derive it from shapes"))
+        self.generic_visit(node)
+
+
+class _TracedBranchVisitor(ast.NodeVisitor):
+    """Flags ``if param:`` / ``while param > 0:`` on bare parameters of
+    jit-decorated functions."""
+
+    def __init__(self, ctx: ModuleContext, fn, params: Set[str]):
+        self.ctx = ctx
+        self.params = params
+        self.findings: List[Finding] = []
+        for stmt in fn.body:
+            self.visit(stmt)
+
+    def visit_FunctionDef(self, node):      # nested defs: own params
+        pass
+
+    def visit_AsyncFunctionDef(self, node):
+        pass
+
+    def visit_Lambda(self, node):
+        pass
+
+    def _check_test(self, test: ast.expr):
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Attribute) \
+                    and sub.attr in ("shape", "ndim", "dtype", "size"):
+                return            # shape math: trace-time constant
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Name) and sub.id in self.params:
+                self.findings.append(self.ctx.finding(
+                    RULE, test.lineno,
+                    f"Python branch on traced parameter '{sub.id}' "
+                    "inside a jitted function: this either fails to "
+                    "trace or (made static) recompiles per value — "
+                    "use lax.cond / jnp.where, or branch on shapes"))
+                return
+
+    def visit_If(self, node: ast.If):
+        self._check_test(node.test)
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While):
+        self._check_test(node.test)
+        self.generic_visit(node)
+
+
+def _jitted_functions(tree: ast.Module, aliases: Set[str]):
+    """(FunctionDef, params) for defs decorated with jax.jit /
+    partial(jax.jit, ...) or passed to jax.jit by name at module
+    level."""
+    jitted_names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and (is_jit_callable(node.func, aliases)
+                     or _is_partial_jit(node, aliases)):
+            args = node.args[1:] if _is_partial_jit(node, aliases) \
+                else node.args
+            for a in args[:1]:
+                if isinstance(a, ast.Name):
+                    jitted_names.add(a.id)
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        decorated = False
+        static_pos: List[int] = []
+        for dec in node.decorator_list:
+            if is_jit_callable(dec, aliases):
+                decorated = True
+            elif isinstance(dec, ast.Call) \
+                    and (is_jit_callable(dec.func, aliases)
+                         or _is_partial_jit(dec, aliases)):
+                decorated = True
+                static_pos = _static_positions(dec, aliases) or []
+        if decorated or node.name in jitted_names:
+            pos_args = node.args.posonlyargs + node.args.args
+            params = {a.arg for i, a in enumerate(pos_args)
+                      if a.arg not in ("self", "cls")
+                      and i not in static_pos}
+            params |= {a.arg for a in node.args.kwonlyargs}
+            yield node, params
+
+
+class RecompileHazardRule(Rule):
+    name = RULE
+    description = ("jit construction in loops/per-call paths, "
+                   "data-dependent static args, traced-value branches")
+    paths = ("deeplearning4j_tpu",)
+
+    def check(self, ctx: ModuleContext,
+              project: Project) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return
+        aliases = collect_jit_aliases(ctx.tree)
+        # module-level map: name -> static positions (for the
+        # data-dependent-static check)
+        static_table: Dict[str, List[int]] = {}
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                pos = _static_positions(node.value, aliases)
+                if pos:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            static_table[t.id] = pos
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        pos = _static_positions(dec, aliases)
+                        if pos:
+                            static_table[node.name] = pos
+        v = _Visitor(self, ctx, aliases, static_table)
+        v.visit(ctx.tree)
+        yield from v.findings
+        for fn, params in _jitted_functions(ctx.tree, aliases):
+            yield from _TracedBranchVisitor(ctx, fn, params).findings

@@ -1,0 +1,312 @@
+"""thread-discipline: cross-thread shared state without a common lock.
+
+The PR 4 ``AsyncDataSetIterator`` reset race and the PR 6
+``queue_depth`` accounting miss were both the same shape: a class
+spawns a thread, and an instance attribute is mutated both by the
+thread's code and by methods other threads call, with no lock (or no
+*common* lock) covering every writer. Two findings:
+
+- **unlocked-shared-write** — within a class that spawns threads
+  (``threading.Thread(target=self.m ...)``, a nested closure handed to
+  ``Thread``, or a ``threading.Thread`` subclass with ``run``), an
+  instance attribute is written both from thread-side code (the target
+  and everything it calls via ``self.*``) and from outside it, and the
+  writers' held-lock sets share no common lock. ``__init__`` writes are
+  pre-spawn and exempt.
+- **lock-order-inversion** — two methods of one class acquire the same
+  pair of ``self.*`` locks in opposite orders (``with self.a: with
+  self.b:`` vs ``with self.b: with self.a:``): a classic ABBA deadlock.
+
+Held locks are tracked through ``with self.<lock>:`` blocks where
+``<lock>`` is an attribute assigned ``threading.Lock()/RLock()/
+Condition()/Semaphore()`` in the class, or whose name contains
+"lock"/"mutex"/"cond". Queue/Event primitives are internally
+synchronized and excluded from the shared-write check.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.graftlint.engine import (
+    Finding, ModuleContext, Project, Rule, dotted_name)
+
+RULE = "thread-discipline"
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock",
+               "threading.Condition", "threading.Semaphore",
+               "threading.BoundedSemaphore", "Lock", "RLock",
+               "Condition", "Semaphore", "BoundedSemaphore"}
+# attributes whose values synchronize themselves — writes to the
+# *binding* still race, but rebinding one is almost always init-shaped;
+# mutating methods (q.put) aren't attribute writes anyway
+_SELF_SYNC_CTORS = {"queue.Queue", "queue.SimpleQueue",
+                    "queue.LifoQueue", "queue.PriorityQueue",
+                    "threading.Event", "Queue", "SimpleQueue", "Event"}
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    return dotted_name(call.func) in ("threading.Thread", "Thread")
+
+
+def _thread_target(call: ast.Call) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == "target":
+            return kw.value
+    if call.args:
+        return None     # positional arg 0 is group, never the target
+    return None
+
+
+class _MethodScan(ast.NodeVisitor):
+    """One method (or thread-closure) body: self.* writes with held
+    locks, self-method calls, nested-with lock acquisition order."""
+
+    def __init__(self, lock_attrs: Set[str]):
+        self.lock_attrs = lock_attrs
+        self.held: List[str] = []
+        # attr -> list of (frozenset(held locks), lineno)
+        self.writes: Dict[str, List[Tuple[frozenset, int]]] = {}
+        self.calls: Set[str] = set()           # self.<m>() call targets
+        self.pairs: List[Tuple[str, str, int]] = []  # (outer, inner, ln)
+        self.spawns: List[ast.Call] = []       # Thread(...) ctor calls
+        self.local_funcs: Dict[str, ast.AST] = {}
+
+    def _lock_of(self, node: ast.expr) -> Optional[str]:
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            name = node.attr
+            if name in self.lock_attrs or any(
+                    t in name.lower()
+                    for t in ("lock", "mutex", "cond")):
+                return name
+        return None
+
+    def visit_With(self, node: ast.With):
+        acquired = []
+        for item in node.items:
+            self.visit(item.context_expr)
+            lock = self._lock_of(item.context_expr)
+            if lock is not None:
+                for outer in self.held:
+                    if outer != lock:
+                        self.pairs.append((outer, lock, node.lineno))
+                self.held.append(lock)
+                acquired.append(lock)
+        for stmt in node.body:
+            self.visit(stmt)
+        for lock in acquired:
+            self.held.remove(lock)
+
+    visit_AsyncWith = visit_With
+
+    def _record_write(self, target: ast.expr, lineno: int):
+        if isinstance(target, ast.Attribute) \
+                and isinstance(target.value, ast.Name) \
+                and target.value.id == "self":
+            self.writes.setdefault(target.attr, []).append(
+                (frozenset(self.held), lineno))
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._record_write(elt, lineno)
+        elif isinstance(target, ast.Starred):
+            self._record_write(target.value, lineno)
+
+    def visit_Assign(self, node: ast.Assign):
+        self.visit(node.value)
+        for t in node.targets:
+            self._record_write(t, node.lineno)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self.visit(node.value)
+        self._record_write(node.target, node.lineno)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self.visit(node.value)
+        self._record_write(node.target, node.lineno)
+
+    def visit_Call(self, node: ast.Call):
+        if _is_thread_ctor(node):
+            self.spawns.append(node)
+        if isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "self":
+            self.calls.add(node.func.attr)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        # nested closure: scanned separately (it may be a thread target)
+        self.local_funcs[node.name] = node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda):
+        pass
+
+
+class _ClassInfo:
+    def __init__(self, node: ast.ClassDef, ctx: ModuleContext):
+        self.node = node
+        self.ctx = ctx
+        self.methods: Dict[str, _MethodScan] = {}
+        self.closure_scans: Dict[str, _MethodScan] = {}
+        self.lock_attrs: Set[str] = set()
+        self.self_sync_attrs: Set[str] = set()
+        self.thread_entries: Set[str] = set()      # method names
+        self.thread_closures: Set[str] = set()     # "method.closure"
+        self.is_thread_subclass = any(
+            dotted_name(b) in ("threading.Thread", "Thread")
+            for b in node.bases)
+        self._collect()
+
+    def _collect(self):
+        # pass 1: lock / self-synchronized attribute discovery
+        for m in self.node.body:
+            if not isinstance(m, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                continue
+            for sub in ast.walk(m):
+                if isinstance(sub, ast.Assign) \
+                        and isinstance(sub.value, ast.Call):
+                    ctor = dotted_name(sub.value.func)
+                    for t in sub.targets:
+                        if isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self":
+                            if ctor in _LOCK_CTORS:
+                                self.lock_attrs.add(t.attr)
+                            elif ctor in _SELF_SYNC_CTORS:
+                                self.self_sync_attrs.add(t.attr)
+        # pass 2: per-method scans
+        for m in self.node.body:
+            if not isinstance(m, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                continue
+            scan = _MethodScan(self.lock_attrs)
+            for stmt in m.body:
+                scan.visit(stmt)
+            self.methods[m.name] = scan
+            for name, fn in scan.local_funcs.items():
+                sub = _MethodScan(self.lock_attrs)
+                for stmt in fn.body:
+                    sub.visit(stmt)
+                self.closure_scans[f"{m.name}.{name}"] = sub
+            # thread targets spawned by this method
+            for spawn in scan.spawns:
+                tgt = _thread_target(spawn)
+                if isinstance(tgt, ast.Attribute) \
+                        and isinstance(tgt.value, ast.Name) \
+                        and tgt.value.id == "self":
+                    self.thread_entries.add(tgt.attr)
+                elif isinstance(tgt, ast.Name) \
+                        and tgt.id in scan.local_funcs:
+                    self.thread_closures.add(f"{m.name}.{tgt.id}")
+        if self.is_thread_subclass and "run" in self.methods:
+            self.thread_entries.add("run")
+
+    def thread_side_methods(self) -> Set[str]:
+        """Thread entries plus everything they reach via self.* calls
+        (transitive, within the class)."""
+        side = set(self.thread_entries)
+        frontier = list(side)
+        while frontier:
+            m = frontier.pop()
+            scan = self.methods.get(m)
+            if scan is None:
+                continue
+            for callee in scan.calls:
+                if callee in self.methods and callee not in side:
+                    side.add(callee)
+                    frontier.append(callee)
+        return side
+
+    def spawns_threads(self) -> bool:
+        return bool(self.thread_entries or self.thread_closures)
+
+
+class ThreadDisciplineRule(Rule):
+    name = RULE
+    description = ("instance attributes mutated across threads without "
+                   "a common lock; inconsistent lock acquisition order")
+    paths = ("deeplearning4j_tpu",)
+
+    def check(self, ctx: ModuleContext,
+              project: Project) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                info = _ClassInfo(node, ctx)
+                yield from self._check_shared_writes(info)
+                yield from self._check_lock_order(info)
+
+    # ---- unlocked cross-thread writes ------------------------------------
+    def _check_shared_writes(self, info: _ClassInfo
+                             ) -> Iterable[Finding]:
+        if not info.spawns_threads():
+            return
+        side = info.thread_side_methods()
+        # writer table: attr -> [(method label, is_thread_side,
+        #                         locks, line)]
+        writers: Dict[str, List[Tuple[str, bool, frozenset, int]]] = {}
+
+        def add(label: str, thread_side: bool, scan: _MethodScan):
+            for attr, accesses in scan.writes.items():
+                if attr in info.lock_attrs \
+                        or attr in info.self_sync_attrs:
+                    continue
+                for locks, line in accesses:
+                    writers.setdefault(attr, []).append(
+                        (label, thread_side, locks, line))
+
+        for name, scan in info.methods.items():
+            if name in ("__init__", "__new__"):
+                continue          # pre-spawn construction
+            add(name, name in side, scan)
+        for label, scan in info.closure_scans.items():
+            add(label, label in info.thread_closures, scan)
+
+        for attr, ws in sorted(writers.items()):
+            t_side = [w for w in ws if w[1]]
+            o_side = [w for w in ws if not w[1]]
+            if not t_side or not o_side:
+                continue
+            methods_t = sorted({w[0] for w in t_side})
+            methods_o = sorted({w[0] for w in o_side})
+            common = frozenset.intersection(
+                *[w[2] for w in ws]) if ws else frozenset()
+            if common:
+                continue
+            # flag every unlocked write site (locked-but-disjoint sites
+            # are flagged too: they prove no common lock exists)
+            flagged = [w for w in ws if not w[2]] or ws
+            for label, _ts, _locks, line in flagged:
+                yield info.ctx.finding(
+                    RULE, line,
+                    f"'self.{attr}' is written from thread-side "
+                    f"{methods_t} and from {methods_o} with no common "
+                    f"lock held (class {info.node.name} spawns "
+                    "threads) — guard every writer with one lock or "
+                    "make the state thread-local")
+
+    # ---- lock ordering ---------------------------------------------------
+    def _check_lock_order(self, info: _ClassInfo) -> Iterable[Finding]:
+        order: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        scans = dict(info.methods)
+        scans.update(info.closure_scans)
+        for label, scan in sorted(scans.items()):
+            for outer, inner, line in scan.pairs:
+                order.setdefault((outer, inner), (label, line))
+        for (a, b), (label, line) in sorted(order.items()):
+            rev = order.get((b, a))
+            if rev is not None and (a, b) < (b, a):
+                yield info.ctx.finding(
+                    RULE, line,
+                    f"lock-order inversion in class {info.node.name}: "
+                    f"'{label}' acquires self.{a} then self.{b}, but "
+                    f"'{rev[0]}' (line {rev[1]}) acquires them in the "
+                    "opposite order — a concurrent pair can deadlock "
+                    "(ABBA)")

@@ -1,0 +1,223 @@
+"""tracer-leak: traced values escaping a jit/shard_map trace.
+
+Inside ``jax.jit``/``pjit``/``shard_map``/``pmap``-traced code, every
+value derived from an argument is a Tracer. Storing one onto ``self``,
+a global, or an enclosing scope outlives the trace: at best a
+``TracerLeakError`` under ``jax.check_tracer_leaks``, at worst a stale
+abstract value silently captured by the *first* trace and replayed
+forever after (the classic "metrics stuck at step 0" bug). Flags, in
+any function that is jit-decorated, passed to jax.jit/shard_map/pmap
+in the same module, or nested inside such a function:
+
+- assignments to ``self.<attr>`` (and any parameter's attribute)
+- assignments to names declared ``global`` / ``nonlocal``
+- subscript stores into closure/global names (``cache[k] = x``)
+
+Trace-time configuration writes are rare and explicit — pragma them
+with ``# graftlint: disable=tracer-leak: <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from tools.graftlint.engine import (
+    Finding, ModuleContext, Project, Rule, collect_jit_aliases,
+    dotted_name, is_jit_callable)
+
+RULE = "tracer-leak"
+
+_TRACING_WRAPPERS = ("shard_map", "jax.experimental.shard_map.shard_map",
+                     "pmap", "jax.pmap", "vmap_of_jit")
+
+
+def _is_tracing_call(node: ast.Call, aliases: Set[str]) -> bool:
+    if is_jit_callable(node.func, aliases):
+        return True
+    name = dotted_name(node.func)
+    if name is None:
+        return False
+    return name in _TRACING_WRAPPERS \
+        or name.split(".")[-1] in ("shard_map", "pmap")
+
+
+def _wrapped_names(tree: ast.Module, aliases: Set[str]) -> Set[str]:
+    """Names passed (positionally, arg 0, incl. through
+    functools.partial) to jit/shard_map/pmap anywhere in the module."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_tracing_call(node, aliases) and node.args:
+            a = node.args[0]
+            if isinstance(a, ast.Name):
+                out.add(a.id)
+            elif isinstance(a, ast.Call) \
+                    and dotted_name(a.func) in ("functools.partial",
+                                                "partial") and a.args \
+                    and isinstance(a.args[0], ast.Name):
+                out.add(a.args[0].id)
+    return out
+
+
+class _LeakVisitor(ast.NodeVisitor):
+    """Walks one traced function body; nested defs inherit traced-ness
+    (they trace too) but keep their own local-name tables."""
+
+    def __init__(self, ctx: ModuleContext, fn, findings: List[Finding]):
+        self.ctx = ctx
+        self.findings = findings
+        self.fn_name = fn.name if hasattr(fn, "name") else "<lambda>"
+        self.locals: Set[str] = {
+            a.arg for a in fn.args.args + fn.args.posonlyargs
+            + fn.args.kwonlyargs}
+        if fn.args.vararg:
+            self.locals.add(fn.args.vararg.arg)
+        if fn.args.kwarg:
+            self.locals.add(fn.args.kwarg.arg)
+        self.globals: Set[str] = set()
+        self.nonlocals: Set[str] = set()
+        for stmt in fn.body:
+            self.visit(stmt)
+
+    # ---- scope declarations ---------------------------------------------
+    def visit_Global(self, node: ast.Global):
+        self.globals.update(node.names)
+
+    def visit_Nonlocal(self, node: ast.Nonlocal):
+        self.nonlocals.update(node.names)
+
+    def visit_FunctionDef(self, node):
+        self.locals.add(node.name)
+        _LeakVisitor(self.ctx, node, self.findings)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+    # ---- stores ----------------------------------------------------------
+    def _check_target(self, t: ast.expr, lineno: int):
+        if isinstance(t, ast.Attribute):
+            base = t.value
+            if isinstance(base, ast.Name):
+                who = ("self" if base.id == "self"
+                       else f"parameter '{base.id}'"
+                       if base.id in self.locals else base.id)
+                self.findings.append(self.ctx.finding(
+                    RULE, lineno,
+                    f"store to {who}.{t.attr} inside traced function "
+                    f"'{self.fn_name}': the traced value outlives the "
+                    "trace (leaked Tracer / value frozen at first "
+                    "trace) — return it instead, or carry it in the "
+                    "function's outputs"))
+        elif isinstance(t, ast.Subscript):
+            base = t.value
+            if isinstance(base, ast.Name) \
+                    and base.id not in self.locals:
+                self.findings.append(self.ctx.finding(
+                    RULE, lineno,
+                    f"subscript store into enclosing-scope "
+                    f"'{base.id}' inside traced function "
+                    f"'{self.fn_name}': mutating host containers "
+                    "under trace leaks tracers and runs only on the "
+                    "first trace — return the value instead"))
+        elif isinstance(t, ast.Name):
+            if t.id in self.globals or t.id in self.nonlocals:
+                kind = "global" if t.id in self.globals else "nonlocal"
+                self.findings.append(self.ctx.finding(
+                    RULE, lineno,
+                    f"assignment to {kind} '{t.id}' inside traced "
+                    f"function '{self.fn_name}': the binding escapes "
+                    "the trace and is only updated when (re)tracing — "
+                    "thread it through the function's inputs/outputs"))
+            else:
+                self.locals.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for elt in t.elts:
+                self._check_target(elt, lineno)
+        elif isinstance(t, ast.Starred):
+            self._check_target(t.value, lineno)
+
+    def visit_Assign(self, node: ast.Assign):
+        self.visit(node.value)
+        for t in node.targets:
+            self._check_target(t, node.lineno)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self.visit(node.value)
+        self._check_target(node.target, node.lineno)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self.visit(node.value)
+            self._check_target(node.target, node.lineno)
+
+    def visit_For(self, node: ast.For):
+        # loop targets are local bindings, not leaks
+        for sub in ast.walk(node.target):
+            if isinstance(sub, ast.Name):
+                self.locals.add(sub.id)
+        self.visit(node.iter)
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    def visit_With(self, node: ast.With):
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                for sub in ast.walk(item.optional_vars):
+                    if isinstance(sub, ast.Name):
+                        self.locals.add(sub.id)
+        for stmt in node.body:
+            self.visit(stmt)
+
+
+def _traced_defs(tree: ast.Module, aliases: Set[str]):
+    wrapped = _wrapped_names(tree, aliases)
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        decorated = False
+        for dec in node.decorator_list:
+            if is_jit_callable(dec, aliases):
+                decorated = True
+            elif isinstance(dec, ast.Call):
+                if _is_tracing_call(dec, aliases):
+                    decorated = True
+                elif dotted_name(dec.func) in ("functools.partial",
+                                               "partial") \
+                        and dec.args \
+                        and is_jit_callable(dec.args[0], aliases):
+                    decorated = True
+        if decorated or node.name in wrapped:
+            yield node
+
+
+class TracerLeakRule(Rule):
+    name = RULE
+    description = ("traced values stored on self/globals/closures from "
+                   "inside jitted or shard_map'd functions")
+    paths = ("deeplearning4j_tpu",)
+
+    def check(self, ctx: ModuleContext,
+              project: Project) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return
+        aliases = collect_jit_aliases(ctx.tree)
+        findings: List[Finding] = []
+        seen: Set[int] = set()
+        for fn in _traced_defs(ctx.tree, aliases):
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            _LeakVisitor(ctx, fn, findings)
+        # dedup (a def both decorated and re-wrapped)
+        out, keys = [], set()
+        for f in findings:
+            k = (f.line, f.message)
+            if k not in keys:
+                keys.add(k)
+                out.append(f)
+        yield from out
