@@ -42,6 +42,10 @@ Usage:
     python benchmarks/serving.py --smoke           # CI gate: bitwise vs
         # direct model.output, zero recompiles after warmup, pipelined
         # >= 1.3x blocking closed-loop
+    python benchmarks/serving.py --precision-ab    # f32/bf16/int8 $/req
+    python benchmarks/serving.py --precision-ab --smoke  # CI gate:
+        # int8 within top-1 budget of f32, all arms warm, int8 bytes
+        # proxy strictly below bf16
     python benchmarks/serving.py --cold-start      # cached vs uncached
     python benchmarks/serving.py --smoke-fleet     # CI fleet gate
     python benchmarks/serving.py --soak-fleet --rate 150 --duration 10
@@ -87,14 +91,15 @@ def build_model(seed: int = 7, width: int = 1024):
 
 def make_engine(model, *, pipelined: bool, session: str,
                 batch_limit: int = 32, timeout_ms: float = 5.0,
-                replicas=1, aot_cache_dir=None) -> ServingEngine:
+                replicas=1, aot_cache_dir=None,
+                precision=None) -> ServingEngine:
     # isolated registry per arm: the A/B must not share counters
     return ServingEngine(
         model, batch_limit=batch_limit, timeout_ms=timeout_ms,
         pipelined=pipelined, replicas=replicas,
         feature_shape=(FEATURES,), registry=MetricsRegistry(),
         session_id=session, aot_cache_dir=aot_cache_dir,
-        model_version="bench")
+        model_version="bench", precision=precision)
 
 
 def closed_loop(engine: ServingEngine, n_clients: int, n_requests: int,
@@ -268,6 +273,115 @@ def run_smoke(args) -> int:
           f"{stats['recompiles_after_warmup']} recompiles after warmup, "
           f"pipelined {speedup:.2f}x blocking")
     return 0
+
+
+# ---- precision A/B: $/req proxy across f32 / bf16 / int8 -----------------
+
+def run_precision_ab(args, smoke: bool = False) -> int:
+    """A/B the serving PrecisionPolicy arms on a $/req cost proxy next
+    to the latency columns. Dollar cost on a rented accelerator tracks
+    device-seconds and bytes moved, so per completed request we report:
+
+    - **bytes/req** — params-resident bytes x (device batches / requests)
+      plus the request's own feature/output payload: the per-request
+      share of weight traffic the matmuls pull through the memory
+      hierarchy. Int8 holds a quarter of f32's weight bytes (bf16 half),
+      so this is the column quantization is buying down.
+    - **devms/req** — engine-measured device milliseconds (dispatch to
+      ready) per request.
+    - **params MB** — resident committed weights (the HBM rent).
+
+    ``--smoke`` gates: int8 answers like f32 (top-1 agreement within
+    budget), every arm warm (zero post-warmup recompiles), and int8's
+    bytes/req strictly below bf16's — the headline the quantization
+    path must actually deliver.
+    """
+    from deeplearning4j_tpu.parallel.quant import PrecisionPolicy
+    width = 64 if smoke else args.width
+    batch_limit = 16 if smoke else args.batch_limit
+    clients = 4 if smoke else args.clients
+    requests = 25 if smoke else args.requests
+    rounds = 2 if smoke else args.rounds
+    model = build_model(width=width)
+    rng = np.random.default_rng(11)
+    calib = rng.normal(size=(256, FEATURES)).astype(np.float32)
+    eval_x = rng.normal(size=(batch_limit, FEATURES)).astype(np.float32)
+    policies = {
+        "f32": PrecisionPolicy.f32(),
+        "bf16": PrecisionPolicy.bf16(),
+        "int8": PrecisionPolicy.int8(calib),
+    }
+    rows = {}
+    outputs = {}
+    failures = []
+    for name, policy in policies.items():
+        eng = make_engine(model, pipelined=True,
+                          session=f"prec-{name}",
+                          batch_limit=batch_limit,
+                          timeout_ms=args.timeout_ms,
+                          precision=policy)
+        try:
+            outputs[name] = np.asarray(eng.output(eval_x))
+            d0, ms0 = eng.dispatch_count, eng.device_ms_total
+            ring = LatencyRing(capacity=1 << 16)
+            tputs = []
+            for r in range(rounds):
+                tp, rg = closed_loop(eng, clients, requests,
+                                     args.req_size, seed=r)
+                tputs.append(tp)
+                for v in rg.snapshot():
+                    ring.record(v)
+            n_req = clients * requests * rounds
+            batches = eng.dispatch_count - d0
+            dev_ms = eng.device_ms_total - ms0
+            pbytes = eng.params_resident_bytes
+            io_bytes = (args.req_size * FEATURES * 4
+                        + args.req_size * outputs[name].shape[-1] * 4)
+            q = ring.quantiles((0.5, 0.99))
+            try:
+                eng.assert_warm()
+            except Exception as e:
+                failures.append(f"{name} arm not warm: {e}")
+            rows[name] = {
+                "tput": statistics.median(tputs),
+                "p50_ms": q[0.5] * 1e3, "p99_ms": q[0.99] * 1e3,
+                "params_bytes": pbytes,
+                "bytes_per_req": pbytes * (batches / n_req) + io_bytes,
+                "devms_per_req": dev_ms / n_req,
+            }
+        finally:
+            eng.shutdown()
+
+    print(f"precision A/B: width={width}, {clients} clients x "
+          f"{requests} requests x{args.req_size}, median of {rounds} "
+          "rounds:")
+    print(f"  {'arm':5s} {'req/s':>9s} {'p50':>9s} {'p99':>9s} "
+          f"{'paramsMB':>9s} {'bytes/req':>11s} {'devms/req':>10s}")
+    for name, r in rows.items():
+        print(f"  {name:5s} {r['tput']:9.1f} {r['p50_ms']:8.2f}m "
+              f"{r['p99_ms']:8.2f}m {r['params_bytes'] / 1e6:9.3f} "
+              f"{r['bytes_per_req']:11.0f} {r['devms_per_req']:10.3f}")
+
+    a_f32 = outputs["f32"].argmax(axis=-1).reshape(-1)
+    a_int8 = outputs["int8"].argmax(axis=-1).reshape(-1)
+    agreement = float((a_f32 == a_int8).mean())
+    print(f"  int8 top-1 agreement vs f32: {agreement:.4f}  "
+          f"bytes/req vs bf16: {rows['int8']['bytes_per_req']:.0f} "
+          f"vs {rows['bf16']['bytes_per_req']:.0f}")
+    if smoke:
+        if agreement < 1.0 - args.top1_budget:
+            failures.append(
+                f"int8 top-1 agreement {agreement:.4f} below the "
+                f"{1.0 - args.top1_budget:.4f} floor")
+        if not rows["int8"]["bytes_per_req"] < \
+                rows["bf16"]["bytes_per_req"]:
+            failures.append(
+                "int8 bytes/req "
+                f"{rows['int8']['bytes_per_req']:.0f} not strictly "
+                f"below bf16 {rows['bf16']['bytes_per_req']:.0f}")
+    for f in failures:
+        print(f"FAIL: {f}")
+    return 1 if failures else 0
 
 
 # ---- cold start: persisted AOT cache A/B (subprocess arms) ---------------
@@ -541,6 +655,15 @@ def main(argv=None) -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="CI gate: bitwise outputs, zero post-warmup "
                     "recompiles, >=1.3x closed-loop")
+    # precision A/B ($/req proxy across serving precisions)
+    ap.add_argument("--precision-ab", action="store_true",
+                    help="A/B f32 / bf16 / int8 serving arms on a "
+                    "$/req proxy (bytes moved, device ms, resident "
+                    "params) next to p50/p99; with --smoke also gates "
+                    "int8 accuracy + bytes strictly below bf16")
+    ap.add_argument("--top1-budget", type=float, default=0.02,
+                    help="--precision-ab --smoke: max tolerated int8 "
+                    "top-1 disagreement vs f32")
     # cold start (persisted AOT cache A/B)
     ap.add_argument("--cold-start", action="store_true",
                     help="subprocess A/B: cold-start-to-assert_warm "
@@ -585,6 +708,8 @@ def main(argv=None) -> int:
         return run_cold_child(args)
     if args.cold_start:
         return run_cold_start(args)
+    if args.precision_ab:
+        return run_precision_ab(args, smoke=args.smoke)
     if args.smoke_fleet or args.soak_fleet:
         return run_fleet(args, smoke=args.smoke_fleet)
     return run_smoke(args) if args.smoke else run_timed(args)

@@ -1,0 +1,21 @@
+"""Evaluation harnesses (quantization accuracy gate)."""
+
+from deeplearning4j_tpu.evaluation.quant_gate import (
+    GateResult,
+    QuantGate,
+    QuantGateError,
+    enforce_quant_gate,
+    run_quant_gate,
+    run_zoo_gates,
+    zoo_gate_cases,
+)
+
+__all__ = [
+    "GateResult",
+    "QuantGate",
+    "QuantGateError",
+    "enforce_quant_gate",
+    "run_quant_gate",
+    "run_zoo_gates",
+    "zoo_gate_cases",
+]

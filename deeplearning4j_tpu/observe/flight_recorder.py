@@ -71,6 +71,7 @@ class FlightRecorder:
             else bool(enabled)
         self.max_dumps = int(max_dumps)
         self.dumps: List[str] = []          # paths written, in order
+        self._notes: Dict[str, Any] = {}    # breadcrumbs (see note())
         self._dumped_reasons: set = set()
         self._seen_records = 0
         self._seen_replica = 0
@@ -110,6 +111,19 @@ class FlightRecorder:
         if hit:
             return self.record_crash(model, reason="nonfinite")
         return None
+
+    def note(self, key: str, value: Any):
+        """Attach a breadcrumb that rides along in ``context.json`` of
+        every FUTURE dump (last write per key wins). For non-fatal
+        events worth having in the post-mortem — e.g. the serving
+        engine records WHY a persisted quantized AOT cache was rejected
+        (the fingerprint field that diverged), so a later crash dump
+        explains the cold start that preceded it. Never raises."""
+        try:
+            with self._lock:
+                self._notes[str(key)] = value
+        except Exception:
+            pass
 
     # ---- terminal events ------------------------------------------------
     def record_crash(self, model, reason: Optional[str] = None,
@@ -208,8 +222,12 @@ class FlightRecorder:
             trace = tracer.to_chrome_trace()
             trace["traceEvents"] = trace["traceEvents"][-500:]
             write("spans.json", trace)
+        with self._lock:
+            context = dict(self._notes)
         if extra:
-            write("context.json", extra)
+            context.update(extra)
+        if context:
+            write("context.json", context)
         write("environment.json", self._environment_section(model))
         self._write_report(path, model, reason, exc, sections)
         return path

@@ -1,0 +1,114 @@
+"""Int8 post-training quantization primitives.
+
+The reference framework leans on ND4J's ``org.nd4j.linalg.compression``
+codecs for smaller model artifacts; it has no inference-side integer
+compute path. Here the serving stack gets a real one: per-channel
+symmetric int8 weights + per-layer static activation scales, with the
+hot matmul/conv running int8 x int8 -> int32 on the device
+(``preferred_element_type=jnp.int32`` keeps XLA's integer MAC path —
+on TPU this hits the MXU's int8 mode, on CPU the VNNI-style kernels)
+and a fused dequant-rescale back to f32 for bias + activation.
+
+Conventions (all symmetric, zero-point-free):
+
+- **Weights** quantize per OUTPUT channel: scale[o] = absmax(W[..., o])
+  / 127 so each channel uses the full int8 range regardless of the
+  others. Dense kernels are (n_in, n_out) -> reduce axis 0; conv
+  kernels are HWIO -> reduce axes (0, 1, 2).
+- **Activations** quantize with ONE static scalar scale per layer,
+  calibrated offline (parallel/quant.py) from observed ranges. Static
+  (not dynamic) scales keep the executable free of data-dependent
+  reductions on the request path.
+- **Dequant** folds both scales into a single f32 multiply on the int32
+  accumulator: y = (xq @ wq) * (x_scale * w_scale[o]).
+
+Scale *computation* is host-side numpy (float32) so calibration is
+bitwise deterministic across processes — the same sample stream must
+produce the identical AOT-cache fingerprint (tests/test_aot_cache.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+Q_MAX = 127  # symmetric int8: [-127, 127]; -128 unused (keeps |q| symmetric)
+
+
+# ---- host-side scale computation (numpy, deterministic) ------------------
+
+def per_channel_scales(w: np.ndarray,
+                       reduce_axes: Optional[Sequence[int]] = None
+                       ) -> np.ndarray:
+    """f32 scale per output channel (last axis): absmax / 127. Dead
+    channels (all-zero) get scale 1.0 so dequant never divides by 0."""
+    w = np.asarray(w, np.float32)  # host-sync-ok: quantization happens host-side once, before serving — numpy IS the point (bitwise-deterministic scales)
+    if reduce_axes is None:
+        reduce_axes = tuple(range(w.ndim - 1))
+    amax = np.max(np.abs(w), axis=tuple(reduce_axes))
+    amax = np.where(amax > 0, amax, np.float32(Q_MAX))
+    return (amax / np.float32(Q_MAX)).astype(np.float32)
+
+
+def quantize_weight(w: np.ndarray,
+                    reduce_axes: Optional[Sequence[int]] = None
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-output-channel symmetric int8 quantization of a weight
+    tensor whose LAST axis is the output channel. Returns
+    ``(w_q int8, scales f32[n_out])``; ``w ≈ w_q * scales``."""
+    w = np.asarray(w, np.float32)  # host-sync-ok: one-time host-side weight quantization, not a serving hot path
+    scales = per_channel_scales(w, reduce_axes)
+    q = np.rint(w / scales)                     # broadcast over last axis
+    q = np.clip(q, -Q_MAX, Q_MAX).astype(np.int8)
+    return q, scales
+
+
+def activation_scale(amax: float) -> np.float32:
+    """Static per-layer activation scale from a calibrated absmax."""
+    a = np.float32(amax)
+    if not np.isfinite(a) or a <= 0:
+        a = np.float32(Q_MAX)                   # degenerate: identity scale
+    return np.float32(a / np.float32(Q_MAX))
+
+
+# ---- device-side quantized compute (jax, traced) -------------------------
+
+def quantize_act(x: jnp.ndarray, x_scale) -> jnp.ndarray:
+    """f32 activation -> int8 with the layer's static scale (symmetric,
+    saturating). ``x_scale`` is a traced f32 scalar from the quantized
+    params pytree — NOT a Python constant — so the exported StableHLO is
+    parametric in it and one blob serves any calibration."""
+    q = jnp.round(x.astype(jnp.float32) / x_scale)
+    return jnp.clip(q, -Q_MAX, Q_MAX).astype(jnp.int8)
+
+
+def int8_dot(x: jnp.ndarray, w_q: jnp.ndarray, w_scale: jnp.ndarray,
+             x_scale: jnp.ndarray) -> jnp.ndarray:
+    """``act(x) @ w_q`` in int8 with int32 accumulation and fused
+    dequant-rescale: works on (N, F) and (N, T, F) alike (contracts the
+    last axis of x with axis 0 of w_q, like the dense einsum)."""
+    xq = quantize_act(x, x_scale)
+    y32 = lax.dot_general(
+        xq, w_q, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return y32.astype(jnp.float32) * (x_scale * w_scale)
+
+
+def int8_conv(x: jnp.ndarray, w_q: jnp.ndarray, w_scale: jnp.ndarray,
+              x_scale: jnp.ndarray, *, window_strides, padding,
+              rhs_dilation, dimension_numbers,
+              feature_group_count: int = 1) -> jnp.ndarray:
+    """Int8 convolution with int32 accumulation + fused dequant. The
+    conv geometry kwargs are forwarded verbatim from the f32 layer so
+    the quantized op computes the identical spatial map."""
+    xq = quantize_act(x, x_scale)
+    y32 = lax.conv_general_dilated(
+        xq, w_q, window_strides=window_strides, padding=padding,
+        rhs_dilation=rhs_dilation, dimension_numbers=dimension_numbers,
+        feature_group_count=feature_group_count,
+        preferred_element_type=jnp.int32)
+    return y32.astype(jnp.float32) * (x_scale * w_scale)

@@ -11,16 +11,30 @@ from deeplearning4j_tpu.parallel.inference import (
     InferenceMode,
     ParallelInference,
 )
+from deeplearning4j_tpu.parallel.quant import (
+    CalibrationResult,
+    PrecisionPolicy,
+    QuantizationError,
+    QuantizedModel,
+    calibrate,
+    quantize_model,
+)
 from deeplearning4j_tpu.parallel.serving import ServingEngine
 from deeplearning4j_tpu.parallel.wrapper import ElasticOptions
 
 __all__ = [
+    "CalibrationResult",
     "CollectiveWatchdog",
     "ElasticOptions",
     "FleetRouter",
     "InferenceMode",
     "ParallelInference",
     "PEER_LOSS_EXIT_CODE",
+    "PrecisionPolicy",
+    "QuantizationError",
+    "QuantizedModel",
     "ServingEngine",
     "ShedError",
+    "calibrate",
+    "quantize_model",
 ]

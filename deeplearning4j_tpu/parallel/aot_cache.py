@@ -20,18 +20,28 @@ XLA compile per (bucket, target). This module persists both halves:
 A ``manifest.json`` fingerprints what the blobs were exported from:
 model version + weights digest, parameter tree spec, jax/jaxlib
 versions, backend platform/device kind, the serving contract
-(feature_shape, dtype, ladder, bf16). ``try_load`` compares field by
-field and falls through to live compile on ANY mismatch (recording
-which field diverged) — a cache can make a cold start fast, never
+(feature_shape, dtype, ladder, precision, calibration hash).
+``try_load`` compares field by field and falls through to live compile
+on ANY mismatch (recording which field diverged — for the precision /
+calibration fields the reason carries both values, so a rejected quant
+cache explains itself) — a cache can make a cold start fast, never
 wrong. Mesh-sharded (multi-replica full-bucket) executables are not
 exported; they fall through to live compile and still benefit from the
 XLA cache half.
 
+Format 2 manifests hold one entry PER PRECISION: f32, bf16 and int8
+executables of the same model coexist in one cache dir as first-class
+``entries[<precision>]`` rows with per-precision blob filenames, and a
+lookup only ever consults its own precision's entry — a quantized blob
+can never satisfy an f32 lookup (their fingerprints differ in
+``serving.precision``, ``serving.calibration`` AND ``weights_sha256``,
+since int8 committed params are different bytes) nor vice versa.
+
 Layout on disk::
 
-    <cache_dir>/manifest.json      fingerprint + entry list
-    <cache_dir>/bucket_<N>.stablehlo   one exported module per bucket
-    <cache_dir>/xla/...            JAX persistent compilation cache
+    <cache_dir>/manifest.json          per-precision fingerprints + buckets
+    <cache_dir>/bucket_<N>.<precision>.stablehlo   exported modules
+    <cache_dir>/xla/...                JAX persistent compilation cache
 """
 
 from __future__ import annotations
@@ -46,7 +56,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 MANIFEST = "manifest.json"
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2          # 2: per-precision entries + calibration hash
 
 _xla_cache_lock = threading.Lock()
 _xla_cache_dir: Optional[str] = None
@@ -114,10 +124,22 @@ def weights_digest(params) -> str:
 
 
 def fingerprint(params, mstate, *, feature_shape, dtype, ladder,
-                bf16: bool, model_version: Optional[str] = None) -> Dict:
-    """Everything a loaded executable's validity depends on."""
+                precision: str = "f32",
+                calibration: Optional[str] = None,
+                bf16: Optional[bool] = None,
+                model_version: Optional[str] = None) -> Dict:
+    """Everything a loaded executable's validity depends on.
+
+    ``precision`` is the PrecisionPolicy tag (f32/bf16/int8) and
+    ``calibration`` the int8 calibration provenance hash
+    (QuantizedModel.calibration_hash()) — both are load-bearing: a
+    quant entry must never satisfy an f32 lookup, and a re-calibrated
+    model must never be served from stale-scale executables. ``bf16=``
+    is the pre-PrecisionPolicy spelling, kept for old callers."""
     import jax
     import jaxlib
+    if bf16 is not None:
+        precision = "bf16" if bf16 else "f32"
     dev = jax.devices()[0]
     return {
         "format_version": FORMAT_VERSION,
@@ -132,7 +154,8 @@ def fingerprint(params, mstate, *, feature_shape, dtype, ladder,
         "serving": {"feature_shape": list(feature_shape),
                     "dtype": str(np.dtype(dtype)),
                     "ladder": list(ladder),
-                    "bf16": bool(bf16)},
+                    "precision": str(precision),
+                    "calibration": calibration},
     }
 
 
@@ -146,6 +169,30 @@ def _first_mismatch(want: Dict, got: Dict, prefix: str = "") -> Optional[str]:
         elif w != g:
             return f"{prefix}{k}"
     return None
+
+
+def _dig(d: Dict, dotted: str):
+    for part in dotted.split("."):
+        if not isinstance(d, dict):
+            return None
+        d = d.get(part)
+    return d
+
+
+def _mismatch_reason(fp: Dict, got_fp: Dict, diff: str) -> str:
+    """Human-readable mismatch: always names the diverged field; for
+    scalar fields (notably ``serving.precision`` and
+    ``serving.calibration``) it also shows both values, so a rejected
+    quant cache states exactly WHICH precision/calibration it held."""
+    want_v, got_v = _dig(fp, diff), _dig(got_fp, diff)
+    if all(isinstance(v, (str, int, float, bool, type(None)))
+           for v in (want_v, got_v)):
+        def short(v):
+            s = repr(v)
+            return s[:20] + "..." if len(s) > 23 else s
+        return (f"fingerprint field {diff!r} diverged "
+                f"(want {short(want_v)}, got {short(got_v)})")
+    return f"fingerprint field {diff!r} diverged"
 
 
 class AOTExecutableCache:
@@ -181,10 +228,21 @@ class AOTExecutableCache:
                 self.state = "disabled"
                 self.reason = "jax.export unavailable"
 
+    @staticmethod
+    def _precision_of(fp: Dict) -> str:
+        return str(fp.get("serving", {}).get("precision", "f32"))
+
+    @staticmethod
+    def _blob_name(bucket, precision: str) -> str:
+        return f"bucket_{bucket}.{precision}.stablehlo"
+
     # ---- load ------------------------------------------------------------
     def try_load(self, fp: Dict) -> Dict[int, Any]:
-        """Deserialized ``Exported`` per bucket when the manifest
-        matches ``fp``; {} otherwise (state/reason record why)."""
+        """Deserialized ``Exported`` per bucket when the manifest's
+        entry FOR THIS PRECISION matches ``fp``; {} otherwise
+        (state/reason record why). Other precisions' entries are
+        invisible to the lookup — they can neither satisfy nor
+        invalidate it."""
         if self._export is None:
             return {}
         path = self.dir / MANIFEST
@@ -197,14 +255,31 @@ class AOTExecutableCache:
             self.state = "mismatch"
             self.reason = f"unreadable manifest: {e}"
             return {}
-        diff = _first_mismatch(fp, manifest.get("fingerprint", {}))
+        precision = self._precision_of(fp)
+        entries = manifest.get("entries")
+        if entries is None:
+            # format-1 manifest (single fingerprint, pre-precision):
+            # diff against its flat fingerprint so the reason names the
+            # real divergence (format_version at minimum); save()
+            # rewrites it as format 2
+            entry = {"fingerprint": manifest.get("fingerprint", {}),
+                     "buckets": []}
+        else:
+            entry = entries.get(precision)
+            if entry is None:
+                self.state = "cold"
+                self.reason = (f"no {precision!r} entry (cache holds "
+                               f"{sorted(entries)})")
+                return {}
+        got_fp = entry.get("fingerprint", {})
+        diff = _first_mismatch(fp, got_fp)
         if diff is not None:
             self.state = "mismatch"
-            self.reason = f"fingerprint field {diff!r} diverged"
+            self.reason = _mismatch_reason(fp, got_fp, diff)
             return {}
         loaded: Dict[int, Any] = {}
-        for bucket in manifest.get("buckets", []):
-            blob_path = self.dir / f"bucket_{bucket}.stablehlo"
+        for bucket in entry.get("buckets", []):
+            blob_path = self.dir / self._blob_name(bucket, precision)
             try:
                 blob = bytearray(blob_path.read_bytes())
                 loaded[int(bucket)] = self._export.deserialize(blob)
@@ -221,10 +296,14 @@ class AOTExecutableCache:
         """Export + serialize one module per ladder bucket and prime the
         XLA cache under the blob-wrapper's compile key, then write the
         manifest (atomically, last — a crash mid-save leaves a cache
-        that simply misses). Returns the number of buckets saved."""
+        that simply misses). Only THIS precision's entry is replaced;
+        sibling precisions keep theirs (each entry's fingerprint is
+        self-contained, so a stale sibling just misses at its own
+        load). Returns the number of buckets saved."""
         if self._export is None:
             return 0
         import jax
+        precision = self._precision_of(fp)
         params, mstate = committed
         saved = []
         for bucket in ladder:
@@ -233,7 +312,8 @@ class AOTExecutableCache:
             try:
                 exp = self._export.export(jit_fn)(params, mstate, x)
                 blob = exp.serialize()
-                (self.dir / f"bucket_{bucket}.stablehlo").write_bytes(
+                (self.dir / self._blob_name(bucket,
+                                            precision)).write_bytes(
                     bytes(blob))
                 # prime: the loading process compiles jit(exp.call), a
                 # different cache key than jit_fn's — pay it here, once,
@@ -243,9 +323,18 @@ class AOTExecutableCache:
             except Exception:
                 continue        # that bucket warms live on load; rest save
         if saved:
+            entries: Dict[str, Any] = {}
+            try:
+                manifest = json.loads((self.dir / MANIFEST).read_text())
+                # format-1 manifests are superseded wholesale
+                entries = dict(manifest.get("entries") or {})
+            except Exception:
+                pass
+            entries[precision] = {"fingerprint": fp, "buckets": saved}
             tmp = self.dir / (MANIFEST + ".tmp")
             tmp.write_text(json.dumps(
-                {"fingerprint": fp, "buckets": saved}, indent=2))
+                {"format_version": FORMAT_VERSION, "entries": entries},
+                indent=2))
             os.replace(tmp, self.dir / MANIFEST)
         return len(saved)
 

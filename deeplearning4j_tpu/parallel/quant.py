@@ -1,0 +1,465 @@
+"""Post-training int8 quantization for serving: precision policy,
+feeder-driven calibration, and the quantized inference builder.
+
+``ops/quantize.py`` holds the numeric primitives; this module turns a
+trained MultiLayerNetwork into a quantized ``build_inference_fn``
+variant the ServingEngine can commit and AOT-compile like any other:
+
+1. **PrecisionPolicy** generalizes the engine's old all-or-nothing
+   ``bf16`` flag into f32 / bf16 / int8 per model, carrying the int8
+   calibration recipe (method, sample stream, error budget).
+2. **calibrate()** streams the policy's sample batches through the
+   existing DeviceFeeder once, running a single jitted stats pass that
+   taps the absmax of every quantizable layer's input. Scales are
+   reduced host-side in float32 numpy so the same sample stream is
+   bitwise deterministic across processes — ``CalibrationResult.hash()``
+   feeds the AOT-cache fingerprint.
+3. **quantize_model()** quantizes per-channel symmetric int8 weights,
+   probes each layer's observed quantization error against the policy
+   budget (layers that blow the budget stay f32 — per-layer fallback),
+   and returns a QuantizedModel whose ``build_inference_fn`` replays
+   the model's exact inference layer walk with int8 substitutions.
+
+Only layers whose forward IS the dense matmul (DenseLayer and
+subclasses that inherit its ``apply`` unchanged: OutputLayer,
+RnnOutputLayer, ...) or the plain 2D convolution (exactly
+ConvolutionLayer — Separable/Deconvolution subclasses rewire the
+kernel layout) are candidates; everything else (LSTM, pooling,
+preprocessors, ...) runs f32 unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.ops import quantize as qz
+
+_MODES = ("f32", "bf16", "int8")
+_CALIBRATIONS = ("absmax", "percentile")
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionPolicy:
+    """Per-model serving precision. ``f32``/``bf16`` need no extras;
+    ``int8`` carries the calibration recipe:
+
+    - ``calibration``: "absmax" (max over every calibration batch) or
+      "percentile" (the given percentile of per-batch absmaxima —
+      clips rare outliers for tighter scales)
+    - ``samples``: the calibration stream — an (N, ...) feature array,
+      an iterable of feature arrays, or an iterable of DataSets (a
+      DataSetIterator works as-is); batches stream through DeviceFeeder
+    - ``error_budget``: max per-layer relative L2 error vs f32 before
+      that layer falls back to f32
+    """
+    mode: str = "f32"
+    calibration: str = "absmax"
+    percentile: float = 99.9
+    calib_batch_size: int = 32
+    max_calib_batches: int = 16
+    error_budget: float = 0.05
+    samples: Any = dataclasses.field(default=None, repr=False,
+                                     compare=False)
+
+    def __post_init__(self):
+        if self.mode not in _MODES:
+            raise ValueError(f"mode must be one of {_MODES}, "
+                             f"got {self.mode!r}")
+        if self.calibration not in _CALIBRATIONS:
+            raise ValueError(f"calibration must be one of {_CALIBRATIONS},"
+                             f" got {self.calibration!r}")
+        if not 0 < self.percentile <= 100:
+            raise ValueError("percentile must be in (0, 100]")
+        if self.calib_batch_size < 1 or self.max_calib_batches < 1:
+            raise ValueError("calib_batch_size and max_calib_batches "
+                             "must be >= 1")
+
+    @property
+    def tag(self) -> str:
+        """The precision label used in cache keys, metrics and stats."""
+        return self.mode
+
+    @classmethod
+    def f32(cls) -> "PrecisionPolicy":
+        return cls(mode="f32")
+
+    @classmethod
+    def bf16(cls) -> "PrecisionPolicy":
+        return cls(mode="bf16")
+
+    @classmethod
+    def int8(cls, samples, **kw) -> "PrecisionPolicy":
+        return cls(mode="int8", samples=samples, **kw)
+
+
+@dataclasses.dataclass(frozen=True)
+class CalibrationResult:
+    """Per-layer static activation scales from one calibration pass.
+    ``hash()`` is the provenance key folded into the AOT-cache
+    fingerprint: identical sample streams must produce identical
+    hashes (scales are reduced in host f32 — bitwise deterministic)."""
+    method: str
+    percentile: float
+    n_batches: int
+    amax: Dict[str, float]           # calibrated |x| bound per layer input
+    scales: Dict[str, float]         # activation scale per layer
+
+    def hash(self) -> str:
+        # float.hex() round-trips exactly — the hash changes iff a
+        # scale's bits change
+        payload = {
+            "method": self.method,
+            "percentile": float(np.float32(self.percentile)).hex(),  # host-sync-ok: python/np host floats, no device value in sight
+            "n_batches": self.n_batches,
+            "scales": {k: float(np.float32(v)).hex()  # host-sync-ok: scales are host f32 from calibration
+                       for k, v in sorted(self.scales.items())},
+        }
+        return hashlib.sha256(
+            json.dumps(payload, sort_keys=True).encode()).hexdigest()
+
+
+class QuantizationError(ValueError):
+    pass
+
+
+# ---- layer classification ------------------------------------------------
+
+def _dense_like(layer) -> bool:
+    from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+    return (isinstance(layer, DenseLayer)
+            and type(layer).apply is DenseLayer.apply)
+
+
+def _conv_like(layer) -> bool:
+    from deeplearning4j_tpu.nn.layers.convolution import ConvolutionLayer
+    return type(layer) is ConvolutionLayer
+
+
+def _quant_kind(layer) -> Optional[str]:
+    if _dense_like(layer):
+        return "dense"
+    if _conv_like(layer):
+        return "conv"
+    return None
+
+
+def _quant_apply(layer, kind: str) -> Callable:
+    """The int8 substitute for one layer's f32 ``apply`` (inference
+    ctx only: no dropout, no state)."""
+    if kind == "dense":
+        def run(lp, x):
+            y = qz.int8_dot(x, lp["W_q"], lp["w_scale"], lp["x_scale"])
+            if layer.has_bias:
+                y = y + lp["b"]
+            return layer.activation.apply(y)
+        return run
+    from deeplearning4j_tpu.nn.layers.convolution import (
+        DIMENSION_NUMBERS, _padding_arg, _pair)
+    s, d, p = map(_pair, (layer.stride, layer.dilation, layer.padding))
+    padding = _padding_arg(layer.convolution_mode, p)
+
+    def run(lp, x):
+        y = qz.int8_conv(x, lp["W_q"], lp["w_scale"], lp["x_scale"],
+                         window_strides=s, padding=padding,
+                         rhs_dilation=d,
+                         dimension_numbers=DIMENSION_NUMBERS,
+                         feature_group_count=layer.groups)
+        if layer.has_bias:
+            y = y + lp["b"]
+        return layer.activation.apply(y)
+    return run
+
+
+def _require_mln(model):
+    if not (hasattr(model, "layers") and hasattr(model, "_forward")
+            and hasattr(model, "_preprocessors")):
+        raise QuantizationError(
+            "int8 quantization currently supports MultiLayerNetwork "
+            f"only (got {type(model).__name__}); ComputationGraph "
+            "models must serve at f32/bf16")
+
+
+# ---- the shared inference layer walk -------------------------------------
+
+def _inference_walk(model, params, model_state, x, fmask,
+                    qmap: Dict[str, Callable]):
+    """Replays build_inference_fn's exact walk (models/
+    multi_layer_network.py): _forward(..., train=False, upto=n-1) then
+    the output layer with mask=fmask — substituting ``qmap`` entries.
+    With an empty qmap this is bitwise-identical to the f32 builder."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models.base import cast_params, compute_cast
+    from deeplearning4j_tpu.nn.inputs import RecurrentType
+    from deeplearning4j_tpu.nn.layers.base import LayerContext
+    g = model.conf.global_config
+    x = compute_cast(jnp.asarray(x), g.compute_dtype)
+    n = len(model.layers)
+    for i in range(n):
+        layer = model.layers[i]
+        pp = model._preprocessors.get(i)
+        if pp is not None:
+            x = pp.apply(x)
+        last = i == n - 1
+        mask = fmask if (last or isinstance(model._input_types[i],
+                                            RecurrentType)) else None
+        ctx = LayerContext(train=False, rng=None, mask=mask)
+        run = qmap.get(layer.name)
+        if run is not None:
+            x = run(params.get(layer.name, {}), x)
+        else:
+            lp = params.get(layer.name, {})
+            if not last:
+                # hidden layers go through the same working-copy cast +
+                # (no-op at inference) weight-noise hook as _forward
+                lp = cast_params(lp, g.compute_dtype)
+                lp = layer.apply_weight_noise(lp, ctx, None)
+            x, _ = layer.apply(lp, model_state.get(layer.name, {}), x,
+                               ctx)
+        if not last and model._tp_plan is not None:
+            x = model._tp_plan.constrain(layer.name, x)
+    return x
+
+
+# ---- calibration ---------------------------------------------------------
+
+def _calib_batches(policy: PrecisionPolicy) -> List[Any]:
+    """Normalize the policy's sample stream to a bounded list of host
+    DataSets (kept small: max_calib_batches x calib_batch_size)."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    src = policy.samples
+    if src is None:
+        raise QuantizationError(
+            "PrecisionPolicy(mode='int8') needs calibration samples "
+            "(PrecisionPolicy.int8(samples=...))")
+    out: List[Any] = []
+    if isinstance(src, np.ndarray) or hasattr(src, "shape"):
+        arr = np.asarray(src)  # host-sync-ok: one-time calibration staging, offline
+        b = min(policy.calib_batch_size, arr.shape[0])
+        for i in range(0, arr.shape[0] - b + 1, b):
+            out.append(DataSet(np.ascontiguousarray(arr[i:i + b])))
+            if len(out) >= policy.max_calib_batches:
+                break
+    else:
+        for item in src:
+            if isinstance(item, DataSet):
+                out.append(item)
+            else:
+                out.append(DataSet(np.asarray(item)))  # host-sync-ok: one-time calibration staging, offline
+            if len(out) >= policy.max_calib_batches:
+                break
+    if not out:
+        raise QuantizationError("calibration sample stream is empty")
+    return out
+
+
+def calibrate(model, policy: PrecisionPolicy, *, registry=None,
+              tracer=None) -> CalibrationResult:
+    """One pass through the DeviceFeeder over the policy's sample
+    stream, collecting each quantizable layer's input absmax with a
+    single jitted stats fn; scales reduce host-side in f32."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.datasets.feeder import DeviceFeeder
+    _require_mln(model)
+    if model.train_state is None:
+        model.init()
+    names = [l.name for l in model.layers if _quant_kind(l)]
+    if not names:
+        raise QuantizationError(
+            f"{type(model).__name__} has no quantizable (dense/conv) "
+            "layers")
+    batches = _calib_batches(policy)
+
+    def stats(params, mstate, x):
+        # taps fills during trace: each quantizable layer's substitute
+        # records its input absmax then runs the ORIGINAL f32 apply
+        taps: Dict[str, Any] = {}
+        qmap: Dict[str, Callable] = {}
+        for nm in names:
+            def run(lp, h, _layer=_layer_by_name(model, nm), _nm=nm):
+                taps[_nm] = jnp.max(jnp.abs(h.astype(jnp.float32)))  # graftlint: disable=tracer-leak — taps is LOCAL to stats (rebuilt per trace) and returned via jnp.stack below; nothing escapes the trace
+                return _tapped_apply(_layer, lp, h)
+            qmap[nm] = run
+        _inference_walk(model, params, mstate, x, None, qmap)
+        return jnp.stack([taps[nm] for nm in names])
+
+    stats_fn = jax.jit(stats)
+    params = model.train_state.params
+    mstate = model.train_state.model_state
+    per_batch: List[np.ndarray] = []
+    feeder = DeviceFeeder(iter(batches), depth=2, registry=registry,
+                          tracer=tracer, session_id="quant-calib")
+    for item in feeder:
+        vec = stats_fn(params, mstate, item.features)
+        per_batch.append(np.asarray(vec, np.float32))  # host-sync-ok: offline calibration reduce, one scalar vector per batch
+    m = np.stack(per_batch)                    # (n_batches, n_layers) f32
+    if policy.calibration == "percentile" and m.shape[0] > 1:
+        col = np.percentile(m, policy.percentile, axis=0,
+                            method="linear").astype(np.float32)
+    else:
+        col = np.max(m, axis=0)
+    amax = {n: float(col[i]) for i, n in enumerate(names)}  # host-sync-ok: col is a host numpy reduction, already fetched
+    scales = {n: float(qz.activation_scale(col[i]))  # host-sync-ok: host numpy, offline calibration
+              for i, n in enumerate(names)}
+    return CalibrationResult(method=policy.calibration,
+                             percentile=policy.percentile,
+                             n_batches=m.shape[0], amax=amax,
+                             scales=scales)
+
+
+def _layer_by_name(model, name):
+    for l in model.layers:
+        if l.name == name:
+            return l
+    raise KeyError(name)
+
+
+def _tapped_apply(layer, lp, x):
+    """The layer's ORIGINAL f32 apply under an inference ctx — the
+    calibration substitute runs the same math as the f32 walk."""
+    from deeplearning4j_tpu.nn.layers.base import LayerContext
+    y, _ = layer.apply(lp, {}, x,
+                       LayerContext(train=False, rng=None, mask=None))
+    return y
+
+
+# ---- quantization --------------------------------------------------------
+
+@dataclasses.dataclass
+class QuantizedModel:
+    """A trained model plus its int8 serving artifacts: quantized
+    params pytree, calibration, per-layer error report and the
+    quantized inference builder."""
+    model: Any
+    policy: PrecisionPolicy
+    calibration: CalibrationResult
+    params: Any                       # quantized params pytree
+    report: Dict[str, Dict[str, Any]]  # layer -> {kind, error, quantized}
+    fallback: List[str]               # layers kept f32 (budget exceeded)
+
+    @property
+    def quantized_layers(self) -> List[str]:
+        return [n for n, r in self.report.items() if r["quantized"]]
+
+    def calibration_hash(self) -> str:
+        """Provenance key for the AOT-cache fingerprint: calibration
+        scales + the budget decisions actually baked into the fwd."""
+        payload = {"calibration": self.calibration.hash(),
+                   "error_budget": float(  # host-sync-ok: policy field is a host python float
+                       np.float32(self.policy.error_budget)).hex(),
+                   "fallback": sorted(self.fallback)}
+        return hashlib.sha256(
+            json.dumps(payload, sort_keys=True).encode()).hexdigest()
+
+    def build_inference_fn(self):
+        """Quantized ``(params, model_state, x, fmask) -> y`` — same
+        contract as the model's own build_inference_fn, against
+        ``self.params`` instead of the f32 train_state params."""
+        qmap = {n: _quant_apply(_layer_by_name(self.model, n),
+                                self.report[n]["kind"])
+                for n in self.quantized_layers}
+        model = self.model
+
+        def fwd(params, model_state, x, fmask):
+            return _inference_walk(model, params, model_state, x, fmask,
+                                   qmap)
+        return fwd
+
+
+def _rel_l2(a, b) -> float:
+    import jax.numpy as jnp
+    num = jnp.linalg.norm((a - b).astype(jnp.float32).ravel())
+    den = jnp.linalg.norm(b.astype(jnp.float32).ravel()) + 1e-12
+    return float(num / den)  # host-sync-ok: offline per-layer error probe at quantize time
+
+
+def quantize_model(model, policy: PrecisionPolicy, *, registry=None,
+                   tracer=None,
+                   calibration: Optional[CalibrationResult] = None
+                   ) -> QuantizedModel:
+    """Calibrate (unless a result is supplied), quantize per-channel
+    int8 weights, and probe each candidate layer's quantization error
+    on the first calibration batch: the probe walks the net once,
+    feeding every layer the activations produced by the
+    already-quantized prefix, so each accept/fallback decision sees
+    realistic (error-carrying) inputs."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.inputs import RecurrentType
+    from deeplearning4j_tpu.nn.layers.base import LayerContext
+    from deeplearning4j_tpu.models.base import cast_params, compute_cast
+    _require_mln(model)
+    if policy.mode != "int8":
+        raise QuantizationError(
+            f"quantize_model needs an int8 policy, got {policy.mode!r}")
+    if model.train_state is None:
+        model.init()
+    calib = calibration if calibration is not None else calibrate(
+        model, policy, registry=registry, tracer=tracer)
+    params = model.train_state.params
+    mstate = model.train_state.model_state
+    probe = np.asarray(_calib_batches(policy)[0].features)  # host-sync-ok: offline probe batch staging
+
+    g = model.conf.global_config
+    x = compute_cast(jnp.asarray(probe), g.compute_dtype)
+    n = len(model.layers)
+    params_q: Dict[str, Any] = {}
+    report: Dict[str, Dict[str, Any]] = {}
+    fallback: List[str] = []
+    for i in range(n):
+        layer = model.layers[i]
+        pp = model._preprocessors.get(i)
+        if pp is not None:
+            x = pp.apply(x)
+        last = i == n - 1
+        mask = None                     # probe runs unmasked
+        ctx = LayerContext(train=False, rng=None, mask=mask)
+        lp = params.get(layer.name, {})
+        kind = _quant_kind(layer)
+        if kind is None or layer.name not in calib.scales:
+            params_q[layer.name] = lp
+            x, _ = layer.apply(
+                lp if last else cast_params(lp, g.compute_dtype),
+                mstate.get(layer.name, {}), x, ctx)
+            continue
+        w = np.asarray(lp["W"], np.float32)  # host-sync-ok: one-time weight fetch at quantize time
+        w_q, w_scale = qz.quantize_weight(w)
+        lq = {"W_q": jnp.asarray(w_q),
+              "w_scale": jnp.asarray(w_scale),
+              "x_scale": jnp.asarray(
+                  np.float32(calib.scales[layer.name]))}
+        if layer.has_bias and "b" in lp:
+            lq["b"] = jnp.asarray(np.asarray(lp["b"], np.float32))  # host-sync-ok: one-time bias fetch at quantize time
+        y_f, _ = layer.apply(lp, mstate.get(layer.name, {}), x, ctx)
+        y_q = _quant_apply(layer, kind)(lq, x)
+        err = _rel_l2(y_q, y_f)
+        ok = err <= policy.error_budget
+        report[layer.name] = {"kind": kind, "error": err,
+                              "quantized": ok}
+        if ok:
+            params_q[layer.name] = lq
+            x = y_q
+        else:
+            params_q[layer.name] = lp
+            fallback.append(layer.name)
+            x = y_f
+    return QuantizedModel(model=model, policy=policy, calibration=calib,
+                          params=params_q, report=report,
+                          fallback=fallback)
+
+
+def params_nbytes(params) -> int:
+    """Total bytes of a committed params pytree — the params-resident
+    term of the serving $/req proxy (int8 entries are ~1/4 of f32)."""
+    import jax
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        n = getattr(leaf, "nbytes", None)
+        if n is None:
+            n = np.asarray(leaf).nbytes  # host-sync-ok: metadata-only size probe at startup
+        total += int(n)
+    return total

@@ -109,8 +109,13 @@ class ServingEngine:
     feature_shape : per-example feature shape (no batch dim); providing
         it (with ``dtype``) enables the warmup sweep at start
     dtype : feature dtype requests are cast to (default float32)
-    bf16 : cast committed float params to bfloat16 (inference-only copy;
-        the model's train_state is untouched)
+    precision : a ``PrecisionPolicy`` (or its mode string) selecting the
+        committed-params precision: "f32" (default), "bf16" (cast the
+        inference copy to bfloat16), or "int8" (post-training quantized
+        via parallel/quant.py — the policy must carry calibration
+        ``samples``; the model's train_state is untouched in all modes)
+    bf16 : DEPRECATED — the pre-PrecisionPolicy spelling of
+        ``precision=PrecisionPolicy.bf16()``; passing it warns
     warmup : compile the whole bucket ladder at start (default: True
         when ``feature_shape`` is known)
     aot_cache_dir : persist the warmed executable table here
@@ -131,6 +136,7 @@ class ServingEngine:
                  min_bucket: int = 1,
                  feature_shape: Optional[Tuple[int, ...]] = None,
                  dtype: Any = np.float32, bf16: bool = False,
+                 precision: Any = None,
                  warmup: Optional[bool] = None,
                  aot_cache_dir: Optional[str] = None,
                  model_version: Optional[str] = None,
@@ -152,7 +158,27 @@ class ServingEngine:
         self.dtype = np.dtype(dtype)
         self.feature_shape = (None if feature_shape is None
                               else tuple(feature_shape))
-        self.bf16 = bool(bf16)
+        from deeplearning4j_tpu.parallel.quant import PrecisionPolicy
+        if precision is None:
+            if bf16:
+                import warnings
+                warnings.warn(
+                    "ServingEngine(bf16=True) is deprecated; pass "
+                    "precision=PrecisionPolicy.bf16() instead",
+                    DeprecationWarning, stacklevel=2)
+                precision = PrecisionPolicy.bf16()
+            else:
+                precision = PrecisionPolicy.f32()
+        else:
+            if bf16:
+                raise ValueError(
+                    "pass either precision= or the deprecated bf16= "
+                    "flag, not both")
+            if isinstance(precision, str):
+                precision = PrecisionPolicy(mode=precision)
+        self.precision = precision
+        self._ptag = precision.tag
+        self.bf16 = precision.mode == "bf16"   # back-compat attribute
         self.tracer = tracer if tracer is not None else NULL_TRACER
         self.registry = registry if registry is not None \
             else default_registry()
@@ -209,10 +235,23 @@ class ServingEngine:
         self._c_replica_busy = reg.counter(
             "dl4j_serving_replica_busy_ms",
             "cumulative ms a replica spent computing dispatched batches")
-        self._c_requests.inc(0.0, session=session_id)
-        self._c_batches.inc(0.0, session=session_id)
-        self._c_compiles.inc(0.0, session=session_id, phase="live")
-        self._g_inflight.set(0.0, session=session_id)
+        self._g_precision = reg.gauge(
+            "dl4j_serving_precision",
+            "1 for the engine's active precision label (f32|bf16|int8)")
+        self._g_quant_err = reg.gauge(
+            "dl4j_quant_layer_error",
+            "per-layer relative L2 quantization error observed on the "
+            "calibration probe batch (int8 engines only; layers over "
+            "the policy budget fell back to f32)")
+        self._c_requests.inc(0.0, session=session_id, precision=self._ptag)
+        self._c_batches.inc(0.0, session=session_id, precision=self._ptag)
+        self._c_compiles.inc(0.0, session=session_id, precision=self._ptag, phase="live")
+        self._g_inflight.set(0.0, session=session_id, precision=self._ptag)
+        self._g_precision.set(1.0, session=session_id,
+                              precision=self._ptag)
+        # $/req proxy accumulators (benchmarks/serving.py --precision-ab)
+        self.dispatch_count = 0
+        self.device_ms_total = 0.0
 
         # ---- committed inference params ----------------------------------
         # Duck-typed models exposing only .output() (pre-engine callers,
@@ -221,18 +260,35 @@ class ServingEngine:
         self._committed: Dict[Union[int, str], Any] = {}
         self._batch_sharding = None
         self._jit = None
+        self.quantized = None        # QuantizedModel for int8 engines
+        self._calib_hash: Optional[str] = None
         if hasattr(model, "build_inference_fn"):
             if model.train_state is None:
                 model.init()
             params = model.train_state.params
             mstate = model.train_state.model_state
-            if self.bf16:
-                import jax.numpy as jnp
-                params = jax.tree_util.tree_map(
-                    lambda a: a.astype(jnp.bfloat16)
-                    if jnp.issubdtype(a.dtype, jnp.floating) else a,
-                    params)
-            fwd = model.build_inference_fn()
+            if self.precision.mode == "int8":
+                from deeplearning4j_tpu.parallel.quant import (
+                    quantize_model)
+                qm = quantize_model(model, self.precision,
+                                    registry=self.registry,
+                                    tracer=self.tracer)
+                self.quantized = qm
+                self._calib_hash = qm.calibration_hash()
+                params = qm.params
+                fwd = qm.build_inference_fn()
+                for lname, rep in qm.report.items():
+                    self._g_quant_err.set(
+                        rep["error"], session=session_id, layer=lname,
+                        quantized=str(rep["quantized"]).lower())
+            else:
+                if self.bf16:
+                    import jax.numpy as jnp
+                    params = jax.tree_util.tree_map(
+                        lambda a: a.astype(jnp.bfloat16)
+                        if jnp.issubdtype(a.dtype, jnp.floating) else a,
+                        params)
+                fwd = model.build_inference_fn()
             self._jit = jax.jit(lambda p, s, x: fwd(p, s, x, None))
             # one committed (params, model_state) copy per replica; plus
             # a mesh-replicated copy backing the sharded full-bucket path
@@ -247,10 +303,11 @@ class ServingEngine:
                 self._committed[MESH] = jax.device_put(
                     (params, mstate), replicated(mesh))
                 self._batch_sharding = batch_sharding(mesh)
-        elif self.n_replicas > 1 or self.bf16:
+        elif self.n_replicas > 1 or self.precision.mode != "f32":
             raise ValueError(
-                "replicas > 1 / bf16 need a model exposing "
-                "build_inference_fn (committed per-replica params); "
+                f"replicas > 1 / precision={self.precision.mode!r} "
+                "need a model exposing build_inference_fn (committed "
+                "per-replica params); "
                 f"{type(model).__name__} only has .output")
 
         # ---- persisted AOT executable cache ------------------------------
@@ -271,12 +328,31 @@ class ServingEngine:
             params0, mstate0 = self._committed[0]
             self._cache_fp = fingerprint(
                 params0, mstate0, feature_shape=self.feature_shape,
-                dtype=self.dtype, ladder=self.ladder, bf16=self.bf16,
+                dtype=self.dtype, ladder=self.ladder,
+                precision=self._ptag, calibration=self._calib_hash,
                 model_version=model_version)
             self._loaded_exports = self.aot_cache.try_load(self._cache_fp)
+            if (self.aot_cache.state == "mismatch"
+                    and self.precision.mode == "int8"):
+                # a rejected quant cache is worth a breadcrumb: the
+                # divergence reason (stale calibration? precision?)
+                # rides into any later crash dump's context.json
+                from deeplearning4j_tpu.observe.flight_recorder import (
+                    default_flight_recorder)
+                rec = default_flight_recorder()
+                if rec is not None:
+                    rec.note(f"aot_cache_rejected_{session_id}", {
+                        "dir": str(aot_cache_dir),
+                        "precision": self._ptag,
+                        "calibration": self._calib_hash,
+                        "reason": self.aot_cache.reason,
+                    })
 
         # ---- dispatch machinery ------------------------------------------
-        self._exe: Dict[Tuple[int, Union[int, str]], Any] = {}
+        # executable table keyed (bucket, target, precision): precision
+        # is per-engine today, but first-class in the key so quant and
+        # f32 executables of co-resident engines can never collide
+        self._exe: Dict[Tuple[int, Union[int, str], str], Any] = {}
         self._exe_lock = threading.Lock()
         self._warmed = False
         self._post_warmup_compiles = 0
@@ -348,7 +424,7 @@ class ServingEngine:
         return jax.device_put(x, self.devices[where])
 
     def _get_exe(self, bucket: int, where: Union[int, str]):
-        key = (bucket, where)
+        key = (bucket, where, self._ptag)
         exe = self._exe.get(key)
         if exe is not None:
             return exe
@@ -371,11 +447,11 @@ class ServingEngine:
                     exe = jax.jit(exp.call).lower(params, mstate,
                                                   x).compile()
                     self.aot_cache.hits += 1
-                    self._c_aot.inc(1.0, session=self.session_id,
+                    self._c_aot.inc(1.0, session=self.session_id, precision=self._ptag,
                                     event="hit")
                 except Exception:
                     self.aot_cache.misses += 1
-                    self._c_aot.inc(1.0, session=self.session_id,
+                    self._c_aot.inc(1.0, session=self.session_id, precision=self._ptag,
                                     event="miss")
             if exe is None:
                 try:
@@ -389,7 +465,7 @@ class ServingEngine:
             phase = "warmup" if not self._warmed else "live"
             if self._warmed:
                 self._post_warmup_compiles += 1
-            self._c_compiles.inc(1.0, session=self.session_id,
+            self._c_compiles.inc(1.0, session=self.session_id, precision=self._ptag,
                                  phase=phase)
             self.tracer.instant("serve_compile", cat="serve",
                                 bucket=bucket, where=str(where),
@@ -422,7 +498,7 @@ class ServingEngine:
         device-resident (un-fetched) result."""
         if x.dtype != self.dtype:
             x = x.astype(self.dtype)
-        self.watchdog.observe(f"serve_fwd_b{bucket}", x)
+        self.watchdog.observe(f"serve_fwd_{self._ptag}_b{bucket}", x)
         if self._jit is None:        # legacy duck-typed model
             return self.model.output(x)
         exe = self._get_exe(bucket, where)
@@ -454,11 +530,11 @@ class ServingEngine:
             raise RuntimeError("ServingEngine is shut down")
         chunks = [x[i:i + self.batch_limit]
                   for i in range(0, x.shape[0], self.batch_limit)]
-        self._c_requests.inc(1.0, session=self.session_id)
+        self._c_requests.inc(1.0, session=self.session_id, precision=self._ptag)
         with self._count_lock:
             self._inflight_count += 1
             self._g_inflight.set(self._inflight_count,
-                                 session=self.session_id)
+                                 session=self.session_id, precision=self._ptag)
         futures = [self._enqueue(c) for c in chunks]
         if len(futures) == 1:
             self._track(futures[0])
@@ -482,7 +558,7 @@ class ServingEngine:
                 break
             except queue.Full:
                 continue
-        self._g_queue.set(self._queue.qsize(), session=self.session_id)
+        self._g_queue.set(self._queue.qsize(), session=self.session_id, precision=self._ptag)
         if self._shutdown.is_set():
             # raced with shutdown(): the dispatcher may never pop this
             self._drain_queue()
@@ -493,7 +569,7 @@ class ServingEngine:
             with self._count_lock:
                 self._inflight_count -= 1
                 self._g_inflight.set(self._inflight_count,
-                                     session=self.session_id)
+                                     session=self.session_id, precision=self._ptag)
         f.add_done_callback(done)
 
     def _join_futures(self, parts: List[Future]) -> Future:
@@ -529,6 +605,15 @@ class ServingEngine:
         with self._carry_lock:
             return self._carry
 
+    @property
+    def params_resident_bytes(self) -> int:
+        """Bytes of ONE committed params copy (int8 engines ~1/4 of
+        f32) — the params term of the $/req proxy."""
+        if not self._committed:
+            return 0
+        from deeplearning4j_tpu.parallel.quant import params_nbytes
+        return params_nbytes(self._committed[0][0])
+
     def stats(self) -> Dict[str, Any]:
         """Point-in-time snapshot for the CLI / UI module."""
         q = self.latency.quantiles()
@@ -537,6 +622,10 @@ class ServingEngine:
             "replicas": self.n_replicas,
             "ladder": list(self.ladder),
             "pipelined": self.pipelined,
+            "precision": self._ptag,
+            "params_resident_bytes": self.params_resident_bytes,
+            "batches": self.dispatch_count,
+            "device_ms_total": self.device_ms_total,
             "requests": self.latency.count,
             "inflight": self._inflight_count,
             # a carried-over request parked in self._carry is waiting
@@ -550,6 +639,14 @@ class ServingEngine:
         }
         if self.aot_cache is not None:
             out["aot_cache"] = self.aot_cache.stats()
+        if self.quantized is not None:
+            out["quant"] = {
+                "calibration": self._calib_hash,
+                "error_budget": self.precision.error_budget,
+                "fallback": list(self.quantized.fallback),
+                "layers": {n: r["error"]
+                           for n, r in self.quantized.report.items()},
+            }
         return out
 
     def save_aot_cache(self) -> int:
@@ -567,7 +664,7 @@ class ServingEngine:
         self.cache_save_seconds = time.perf_counter() - t0
         if n:
             self._c_aot.inc(float(n),  # host-sync-ok: python int bucket count, not a device value
-                            session=self.session_id,
+                            session=self.session_id, precision=self._ptag,
                             event="save")
         return n
 
@@ -643,7 +740,7 @@ class ServingEngine:
             if not batch:
                 continue
             self._g_queue.set(self._queue.qsize(),
-                              session=self.session_id)
+                              session=self.session_id, precision=self._ptag)
             try:
                 inflight = self._dispatch(batch, t_form0)
             except Exception as e:
@@ -699,10 +796,11 @@ class ServingEngine:
         t_dispatched = time.perf_counter()
         tracer.add_span("dispatch", t_formed, t_dispatched, cat="serve",
                         where=str(where))
-        self._c_batches.inc(1.0, session=self.session_id)
-        self._c_replica_disp.inc(1.0, session=self.session_id,
+        self._c_batches.inc(1.0, session=self.session_id, precision=self._ptag)
+        self.dispatch_count += 1
+        self._c_replica_disp.inc(1.0, session=self.session_id, precision=self._ptag,
                                  replica=str(where))
-        self._g_occupancy.set(n / bucket, session=self.session_id)
+        self._g_occupancy.set(n / bucket, session=self.session_id, precision=self._ptag)
         return _InFlight(out, batch, n, bucket, where, t_dispatched)
 
     # ---- completion ------------------------------------------------------
@@ -727,7 +825,9 @@ class ServingEngine:
                             bytes=host.nbytes)
             self._c_replica_busy.inc(
                 (t_ready - inflight.t_dispatched) * 1e3,
-                session=self.session_id, replica=str(inflight.where))
+                session=self.session_id, precision=self._ptag, replica=str(inflight.where))
+            self.device_ms_total += (t_ready
+                                     - inflight.t_dispatched) * 1e3
             ofs = 0
             now = time.perf_counter()
             for req in inflight.requests:
@@ -745,7 +845,7 @@ class ServingEngine:
     def _publish_latency(self):
         q = self.latency.quantiles()
         for qq, v in q.items():
-            self._g_latency.set(v * 1e3, session=self.session_id,
+            self._g_latency.set(v * 1e3, session=self.session_id, precision=self._ptag,
                                 quantile=f"p{int(qq * 100)}")
 
     # ---- lifecycle -------------------------------------------------------

@@ -32,6 +32,10 @@ JAX_PLATFORMS=cpu python -m benchmarks.input_pipeline --smoke
 # zero recompiles after the warmup sweep (watchdog-asserted), and
 # pipelined dispatch >=1.3x the blocking dispatcher closed-loop
 JAX_PLATFORMS=cpu python -m benchmarks.serving --smoke
+# quantization tier: int8 serving arm answers within the top-1 budget
+# of f32, every precision arm warm (zero post-warmup recompiles), and
+# int8's bytes-moved-per-request proxy strictly below bf16's
+JAX_PLATFORMS=cpu python -m benchmarks.serving --precision-ab --smoke
 # fleet tier: multi-process Poisson soak through the front-door router
 # (admission control + SLO shedding) — zero post-warmup recompiles,
 # shed rate < 100%, served p99 under the CPU-calibrated bound

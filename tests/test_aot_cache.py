@@ -176,7 +176,8 @@ class TestFingerprint:
         m = _tiny_model()
         e1 = _engine(m, cache)
         e1.shutdown()
-        with open(os.path.join(cache, "bucket_2.stablehlo"), "wb") as f:
+        with open(os.path.join(cache, "bucket_2.f32.stablehlo"),
+                  "wb") as f:
             f.write(b"garbage")
         e2 = _engine(m, cache)
         try:
@@ -200,6 +201,125 @@ class TestFingerprint:
                     "backend", "serving", "model_version"):
             assert key in fp, key
         assert fp["serving"]["ladder"] == [1, 2, 4]
+
+
+class TestPrecisionEntries:
+    """Format-2 manifests hold one entry per precision: an int8 save
+    must never satisfy an f32 lookup (and vice versa), while both
+    coexist in one cache dir with precision-tagged blobs."""
+
+    def _int8_engine(self, model, cache, **kw):
+        from deeplearning4j_tpu.parallel.quant import PrecisionPolicy
+        rng = np.random.default_rng(7)
+        feats = rng.normal(size=(32, N_IN)).astype(np.float32)
+        return _engine(model, cache,
+                       precision=PrecisionPolicy.int8(feats), **kw)
+
+    def test_precisions_coexist_and_never_cross(self, tmp_path):
+        cache = str(tmp_path / "aot")
+        m = _tiny_model()
+        # f32 saves first
+        e1 = _engine(m, cache)
+        e1.shutdown()
+        # int8 must NOT hit the f32 entry: cold, with a reason that
+        # names the diverged axis
+        e2 = self._int8_engine(m, cache)
+        try:
+            assert e2.aot_cache.state == "cold"
+            assert "int8" in e2.aot_cache.reason
+            e2.assert_warm()
+        finally:
+            e2.shutdown()
+        with open(os.path.join(cache, "manifest.json")) as f:
+            manifest = json.load(f)
+        assert manifest["format_version"] == 2
+        assert sorted(manifest["entries"]) == ["f32", "int8"]
+        blobs = sorted(os.listdir(cache))
+        assert any(b.endswith(".f32.stablehlo") for b in blobs)
+        assert any(b.endswith(".int8.stablehlo") for b in blobs)
+        # both precisions now warm-load from the same dir
+        for build in (lambda: _engine(m, cache),
+                      lambda: self._int8_engine(m, cache)):
+            e = build()
+            try:
+                assert e.aot_cache.state == "warm"
+                assert e.aot_cache.hits > 0
+                e.assert_warm()
+            finally:
+                e.shutdown()
+
+    def test_calibration_divergence_named_in_reason(self, tmp_path):
+        cache = str(tmp_path / "aot")
+        m = _tiny_model()
+        e1 = self._int8_engine(m, cache)
+        e1.shutdown()
+        # tamper with the stored calibration hash: the mismatch reason
+        # must name the exact diverged field
+        path = os.path.join(cache, "manifest.json")
+        with open(path) as f:
+            manifest = json.load(f)
+        fp = manifest["entries"]["int8"]["fingerprint"]
+        fp["serving"]["calibration"] = "deadbeef" * 8
+        with open(path, "w") as f:
+            json.dump(manifest, f)
+        e2 = self._int8_engine(m, cache)
+        try:
+            assert e2.aot_cache.state == "mismatch"
+            assert "serving.calibration" in e2.aot_cache.reason
+            e2.assert_warm()
+        finally:
+            e2.shutdown()
+
+
+# child: calibrate + quantize in a FRESH process and report the scale
+# bits, the calibration hash, and the engine's AOT fingerprint — run
+# twice, everything must be bitwise identical (the determinism the
+# int8 cache entry's reuse story rests on)
+_CALIB_CHILD = """
+import json, sys
+import numpy as np
+sys.path.insert(0, {root!r})
+from tests.test_aot_cache import _tiny_model, N_IN
+from deeplearning4j_tpu.parallel.aot_cache import fingerprint
+from deeplearning4j_tpu.parallel.quant import (
+    PrecisionPolicy, quantize_model)
+
+m = _tiny_model()
+rng = np.random.default_rng(21)
+feats = rng.normal(size=(64, N_IN)).astype(np.float32)
+qm = quantize_model(m, PrecisionPolicy.int8(feats))
+fp = fingerprint(qm.params, m.train_state.model_state,
+                 feature_shape=(N_IN,), dtype=np.float32,
+                 ladder=(1, 2, 4), precision="int8",
+                 calibration=qm.calibration_hash(), model_version="t1")
+print(json.dumps({{
+    "scales": {{k: float(np.float32(v)).hex()
+               for k, v in sorted(qm.calibration.scales.items())}},
+    "calib_hash": qm.calibration.hash(),
+    "provenance": qm.calibration_hash(),
+    "fingerprint": fp}}, sort_keys=True))
+"""
+
+
+class TestCalibrationDeterminism:
+    def test_two_fresh_processes_bitwise_identical(self):
+        child = _CALIB_CHILD.format(root=_ROOT)
+        runs = []
+        for _ in range(2):
+            proc = subprocess.run(
+                [sys.executable, "-c", child], cwd=_ROOT,
+                capture_output=True, text=True, timeout=300,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"})
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            runs.append(json.loads(proc.stdout.strip().splitlines()[-1]))
+        a, b = runs
+        assert a["scales"] == b["scales"]       # bit-exact hex floats
+        assert a["calib_hash"] == b["calib_hash"]
+        assert a["provenance"] == b["provenance"]
+        assert a["fingerprint"] == b["fingerprint"]
+        assert a["fingerprint"]["serving"]["precision"] == "int8"
+        assert a["fingerprint"]["serving"]["calibration"] == \
+            a["provenance"]
 
 
 class TestXlaCacheConfig:

@@ -191,6 +191,27 @@ class TestDonationSafety:
         """, rules=["donation-safety"])
         assert len(findings) == 1
 
+    def test_inference_builders_pinned_non_donating(self, tmp_path):
+        # the quantized (and plain) inference builders return
+        # NON-donating callables: the serving engine replays committed
+        # int8 buffers across requests, so reusing the un-rebound
+        # params pytree forever is the CORRECT shape — no finding, even
+        # with numpy-backed inputs flowing in
+        findings = lint(tmp_path, """
+            import numpy as np
+            from deeplearning4j_tpu.parallel.quant import quantize_model
+
+            def serve(model, policy, mstate, batches):
+                qm = quantize_model(model, policy)
+                fwd = qm.build_inference_fn()
+                outs = []
+                for b in batches:
+                    x = np.asarray(b)
+                    outs.append(fwd(qm.params, mstate, x, None))
+                return outs
+        """, rules=["donation-safety"])
+        assert findings == []
+
     def test_non_literal_argnums_is_unknown_not_flagged(self, tmp_path):
         findings = lint(tmp_path, """
             import jax

@@ -1,0 +1,318 @@
+"""Int8 post-training quantization tests (PR 9).
+
+The quantization contract across its three layers:
+
+- **ops/quantize.py**: per-channel symmetric int8 round-trips within
+  half a scale step, dead channels never divide by zero, degenerate
+  activation stats degrade to the identity scale.
+- **parallel/quant.py**: calibration is bitwise deterministic for the
+  same sample stream, the quantized walk reproduces ``f32`` EXACTLY
+  when every layer falls back (the walk itself adds no drift), and
+  within-budget layers quantize with the error the report claims.
+- **serving/fleet**: PrecisionPolicy threads through the engine (the
+  deprecated ``bf16`` flag still works, once, with a warning), int8
+  engines serve warm with precision-labelled metrics, and the accuracy
+  gate admits/blocks FleetRouter versions as a hard precondition.
+
+The committed-zoo acceptance (int8 passes the gate on the real
+pretrained artifacts) lives in ``TestZooGate``.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.observe.registry import MetricsRegistry
+from deeplearning4j_tpu.ops import quantize as qz
+from deeplearning4j_tpu.parallel.quant import (
+    PrecisionPolicy,
+    QuantizationError,
+    calibrate,
+    params_nbytes,
+    quantize_model,
+)
+from deeplearning4j_tpu.parallel.serving import ServingEngine
+
+N_IN = 6
+
+
+def _model(seed: int = 3, width: int = 16, n_out: int = 4):
+    from deeplearning4j_tpu.models.multi_layer_network import (
+        MultiLayerNetwork)
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+    from deeplearning4j_tpu.ops.losses import LossFunction
+    from deeplearning4j_tpu.optimize.updaters import Adam
+    conf = (NeuralNetConfiguration.Builder().seed(seed)
+            .updater(Adam(1e-2)).list()
+            .layer(DenseLayer(n_out=width))
+            .layer(OutputLayer(n_out=n_out, loss=LossFunction.MCXENT))
+            .set_input_type(InputType.feed_forward(N_IN)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _calib(n: int = 64, seed: int = 0) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(n, N_IN)).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# numeric primitives
+# ---------------------------------------------------------------------------
+
+class TestQuantOps:
+    def test_weight_round_trip_within_half_step(self):
+        rng = np.random.default_rng(1)
+        w = rng.normal(size=(9, 5)).astype(np.float32) * 3.0
+        w_q, scales = qz.quantize_weight(w)
+        assert w_q.dtype == np.int8 and scales.dtype == np.float32
+        assert scales.shape == (5,)
+        # symmetric: -128 never used
+        assert w_q.min() >= -qz.Q_MAX
+        err = np.abs(w_q.astype(np.float32) * scales - w)
+        assert np.all(err <= scales / 2 + 1e-7)
+
+    def test_dead_channel_gets_identity_scale(self):
+        w = np.zeros((4, 3), np.float32)
+        w[:, 0] = 1.0
+        w_q, scales = qz.quantize_weight(w)
+        assert scales[1] == 1.0 and scales[2] == 1.0
+        assert np.all(w_q[:, 1:] == 0)
+
+    def test_activation_scale_degenerate(self):
+        assert qz.activation_scale(0.0) == np.float32(1.0)
+        assert qz.activation_scale(float("nan")) == np.float32(1.0)
+        assert qz.activation_scale(float("inf")) == np.float32(1.0)
+        assert qz.activation_scale(qz.Q_MAX) == np.float32(1.0)
+
+    def test_int8_dot_matches_dequant_reference(self):
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(4, 7)).astype(np.float32)
+        w = rng.normal(size=(7, 3)).astype(np.float32)
+        w_q, w_scale = qz.quantize_weight(w)
+        x_scale = qz.activation_scale(np.abs(x).max())
+        got = np.asarray(qz.int8_dot(x, w_q, w_scale, x_scale))
+        x_q = np.clip(np.round(x / x_scale), -127, 127)
+        want = (x_q @ w_q.astype(np.float32)) * (x_scale * w_scale)
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# calibration + quantize_model
+# ---------------------------------------------------------------------------
+
+class TestCalibration:
+    def test_same_stream_bitwise_identical(self):
+        m = _model()
+        pol = PrecisionPolicy.int8(_calib())
+        c1 = calibrate(m, pol)
+        c2 = calibrate(m, pol)
+        assert c1.scales == c2.scales           # exact float equality
+        assert c1.hash() == c2.hash()
+
+    def test_percentile_tighter_than_absmax(self):
+        m = _model()
+        feats = _calib(256)
+        ab = calibrate(m, PrecisionPolicy.int8(feats, calib_batch_size=32))
+        pc = calibrate(m, PrecisionPolicy.int8(
+            feats, calibration="percentile", percentile=75.0,
+            calib_batch_size=32))
+        assert ab.hash() != pc.hash()
+        assert all(pc.amax[k] <= ab.amax[k] for k in ab.amax)
+
+    def test_int8_without_samples_raises(self):
+        with pytest.raises(QuantizationError, match="samples"):
+            quantize_model(_model(), PrecisionPolicy(mode="int8"))
+
+
+class TestQuantizeModel:
+    def test_quantizes_within_budget_and_shrinks(self):
+        m = _model()
+        qm = quantize_model(m, PrecisionPolicy.int8(_calib()))
+        assert qm.quantized_layers      # something actually quantized
+        for name, rep in qm.report.items():
+            if rep["quantized"]:
+                assert rep["error"] <= qm.policy.error_budget
+        assert params_nbytes(qm.params) < \
+            params_nbytes(m.train_state.params)
+        x = _calib(8, seed=9)
+        y_q = np.asarray(qm.build_inference_fn()(
+            qm.params, m.train_state.model_state, x, None))
+        y_f = np.asarray(m.output(x))
+        assert y_q.shape == y_f.shape
+        # budgeted layers: outputs agree on the argmax for easy inputs
+        assert np.mean(y_q.argmax(-1) == y_f.argmax(-1)) >= 0.9
+
+    def test_all_fallback_is_bitwise_f32(self):
+        # an impossible budget forces every layer back to f32: the
+        # quantized WALK must then reproduce build_inference_fn exactly
+        # (proof the walk replication adds zero numeric drift)
+        m = _model()
+        qm = quantize_model(
+            m, PrecisionPolicy.int8(_calib(), error_budget=-1.0))
+        assert qm.quantized_layers == []
+        assert sorted(qm.fallback) == sorted(qm.report)
+        x = _calib(8, seed=11)
+        y_q = np.asarray(qm.build_inference_fn()(
+            qm.params, m.train_state.model_state, x, None))
+        assert np.array_equal(y_q, np.asarray(m.output(x)))
+
+    def test_calibration_hash_tracks_fallback(self):
+        m = _model()
+        qm_a = quantize_model(m, PrecisionPolicy.int8(_calib()))
+        qm_b = quantize_model(
+            m, PrecisionPolicy.int8(_calib(), error_budget=-1.0))
+        assert qm_a.calibration_hash() != qm_b.calibration_hash()
+
+
+# ---------------------------------------------------------------------------
+# ServingEngine precision plumbing
+# ---------------------------------------------------------------------------
+
+def _engine(model, **kw):
+    kw.setdefault("batch_limit", 4)
+    kw.setdefault("feature_shape", (N_IN,))
+    kw.setdefault("registry", MetricsRegistry())
+    return ServingEngine(model, **kw)
+
+
+class TestEnginePrecision:
+    def test_int8_serves_warm_with_labelled_metrics(self):
+        m = _model()
+        reg = MetricsRegistry()
+        eng = _engine(m, registry=reg,
+                      precision=PrecisionPolicy.int8(_calib()),
+                      session_id="q8")
+        try:
+            x = _calib(3, seed=5)
+            y = np.asarray(eng.output(x))
+            assert np.mean(y.argmax(-1) ==
+                           np.asarray(m.output(x)).argmax(-1)) >= 0.9
+            eng.assert_warm()
+            st = eng.stats()
+            assert st["precision"] == "int8"
+            assert st["quant"]["layers"]
+            assert st["params_resident_bytes"] == \
+                eng.params_resident_bytes
+        finally:
+            eng.shutdown()
+        text = reg.render()
+        assert 'dl4j_serving_precision{' in text
+        assert 'precision="int8"' in text
+        assert "dl4j_quant_layer_error{" in text
+
+    def test_int8_resident_bytes_below_f32(self):
+        m = _model()
+        e8 = _engine(m, precision=PrecisionPolicy.int8(_calib()))
+        ef = _engine(m)
+        try:
+            assert e8.params_resident_bytes < ef.params_resident_bytes
+            assert ef.stats()["precision"] == "f32"
+        finally:
+            e8.shutdown()
+            ef.shutdown()
+
+    def test_bf16_kwarg_deprecated_but_works(self):
+        m = _model()
+        with pytest.warns(DeprecationWarning, match="precision"):
+            eng = _engine(m, bf16=True)
+        try:
+            assert eng.precision.mode == "bf16"
+            assert eng.stats()["precision"] == "bf16"
+        finally:
+            eng.shutdown()
+
+    def test_precision_string_accepted(self):
+        eng = _engine(_model(), precision="bf16")
+        try:
+            assert eng.precision.mode == "bf16"
+        finally:
+            eng.shutdown()
+
+    def test_both_flags_rejected(self):
+        with pytest.raises(ValueError, match="not both"):
+            _engine(_model(), bf16=True,
+                    precision=PrecisionPolicy.f32())
+
+
+# ---------------------------------------------------------------------------
+# accuracy gate: standalone + fleet warm-swap precondition
+# ---------------------------------------------------------------------------
+
+class TestQuantGate:
+    def test_gate_pass_and_fail_shapes(self):
+        from deeplearning4j_tpu.evaluation import (
+            QuantGate, QuantGateError, enforce_quant_gate,
+            run_quant_gate)
+        m = _model()
+        pol = PrecisionPolicy.int8(_calib())
+        ok = run_quant_gate(m, pol, QuantGate(top1_budget=0.5))
+        assert ok.passed and ok.n_examples > 0
+        assert "PASS" in ok.summary()
+        with pytest.raises(QuantGateError) as ei:
+            enforce_quant_gate(m, pol, QuantGate(top1_budget=-1.0))
+        assert not ei.value.result.passed
+        assert "FAIL" in str(ei.value)
+
+    def test_fleet_gate_blocks_swap_keeps_serving(self):
+        from deeplearning4j_tpu.evaluation import (
+            QuantGate, QuantGateError)
+        from deeplearning4j_tpu.parallel.fleet import FleetRouter
+        feats = _calib()
+        router = FleetRouter(session_id="quant-gate-t")
+        try:
+            pool = router.add_pool(
+                "m", _model(), version="v1",
+                precision=PrecisionPolicy.int8(feats),
+                quant_gate=QuantGate(top1_budget=0.5, samples=feats),
+                feature_shape=(N_IN,), batch_limit=4)
+            assert pool.gate_results and pool.gate_results[-1].passed
+            assert router.stats()["pools"]["m"]["engines"][0][
+                "precision"] == "int8"
+            y1 = np.asarray(router.output(feats[:2], model="m"))
+            # impossible budget: swap must raise BEFORE any engine
+            # exists and v1 must keep answering
+            pool.quant_gate = QuantGate(top1_budget=-1.0, samples=feats)
+            with pytest.raises(QuantGateError):
+                router.swap("m", _model(seed=8), "v2")
+            assert pool.active_version == "v1"
+            assert np.array_equal(
+                np.asarray(router.output(feats[:2], model="m")), y1)
+            text = router.registry.render()
+            assert 'dl4j_fleet_quant_gate_total{model="m",' \
+                   'outcome="fail"} 1.0' in text
+            assert 'outcome="pass"} 1.0' in text
+        finally:
+            router.shutdown()
+
+    def test_gate_skipped_for_f32_pool(self):
+        from deeplearning4j_tpu.evaluation import QuantGate
+        from deeplearning4j_tpu.parallel.fleet import FleetRouter
+        router = FleetRouter(session_id="quant-gate-f32")
+        try:
+            pool = router.add_pool(
+                "m", _model(), quant_gate=QuantGate(top1_budget=-1.0),
+                feature_shape=(N_IN,), batch_limit=4)
+            assert pool.gate_results == []      # gate not applicable
+        finally:
+            router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# committed-zoo acceptance: int8 passes the gate on real weights
+# ---------------------------------------------------------------------------
+
+class TestZooGate:
+    def test_committed_zoo_models_pass_gate(self):
+        from deeplearning4j_tpu.evaluation import run_zoo_gates
+        results = run_zoo_gates()
+        assert len(results) >= 2        # LeNet + TextGenerationLSTM
+        for r in results:
+            assert r.passed, r.summary()
+            assert r.n_examples > 0
+        # the convnet actually exercised the int8 conv path
+        lenet = next(r for r in results if r.model == "LeNet")
+        assert lenet.layer_errors and not lenet.fallback

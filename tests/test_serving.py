@@ -94,7 +94,7 @@ class TestServingEngine:
             assert got.shape == (19, 3)
             assert np.array_equal(got, np.asarray(m.output(x)))
             # the ladder stays bounded: no executable above batch_limit
-            assert all(b <= 4 for b, _w in eng._exe)
+            assert all(b <= 4 for b, _w, _p in eng._exe)
             eng.assert_warm()
 
     def test_empty_and_misshaped_requests(self):
@@ -124,7 +124,7 @@ class TestServingEngine:
             rendered = reg.render()
             assert 'phase="warmup"' in rendered
             assert ('dl4j_serving_compiles_total{phase="live",'
-                    'session="serve"} 0.0') in rendered
+                    'precision="f32",session="serve"} 0.0') in rendered
 
     def test_shutdown_fails_waiters_no_hang(self):
         class Slow:

@@ -21,9 +21,12 @@ jax.jit(fn, donate_argnums=...)`` / ``partial(jax.jit, ...)(fn)``
 assignments, ``from x import donating_fn`` / ``import x as y`` +
 ``y.donating_fn`` call sites, plus the repo's train-step makers
 (``make_train_step``/``make_scan_train_step``/``build_train_step``,
-which donate arg 0 unless called with ``donate=False``). Non-literal
-``donate_argnums`` expressions are treated as unknown (no finding) —
-we only flag what we can prove.
+which donate arg 0 unless called with ``donate=False``). The inference
+builders (``build_inference_fn`` — plain or quantized — and
+``quantize_model``) are pinned as NON-donating: serving replays
+committed buffers across requests, so the maker heuristic must never
+claim them. Non-literal ``donate_argnums`` expressions are treated as
+unknown (no finding) — we only flag what we can prove.
 """
 
 from __future__ import annotations
@@ -42,6 +45,16 @@ RULE = "donation-safety"
 # donating its TrainState (arg 0) unless built with donate=False
 _MAKER_RX = re.compile(
     r"(?:^|\.)(?:make_(?:scan_)?train_step|_?build_(?:scan_)?train_step)$")
+
+# the OTHER repo convention, pinned explicitly: inference builders
+# (``model.build_inference_fn``, ``QuantizedModel.build_inference_fn``,
+# ``quantize_model``) return callables that donate NOTHING — the serving
+# engine replays committed params (and, quantized, int8 weight buffers
+# adopted zero-copy from numpy) across every request, so donation there
+# would be the PR 1 use-after-free all over again. Matching names are
+# excluded from the maker heuristic no matter how it grows.
+_NON_DONATING_RX = re.compile(
+    r"(?:^|\.)(?:build_inference_fn|quantize_model)$")
 
 _NUMPY_MODULES = ("np", "numpy", "onp")
 # jnp/jax wrappers that take ownership with a device copy
@@ -76,7 +89,8 @@ def _maker_positions(call: ast.Call) -> Optional[List[int]]:
     """Train-step factory convention: donates arg 0 unless
     donate=False is passed explicitly."""
     name = dotted_name(call.func)
-    if name is None or not _MAKER_RX.search(name):
+    if name is None or _NON_DONATING_RX.search(name) \
+            or not _MAKER_RX.search(name):
         return None
     for kw in call.keywords:
         if kw.arg == "donate":
