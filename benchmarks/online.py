@@ -1,0 +1,332 @@
+"""Online learning soak: train-and-serve in one process (ISSUE 10).
+
+The claim under test (deeplearning4j_tpu/online/): a model can serve a
+Poisson request stream WHILE an OnlineLearner incrementally fits it
+from a broker sample stream, and the promotion gate hot-swaps improved
+params into the warm AOT executables with **zero recompiles** — the
+swap is invisible to the latency tail. The RegressionSentinel guards
+the other direction: a degraded candidate never reaches serving
+through the gate, and if one is forced through, the live holdout probe
+rolls it back to a bitwise-identical standby.
+
+Scenario (the demo model is the committed SimpleCNN digits artifact,
+zoo/weights/simplecnn_digits.zip — a real conv+batchnorm stack, not a
+toy dense net):
+
+1. Restore the artifact, then DEGRADE its output layer (zeroed) — the
+   process starts serving a deliberately-bad head so the gate has
+   headroom to demonstrate a promotion.
+2. A publisher thread feeds Poisson-timed RAGGED digit micro-batches
+   to an in-process broker topic; the OnlineLearner fits off it
+   (holdout batches diverted, never trained on).
+3. A client thread drives Poisson predict traffic the whole time,
+   recording client-observed latency through every swap.
+4. The promotion gate runs until the retrained head is promoted.
+5. A freshly re-degraded candidate is offered: the gate must REJECT it.
+6. The same candidate is FORCED through: the sentinel's live score
+   probe must roll it back, restoring bitwise-identical params.
+
+Smoke gates (CI, CPU):
+- promotion happens within ``--promote-window`` seconds;
+- the degraded candidate is rejected (reason "worse");
+- the forced degraded promotion is rolled back (reason "score") and
+  the restored committed params are BITWISE equal to the pre-force
+  snapshot;
+- ``FleetRouter.assert_warm()`` — zero post-warmup recompiles across
+  promote + forced promote + rollback (watchdog-asserted);
+- client-observed p99 under ``--p99-bound`` seconds through it all;
+- every serve request answered (no errors; no SLO → no shedding).
+
+Usage:
+    python -m benchmarks.online --smoke      # CI gate (above)
+    python -m benchmarks.online --duration 60 --rate 20  # longer soak
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+import time
+
+import numpy as np
+
+from deeplearning4j_tpu.observe.latency import LatencyRing
+from deeplearning4j_tpu.observe.registry import MetricsRegistry
+
+
+# ---------------------------------------------------------------------------
+# demo model: the committed SimpleCNN digits artifact, degradable head
+# ---------------------------------------------------------------------------
+
+def load_demo_model():
+    from deeplearning4j_tpu.zoo.models import SimpleCNN
+    return SimpleCNN().init_pretrained(flavor="digits")
+
+
+def degrade_head(model):
+    """Zero the output layer in place: a uniform-softmax head (loss
+    ~ln(10)) over an intact conv trunk — bad enough to gate on, easy
+    enough to retrain quickly."""
+    import jax.numpy as jnp
+    name = model.layers[-1].name
+    ts = model.train_state
+    params = dict(ts.params)
+    params[name] = {k: jnp.zeros_like(v)
+                    for k, v in params[name].items()}
+    model.train_state = ts._replace(params=params)
+    return model
+
+
+def degrade_candidate(cand):
+    """A Candidate with the same zeroed head (host-side numpy)."""
+    params = {k: dict(v) if isinstance(v, dict) else v
+              for k, v in cand.params.items()}
+    last = sorted(params, key=lambda s: int(s.rsplit("_", 1)[-1]))[-1]
+    params[last] = {k: np.zeros_like(np.asarray(v))
+                    for k, v in params[last].items()}
+    return cand._replace(params=params)
+
+
+def digits_batches(seed=0):
+    """Endless ragged micro-batches of real NHWC digits."""
+    from deeplearning4j_tpu.datasets.fetchers import DigitsDataSetIterator
+    x, y = DigitsDataSetIterator.fetch(train=True)
+    x = x.reshape(-1, 28, 28, 1)
+    oh = np.eye(10, dtype=np.float32)[y]
+    rng = np.random.default_rng(seed)
+    while True:
+        n = int(rng.integers(4, 17))       # ragged: 4..16 examples
+        idx = rng.integers(0, x.shape[0], size=n)
+        yield x[idx], oh[idx]
+
+
+# ---------------------------------------------------------------------------
+# load threads
+# ---------------------------------------------------------------------------
+
+class PoissonPublisher(threading.Thread):
+    def __init__(self, transport, topic, rate_hz, seed=1):
+        super().__init__(daemon=True, name="online-bench-pub")
+        self.transport, self.topic = transport, topic
+        self.rate_hz = rate_hz
+        self.batches = digits_batches(seed)
+        self.rng = np.random.default_rng(seed + 1)
+        self.published = 0
+        self.stop_event = threading.Event()
+
+    def run(self):
+        from deeplearning4j_tpu.online import publish_samples
+        while not self.stop_event.is_set():
+            fx, fy = next(self.batches)
+            publish_samples(self.transport, self.topic, fx, fy)
+            self.published += 1
+            self.stop_event.wait(self.rng.exponential(1.0 / self.rate_hz))
+
+
+class PoissonClient(threading.Thread):
+    """Open-loop-ish predict traffic: Poisson think time between
+    requests, client-observed latency into a ring."""
+
+    def __init__(self, online, rate_hz, seed=2):
+        super().__init__(daemon=True, name="online-bench-client")
+        self.online = online
+        self.rate_hz = rate_hz
+        self.rng = np.random.default_rng(seed)
+        self.ring = LatencyRing(capacity=65536)
+        self.ok = 0
+        self.errors = 0
+        self.stop_event = threading.Event()
+        from deeplearning4j_tpu.datasets.fetchers import (
+            DigitsDataSetIterator)
+        x, _ = DigitsDataSetIterator.fetch(train=False)
+        self.x = x.reshape(-1, 28, 28, 1)
+
+    def run(self):
+        while not self.stop_event.is_set():
+            n = int(self.rng.integers(1, 5))
+            idx = self.rng.integers(0, self.x.shape[0], size=n)
+            t0 = time.perf_counter()
+            try:
+                self.online.output(self.x[idx])
+                self.ok += 1
+            except Exception:
+                self.errors += 1
+            self.ring.record(time.perf_counter() - t0)
+            self.stop_event.wait(self.rng.exponential(1.0 / self.rate_hz))
+
+
+# ---------------------------------------------------------------------------
+# the soak
+# ---------------------------------------------------------------------------
+
+def trees_equal(a, b) -> bool:
+    import jax
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    return ta == tb and len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb))
+
+
+def run(args) -> int:
+    from deeplearning4j_tpu.online import OnlineServing
+    from deeplearning4j_tpu.streaming.broker import InProcessTransport
+
+    print("restoring SimpleCNN digits artifact (demo model), "
+          "degrading its head for promotion headroom")
+    model = degrade_head(load_demo_model())
+    transport = InProcessTransport()
+    online = OnlineServing(
+        model, transport, topic="train", model_name="digits",
+        feature_shape=(28, 28, 1), batch_limit=8,
+        holdout_every=4, holdout_max=args.holdout_max,
+        holdout_batch=64, min_delta=0.0,
+        sentinel_window_s=args.promote_window,
+        registry=MetricsRegistry())
+    # the bench drives gate and sentinel itself (deterministic CI);
+    # the learner still trains on its own thread
+    online.start(background_promotion=False)
+    publisher = PoissonPublisher(transport, "train", args.publish_rate)
+    client = PoissonClient(online, args.rate)
+    publisher.start()
+    client.start()
+    promoter, sentinel = online.promoter, online.sentinel
+    failures = []
+    try:
+        if args.duration:
+            # soak phase: serve-while-train, no gate pressure yet
+            print(f"soaking {args.duration:.0f}s before the gates")
+            time.sleep(args.duration)
+        # ---- gate 1: promotion within the window ------------------------
+        deadline = time.time() + args.promote_window
+        decision = None
+        while time.time() < deadline:
+            d = promoter.run_once()
+            if d.reason != "no_candidate":
+                print(f"  gate: promoted={d.promoted} reason={d.reason} "
+                      f"cand={d.candidate_score} active={d.active_score} "
+                      f"it={d.iteration}")
+            if d.promoted:
+                decision = d
+                break
+            time.sleep(1.0)
+        if decision is None:
+            failures.append(
+                f"no promotion within {args.promote_window:.0f}s "
+                f"(learner at {online.learner.iterations} iterations)")
+        else:
+            print(f"PROMOTED {decision.version} after "
+                  f"{online.learner.iterations} learner iterations "
+                  f"(score {decision.active_score:.3f} -> "
+                  f"{decision.candidate_score:.3f})")
+            # the good swap must survive the sentinel's probe
+            r = sentinel.check()
+            if r is not None:
+                failures.append(f"sentinel rolled back a GOOD swap: {r}")
+
+        # ---- gate 2: degraded candidate rejected ------------------------
+        cand = online.learner.snapshot(timeout=10.0)
+        if cand is None:
+            failures.append("no candidate snapshot for the degraded arm")
+        else:
+            bad = degrade_candidate(cand)
+            d2 = promoter.run_once(candidate=bad)
+            print(f"  degraded candidate: promoted={d2.promoted} "
+                  f"reason={d2.reason} cand={d2.candidate_score}")
+            if d2.promoted or d2.reason != "worse":
+                failures.append(
+                    f"degraded candidate not rejected as worse: {d2}")
+
+            # ---- gate 3: forced degrade -> sentinel rollback, bitwise --
+            engine = online.pool.engines[0]
+            pre_params, pre_mstate = engine.committed_host()
+            d3 = promoter.run_once(candidate=bad, force=True)
+            if not d3.promoted or d3.reason != "forced":
+                failures.append(f"force-promotion did not take: {d3}")
+            else:
+                reason = sentinel.check()
+                print(f"  forced {d3.version}: sentinel says "
+                      f"rollback={reason!r}")
+                if reason != "score":
+                    failures.append(
+                        f"sentinel missed the forced degrade: {reason!r}")
+                post_params, post_mstate = engine.committed_host()
+                if not trees_equal(pre_params, post_params):
+                    failures.append(
+                        "post-rollback params NOT bitwise-identical")
+                else:
+                    print("  rollback restored bitwise-identical params")
+
+        # ---- gate 4: warm across everything -----------------------------
+        try:
+            online.router.assert_warm()
+            print("  assert_warm(): zero post-warmup recompiles across "
+                  "promote + forced promote + rollback")
+        except Exception as e:
+            failures.append(f"recompile watchdog tripped: {e}")
+    finally:
+        publisher.stop_event.set()
+        client.stop_event.set()
+        publisher.join(5)
+        client.join(5)
+        stats = online.stats()
+        online.stop()
+
+    # ---- gate 5: the latency tail through the swaps ---------------------
+    q = client.ring.quantiles((0.5, 0.99))
+    p50, p99 = q.get(0.5), q.get(0.99)
+    print(f"served ok={client.ok} errors={client.errors} "
+          f"p50={p50 if p50 is None else round(p50 * 1e3, 1)}ms "
+          f"p99={p99 if p99 is None else round(p99 * 1e3, 1)}ms "
+          f"(bound {args.p99_bound * 1e3:.0f}ms); "
+          f"stream batches={stats['stream']['batches']} "
+          f"holdout={stats['stream']['holdout_examples']} "
+          f"promotions={stats['promotion']['promotions']} "
+          f"rollbacks={stats['sentinel']['rollbacks']}")
+    if client.ok == 0:
+        failures.append("no serve requests completed")
+    if client.errors:
+        failures.append(f"{client.errors} serve errors")
+    if p99 is not None and p99 > args.p99_bound:
+        failures.append(
+            f"client p99 {p99 * 1e3:.1f}ms over the "
+            f"{args.p99_bound * 1e3:.0f}ms bound")
+
+    if failures:
+        print("ONLINE SOAK FAILED:")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("ONLINE SOAK PASSED")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI gate: short window, hard asserts")
+    ap.add_argument("--rate", type=float, default=20.0,
+                    help="serve request rate (Hz, Poisson)")
+    ap.add_argument("--publish-rate", type=float, default=8.0,
+                    help="sample micro-batch publish rate (Hz, Poisson)")
+    ap.add_argument("--promote-window", type=float, default=None,
+                    help="seconds the gate has to promote (default: 120 "
+                    "smoke, 300 soak)")
+    ap.add_argument("--p99-bound", type=float, default=2.5,
+                    help="client-observed p99 bound in seconds "
+                    "(CPU-calibrated: training and scoring share the "
+                    "cores with serving)")
+    ap.add_argument("--holdout-max", type=int, default=160,
+                    help="holdout reservoir bound, examples")
+    ap.add_argument("--duration", type=float, default=None,
+                    help="(soak) extra serve-while-train seconds before "
+                    "the gates run")
+    args = ap.parse_args(argv)
+    if args.promote_window is None:
+        args.promote_window = 120.0 if args.smoke else 300.0
+    return run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -78,6 +78,46 @@ def _build_parser() -> argparse.ArgumentParser:
     s.add_argument("--duration", type=float, default=None,
                    help="serve for N seconds then exit (default: until "
                    "interrupted)")
+    # ---- online learning (train-and-serve in one process) -----------
+    o = s.add_argument_group(
+        "online learning", "train-and-serve in one process: consume a "
+        "broker sample stream, incrementally fit the restored model, "
+        "and hot-promote holdout-gated candidates into the warm "
+        "serving engines (zero recompiles); a regression sentinel "
+        "auto-rolls-back on live p99/score regressions")
+    o.add_argument("--online", action="store_true",
+                   help="enable the online-learning loop (needs "
+                   "--stream-endpoint and batched inference mode)")
+    o.add_argument("--stream-endpoint", default=None, metavar="HOST:PORT",
+                   help="TCP broker to consume training samples from "
+                   "(streaming/broker.py TcpTransport)")
+    o.add_argument("--stream-topic", default="train",
+                   help="broker topic carrying packed sample frames")
+    o.add_argument("--promote-interval-s", type=float, default=5.0,
+                   help="seconds between promotion-gate cycles")
+    o.add_argument("--min-delta", type=float, default=0.0,
+                   help="required holdout-score improvement margin; "
+                   "candidates within it are rejected as 'equal'")
+    o.add_argument("--score-budget-s", type=float, default=None,
+                   help="advisory wall-clock budget for one holdout "
+                   "scoring pass (over-budget is flagged, not fatal)")
+    o.add_argument("--rollback-p99-factor", type=float, default=3.0,
+                   help="sentinel: live p99 over baseline*factor (and "
+                   "over the floor) rolls the promotion back")
+    o.add_argument("--rollback-p99-floor-ms", type=float, default=50.0,
+                   help="sentinel: absolute p99 floor (ms) the live "
+                   "value must also exceed before a p99 rollback")
+    o.add_argument("--rollback-score-delta", type=float, default=0.0,
+                   help="sentinel: tolerated live holdout-score slack "
+                   "vs the pre-swap baseline before a score rollback")
+    o.add_argument("--sentinel-window-s", type=float, default=30.0,
+                   help="how long the sentinel watches after each "
+                   "promotion")
+    o.add_argument("--holdout-every", type=int, default=8,
+                   help="divert every Nth stream micro-batch to the "
+                   "holdout reservoir (never trained on)")
+    o.add_argument("--holdout-max", type=int, default=512,
+                   help="holdout reservoir bound, in examples")
     return p
 
 
@@ -115,7 +155,41 @@ def cmd_serve(args, block: bool = True):
 
     fleet = None
     engine = None
-    if args.slo_ms is not None and mode == InferenceMode.BATCHED:
+    online = None
+    if args.online:
+        if mode != InferenceMode.BATCHED:
+            raise SystemExit(
+                "--online requires --inference-mode batched")
+        if args.stream_endpoint is None:
+            raise SystemExit(
+                "--online requires --stream-endpoint HOST:PORT")
+        from deeplearning4j_tpu.online import OnlineServing
+        from deeplearning4j_tpu.streaming.broker import TcpTransport
+        host, _, port = args.stream_endpoint.rpartition(":")
+        transport = TcpTransport(host or "127.0.0.1", int(port))
+        name = os.path.splitext(os.path.basename(args.model))[0] \
+            or "default"
+        online = OnlineServing(
+            model, transport, topic=args.stream_topic,
+            model_name=name,
+            feature_shape=kwargs.pop("feature_shape", None),
+            batch_limit=args.batch_limit,
+            queue_limit=args.queue_limit, timeout_ms=args.timeout_ms,
+            slo_ms=args.slo_ms,
+            promote_interval_s=args.promote_interval_s,
+            min_delta=args.min_delta,
+            score_budget_s=args.score_budget_s,
+            rollback_p99_factor=args.rollback_p99_factor,
+            rollback_p99_floor_s=args.rollback_p99_floor_ms / 1000.0,
+            rollback_score_delta=args.rollback_score_delta,
+            sentinel_window_s=args.sentinel_window_s,
+            holdout_every=args.holdout_every,
+            holdout_max=args.holdout_max, **kwargs)
+        online.start()
+        fleet = online.router
+        engine = online.pool.engines[0]
+        front = online
+    elif args.slo_ms is not None and mode == InferenceMode.BATCHED:
         # fleet front door: admission control + SLO shedding wrap the
         # engine; the pool is named after the model file
         from deeplearning4j_tpu.parallel.fleet import FleetRouter
@@ -143,6 +217,9 @@ def cmd_serve(args, block: bool = True):
         server.register_module(FleetModule(fleet))
     if engine is not None:
         server.register_module(ServingModule(engine))
+    if online is not None:
+        from deeplearning4j_tpu.ui.online_module import OnlineModule
+        server.register_module(OnlineModule(online))
     server.start()
     print(f"serving {args.model} at {server.url} "
           f"(mode={mode.value}, replicas={replicas}, "
@@ -159,6 +236,9 @@ def cmd_serve(args, block: bool = True):
     if fleet is not None:
         print(f"  fleet:    GET  {server.url}/api/fleet/stats, "
               f"POST {server.url}/api/fleet/swap|rollback")
+    if online is not None:
+        print(f"  online:   GET  {server.url}/api/online/stats, "
+              f"POST {server.url}/api/online/promote|rollback")
     if not block:
         return front, server
     try:

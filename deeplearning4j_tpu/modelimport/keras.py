@@ -123,7 +123,9 @@ def _set_imported(model, name: str, conv: Converted,
                     f"imported weight {path}/{k} has shape {v.shape}, "
                     f"model expects {tuple(cur[k].shape)}")
             tgt_dtype = cur[k].dtype if k in cur else jnp.float32
-            cur[k] = jnp.asarray(v, tgt_dtype)
+            # copy, never alias: a donated train step after import must
+            # not inherit buffers the h5 reader's numpy still owns
+            cur[k] = jnp.array(v, tgt_dtype, copy=True)
         return cur
 
     ts = model.train_state
@@ -134,7 +136,7 @@ def _set_imported(model, name: str, conv: Converted,
     if state:
         cur = dict(new_s.get(name, {}))
         for k, v in state.items():
-            cur[k] = jnp.asarray(np.asarray(v), jnp.float32)
+            cur[k] = jnp.array(np.asarray(v), jnp.float32, copy=True)
         new_s[name] = cur
     model.train_state = ts._replace(params=new_p, model_state=new_s)
 

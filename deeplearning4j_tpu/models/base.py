@@ -100,6 +100,12 @@ class BaseModel:
         return int(sum(np.prod(l.shape) for l in leaves))
 
     def set_params(self, params):
+        # numpy leaves are copied onto the device, never zero-copy
+        # aliased: the donated train step must own every buffer it is
+        # handed, and CPU asarray/device_put alias aligned host arrays
+        params = jax.tree_util.tree_map(
+            lambda a: jnp.array(a, copy=True)
+            if isinstance(a, np.ndarray) else a, params)
         self.train_state = self.train_state._replace(params=params)
 
     def set_listeners(self, *listeners: TrainingListener):

@@ -79,7 +79,13 @@ def _unflatten_like(template, flat: Dict[str, np.ndarray]):
         if key not in flat:
             raise KeyError(f"checkpoint missing array: {key}")
         arr = flat[key]
-        new_leaves.append(jnp.asarray(arr).astype(leaf.dtype).reshape(leaf.shape))
+        # jnp.array(copy=True), never asarray: on the CPU backend asarray
+        # zero-copy aliases any 64-byte-aligned host array (astype/reshape
+        # to the same dtype/shape are no-ops that keep the alias), and a
+        # donated train step after restore would then hand XLA a buffer
+        # numpy still owns — intermittent heap corruption on restore->fit
+        new_leaves.append(
+            jnp.array(arr, copy=True).astype(leaf.dtype).reshape(leaf.shape))
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
 
 

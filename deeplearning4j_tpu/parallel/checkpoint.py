@@ -399,7 +399,11 @@ def restore_sharded(model, path: str, mesh: Optional[Mesh] = None,
                     leaves.append(jax.make_array_from_callback(
                         tuple(leaf.shape), sh, fetch))
                 else:
-                    leaves.append(jnp.asarray(fetch()))
+                    # copy=True, never asarray: CPU asarray zero-copy
+                    # aliases aligned host arrays, and the resumed fit's
+                    # donated step would hand XLA a buffer the reader's
+                    # numpy still owns (intermittent heap corruption)
+                    leaves.append(jnp.array(fetch(), copy=True))
             elif hasattr(leaf, "shape") and np.size(leaf) > 0:
                 # an array the model expects but the checkpoint lacks:
                 # resuming would silently mix restored and random weights

@@ -39,7 +39,7 @@ Environment knobs (all read at router construction; OBSERVABILITY.md):
 Prometheus series (rides the PR 2 registry, scraped at ``/metrics``):
 ``dl4j_fleet_admitted_total{model}``, ``dl4j_fleet_shed_total{model,
 reason=queue|slo}``, ``dl4j_fleet_swap_total{model, event=swap|
-rollback}``, ``dl4j_fleet_pool_depth{model}``,
+rollback|param_swap|param_rollback}``, ``dl4j_fleet_pool_depth{model}``,
 ``dl4j_fleet_shed_fraction{model}``, ``dl4j_fleet_p99_ms{model}``,
 ``dl4j_fleet_pool_engines{model}``.
 """
@@ -118,6 +118,11 @@ class ModelPool:
         self.engines: List[ServingEngine] = []
         self.active_version: Optional[str] = None
         self.standby: Optional[Tuple[str, List[ServingEngine]]] = None
+        # param-only standby: (version, host params, host model_state)
+        # captured by promote_params before it overwrites the committed
+        # params — the rollback target for the online-learning path
+        self.param_standby: Optional[Tuple[Optional[str], Any, Any]] = \
+            None
         self.ring = LatencyRing()
         self.pending = 0
         self.shed_fraction = 0.0
@@ -205,6 +210,8 @@ class ModelPool:
                 "active_version": self.active_version,
                 "standby_version": self.standby[0] if self.standby
                 else None,
+                "param_standby_version": self.param_standby[0]
+                if self.param_standby else None,
                 "pool_size": len(engines),
                 "pending": self.pending,
                 "shed_fraction": self.shed_fraction,
@@ -424,6 +431,70 @@ class FleetRouter:
             pool.ring.reset()
         self._c_swap.inc(1.0, model=name, event="rollback")
         self._g_engines.set(len(pool.engines), model=name)
+        return pool
+
+    # ---- param-only promotion (online learning) --------------------------
+    def promote_params(self, name: str, params, model_state=None, *,
+                       version: Optional[str] = None) -> ModelPool:
+        """Param-only hot promotion: push new weights into the pool's
+        warm engines via ``ServingEngine.swap_params`` — **zero
+        recompiles**, no new engines, no warmup sweep. The previous
+        committed params are captured host-side first and kept as
+        ``pool.param_standby`` (the ``rollback_params`` target).
+
+        Each engine's swap is individually atomic; across a multi-
+        engine pool there is a brief window where engines serve
+        different param versions (same structure, so every request
+        still completes normally). Structural validation happens on the
+        first engine before anything is overwritten — a mismatched
+        candidate raises with the whole pool untouched."""
+        pool = self.pool(name)
+        with pool.lock:
+            engines = list(pool.engines)
+            old_version = pool.active_version
+        if not engines:
+            raise RuntimeError(f"pool {name!r} has no engines")
+        standby_params, standby_mstate = engines[0].committed_host()
+        for e in engines:
+            e.swap_params(params, model_state, version=version)
+        with pool.lock:
+            pool.param_standby = (old_version, standby_params,
+                                  standby_mstate)
+            if version is not None:
+                pool.active_version = version
+            # pre-promotion latencies must not drive the new params'
+            # shedding / regression verdicts
+            pool.ring.reset()
+        self._c_swap.inc(1.0, model=name, event="param_swap")
+        return pool
+
+    def rollback_params(self, name: str) -> ModelPool:
+        """Restore the ``param_standby`` captured by the last
+        ``promote_params`` — bitwise-identical host copies pushed back
+        through the same warm executables. The rolled-back-from params
+        become the new standby, so a flapping promotion can flip
+        repeatedly."""
+        pool = self.pool(name)
+        with pool.lock:
+            standby = pool.param_standby
+            engines = list(pool.engines)
+            old_version = pool.active_version
+        if standby is None:
+            raise RuntimeError(
+                f"pool {name!r} has no param standby to roll back to")
+        if not engines:
+            raise RuntimeError(f"pool {name!r} has no engines")
+        sv, sp, sm = standby
+        current_params, current_mstate = engines[0].committed_host()
+        for e in engines:
+            e.swap_params(sp, sm, version=sv)
+        with pool.lock:
+            pool.param_standby = (old_version, current_params,
+                                  current_mstate)
+            if sv is not None:
+                pool.active_version = sv
+            pool.ring.reset()
+        self._c_swap.inc(1.0, model=name, event="param_rollback")
         return pool
 
     # ---- introspection ---------------------------------------------------

@@ -356,6 +356,7 @@ class ServingEngine:
         self._exe_lock = threading.Lock()
         self._warmed = False
         self._post_warmup_compiles = 0
+        self.param_swaps = 0
         self._rr = 0                       # round-robin replica cursor
         self._inflight_count = 0
         self._count_lock = threading.Lock()
@@ -671,6 +672,96 @@ class ServingEngine:
     @property
     def recompiles_after_warmup(self) -> int:
         return self._post_warmup_compiles
+
+    # ---- param-only hot swap ---------------------------------------------
+    def committed_host(self) -> Tuple[Any, Any]:
+        """Host copies of the committed ``(params, model_state)`` for
+        replica 0 — the rollback standby snapshot. ``np.array`` copies,
+        never views: on the CPU backend ``device_get`` can alias the
+        live buffers, and a standby that shares storage with params
+        about to be overwritten is no standby at all."""
+        if not self._committed:
+            raise ValueError(
+                "legacy .output-only engines have no committed params")
+        import jax
+        return jax.tree_util.tree_map(
+            lambda a: np.array(a, copy=True),
+            jax.device_get(self._committed[0]))
+
+    def swap_params(self, params, model_state=None, *,
+                    version: Optional[str] = None) -> None:
+        """Atomically replace the committed inference params without
+        touching the executable table.
+
+        Params are **traced arguments** of every bucket executable (not
+        baked constants), so as long as the new tree matches the old
+        one structurally — same treedef, same leaf shapes/dtypes — the
+        warm AOT executables serve the new weights with **zero
+        recompiles**. Structure is validated up front and a mismatch
+        raises before anything is committed; the swap itself is one
+        dict-reference assignment, so a dispatch racing the swap sees
+        either the old committed set or the new one, never a mix.
+
+        int8 engines refuse: quantized params bake calibration scales,
+        so new weights need requantization (build a new engine — the
+        fleet's warm-first ``swap`` path).
+        """
+        import jax
+        if self._jit is None:
+            raise ValueError(
+                "legacy .output-only model: no committed params to swap")
+        if self.precision.mode == "int8":
+            raise ValueError(
+                "int8 engines cannot hot-swap params (weights bake "
+                "calibration scales); build a new engine and use the "
+                "fleet swap path")
+        old_params, old_mstate = self._committed[0]
+        if model_state is None:
+            model_state = old_mstate
+        if self.bf16:
+            import jax.numpy as jnp
+            params = jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.bfloat16)
+                if jnp.issubdtype(np.asarray(a).dtype,  # host-sync-ok: incoming host candidate, dtype probe only
+                                  np.floating)
+                else a, params)
+        old_leaves, old_def = jax.tree_util.tree_flatten(
+            (old_params, old_mstate))
+        new_leaves, new_def = jax.tree_util.tree_flatten(
+            (params, model_state))
+        if new_def != old_def:
+            raise ValueError(
+                "swap_params: tree structure mismatch vs committed "
+                f"params ({new_def} != {old_def}); a structural change "
+                "invalidates the warm executables — use the fleet's "
+                "full swap instead")
+        for i, (o, nl) in enumerate(zip(old_leaves, new_leaves)):
+            os_, ns = np.shape(o), np.shape(nl)
+            od = o.dtype if hasattr(o, "dtype") \
+                else np.asarray(o).dtype  # host-sync-ok: plain-python leaf, structural check
+            nd = nl.dtype if hasattr(nl, "dtype") \
+                else np.asarray(nl).dtype  # host-sync-ok: plain-python leaf, structural check
+            if os_ != ns or od != nd:
+                raise ValueError(
+                    f"swap_params: leaf {i} is {ns}/{nd}, committed "
+                    f"expects {os_}/{od}; shape/dtype changes "
+                    "invalidate the warm executables")
+        new_committed: Dict[Union[int, str], Any] = {}
+        for r, dev in enumerate(self.devices):
+            new_committed[r] = jax.device_put((params, model_state),
+                                              dev)
+        if MESH in self._committed:
+            # reuse the live replicated sharding rather than rebuilding
+            # the mesh — same placement, no new compile keys
+            shd = jax.tree_util.tree_leaves(
+                self._committed[MESH])[0].sharding
+            new_committed[MESH] = jax.device_put(
+                (params, model_state), shd)
+        # single reference assignment = the atomic commit point
+        self._committed = new_committed
+        if version is not None:
+            self.model_version = version
+        self.param_swaps += 1
 
     def assert_warm(self):
         """Raise when any live request paid a compile after the warmup

@@ -5,6 +5,14 @@ broker abstracted: ``InProcessTransport`` (queue per topic — the test/
 single-host path, like the reference's Camel direct: routes) and
 ``TcpTransport`` (length-prefixed frames over a socket — cross-process).
 A Kafka/PubSub transport is the same interface against a real broker.
+
+``TcpTransport`` survives peer drops: a broken/timed-out socket is torn
+down and the frame retried over a fresh connection with bounded
+exponential backoff (a mid-exchange failure desyncs the framed
+protocol, so reconnect is the only safe resync). Every reconnect
+attempt is surfaced on the ``dl4j_stream_reconnects_total`` Prometheus
+counter — before this, one dropped connection killed the consumer
+thread for good (the online learner's input just stopped).
 """
 
 from __future__ import annotations
@@ -14,6 +22,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from collections import defaultdict
 from typing import Dict, List, Optional
 
@@ -94,21 +103,49 @@ class _FrameHandler(socketserver.BaseRequestHandler):
         return buf
 
 
+class _BrokerServer(socketserver.ThreadingTCPServer):
+    # SO_REUSEADDR: a restarted broker must be able to rebind its port
+    # while old connections sit in TIME_WAIT (the reconnect story
+    # depends on it)
+    allow_reuse_address = True
+    daemon_threads = True
+
+
 class TcpTransport(Transport):
     """Client side of the socket broker; ``serve()`` starts the broker
-    (an InProcessTransport behind a threaded TCP server)."""
+    (an InProcessTransport behind a threaded TCP server).
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    ``reconnect=True`` (default) makes ``publish``/``poll`` retry over a
+    fresh connection when the peer drops mid-exchange: up to
+    ``max_retries`` attempts with exponential backoff
+    ``backoff_base_s * 2**attempt`` capped at ``backoff_max_s``. A
+    publish retried after a send-side failure may be delivered twice
+    (at-least-once, like any reconnecting producer); polls are
+    idempotent. Retries exhausted -> the original error propagates."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
+                 reconnect: bool = True, max_retries: int = 5,
+                 backoff_base_s: float = 0.05,
+                 backoff_max_s: float = 2.0, registry=None):
         self.host = host
         self.port = port
+        self.reconnect = bool(reconnect)
+        self.max_retries = int(max_retries)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.reconnects = 0
         self._sock: Optional[socket.socket] = None
         self._server = None
         self._lock = threading.Lock()
+        from deeplearning4j_tpu.observe.registry import default_registry
+        reg = registry if registry is not None else default_registry()
+        self._c_reconnects = reg.counter(
+            "dl4j_stream_reconnects_total",
+            "streaming transport reconnect attempts after a dropped/"
+            "failed broker connection, by endpoint and operation")
 
     def serve(self) -> "TcpTransport":
-        srv = socketserver.ThreadingTCPServer(
-            (self.host, self.port), _FrameHandler)
-        srv.daemon_threads = True
+        srv = _BrokerServer((self.host, self.port), _FrameHandler)
         srv.broker = InProcessTransport()  # type: ignore
         self.port = srv.server_address[1]
         self._server = srv
@@ -121,15 +158,53 @@ class TcpTransport(Transport):
                                                   timeout=10)
         return self._sock
 
+    def _drop_conn(self):
+        """Tear down the (possibly desynced) connection so the next
+        attempt starts from a clean frame boundary."""
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _with_retry(self, op: str, fn):
+        """Run ``fn`` holding the connection lock; on a transport error
+        drop the connection and retry with bounded exponential backoff.
+        The lock is held across the whole retry loop so interleaved
+        callers can never split a frame."""
+        with self._lock:
+            attempt = 0
+            while True:
+                try:
+                    return fn()
+                except (ConnectionError, OSError) as e:
+                    self._drop_conn()
+                    if not self.reconnect or attempt >= self.max_retries:
+                        raise ConnectionError(
+                            f"broker {self.host}:{self.port} {op} failed "
+                            f"after {attempt} reconnect attempt(s): {e}"
+                        ) from e
+                    delay = min(self.backoff_max_s,
+                                self.backoff_base_s * (2 ** attempt))
+                    attempt += 1
+                    self.reconnects += 1
+                    self._c_reconnects.inc(
+                        1.0, endpoint=f"{self.host}:{self.port}", op=op)
+                    time.sleep(delay)
+
     def publish(self, topic: str, payload: bytes) -> None:
         tb = topic.encode("utf-8")
-        with self._lock:
-            self._conn().sendall(
-                struct.pack("<BII", 0, len(tb), len(payload)) + tb + payload)
+        frame = struct.pack("<BII", 0, len(tb), len(payload)) + tb + payload
+
+        def send():
+            self._conn().sendall(frame)
+        self._with_retry("publish", send)
 
     def poll(self, topic: str, timeout: float = 1.0) -> Optional[bytes]:
         tb = topic.encode("utf-8")
-        with self._lock:
+
+        def exchange():
             s = self._conn()
             # socket deadline must outlast the server-side poll wait, or a
             # mid-exchange timeout desyncs the framed protocol
@@ -141,6 +216,7 @@ class TcpTransport(Transport):
             if plen == 0:
                 return None
             return self._recv_exact(s, plen)
+        return self._with_retry("poll", exchange)
 
     @staticmethod
     def _recv_exact(s: socket.socket, n: int) -> bytes:
@@ -158,6 +234,9 @@ class TcpTransport(Transport):
             self._sock = None
         if self._server is not None:
             self._server.shutdown()
+            # release the listening socket too, so a restarted broker
+            # can rebind the same port immediately
+            self._server.server_close()
             self._server = None
 
 

@@ -59,10 +59,13 @@ class Route:
 
         def run():
             while not self._stop.is_set():
-                msg = consumer.poll(timeout=0.1)
-                if msg is None:
-                    continue
                 try:
+                    # poll inside the try: a transport error (broker
+                    # restart beyond the transport's own retries) must
+                    # not kill the route thread for good
+                    msg = consumer.poll(timeout=0.1)
+                    if msg is None:
+                        continue
                     arr = msg.array
                     for step in self._steps:
                         arr = step(arr)

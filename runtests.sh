@@ -44,4 +44,10 @@ JAX_PLATFORMS=cpu python -m benchmarks.serving --smoke-fleet
 # sustains >=1.5x the SYNC round rate with divergence under the
 # hard-sync threshold, and reduces exactly to AVERAGING without one
 JAX_PLATFORMS=cpu python -m benchmarks.elastic --smoke
+# online tier: train-and-serve in one process — a broker-fed learner's
+# improved params hot-promote into the warm executables within the
+# window (zero recompiles, watchdog-asserted), a degraded candidate is
+# rejected, a forced degrade is sentinel-rolled-back to bitwise params,
+# and client p99 stays bounded through every swap
+JAX_PLATFORMS=cpu python -m benchmarks.online --smoke
 exec python -m pytest tests/ -q "$@"

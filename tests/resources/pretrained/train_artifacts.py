@@ -107,7 +107,42 @@ def train_textgen():
         json.dump({c: i for c, i in vocab.items()}, f)
 
 
+def train_simplecnn():
+    """SimpleCNN on the real UCI digits (28x28 upscale) — the online-
+    learning demo model (ISSUE 10): conv+batchnorm stack, small enough
+    to hot-promote on CPU."""
+    from deeplearning4j_tpu.datasets.dataset import (
+        ArrayDataSetIterator, DataSet)
+    from deeplearning4j_tpu.datasets.fetchers import DigitsDataSetIterator
+    from deeplearning4j_tpu.models.serialization import save_model
+    from deeplearning4j_tpu.zoo.models import SimpleCNN
+
+    def nhwc(train):
+        # SimpleCNN's input type is convolutional (NHWC), not the
+        # flat variant LeNet uses — reshape the real digits ourselves
+        x, y = DigitsDataSetIterator.fetch(train)
+        oh = np.eye(10, dtype=np.float32)[y]
+        return DataSet(x.reshape(-1, 28, 28, 1), oh)
+
+    model = SimpleCNN(num_classes=10, height=28, width=28,
+                      channels=1).init()
+    model.fit(ArrayDataSetIterator(nhwc(True), 64, shuffle=True),
+              epochs=8)
+    ev = model.evaluate(ArrayDataSetIterator(nhwc(False), 64))
+    acc = ev.accuracy()
+    print("SimpleCNN digits test accuracy:", acc)
+    assert acc >= 0.95, acc
+    out = os.path.join(WEIGHTS, "simplecnn_digits.zip")
+    save_model(model, out)
+    finish(out)
+
+
 if __name__ == "__main__":
     os.makedirs(WEIGHTS, exist_ok=True)
-    train_lenet()
-    train_textgen()
+    only = sys.argv[1] if len(sys.argv) > 1 else None
+    if only in (None, "lenet"):
+        train_lenet()
+    if only in (None, "textgen"):
+        train_textgen()
+    if only in (None, "simplecnn"):
+        train_simplecnn()

@@ -1,0 +1,518 @@
+"""Online learning subsystem tests (ISSUE 10).
+
+Promotion-gate edge cases (a worse/equal/NaN/unscoreable candidate
+NEVER reaches serving), bitwise param rollback, the param swap racing
+in-flight requests, the stream's serde/holdout/malformed handling, and
+broker reconnect with bounded backoff.
+"""
+
+import math
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.observe.registry import MetricsRegistry
+from deeplearning4j_tpu.online import (
+    OnlineLearner,
+    OnlineServing,
+    PromotionController,
+    RegressionSentinel,
+    SampleStreamIterator,
+    pack_samples,
+    publish_samples,
+    unpack_samples,
+)
+from deeplearning4j_tpu.parallel.fleet import FleetRouter
+from deeplearning4j_tpu.streaming.broker import (
+    InProcessTransport,
+    NDArrayPublisher,
+    TcpTransport,
+)
+
+N_IN = 5
+N_OUT = 3
+
+
+def _tiny_model(seed: int = 1):
+    from deeplearning4j_tpu.models.multi_layer_network import (
+        MultiLayerNetwork)
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+    from deeplearning4j_tpu.ops.losses import LossFunction
+    from deeplearning4j_tpu.optimize.updaters import Adam
+    conf = (NeuralNetConfiguration.Builder().seed(seed)
+            .updater(Adam(1e-2)).list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=N_OUT, loss=LossFunction.MCXENT))
+            .set_input_type(InputType.feed_forward(N_IN)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _batch(rng, n):
+    x = rng.normal(size=(n, N_IN)).astype(np.float32)
+    y = np.eye(N_OUT, dtype=np.float32)[rng.integers(0, N_OUT, size=n)]
+    return x, y
+
+
+def _router(model, **kw):
+    reg = kw.pop("registry", None) or MetricsRegistry()
+    router = FleetRouter(registry=reg)
+    router.add_pool("m", model, version="v0", feature_shape=(N_IN,),
+                    batch_limit=8, **kw)
+    return router
+
+
+def _host_params(router):
+    return router.pool("m").engines[0].committed_host()
+
+
+def _trees_equal(a, b):
+    import jax
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    return ta == tb and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb))
+
+
+class _ScriptedCalc:
+    """ScoreCalculator stand-in: returns scripted scores in call
+    order (candidate first, then the lazy active baseline)."""
+    minimize_score = True
+
+    def __init__(self, scores):
+        self.scores = list(scores)
+        self.calls = 0
+
+    def calculate_score(self, model):
+        self.calls += 1
+        s = self.scores.pop(0)
+        if isinstance(s, Exception):
+            raise s
+        return s
+
+
+def _stream_with_holdout(n_examples=8):
+    rng = np.random.default_rng(0)
+    s = SampleStreamIterator(InProcessTransport(), "t",
+                            registry=MetricsRegistry())
+    s._add_holdout(DataSet(*_batch(rng, n_examples)))
+    return s
+
+
+def _controller(router, calc, stream=None, model=None, **kw):
+    model = model if model is not None else _tiny_model(seed=3)
+    learner = OnlineLearner(
+        model, stream if stream is not None else _stream_with_holdout())
+    return PromotionController(
+        router, "m", learner, calc, model.clone(),
+        registry=MetricsRegistry(), **kw)
+
+
+# ---------------------------------------------------------------------------
+# stream serde / holdout / malformed
+# ---------------------------------------------------------------------------
+
+class TestStream:
+    def test_pack_unpack_roundtrip_ragged_and_4d(self):
+        rng = np.random.default_rng(1)
+        for shape in ((7, N_IN), (3, 4, 4, 2)):
+            x = rng.normal(size=shape).astype(np.float32)
+            y = np.eye(3, dtype=np.float32)[
+                rng.integers(0, 3, size=shape[0])]
+            packed, key = pack_samples(x, y)
+            ds = unpack_samples(packed, key)
+            np.testing.assert_array_equal(ds.features, x)
+            np.testing.assert_array_equal(ds.labels, y)
+
+    def test_unpack_rejects_key_geometry_disagreement(self):
+        packed, _ = pack_samples(
+            np.zeros((2, N_IN), np.float32), np.zeros((2, 3), np.float32))
+        with pytest.raises(ValueError):
+            unpack_samples(packed, str(N_IN + 3))   # eats the labels
+        with pytest.raises(ValueError):
+            unpack_samples(packed, "not-a-shape")
+
+    def test_holdout_divert_malformed_skip_and_bound(self):
+        transport = InProcessTransport()
+        rng = np.random.default_rng(2)
+        reg = MetricsRegistry()
+        stream = SampleStreamIterator(
+            transport, "t", holdout_every=3, holdout_max=8,
+            max_batches=9, registry=reg)
+        for _ in range(4):
+            publish_samples(transport, "t", *_batch(rng, 4))
+        # one mid-stream frame whose key disagrees with its geometry:
+        # must be counted + skipped, not kill the iterator (malformed
+        # frames don't count against max_batches)
+        NDArrayPublisher(transport, "t").publish(
+            np.zeros((2, 4), np.float32), key="999")
+        for _ in range(5):
+            publish_samples(transport, "t", *_batch(rng, 4))
+        trained = list(stream)
+        # 9 consumed batches, every 3rd diverted to holdout
+        assert len(trained) == 6
+        assert stream.batches_consumed == 9
+        assert stream.malformed == 1
+        c = reg.get_metric("dl4j_online_stream_malformed_total")
+        assert c.get(topic="t") == 1.0
+        # reservoir bounded by examples (8): 3 diverted 4-example
+        # batches, oldest evicted
+        assert stream.holdout_examples == 8
+        snap = stream.holdout_snapshot()
+        assert snap.num_examples() == 8
+        # the live view re-batches the current reservoir
+        view = list(stream.holdout_view(batch_size=3))
+        assert sum(b.num_examples() for b in view) == 8
+
+
+# ---------------------------------------------------------------------------
+# promotion gate edge cases
+# ---------------------------------------------------------------------------
+
+class TestPromotionGate:
+    def _run_rejection(self, scores, expect_reason):
+        router = _router(_tiny_model())
+        before_version = router.pool("m").active_version
+        before = _host_params(router)
+        ctl = _controller(router, _ScriptedCalc(scores))
+        d = ctl.run_once()
+        assert d.promoted is False
+        assert d.reason == expect_reason
+        # the active params are untouched, bitwise
+        assert router.pool("m").active_version == before_version
+        assert _trees_equal(before, _host_params(router))
+        assert ctl.promotions == 0 and ctl.rejections == 1
+        router.shutdown()
+
+    def test_worse_candidate_never_promotes(self):
+        # candidate scored first (2.0), then the active baseline (1.0)
+        self._run_rejection([2.0, 1.0], "worse")
+
+    def test_equal_candidate_never_promotes(self):
+        self._run_rejection([1.0, 1.0], "equal")
+
+    def test_within_min_delta_rejected_as_equal(self):
+        router = _router(_tiny_model())
+        ctl = _controller(router, _ScriptedCalc([0.95, 1.0]),
+                          min_delta=0.1)
+        d = ctl.run_once()
+        assert (d.promoted, d.reason) == (False, "equal")
+        router.shutdown()
+
+    def test_nan_candidate_never_promotes(self):
+        # NaN rejects before the active baseline is even scored
+        self._run_rejection([float("nan")], "nan")
+
+    def test_inf_candidate_never_promotes(self):
+        self._run_rejection([math.inf], "nan")
+
+    def test_scoring_error_never_promotes(self):
+        self._run_rejection([RuntimeError("holdout exploded")], "error")
+
+    def test_no_holdout_never_promotes(self):
+        router = _router(_tiny_model())
+        ctl = _controller(router, _ScriptedCalc([]),
+                          stream=_stream_with_holdout(0))
+        # empty reservoir: candidate exists but nothing to score on
+        ctl.learner.stream._holdout.clear()
+        ctl.learner.stream._holdout_examples = 0
+        d = ctl.run_once()
+        assert (d.promoted, d.reason) == (False, "no_holdout")
+        router.shutdown()
+
+    def test_improved_candidate_promotes_and_arms_sentinel(self):
+        router = _router(_tiny_model())
+        sentinel = RegressionSentinel(router, "m",
+                                      registry=MetricsRegistry())
+        ctl = _controller(router, _ScriptedCalc([0.5, 1.0]))
+        ctl.sentinel = sentinel
+        d = ctl.run_once()
+        assert d.promoted and d.reason == "improved"
+        assert router.pool("m").active_version == d.version
+        assert sentinel.watching
+        assert ctl.active_score == 0.5
+        router.shutdown()
+
+    def test_score_budget_is_advisory(self):
+        router = _router(_tiny_model())
+
+        class SlowCalc(_ScriptedCalc):
+            def calculate_score(self, model):
+                time.sleep(0.05)
+                return super().calculate_score(model)
+
+        ctl = _controller(router, SlowCalc([2.0, 1.0]),
+                          score_budget_s=0.001)
+        d = ctl.run_once()
+        assert d.over_budget is True
+        assert d.reason == "worse"       # flagged, never fatal
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# hot swap + rollback
+# ---------------------------------------------------------------------------
+
+class TestSwapRollback:
+    def test_rollback_restores_bitwise_params(self):
+        m2 = _tiny_model(seed=99)
+        router = _router(_tiny_model())
+        before_params, before_mstate = _host_params(router)
+        import jax
+        router.promote_params(
+            "m",
+            jax.tree_util.tree_map(np.asarray, m2.train_state.params),
+            jax.tree_util.tree_map(np.asarray,
+                                   m2.train_state.model_state),
+            version="v1")
+        assert router.pool("m").active_version == "v1"
+        assert not _trees_equal(before_params, _host_params(router)[0])
+        router.rollback_params("m")
+        after_params, after_mstate = _host_params(router)
+        assert _trees_equal(before_params, after_params)
+        assert _trees_equal(before_mstate, after_mstate)
+        assert router.pool("m").active_version == "v0"
+        # the whole dance paid zero recompiles
+        router.assert_warm()
+        router.shutdown()
+
+    def test_rollback_without_standby_raises(self):
+        router = _router(_tiny_model())
+        with pytest.raises(RuntimeError):
+            router.rollback_params("m")
+        router.shutdown()
+
+    def test_structural_mismatch_rejected_before_commit(self):
+        router = _router(_tiny_model())
+        before = _host_params(router)
+        with pytest.raises(ValueError):
+            router.promote_params(
+                "m", {"nope": np.zeros(3, np.float32)}, {})
+        assert _trees_equal(before, _host_params(router))
+        router.shutdown()
+
+    def test_swap_races_inflight_futures(self):
+        """Requests submitted concurrently with promote/rollback must
+        ALL complete (old or new params, never an error / hang), and
+        the engines stay warm."""
+        m2 = _tiny_model(seed=7)
+        router = _router(_tiny_model())
+        import jax
+        p2 = jax.tree_util.tree_map(np.asarray, m2.train_state.params)
+        s2 = jax.tree_util.tree_map(np.asarray,
+                                    m2.train_state.model_state)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(4, N_IN)).astype(np.float32)
+        stop = threading.Event()
+        errors, done = [], [0] * 4
+
+        def client(i):
+            while not stop.is_set():
+                try:
+                    fut = router.submit(x, model="m")
+                    out = np.asarray(fut.result(timeout=10))
+                    assert out.shape == (4, N_OUT)
+                    done[i] += 1
+                except Exception as e:      # pragma: no cover
+                    errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            deadline = time.time() + 30
+            for _ in range(10):
+                router.promote_params("m", p2, s2, version="vX")
+                router.rollback_params("m")
+                time.sleep(0.02)
+            # every client must land at least one request THROUGH the
+            # swap storm before we stop the presses
+            while not all(n > 0 for n in done) and not errors \
+                    and time.time() < deadline:
+                router.promote_params("m", p2, s2, version="vX")
+                router.rollback_params("m")
+                time.sleep(0.02)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(10)
+        assert not errors
+        assert all(n > 0 for n in done)
+        router.assert_warm()
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# sentinel
+# ---------------------------------------------------------------------------
+
+class TestSentinel:
+    def test_score_regression_rolls_back_bitwise(self):
+        router = _router(_tiny_model())
+        good = _host_params(router)
+        rolled = []
+        sentinel = RegressionSentinel(
+            router, "m", score_fn=lambda: 5.0, score_delta=0.5,
+            on_rollback=rolled.append, registry=MetricsRegistry())
+        ctl = _controller(router, _ScriptedCalc([0.5, 1.0]))
+        ctl.sentinel = sentinel
+        d = ctl.run_once()
+        assert d.promoted
+        # live score 5.0 vs pre-swap baseline 1.0: regression
+        assert sentinel.check() == "score"
+        assert rolled == ["score"]
+        assert _trees_equal(good[0], _host_params(router)[0])
+        assert router.pool("m").active_version == "v0"
+        router.shutdown()
+
+    def test_survived_window_retires_baseline(self):
+        router = _router(_tiny_model())
+        sentinel = RegressionSentinel(
+            router, "m", score_fn=lambda: 0.4, score_delta=0.0,
+            window_s=0.0, registry=MetricsRegistry())
+        ctl = _controller(router, _ScriptedCalc([0.5, 1.0]))
+        ctl.sentinel = sentinel
+        assert ctl.run_once().promoted
+        time.sleep(0.01)
+        # live score fine, window elapsed: promotion stands, idle
+        assert sentinel.check() is None
+        assert not sentinel.watching
+        assert sentinel.rollbacks == 0
+        router.shutdown()
+
+    def test_nan_live_score_rolls_back(self):
+        router = _router(_tiny_model())
+        sentinel = RegressionSentinel(
+            router, "m", score_fn=lambda: float("nan"),
+            registry=MetricsRegistry())
+        ctl = _controller(router, _ScriptedCalc([0.5, 1.0]))
+        ctl.sentinel = sentinel
+        assert ctl.run_once().promoted
+        assert sentinel.check() == "nan"
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# broker reconnect
+# ---------------------------------------------------------------------------
+
+class TestBrokerReconnect:
+    def test_reconnect_after_server_restart(self):
+        from deeplearning4j_tpu.streaming.broker import NDArrayConsumer
+        srv = TcpTransport().serve()
+        port = srv.port
+        reg = MetricsRegistry()
+        client = TcpTransport(port=port, backoff_base_s=0.01,
+                              backoff_max_s=0.05, registry=reg)
+        a = np.arange(4, dtype=np.float32)
+        NDArrayPublisher(client, "x").publish(a, key="k")
+        consumer = TcpTransport(port=port)
+        assert NDArrayConsumer(consumer, "x").poll(timeout=2) is not None
+        consumer.close()
+        # restart the broker on the same port; the client's half-open
+        # connection dies with it (simulated with a local close — the
+        # server's RST would surface as the same OSError)
+        srv.close()
+        srv2 = TcpTransport(port=port).serve()
+        client._sock.close()
+        try:
+            NDArrayPublisher(client, "x").publish(a, key="k2")
+            consumer2 = TcpTransport(port=port)
+            msg = NDArrayConsumer(consumer2, "x").poll(timeout=2)
+            assert msg is not None and msg.key == "k2"
+            consumer2.close()
+            assert client.reconnects >= 1
+            c = reg.get_metric("dl4j_stream_reconnects_total")
+            assert c.get(endpoint=f"127.0.0.1:{port}",
+                         op="publish") >= 1.0
+        finally:
+            client.close()
+            srv2.close()
+
+    def test_retries_exhausted_raises_connection_error(self):
+        # nothing listens here; bounded backoff then a clear error
+        client = TcpTransport(port=1, max_retries=2,
+                              backoff_base_s=0.005, backoff_max_s=0.01,
+                              registry=MetricsRegistry())
+        t0 = time.perf_counter()
+        with pytest.raises(ConnectionError, match="2 reconnect"):
+            client.publish("x", b"payload")
+        assert time.perf_counter() - t0 < 5.0
+        assert client.reconnects == 2
+
+    def test_reconnect_disabled_fails_fast(self):
+        client = TcpTransport(port=1, reconnect=False,
+                              registry=MetricsRegistry())
+        with pytest.raises(ConnectionError):
+            client.publish("x", b"payload")
+        assert client.reconnects == 0
+
+
+# ---------------------------------------------------------------------------
+# end to end (tiny model, in-process broker)
+# ---------------------------------------------------------------------------
+
+class TestOnlineServingEndToEnd:
+    def test_learn_promote_serve_loop(self):
+        transport = InProcessTransport()
+        online = OnlineServing(
+            _tiny_model(), transport, topic="train", model_name="m",
+            feature_shape=(N_IN,), batch_limit=8, holdout_every=4,
+            holdout_batch=8, registry=MetricsRegistry())
+        rng = np.random.default_rng(5)
+        # a learnable mapping: labels depend on the features
+        w = rng.normal(size=(N_IN, N_OUT)).astype(np.float32)
+        def batch(n, g):
+            x = g.normal(size=(n, N_IN)).astype(np.float32)
+            y = np.eye(N_OUT, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+            return x, y
+        online.start(background_promotion=False)
+        # steady publisher: the promoter's snapshot handshake is
+        # serviced BETWEEN steps, so the learner must keep stepping
+        pub_stop = threading.Event()
+
+        def feed():
+            prng = np.random.default_rng(6)
+            while not pub_stop.is_set():
+                publish_samples(transport, "train",
+                                *batch(int(prng.integers(2, 9)), prng))
+                pub_stop.wait(0.02)
+
+        pub = threading.Thread(target=feed, daemon=True)
+        pub.start()
+        try:
+            deadline = time.time() + 60
+            while (online.learner.iterations < 30
+                   or online.stream.holdout_examples == 0):
+                assert time.time() < deadline, online.stats()
+                assert online.learner.alive, online.learner.error
+                time.sleep(0.1)
+            # serving works while training
+            out = np.asarray(online.output(
+                rng.normal(size=(3, N_IN)).astype(np.float32)))
+            assert out.shape == (3, N_OUT)
+            d = None
+            while time.time() < deadline:
+                d = online.promoter.run_once()
+                if d.promoted:
+                    break
+                time.sleep(0.5)
+            assert d is not None and d.promoted, d
+            assert online.pool.active_version == d.version
+            assert online.sentinel.check() is None   # good swap stands
+            online.router.assert_warm()
+            stats = online.stats()
+            assert stats["promotion"]["promotions"] == 1
+            assert stats["stream"]["holdout_examples"] > 0
+        finally:
+            pub_stop.set()
+            pub.join(5)
+            online.stop()

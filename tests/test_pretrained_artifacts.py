@@ -8,7 +8,8 @@ import os
 import numpy as np
 import pytest
 
-from deeplearning4j_tpu.zoo.models import LeNet, TextGenerationLSTM
+from deeplearning4j_tpu.zoo.models import (
+    LeNet, SimpleCNN, TextGenerationLSTM)
 
 WEIGHTS = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "deeplearning4j_tpu", "zoo", "weights")
@@ -35,6 +36,21 @@ def test_lenet_pretrained_checksum_enforced():
             LeNet().init_pretrained(flavor="digits")
     finally:
         LeNet.PRETRAINED = orig
+
+
+def test_simplecnn_pretrained_digits_accuracy():
+    """The online-learning demo artifact (ISSUE 10): SimpleCNN's
+    conv+batchnorm stack restored through the checksum-verified
+    resource path; NHWC input (SimpleCNN uses InputType.convolutional,
+    not LeNet's flat variant), ≥95% on the held-out digits split."""
+    from deeplearning4j_tpu.datasets.dataset import ArrayDataSetIterator
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.fetchers import DigitsDataSetIterator
+    model = SimpleCNN().init_pretrained(flavor="digits")
+    x, y = DigitsDataSetIterator.fetch(train=False)
+    ds = DataSet(x.reshape(-1, 28, 28, 1), np.eye(10, dtype=np.float32)[y])
+    ev = model.evaluate(ArrayDataSetIterator(ds, 64))
+    assert ev.accuracy() >= 0.95, ev.accuracy()
 
 
 def test_textgen_pretrained_predicts_text():

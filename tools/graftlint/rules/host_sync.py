@@ -48,6 +48,11 @@ HOT_PATHS = (
     # watchdog-guarded collective wait or a replicated-scalar
     # bookkeeping read after it (pragma'd)
     "tests/multihost_chaos_worker.py",
+    # online learning rides both hot paths (the learner's fit loop,
+    # the fleet's dispatch): the only legitimate host reads are the
+    # between-steps snapshot copies, the stream serde boundary and the
+    # scoring result fetch (pragma'd at each site)
+    "deeplearning4j_tpu/online",
 )
 
 PATTERNS = (
