@@ -627,6 +627,264 @@ def run_fleet(args, smoke: bool) -> int:
         fleet.shutdown()
 
 
+# ---- cluster chaos soak: node kill / rejoin through the remote tier ------
+
+def _start_node(model_zip, node_id, reg_dir, store_dir, log_path,
+                slo_ms=1000.0):
+    """Spawn one worker node subprocess (the real CLI path: ``serve
+    --join``). Output goes to a log file — tail printed on failure."""
+    cmd = [sys.executable, "-m", "deeplearning4j_tpu", "serve",
+           "--model", model_zip, "--inference-mode", "batched",
+           "--batch-limit", "16", "--warmup-shape", str(FEATURES),
+           "--ui-port", "0", "--join", reg_dir,
+           "--artifact-store", store_dir, "--model-key", "bench",
+           "--node-id", node_id, "--slo-ms", str(slo_ms),
+           "--drain-timeout", "20"]
+    log = open(log_path, "w")
+    proc = subprocess.Popen(cmd, cwd=_ROOT, stdout=log,
+                            stderr=subprocess.STDOUT)
+    return proc, log
+
+
+def _wait_node(registry, node_id, pid, timeout_s=240.0):
+    """Wait for THIS process's registry record (pid-matched, so a
+    rejoining node with a crashed predecessor's stale file doesn't
+    count until the new process actually published)."""
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        rec = registry.read_all().get(node_id)
+        if rec and rec.get("pid") == pid:
+            return rec
+        time.sleep(0.2)
+    raise RuntimeError(f"node {node_id} (pid {pid}) never registered")
+
+
+def _tail(path, n=2000):
+    try:
+        with open(path) as f:
+            return f.read()[-n:]
+    except OSError:
+        return "<no log>"
+
+
+def run_cluster(args, smoke: bool) -> int:
+    """Chaos soak through the cluster tier (parallel/node.py +
+    parallel/remote.py): two worker-node subprocesses join a shared
+    registry and warm from one shared artifact store; the parent drives
+    Poisson traffic through a RemoteDispatcher while node "a" is
+    SIGKILLed mid-soak and a replacement (SAME node id) joins.
+
+    Gates:
+    - client-visible errors <= the killed node's in-flight count at the
+      kill (everything else retries onto the survivor);
+    - served p99 under ``--cluster-p99-ms`` THROUGH the kill+join;
+    - node "a"'s circuit breaker opened at least once and is closed
+      again at the end (half-open probe recovered onto the rejoiner);
+    - the rejoined node warmed from the shared store: AOT state "warm",
+      zero recompiles after warmup, and it actually served requests;
+    - SIGTERM drain on node "b": exit 0, record deregistered.
+    """
+    import shutil
+    import signal as _signal
+    import tempfile
+    import urllib.request
+    from concurrent.futures import ThreadPoolExecutor
+
+    from deeplearning4j_tpu.models.serialization import save_model
+    from deeplearning4j_tpu.parallel.aot_cache import ArtifactStore
+    from deeplearning4j_tpu.parallel.node import NodeRegistry
+    from deeplearning4j_tpu.parallel.remote import RemoteDispatcher
+
+    width = 64 if smoke else args.width
+    rate = args.rate or (40.0 if smoke else 120.0)
+    kill_after = 4.0 if smoke else max(4.0, args.duration * 0.3)
+    tail_s = 6.0 if smoke else max(8.0, args.duration * 0.3)
+
+    work = tempfile.mkdtemp(prefix="dl4j-cluster-")
+    reg_dir = os.path.join(work, "registry")
+    store_dir = os.path.join(work, "store")
+    model_zip = os.path.join(work, "model.zip")
+    save_model(build_model(width=width), model_zip)
+    registry = NodeRegistry(reg_dir, stale_after_s=1.0, dead_after_s=2.5)
+    procs = {}
+    logs = {}
+    handles = []
+    failures = []
+
+    def start(node_id):
+        p, log = _start_node(model_zip, node_id, reg_dir, store_dir,
+                             os.path.join(work, f"{node_id}.log"),
+                             slo_ms=args.slo_ms)
+        procs.setdefault(node_id, []).append(p)
+        handles.append(log)
+        logs[node_id] = os.path.join(work, f"{node_id}.log")
+        return p
+
+    try:
+        # serial start: node "a" pays the warmup sweep and publishes the
+        # shared store; "b" (and the rejoiner) must warm from it
+        pa = start("a")
+        _wait_node(registry, "a", pa.pid)
+        if ArtifactStore(store_dir).manifest("bench") is None:
+            failures.append("node a did not publish the artifact store")
+        pb = start("b")
+        rec_b = _wait_node(registry, "b", pb.pid)
+
+        disp = RemoteDispatcher(
+            registry, timeout_s=10.0, retries=3, backoff_s=0.05,
+            breaker_failures=3, breaker_reset_s=1.0, hedge_after_s=0.5)
+        counts = {"ok": 0, "error": 0}
+        lat = []
+        lock = threading.Lock()
+        rng = np.random.default_rng(args.seed)
+        x = rng.normal(size=(args.req_size, FEATURES)).astype(np.float32)
+        stop = threading.Event()
+
+        def one():
+            t0 = time.perf_counter()
+            try:
+                disp.predict(x)
+                dt = time.perf_counter() - t0
+                with lock:
+                    counts["ok"] += 1
+                    lat.append(dt)
+            except Exception:   # RemoteError / NoNodesError / transport
+                with lock:
+                    counts["error"] += 1
+
+        pool = ThreadPoolExecutor(max_workers=64)
+        futs = []
+        arrival = random.Random(args.seed)
+
+        def drive():
+            while not stop.is_set():
+                futs.append(pool.submit(one))
+                time.sleep(arrival.expovariate(rate))
+
+        driver = threading.Thread(target=drive, daemon=True)
+        driver.start()
+
+        # ---- chaos: SIGKILL node a mid-soak --------------------------
+        time.sleep(kill_after)
+        gossip_a = registry.read_all().get("a", {}).get("stats", {})
+        pa.kill()                                      # SIGKILL
+        inflight_at_kill = (disp.inflight().get("a", 0)
+                            + int(gossip_a.get("pending") or 0)
+                            + int(gossip_a.get("inflight") or 0))
+        t_kill = time.time()
+        # replacement joins under the SAME identity: exercises the
+        # stale-record overwrite AND lets the breaker genuinely recover
+        pa2 = start("a")
+        rec_a2 = _wait_node(registry, "a", pa2.pid)
+        rejoin_s = time.time() - t_kill
+        time.sleep(tail_s)              # traffic over the full fleet
+        stop.set()
+        driver.join(timeout=10)
+        for f in futs:
+            f.result()
+
+        # post-soak probes: make sure the breaker's half-open window
+        # has traffic to recover through, and the rejoiner serves
+        for _ in range(20):
+            try:
+                disp.predict(x)
+            except Exception:
+                pass
+            if disp.breaker_state("a") == "closed":
+                break
+            time.sleep(0.2)
+
+        ok, errors = counts["ok"], counts["error"]
+        lat_ms = sorted(v * 1e3 for v in lat)
+
+        def q(p):
+            return lat_ms[min(len(lat_ms) - 1,
+                              int(np.ceil(p * len(lat_ms))) - 1)] \
+                if lat_ms else 0.0
+
+        br = disp._breaker("a")
+        with urllib.request.urlopen(
+                rec_a2["url"] + "/api/serving/stats", timeout=10) as r:
+            stats_a2 = json.loads(r.read())
+        served_a2 = int(registry.read_all().get("a", {})
+                        .get("stats", {}).get("requests") or 0)
+        aot = stats_a2.get("aot_cache") or {}
+
+        print(f"cluster soak: 2 nodes, Poisson {rate:.0f} req/s, "
+              f"SIGKILL node a at {kill_after:.0f}s, rejoin in "
+              f"{rejoin_s:.1f}s (same id, shared store):")
+        print(f"  ok={ok}  errors={errors} "
+              f"(bound: in-flight at kill = {inflight_at_kill})")
+        print(f"  served: p50={q(.5):7.2f}ms  p95={q(.95):7.2f}ms  "
+              f"p99={q(.99):7.2f}ms  (bound {args.cluster_p99_ms:.0f}ms)")
+        print(f"  breaker a: opened_total={br.opened_total}  "
+              f"state={br.state}")
+        print(f"  rejoined a: aot_state={aot.get('state')}  "
+              f"recompiles_after_warmup="
+              f"{stats_a2.get('recompiles_after_warmup')}  "
+              f"served={served_a2}")
+
+        if ok == 0:
+            failures.append("no request succeeded")
+        if errors > inflight_at_kill:
+            failures.append(
+                f"{errors} client-visible errors exceed the killed "
+                f"node's in-flight window ({inflight_at_kill})")
+        if lat_ms and q(.99) > args.cluster_p99_ms:
+            failures.append(f"served p99 {q(.99):.1f}ms over the "
+                            f"{args.cluster_p99_ms:.0f}ms bound")
+        if br.opened_total < 1:
+            failures.append("breaker for the killed node never opened")
+        if br.state != "closed":
+            failures.append(
+                f"breaker for node a did not recover (state={br.state})")
+        if aot.get("state") != "warm":
+            failures.append(
+                f"rejoined node not warm from the shared store "
+                f"(aot state={aot.get('state')!r}, "
+                f"reason={aot.get('reason')!r})")
+        if stats_a2.get("recompiles_after_warmup"):
+            failures.append(
+                f"rejoined node recompiled "
+                f"{stats_a2['recompiles_after_warmup']}x after warmup")
+        if served_a2 < 1:
+            failures.append("rejoined node never served a request")
+
+        # ---- graceful drain: SIGTERM node b --------------------------
+        pb.send_signal(_signal.SIGTERM)
+        try:
+            rc_b = pb.wait(timeout=40)
+        except subprocess.TimeoutExpired:
+            rc_b = None
+        if rc_b != 0:
+            failures.append(
+                f"SIGTERM drain on node b exited rc={rc_b} "
+                f"(want 0):\n{_tail(logs['b'])}")
+        if "b" in registry.read_all():
+            failures.append(
+                "node b's registry record survived its drain")
+        else:
+            print(f"  drain b: rc=0, deregistered "
+                  f"(was {rec_b['url']})")
+
+        pool.shutdown(wait=False)
+        disp.shutdown()
+        for f in failures:
+            print(f"FAIL: {f}")
+        if failures:
+            for nid, path in logs.items():
+                print(f"--- node {nid} log tail ---\n{_tail(path)}")
+        return 1 if failures else 0
+    finally:
+        for plist in procs.values():
+            for p in plist:
+                if p.poll() is None:
+                    p.kill()
+        for h in handles:
+            h.close()
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--clients", type=int, default=8,
@@ -692,6 +950,16 @@ def main(argv=None) -> int:
                     help="served-p99 gate for the soak (CPU-calibrated)")
     ap.add_argument("--pool-size", type=int, default=1,
                     help="engines in the soak's replica pool")
+    # cluster chaos soak (worker-node subprocesses + kill/rejoin)
+    ap.add_argument("--smoke-cluster", action="store_true",
+                    help="CI gate: 2 worker nodes join a gossiped "
+                    "registry + shared artifact store; SIGKILL one "
+                    "mid-soak, rejoin same-id, SIGTERM-drain the other")
+    ap.add_argument("--soak-cluster", action="store_true",
+                    help="longer cluster chaos soak at --rate/--duration")
+    ap.add_argument("--cluster-p99-ms", type=float, default=2000.0,
+                    help="served-p99 gate through the kill+join "
+                    "(CPU-calibrated; retries ride the backoff curve)")
     ap.add_argument("--seed", type=int, default=0)
     # internal child modes (spawned by --cold-start / --*-fleet)
     ap.add_argument("--cold-start-child", action="store_true",
@@ -712,6 +980,8 @@ def main(argv=None) -> int:
         return run_precision_ab(args, smoke=args.smoke)
     if args.smoke_fleet or args.soak_fleet:
         return run_fleet(args, smoke=args.smoke_fleet)
+    if args.smoke_cluster or args.soak_cluster:
+        return run_cluster(args, smoke=args.smoke_cluster)
     return run_smoke(args) if args.smoke else run_timed(args)
 
 

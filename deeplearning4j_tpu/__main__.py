@@ -78,6 +78,29 @@ def _build_parser() -> argparse.ArgumentParser:
     s.add_argument("--duration", type=float, default=None,
                    help="serve for N seconds then exit (default: until "
                    "interrupted)")
+    # ---- multi-node fleet (join a serving cluster) -------------------
+    n = s.add_argument_group(
+        "multi-node fleet", "join a health-gossiped serving cluster "
+        "(parallel/node.py): heartbeat into a shared registry dir, "
+        "warm the AOT table from a shared artifact store, and drain "
+        "gracefully on SIGTERM (finish in-flight, deregister, exit 0)")
+    n.add_argument("--join", default=None, metavar="DIR",
+                   help="node registry directory to gossip into "
+                   "(a shared filesystem path); enables node mode")
+    n.add_argument("--node-id", default=None,
+                   help="stable node identity in the registry "
+                   "(default: the pid); a rejoining node reuses its id")
+    n.add_argument("--artifact-store", default=None, metavar="DIR",
+                   help="shared AOT/calibration artifact store root "
+                   "(bucket layout); joining nodes warm from one saved "
+                   "sweep with zero live compiles")
+    n.add_argument("--model-key", default=None,
+                   help="artifact-store key for this model (default: "
+                   "the model file's basename)")
+    n.add_argument("--drain-timeout", type=float, default=30.0,
+                   metavar="S",
+                   help="SIGTERM grace: max seconds to finish in-flight "
+                   "requests before exiting anyway")
     # ---- online learning (train-and-serve in one process) -----------
     o = s.add_argument_group(
         "online learning", "train-and-serve in one process: consume a "
@@ -152,6 +175,55 @@ def cmd_serve(args, block: bool = True):
             aot_cache_dir=args.aot_cache_dir,
             feature_shape=(tuple(args.warmup_shape)
                            if args.warmup_shape else None))
+
+    if getattr(args, "join", None):
+        # cluster node mode: FleetRouter + engine behind the HTTP
+        # surface, heartbeating into the shared registry; SIGTERM
+        # drains gracefully (finish in-flight, deregister, exit 0)
+        if mode != InferenceMode.BATCHED:
+            raise SystemExit("--join requires --inference-mode batched")
+        from deeplearning4j_tpu.parallel.aot_cache import ArtifactStore
+        from deeplearning4j_tpu.parallel.node import (
+            NodeRegistry, ServingNode, install_sigterm_drain)
+        name = os.path.splitext(os.path.basename(args.model))[0] \
+            or "default"
+        store = ArtifactStore(args.artifact_store) \
+            if args.artifact_store else None
+        node_kwargs = dict(kwargs)
+        node_kwargs.pop("replicas", None)   # pool_size is the spelling
+        node = ServingNode(
+            model, node_id=args.node_id or str(os.getpid()),
+            registry=NodeRegistry(args.join),
+            model_name=name, version=args.model_version,
+            slo_ms=args.slo_ms, artifact_store=store,
+            model_key=args.model_key,
+            pool_size=(1 if replicas == "auto" else int(replicas)),
+            ui_port=args.ui_port, batch_limit=args.batch_limit,
+            queue_limit=args.queue_limit, timeout_ms=args.timeout_ms,
+            **{k: v for k, v in node_kwargs.items()
+               if k in ("aot_cache_dir", "feature_shape", "dtype",
+                        "bf16", "depth", "pipelined")})
+        install_sigterm_drain(node, timeout_s=args.drain_timeout)
+        print(f"node {node.node_id} serving {args.model} at {node.url} "
+              f"(registry={args.join}"
+              + (f", artifact_store={args.artifact_store}"
+                 if args.artifact_store else "") + ")")
+        print(f"  predict:  POST {node.url}/api/predict "
+              '{"features": [[...], ...]}')
+        print(f"  metrics:  {node.url}/metrics")
+        if not block:
+            return node, node.server
+        try:
+            if args.duration is not None:
+                time.sleep(args.duration)
+            else:
+                while True:
+                    time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            node.shutdown()
+        return 0
 
     fleet = None
     engine = None

@@ -1,15 +1,23 @@
 """Multi-device training and serving (mesh, wrappers, serving engine,
-fleet router, persisted AOT executable cache, elastic fault
-tolerance)."""
+fleet router, persisted AOT executable cache, multi-node cluster tier,
+elastic fault tolerance)."""
 
+from deeplearning4j_tpu.parallel.aot_cache import ArtifactStore
 from deeplearning4j_tpu.parallel.cluster import (
     PEER_LOSS_EXIT_CODE,
     CollectiveWatchdog,
+    classify_heartbeat_age,
 )
 from deeplearning4j_tpu.parallel.fleet import FleetRouter, ShedError
 from deeplearning4j_tpu.parallel.inference import (
     InferenceMode,
     ParallelInference,
+)
+from deeplearning4j_tpu.parallel.node import (
+    AutoScaler,
+    NodeRegistry,
+    ServingNode,
+    install_sigterm_drain,
 )
 from deeplearning4j_tpu.parallel.quant import (
     CalibrationResult,
@@ -19,22 +27,38 @@ from deeplearning4j_tpu.parallel.quant import (
     calibrate,
     quantize_model,
 )
+from deeplearning4j_tpu.parallel.remote import (
+    CircuitBreaker,
+    NoNodesError,
+    RemoteDispatcher,
+    RemoteError,
+)
 from deeplearning4j_tpu.parallel.serving import ServingEngine
 from deeplearning4j_tpu.parallel.wrapper import ElasticOptions
 
 __all__ = [
+    "ArtifactStore",
+    "AutoScaler",
     "CalibrationResult",
+    "CircuitBreaker",
     "CollectiveWatchdog",
     "ElasticOptions",
     "FleetRouter",
     "InferenceMode",
+    "NoNodesError",
+    "NodeRegistry",
     "ParallelInference",
     "PEER_LOSS_EXIT_CODE",
     "PrecisionPolicy",
     "QuantizationError",
     "QuantizedModel",
+    "RemoteDispatcher",
+    "RemoteError",
     "ServingEngine",
+    "ServingNode",
     "ShedError",
     "calibrate",
+    "classify_heartbeat_age",
+    "install_sigterm_drain",
     "quantize_model",
 ]

@@ -342,6 +342,33 @@ PEER_LOSS_EXIT_CODE = 43
 PEER_LOSS_MARKER = "PEER_LOSS.json"
 
 
+def classify_heartbeat_age(age: Optional[float], dead_after_s: float,
+                           slow_after_s: Optional[float] = None) -> str:
+    """Classify a heartbeat's age: ``"alive"`` | ``"slow"`` | ``"dead"``.
+
+    The one authoritative statement of the staleness boundary, shared by
+    the watchdog's ``dead_peers`` and the serving NodeRegistry
+    (parallel/node.py) so the two tiers can never disagree off-by-one:
+
+    - ``age is None`` (file never appeared / unreadable) -> ``"dead"``;
+    - ``age``  > ``dead_after_s``  (strictly past)        -> ``"dead"``;
+    - ``age`` >= ``slow_after_s``  (at or past)           -> ``"slow"``;
+    - otherwise                                           -> ``"alive"``.
+
+    A heartbeat EXACTLY at a threshold is always given the less severe
+    class: exactly at ``dead_after_s`` is slow, not dead — a beat is a
+    point-in-time sample, so "age == horizon" means the peer beat
+    exactly one horizon ago and may be about to beat again; only
+    strictly-past evidence may kill it. ``slow_after_s`` defaults to
+    ``dead_after_s`` (the single-threshold watchdog case).
+    """
+    if age is None or age > dead_after_s:
+        return "dead"
+    if age >= (dead_after_s if slow_after_s is None else slow_after_s):
+        return "slow"
+    return "alive"
+
+
 class CollectiveWatchdog:
     """Heartbeat/deadline watchdog around the collective path.
 
@@ -496,10 +523,12 @@ class CollectiveWatchdog:
         return ages
 
     def dead_peers(self) -> Dict[int, Optional[float]]:
-        """Peers whose heartbeat is stale past ``dead_after_s`` (or
-        missing entirely)."""
+        """Peers whose heartbeat is stale STRICTLY past ``dead_after_s``
+        (or missing entirely) — :func:`classify_heartbeat_age` owns the
+        boundary; exactly-at-threshold is slow, not dead."""
         return {r: age for r, age in self._peer_ages().items()
-                if age is None or age > self.dead_after_s}
+                if classify_heartbeat_age(age, self.dead_after_s)
+                == "dead"}
 
     # ---- monitor --------------------------------------------------------
     def _monitor_loop(self):

@@ -347,11 +347,13 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *a):   # silence request logging
         pass
 
-    def _json(self, obj, code=200):
+    def _json(self, obj, code=200, headers=None):
         body = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(body)
 
@@ -531,13 +533,15 @@ class _Handler(BaseHTTPRequestHandler):
         q = {k: v[0] for k, v in parse_qs(u.query).items()}
         ctx = UIModuleContext(storage=self.storage, server=self.server)
         status = 200
+        extra_headers = None
         try:
             out = route.handler(ctx, q, body)
             if isinstance(out, tuple) and len(out) == 3 \
                     and isinstance(out[0], dict):
-                # (dict, None, status): JSON with an explicit HTTP
-                # status — the fleet router's 503-on-shed path
-                out, _, status = out
+                # (dict, headers_or_None, status): JSON with an
+                # explicit HTTP status and optional extra headers —
+                # the fleet router's 503-on-shed path (Retry-After)
+                out, extra_headers, status = out
                 payload, ctype = None, None
             elif isinstance(out, tuple):
                 payload, ctype = out[:2]
@@ -570,7 +574,7 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(payload)
         else:
-            self._json(out, status)
+            self._json(out, status, extra_headers)
 
     def _session(self, u) -> Optional[str]:
         q = parse_qs(u.query)
@@ -599,6 +603,27 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         path = urlparse(self.path).path
+        if getattr(self.server, "draining", False) \
+                and path == "/api/predict":
+            # graceful drain: stop ADMITTING new work; requests already
+            # inside _do_post keep running to completion (tracked by
+            # active_requests, which drain() waits on)
+            self._json({"error": "draining"}, 503,
+                       {"Retry-After": "1"})
+            return
+        lock = getattr(self.server, "active_lock", None)
+        if lock is None:
+            self._do_post(path)
+            return
+        with lock:
+            self.server.active_requests += 1
+        try:
+            self._do_post(path)
+        finally:
+            with lock:
+                self.server.active_requests -= 1
+
+    def _do_post(self, path):
         if path == "/api/tsne":
             # TsneModule analog: upload 2-D coordinates (+labels) to plot
             try:
@@ -759,6 +784,14 @@ class UIServer:
                                            for r in m.get_routes()]})
         self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port),
                                           handler)
+        # drain bookkeeping lives on the httpd (handlers see it as
+        # self.server): draining gates /api/predict admission, and
+        # active_requests counts POST handlers still running so drain()
+        # can wait for responses to finish SERIALIZING, not just for
+        # the engine queue to empty
+        self._httpd.draining = False
+        self._httpd.active_requests = 0
+        self._httpd.active_lock = threading.Lock()
         self.port = self._httpd.server_address[1]   # resolves port 0
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True)
@@ -811,6 +844,24 @@ class UIServer:
     @property
     def url(self) -> str:
         return f"http://127.0.0.1:{self.port}"
+
+    def drain(self):
+        """Stop admitting /api/predict requests (they get 503 +
+        Retry-After); everything already in flight keeps running.
+        Idempotent; ``active_requests`` reports what is left."""
+        if self._httpd is not None:
+            self._httpd.draining = True
+        return self
+
+    @property
+    def active_requests(self) -> int:
+        """POST handlers currently executing (admitted before any
+        drain). 0 once every accepted request has fully responded."""
+        httpd = self._httpd
+        if httpd is None:
+            return 0
+        with httpd.active_lock:
+            return httpd.active_requests
 
     def stop(self):
         if self._httpd is not None:

@@ -89,9 +89,16 @@ class FleetModule(UIModule):
             out = self.router.output(x, model=body.get("model"))
         except ShedError as e:
             # 503 = "overloaded, retry elsewhere/later" — distinct from
-            # a 500 module bug, and the worker/soak driver counts it
+            # a 500 module bug, and the worker/soak driver counts it.
+            # Retry-After tells remote retries to back off instead of
+            # hammering: one p99 window is when the AIMD controller's
+            # view of this pool can actually have changed
+            import math
+            retry_after = max(1, int(math.ceil(
+                getattr(self.router, "window_s", 1.0))))
             return ({"error": "shed", "model": e.model,
-                     "reason": e.reason}, None, 503)
+                     "reason": e.reason},
+                    {"Retry-After": str(retry_after)}, 503)
         return {"output": np.asarray(out).tolist(),  # host-sync-ok: HTTP response must be host JSON
                 "n": int(x.shape[0])}
 

@@ -40,6 +40,13 @@ JAX_PLATFORMS=cpu python -m benchmarks.serving --precision-ab --smoke
 # (admission control + SLO shedding) — zero post-warmup recompiles,
 # shed rate < 100%, served p99 under the CPU-calibrated bound
 JAX_PLATFORMS=cpu python -m benchmarks.serving --smoke-fleet
+# cluster tier: chaos soak through the multi-node tier — 2 worker-node
+# subprocesses join a gossiped registry + shared artifact store; one is
+# SIGKILLed mid-soak and rejoins under the same id (breaker opens and
+# recovers, zero live compiles from the shared store), the other is
+# SIGTERM-drained (finishes in-flight, deregisters, exits 0); client
+# errors bounded by the killed node's in-flight window, p99 gated
+JAX_PLATFORMS=cpu python -m benchmarks.serving --smoke-cluster
 # elastic tier: with one straggler, bounded-staleness ASYNC_ELASTIC
 # sustains >=1.5x the SYNC round rate with divergence under the
 # hard-sync threshold, and reduces exactly to AVERAGING without one

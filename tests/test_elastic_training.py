@@ -21,7 +21,8 @@ from deeplearning4j_tpu.nn.layers.output import OutputLayer
 from deeplearning4j_tpu.ops.activations import Activation
 from deeplearning4j_tpu.optimize.updaters import Sgd
 from deeplearning4j_tpu.parallel.cluster import (
-    PEER_LOSS_EXIT_CODE, PEER_LOSS_MARKER, CollectiveWatchdog)
+    PEER_LOSS_EXIT_CODE, PEER_LOSS_MARKER, CollectiveWatchdog,
+    classify_heartbeat_age)
 from deeplearning4j_tpu.parallel.wrapper import (
     ElasticOptions, ParallelWrapper, TrainingMode)
 
@@ -248,6 +249,32 @@ class TestCollectiveWatchdog:
         # the relauncher contract: distinct, stable, not a shell code
         assert PEER_LOSS_EXIT_CODE == 43
 
+    def test_rejoining_rank_reuses_stale_heartbeat_file(self, tmp_path):
+        """A crashed rank leaves its heartbeat file behind; the
+        relaunched rank (same id) just overwrites it — the watchdog must
+        see the rejoiner as alive, not keep condemning the stale record
+        (same contract as a serving node rejoining the NodeRegistry)."""
+        hb = tmp_path / "hb"
+        hb.mkdir()
+        # the crash artifact: rank 1's heartbeat, a minute stale
+        with open(hb / "hb_1.json", "w") as f:
+            json.dump({"rank": 1, "time": time.time() - 60,
+                       "iteration": 3}, f)
+        wd = CollectiveWatchdog(str(hb), rank=0, n_ranks=2,
+                                interval_s=0.05, dead_after_s=0.5,
+                                exit_on_loss=False)
+        assert list(wd.dead_peers()) == [1]     # stale record = dead
+        # rank 1 relaunches and beats into the SAME file
+        stop = threading.Event()
+        self._beat_as(str(hb), 1, stop)
+        try:
+            deadline = time.time() + 5.0
+            while wd.dead_peers() and time.time() < deadline:
+                time.sleep(0.05)
+            assert wd.dead_peers() == {}        # rejoiner is alive
+        finally:
+            stop.set()
+
     def test_peer_loss_counter_degrades_health(self, tmp_path):
         from deeplearning4j_tpu.observe.health import health_status
         from deeplearning4j_tpu.observe.registry import MetricsRegistry
@@ -256,6 +283,32 @@ class TestCollectiveWatchdog:
         st = health_status(r)
         assert st["status"] == "degraded"
         assert any("peer_loss" in x for x in st["reasons"])
+
+
+class TestHeartbeatBoundary:
+    """classify_heartbeat_age is THE staleness boundary — shared by the
+    watchdog and the serving NodeRegistry so the two tiers can never
+    disagree off-by-one. Exactly at a threshold is always the less
+    severe class; only strictly-past evidence kills a peer."""
+
+    def test_exactly_at_stale_is_slow_not_alive(self):
+        assert classify_heartbeat_age(1.99, 6.0, 2.0) == "alive"
+        assert classify_heartbeat_age(2.0, 6.0, 2.0) == "slow"
+
+    def test_exactly_at_dead_is_slow_one_past_is_dead(self):
+        assert classify_heartbeat_age(6.0, 6.0, 2.0) == "slow"
+        assert classify_heartbeat_age(6.000001, 6.0, 2.0) == "dead"
+
+    def test_single_threshold_watchdog_case(self):
+        # slow_after_s defaults to dead_after_s: exactly-at is slow
+        # (the watchdog's dead_peers() keeps waiting), strictly past
+        # is dead
+        assert classify_heartbeat_age(0.49, 0.5) == "alive"
+        assert classify_heartbeat_age(0.5, 0.5) == "slow"
+        assert classify_heartbeat_age(0.51, 0.5) == "dead"
+
+    def test_missing_heartbeat_is_dead(self):
+        assert classify_heartbeat_age(None, 0.5) == "dead"
 
     def test_staleness_gauge_degrades_health(self):
         from deeplearning4j_tpu.observe.health import health_status

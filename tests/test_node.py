@@ -1,0 +1,383 @@
+"""Cluster node tier tests (PR 11): NodeRegistry gossip, ServingNode
+graceful drain, shared-artifact warm start, AutoScaler.
+
+Contracts under test (parallel/node.py + parallel/aot_cache.py):
+
+- registry records are atomic, torn records are invisible, a rejoining
+  node with a crashed predecessor's stale file simply overwrites it;
+- heartbeat health reuses the watchdog boundary: exactly at
+  ``stale_after_s`` is slow (still dispatchable), strictly past
+  ``dead_after_s`` is dead;
+- graceful drain: new predicts get 503 + ``Retry-After`` the moment the
+  drain starts, every ALREADY-ACCEPTED request completes with 200, the
+  node deregisters before its server stops, and the drain result says
+  so;
+- N ServingNodes warm from ONE shared ArtifactStore sweep: the second
+  node's AOT cache loads "warm" with zero recompiles after warmup;
+- AutoScaler: scale-from-zero on the dispatcher's demand signal is
+  immediate, p99-over-SLO pressure must hold before a spawn, sustained
+  idleness retires nodes down to ``min_nodes``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.observe.registry import MetricsRegistry
+from deeplearning4j_tpu.parallel.aot_cache import ArtifactStore
+from deeplearning4j_tpu.parallel.node import (
+    NODE_UP,
+    AutoScaler,
+    NodeRegistry,
+    ServingNode,
+)
+
+N_IN = 5
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tiny_model(seed: int = 1):
+    from deeplearning4j_tpu.models.multi_layer_network import (
+        MultiLayerNetwork)
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+    from deeplearning4j_tpu.ops.losses import LossFunction
+    from deeplearning4j_tpu.optimize.updaters import Adam
+    conf = (NeuralNetConfiguration.Builder().seed(seed)
+            .updater(Adam(1e-2)).list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT))
+            .set_input_type(InputType.feed_forward(N_IN)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+class Slow:
+    """Duck-typed model whose forward blocks — holds requests in flight
+    deterministically (same trick as test_fleet)."""
+
+    def __init__(self, delay=0.2):
+        self.delay = delay
+
+    def output(self, x):
+        time.sleep(self.delay)
+        return np.zeros((x.shape[0], 3), np.float32)
+
+
+def _post(url, payload, timeout=10.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, dict(r.headers), json.loads(r.read())
+
+
+class TestNodeRegistry:
+    def test_write_read_roundtrip_and_rejoin_overwrite(self, tmp_path):
+        reg = NodeRegistry(str(tmp_path / "r"))
+        reg.write("a", "http://127.0.0.1:1", stats={"pending": 3})
+        rec = reg.read_all()["a"]
+        assert rec["url"] == "http://127.0.0.1:1"
+        assert rec["state"] == NODE_UP
+        assert rec["stats"] == {"pending": 3}
+        # a crashed predecessor left this record behind; the rejoining
+        # node (same id, new process) just overwrites it
+        reg.write("a", "http://127.0.0.1:2")
+        assert reg.read_all()["a"]["url"] == "http://127.0.0.1:2"
+        reg.deregister("a")
+        assert reg.read_all() == {}
+        reg.deregister("a")                 # idempotent
+
+    def test_health_boundary_matches_watchdog(self, tmp_path):
+        reg = NodeRegistry(str(tmp_path / "r"),
+                           stale_after_s=2.0, dead_after_s=6.0)
+        reg.write("a", "http://a", now=1000.0)
+        assert reg.snapshot(now=1001.9)["a"]["health"] == "alive"
+        # exactly at stale_after -> slow (the less severe class)
+        assert reg.snapshot(now=1002.0)["a"]["health"] == "slow"
+        # exactly at dead_after is still slow; strictly past is dead
+        assert reg.snapshot(now=1006.0)["a"]["health"] == "slow"
+        assert reg.snapshot(now=1006.01)["a"]["health"] == "dead"
+
+    def test_dispatchable_filters_and_orders(self, tmp_path):
+        reg = NodeRegistry(str(tmp_path / "r"),
+                           stale_after_s=2.0, dead_after_s=6.0)
+        reg.write("slow", "http://s", now=997.0)      # age 3 -> slow
+        reg.write("alive", "http://a", now=999.5)     # age .5 -> alive
+        reg.write("dead", "http://d", now=900.0)      # age 100 -> dead
+        reg.write("drain", "http://x", state="draining", now=999.9)
+        got = [r["node_id"] for r in reg.dispatchable(now=1000.0)]
+        assert got == ["alive", "slow"]     # alive first, slow last
+        #                                     resort; dead/drain absent
+
+    def test_torn_record_is_invisible(self, tmp_path):
+        reg = NodeRegistry(str(tmp_path / "r"))
+        reg.write("good", "http://g")
+        (tmp_path / "r" / "node_torn.json").write_text('{"node_id": "t')
+        assert list(reg.read_all()) == ["good"]
+
+    def test_dead_before_slow_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="dead before slow"):
+            NodeRegistry(str(tmp_path / "r"),
+                         stale_after_s=5.0, dead_after_s=2.0)
+
+
+class TestArtifactStore:
+    def test_bucket_layout_and_keys(self, tmp_path):
+        store = ArtifactStore(str(tmp_path / "store"))
+        d = store.cache_dir("model-a")
+        assert os.path.isdir(d)
+        assert d.endswith(os.path.join("objects", "model-a"))
+        assert store.cache_dir("model-a") == d      # stable
+        assert store.keys() == ["model-a"]
+        assert store.manifest("model-a") is None    # nothing published
+        st = store.stats()
+        assert st["keys"]["model-a"]["published"] is False
+
+    def test_key_sanitization(self, tmp_path):
+        store = ArtifactStore(str(tmp_path / "store"))
+        d = store.cache_dir("a/b zoo:v1")
+        assert "/b" not in os.path.basename(d)
+        assert os.path.basename(d) == "a_b_zoo_v1"
+        with pytest.raises(ValueError):
+            store.cache_dir("..")
+
+
+class TestServingNodeDrain:
+    def test_drain_completes_inflight_rejects_new_deregisters(
+            self, tmp_path):
+        reg = NodeRegistry(str(tmp_path / "reg"))
+        node = ServingNode(
+            Slow(0.8), node_id="n1", registry=reg,
+            metrics_registry=MetricsRegistry(), window_s=10.0,
+            batch_limit=8, ui_port=0)
+        try:
+            rec = reg.read_all()["n1"]
+            assert rec["state"] == NODE_UP and rec["url"] == node.url
+            url = node.url + "/api/predict"
+            payload = {"features": [[0.0] * N_IN]}
+            results = []
+
+            def client():
+                status, _h, body = _post(url, payload)
+                results.append((status, body))
+
+            threads = [threading.Thread(target=client)
+                       for _ in range(3)]
+            for t in threads:
+                t.start()
+            time.sleep(0.25)                # all three admitted
+
+            drain_result = {}
+
+            def drainer():
+                drain_result.update(node.drain(timeout_s=15.0))
+
+            dt = threading.Thread(target=drainer)
+            dt.start()
+            # the drain gossips "draining" first, then closes the door
+            deadline = time.time() + 5.0
+            while time.time() < deadline:
+                r = reg.read_all().get("n1")
+                if r is None or r["state"] == "draining":
+                    break
+                time.sleep(0.02)
+            time.sleep(0.05)
+            # a NEW request during the drain is refused with 503 +
+            # Retry-After — never accepted, never dropped mid-flight
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(url, payload)
+            assert ei.value.code == 503
+            assert ei.value.headers.get("Retry-After") is not None
+            ei.value.read()
+
+            dt.join(timeout=20)
+            for t in threads:
+                t.join(timeout=10)
+            # every ACCEPTED request completed with a real answer
+            assert len(results) == 3
+            assert all(status == 200 for status, _ in results)
+            assert all(body["n"] == 1 for _, body in results)
+            assert drain_result["drained"] is True
+            assert drain_result["inflight_left"] == 0
+            # deregistered: an orderly departure, not a stale record
+            assert "n1" not in reg.read_all()
+            assert "dl4j_cluster_drain_seconds" in node.metrics.render()
+            # idempotent
+            again = node.drain()
+            assert again == {"drained": True, "seconds": 0.0,
+                             "inflight_left": 0}
+        finally:
+            node.shutdown()
+
+    @pytest.mark.slow
+    def test_sigterm_subprocess_drains_and_exits_zero(self, tmp_path):
+        from deeplearning4j_tpu.models.serialization import save_model
+        zip_path = str(tmp_path / "m.zip")
+        save_model(_tiny_model(), zip_path)
+        reg_dir = str(tmp_path / "reg")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "deeplearning4j_tpu", "serve",
+             "--model", zip_path, "--ui-port", "0",
+             "--join", reg_dir, "--node-id", "s1",
+             "--batch-limit", "8"],
+            cwd=_ROOT, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        try:
+            reg = NodeRegistry(reg_dir)
+            deadline = time.time() + 180
+            while time.time() < deadline:
+                rec = reg.read_all().get("s1")
+                if rec and rec.get("pid") == proc.pid:
+                    break
+                time.sleep(0.2)
+            else:
+                out, _ = proc.communicate(timeout=5)
+                raise AssertionError(f"node never registered:\n{out}")
+            proc.terminate()                # SIGTERM -> graceful drain
+            out, _ = proc.communicate(timeout=60)
+            assert proc.returncode == 0, out
+            assert "SIGTERM drain" in out
+            assert "s1" not in reg.read_all()
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+
+
+class TestSharedArtifactWarmStart:
+    def test_second_node_warms_with_zero_compiles(self, tmp_path):
+        model = _tiny_model()
+        store = ArtifactStore(str(tmp_path / "store"))
+        reg = NodeRegistry(str(tmp_path / "reg"))
+        x = np.zeros((2, N_IN), np.float32)
+
+        # node 1 pays the sweep and publishes the shared store
+        with ServingNode(model, node_id="w1", registry=reg,
+                         artifact_store=store, model_key="m",
+                         metrics_registry=MetricsRegistry(),
+                         window_s=10.0, batch_limit=8,
+                         feature_shape=(N_IN,), ui_port=0) as n1:
+            n1.assert_warm()
+            want = np.asarray(n1.output(x))
+        assert store.manifest("m") is not None
+        assert store.stats()["keys"]["m"]["published"] is True
+
+        # node 2 joins later and must warm FROM the store: state
+        # "warm", zero live compiles, bitwise-identical answers
+        with ServingNode(model, node_id="w2", registry=reg,
+                         artifact_store=store, model_key="m",
+                         metrics_registry=MetricsRegistry(),
+                         window_s=10.0, batch_limit=8,
+                         feature_shape=(N_IN,), ui_port=0) as n2:
+            n2.assert_warm()
+            eng = n2.router.pool("default").engines[0]
+            st = eng.stats()
+            assert st["aot_cache"]["state"] == "warm"
+            assert st["recompiles_after_warmup"] == 0
+            got = np.asarray(n2.output(x))
+        assert np.array_equal(got, want)
+
+
+class _FakeFleet:
+    """Injected spawn/stop for AutoScaler tests: spawning writes a
+    fresh registry record, stopping removes it."""
+
+    def __init__(self, reg):
+        self.reg = reg
+        self.spawned = []
+        self.stopped = []
+        self._n = 0
+
+    def spawn(self):
+        nid = f"n{self._n}"
+        self._n += 1
+        self.spawned.append(nid)
+        self.reg.write(nid, f"http://{nid}", stats={"requests": 0})
+
+    def stop(self, node_id):
+        self.stopped.append(node_id)
+        self.reg.deregister(node_id)
+
+
+class TestAutoScaler:
+    def _scaler(self, tmp_path, **kw):
+        reg = NodeRegistry(str(tmp_path / "reg"))
+        fleet = _FakeFleet(reg)
+        clk = {"t": 100.0}
+        kw.setdefault("hold_s", 1.0)
+        kw.setdefault("idle_after_s", 5.0)
+        sc = AutoScaler(reg, spawn=fleet.spawn, stop=fleet.stop,
+                        clock=lambda: clk["t"], **kw)
+        return reg, fleet, clk, sc
+
+    def test_scale_from_zero_on_demand_is_immediate(self, tmp_path):
+        reg, fleet, clk, sc = self._scaler(tmp_path, min_nodes=0)
+        assert sc.tick() is None            # no demand, no nodes: rest
+        sc.note_demand()                    # the on_no_nodes signal
+        assert sc.tick() == "up"            # no hold at zero
+        assert fleet.spawned == ["n0"]
+
+    def test_p99_pressure_requires_hold(self, tmp_path):
+        reg, fleet, clk, sc = self._scaler(tmp_path, slo_ms=100.0,
+                                           max_nodes=3)
+        reg.write("a", "http://a",
+                  stats={"windowed_p99_ms": 500.0, "requests": 1})
+        assert sc.tick() is None            # over, but not HELD yet
+        clk["t"] += 1.0
+        reg.write("a", "http://a",
+                  stats={"windowed_p99_ms": 500.0, "requests": 2})
+        assert sc.tick() == "up"
+        assert sc.scale_ups == 1
+
+    def test_queue_pressure_scales_up(self, tmp_path):
+        reg, fleet, clk, sc = self._scaler(tmp_path, queue_high=4)
+        reg.write("a", "http://a",
+                  stats={"pending": 9, "queue_depth": 3, "requests": 1})
+        sc.tick()
+        clk["t"] += 1.0
+        reg.write("a", "http://a",
+                  stats={"pending": 9, "queue_depth": 3, "requests": 2})
+        assert sc.tick() == "up"
+
+    def test_idle_scales_down_to_min_nodes(self, tmp_path):
+        reg, fleet, clk, sc = self._scaler(tmp_path, min_nodes=1)
+        reg.write("a", "http://a", stats={"requests": 7})
+        reg.write("b", "http://b", stats={"requests": 3})
+        assert sc.tick() is None            # baseline recorded
+        clk["t"] += 5.0
+        reg.write("a", "http://a", stats={"requests": 7})
+        reg.write("b", "http://b", stats={"requests": 3})
+        assert sc.tick() == "down"
+        assert fleet.stopped == ["b"]       # highest id retires first
+        clk["t"] += 5.0
+        reg.write("a", "http://a", stats={"requests": 7})
+        assert sc.tick() is None            # total changed (b left):
+        #                                     a fresh idle baseline
+        clk["t"] += 5.0
+        reg.write("a", "http://a", stats={"requests": 7})
+        assert sc.tick() is None            # idle again — but the
+        assert fleet.stopped == ["b"]       # min_nodes floor holds
+        assert sc.scale_downs == 1
+
+    def test_traffic_resets_idleness(self, tmp_path):
+        reg, fleet, clk, sc = self._scaler(tmp_path, min_nodes=0)
+        reg.write("a", "http://a", stats={"requests": 1})
+        sc.tick()
+        clk["t"] += 4.0
+        reg.write("a", "http://a", stats={"requests": 2})  # traffic!
+        assert sc.tick() is None
+        clk["t"] += 4.0                     # only 4s since the reset
+        reg.write("a", "http://a", stats={"requests": 2})
+        assert sc.tick() is None
+        clk["t"] += 1.0
+        reg.write("a", "http://a", stats={"requests": 2})
+        assert sc.tick() == "down"

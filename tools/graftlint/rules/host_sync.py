@@ -30,10 +30,12 @@ HOT_PATHS = (
     "deeplearning4j_tpu/ops",
     "deeplearning4j_tpu/optimize/solver.py",
     "deeplearning4j_tpu/models",
-    # parallel/ includes the serving engine, the fleet router and the
-    # persisted AOT cache: the only legitimate fetches are the
-    # completion-thread block/asarray pair and the cache's one-time
-    # startup weights fingerprint (pragma'd there)
+    # parallel/ includes the serving engine, the fleet router, the
+    # persisted AOT cache AND the cluster tier (node.py's registry
+    # gossip / drain loop, remote.py's dispatch + breakers): the only
+    # legitimate fetches are the completion-thread block/asarray pair,
+    # the cache's one-time startup weights fingerprint, and the cluster
+    # tier's host-side config/HTTP scalars (each pragma'd in place)
     "deeplearning4j_tpu/parallel",
     # the input-feeder hot path: a stray per-batch host sync here would
     # serialize ETL back onto the step loop the feeder exists to unblock
