@@ -144,6 +144,15 @@ def main():
                 for b in (64, 256, 1024)
                 for h in (256, 512, 1024)
                 for t in (32, 128, 512)]
+        # decode-shape geometries: the generation/ engine's tick is a
+        # T=1 step over a small slot batch (continuous batching keeps
+        # batch at the slot-bucket sizes). Swept here so the dispatch
+        # table has the decode consumer's shapes ready the first time a
+        # chip session runs this — a fused win at T=1 would move the
+        # serving tick, not just training.
+        grid += [(b, h, 1)
+                 for b in (1, 8, 16)
+                 for h in (256, 512)]
 
     wins = []
     for (b, h, t) in grid:

@@ -141,6 +141,36 @@ def _build_parser() -> argparse.ArgumentParser:
                    "holdout reservoir (never trained on)")
     o.add_argument("--holdout-max", type=int, default=512,
                    help="holdout reservoir bound, in examples")
+    # ---- generative serving (autoregressive decode) ------------------
+    g = s.add_argument_group(
+        "generative serving", "serve autoregressive decode next to "
+        "predict: a continuous-batching GenerationEngine "
+        "(generation/engine.py) over the restored recurrent model "
+        "streams tokens at POST /api/generate (SSE); "
+        "--gen-slo-token-ms puts it behind the fleet front door's "
+        "admission control with per-token-p99 shedding")
+    g.add_argument("--generate", action="store_true",
+                   help="enable decode serving (the model must be a "
+                   "stacked-LSTM + dense-head network, e.g. the "
+                   "committed TextGenerationLSTM artifact)")
+    g.add_argument("--gen-slots", type=int, default=8, metavar="N",
+                   help="continuous-batching slot count: concurrent "
+                   "sequences decoding in one device batch; the AOT "
+                   "warmup sweeps the pow2 bucket ladder up to this")
+    g.add_argument("--gen-max-new", type=int, default=256, metavar="N",
+                   help="default per-request max generated tokens")
+    g.add_argument("--gen-precision", default="f32",
+                   choices=["f32", "bf16", "int8"],
+                   help="dense-head precision arm; int8 rides "
+                   "ops/quantize.py and must pass the decode-level "
+                   "next-token-agreement gate at startup")
+    g.add_argument("--gen-slo-token-ms", type=float, default=None,
+                   metavar="MS",
+                   help="per-token p99 SLO: routes /api/generate "
+                   "through fleet admission control (503 + Retry-After "
+                   "on shed)")
+    g.add_argument("--gen-queue-limit", type=int, default=128,
+                   help="bound on sequences waiting for a slot")
     return p
 
 
@@ -182,6 +212,10 @@ def cmd_serve(args, block: bool = True):
         # drains gracefully (finish in-flight, deregister, exit 0)
         if mode != InferenceMode.BATCHED:
             raise SystemExit("--join requires --inference-mode batched")
+        if getattr(args, "generate", False):
+            raise SystemExit(
+                "--generate is not supported in --join node mode; run "
+                "it as a standalone serve process")
         from deeplearning4j_tpu.parallel.aot_cache import ArtifactStore
         from deeplearning4j_tpu.parallel.node import (
             NodeRegistry, ServingNode, install_sigterm_drain)
@@ -281,6 +315,29 @@ def cmd_serve(args, block: bool = True):
             **kwargs)
         engine = front.engine
 
+    # generative serving rides the same process: a GenerationEngine
+    # over the same restored model, exposed at /api/generate — behind
+    # fleet admission when an SLO (request- or token-level) is armed
+    gen_engine = None
+    gen_router = None
+    if getattr(args, "generate", False):
+        from deeplearning4j_tpu.generation import GenerationEngine
+        gen_engine = GenerationEngine(
+            model, max_slots=args.gen_slots,
+            precision=args.gen_precision,
+            max_new_tokens=args.gen_max_new,
+            queue_limit=args.gen_queue_limit)
+        if fleet is not None or args.gen_slo_token_ms is not None:
+            gen_router = fleet
+            if gen_router is None:
+                from deeplearning4j_tpu.parallel.fleet import FleetRouter
+                gen_router = FleetRouter(session_id="generate")
+            gen_name = (os.path.splitext(
+                os.path.basename(args.model))[0] or "default") + "-gen"
+            gen_router.add_generation_pool(
+                gen_name, gen_engine,
+                slo_token_ms=args.gen_slo_token_ms)
+
     server = UIServer(port=args.ui_port)
     server.attach(InMemoryStatsStorage())
     if fleet is not None:
@@ -292,6 +349,13 @@ def cmd_serve(args, block: bool = True):
     if online is not None:
         from deeplearning4j_tpu.ui.online_module import OnlineModule
         server.register_module(OnlineModule(online))
+    if gen_engine is not None:
+        from deeplearning4j_tpu.ui.generation_module import (
+            GenerationModule)
+        server.register_module(
+            GenerationModule(router=gen_router, model=gen_name)
+            if gen_router is not None
+            else GenerationModule(engine=gen_engine))
     server.start()
     print(f"serving {args.model} at {server.url} "
           f"(mode={mode.value}, replicas={replicas}, "
@@ -311,6 +375,12 @@ def cmd_serve(args, block: bool = True):
     if online is not None:
         print(f"  online:   GET  {server.url}/api/online/stats, "
               f"POST {server.url}/api/online/promote|rollback")
+    if gen_engine is not None:
+        print(f"  generate: POST {server.url}/api/generate "
+              '{"prompt": "...", "stream": true}  (SSE token stream, '
+              f"slots={args.gen_slots}, "
+              f"precision={args.gen_precision})")
+        print(f"  genstats: GET  {server.url}/api/generation/stats")
     if not block:
         return front, server
     try:
@@ -323,6 +393,13 @@ def cmd_serve(args, block: bool = True):
         pass
     finally:
         front.shutdown()
+        # front.shutdown() covers the generation engine only when its
+        # pool rides the same fleet router; the standalone cases are
+        # shut down here
+        if gen_router is not None and gen_router is not fleet:
+            gen_router.shutdown()
+        elif gen_engine is not None and gen_router is None:
+            gen_engine.shutdown()
         server.stop()
     return 0
 

@@ -1,0 +1,22 @@
+"""Generative serving: continuous-batching autoregressive decode.
+
+The decode analog of ``parallel/serving.py``'s predict path. A jitted
+single-tick step advances every slot of a fixed-size batch by one token;
+the (h, c) LSTM carry and the per-slot PRNG state stay device-resident
+across ticks, sequences join and leave the batch mid-flight, and the
+sampled tokens stream back to HTTP clients as they decode
+(``POST /api/generate``, SSE).
+
+- ``decode.py``   pure tick builder, vocab, reference decode, int8 head
+- ``engine.py``   GenerationEngine: slots, scheduler, AOT warmup, metrics
+"""
+
+from deeplearning4j_tpu.generation.decode import (
+    DecodeSpec, Vocab, extract_decode_spec, head_bytes_per_token,
+    reference_decode)
+from deeplearning4j_tpu.generation.engine import (
+    GenerationEngine, GenerationStream)
+
+__all__ = ["DecodeSpec", "Vocab", "extract_decode_spec",
+           "head_bytes_per_token", "reference_decode",
+           "GenerationEngine", "GenerationStream"]

@@ -1,0 +1,428 @@
+"""Pure decode building blocks: model → tick function.
+
+A *tick* advances every slot of an (S,)-shaped decode batch by exactly
+one token: one-hot embed the input tokens, run each stacked LSTM cell's
+``step_one``, project through the dense head, sample. Everything that
+crosses ticks — the (h, c) carries and the per-slot PRNG keys — stays
+on device; the only per-tick host traffic is the small int32 control
+arrays in (tokens, reset flags, seeds, sampling knobs) and the sampled
+tokens out (which *are* the streamed response payload).
+
+Join/leave mid-flight rides the same masked-neutral trick as the
+feeder's ragged buckets: a joining slot's ``reset`` flag zeroes its
+carry rows and reseeds its PRNG key inside the tick; an inactive slot's
+rows pass through untouched, so co-resident sequences are bitwise
+independent of who else occupies the batch.
+
+The head has three precision arms (f32 / bf16 / int8 via
+``ops/quantize.py``); the LSTM stack is always f32, so the arms are an
+apples-to-apples $/token comparison of the dense projection alone.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_WEIGHTS_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "zoo", "weights")
+DEFAULT_VOCAB_PATH = os.path.abspath(
+    os.path.join(_WEIGHTS_DIR, "textgen_vocab.json"))
+
+
+# ---- vocab ------------------------------------------------------------
+
+
+class Vocab:
+    """char <-> id mapping for the streamed text surface.
+
+    Index 0 is the unknown bucket (the committed textgen vocab starts
+    at 1); decoding an id with no char yields U+FFFD so a stream is
+    always valid UTF-8 even for an untrained model babbling id 0.
+    """
+
+    def __init__(self, stoi: Dict[str, int], size: int):
+        self.stoi = dict(stoi)
+        self.size = size
+        self.itos = ["�"] * size
+        for ch, i in self.stoi.items():
+            if 0 <= i < size:
+                self.itos[i] = ch
+
+    @classmethod
+    def load(cls, path: str = DEFAULT_VOCAB_PATH) -> "Vocab":
+        with open(path) as f:
+            stoi = json.load(f)
+        return cls(stoi, max(stoi.values()) + 1)
+
+    @classmethod
+    def identity(cls, size: int) -> "Vocab":
+        """No-text fallback for models without a committed char map."""
+        return cls({}, size)
+
+    @classmethod
+    def default_for(cls, vocab_size: int) -> "Vocab":
+        """The committed textgen vocab when sizes line up, else ids."""
+        try:
+            v = cls.load()
+            if v.size == vocab_size:
+                return v
+        except OSError:
+            pass
+        return cls.identity(vocab_size)
+
+    def encode(self, text: str) -> List[int]:
+        return [self.stoi.get(ch, 0) for ch in text]
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return "".join(self.itos[i] if 0 <= i < self.size else "�"
+                       for i in ids)
+
+
+# ---- model -> decode spec --------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class DecodeSpec:
+    """Static decode structure extracted (and validated) once."""
+    lstm_names: Tuple[str, ...]
+    hidden_sizes: Tuple[int, ...]
+    head_name: str
+    vocab_size: int
+
+    @property
+    def n_layers(self) -> int:
+        return len(self.lstm_names)
+
+
+def extract_decode_spec(model) -> DecodeSpec:
+    """Validate the network shape the tick supports: a stack of LSTM
+    cells (Graves peepholes included — ``step_one`` dispatches through
+    the subclass ``_cell``) under a dense softmax head. Anything else
+    fails here, at engine construction, not inside the first trace."""
+    from deeplearning4j_tpu.nn.layers.recurrent import (
+        LSTM, unwrap_recurrent)
+    if model.train_state is None:
+        model.init()
+    layers = model.layers
+    if len(layers) < 2:
+        raise ValueError("decode needs >= 1 LSTM layer + a dense head")
+    if getattr(model, "_preprocessors", None):
+        raise ValueError(
+            "decode tick does not support input preprocessors; got "
+            f"{sorted(model._preprocessors)}")
+    names, sizes, cores = [], [], []
+    for l in layers[:-1]:
+        core = unwrap_recurrent(l)
+        if not isinstance(core, LSTM):
+            raise ValueError(
+                f"decode supports stacked LSTM cores only; layer "
+                f"{l.name!r} is {type(core).__name__}")
+        names.append(l.name)
+        sizes.append(core.n_out)
+        cores.append(core)
+    head = layers[-1]
+    if not hasattr(head, "pre_output"):
+        raise ValueError(
+            f"last layer {head.name!r} ({type(head).__name__}) has no "
+            "dense pre_output; decode needs a projection head")
+    hp = model.train_state.params.get(head.name, {})
+    if "W" not in hp or "b" not in hp:
+        raise ValueError(f"head {head.name!r} params missing W/b")
+    return DecodeSpec(tuple(names), tuple(sizes), head.name,
+                      int(hp["W"].shape[-1]))
+
+
+def _lstm_cores(model, spec: DecodeSpec):
+    from deeplearning4j_tpu.nn.layers.recurrent import unwrap_recurrent
+    by_name = {l.name: l for l in model.layers}
+    return [unwrap_recurrent(by_name[n]) for n in spec.lstm_names]
+
+
+# ---- decode params (per-precision head) ------------------------------
+
+
+def commit_decode_params(model, spec: DecodeSpec, precision: str,
+                         x_scale: Optional[float] = None):
+    """Device-resident decode param tree: f32 LSTM stack + the head in
+    the requested precision arm. int8 rides ops/quantize (per-output-
+    channel weight scales, one calibrated activation scale)."""
+    from deeplearning4j_tpu.ops.quantize import quantize_weight
+    p = model.train_state.params
+    lstm = [{k: jnp.asarray(v, jnp.float32)
+             for k, v in p[name].items()} for name in spec.lstm_names]
+    W = np.array(p[spec.head_name]["W"], dtype=np.float32, copy=True)
+    b = np.array(p[spec.head_name]["b"], dtype=np.float32, copy=True)
+    if precision == "f32":
+        head = {"W": jnp.asarray(W), "b": jnp.asarray(b)}
+    elif precision == "bf16":
+        head = {"W": jnp.asarray(W, jnp.bfloat16),
+                "b": jnp.asarray(b, jnp.bfloat16)}
+    elif precision == "int8":
+        if x_scale is None:
+            raise ValueError("int8 head needs a calibrated x_scale")
+        w_q, w_scale = quantize_weight(W, reduce_axes=(0,))
+        head = {"Wq": jnp.asarray(w_q), "w_scale": jnp.asarray(w_scale),
+                "x_scale": jnp.asarray(np.float32(x_scale)),
+                "b": jnp.asarray(b)}
+    else:
+        raise ValueError(f"unknown decode precision {precision!r}")
+    return jax.device_put({"lstm": lstm, "head": head})
+
+
+def head_bytes_per_token(spec: DecodeSpec, hidden: int,
+                         precision: str) -> int:
+    """Bytes the head moves per decode tick per slot: the weight matrix
+    is re-read every tick (decode is memory-bound), plus bias/scales.
+    The $/token A/B's 'bytes moved' column."""
+    V = spec.vocab_size
+    if precision == "f32":
+        return hidden * V * 4 + V * 4
+    if precision == "bf16":
+        return hidden * V * 2 + V * 2
+    if precision == "int8":
+        # int8 weights + f32 per-channel scales + f32 bias + one x_scale
+        return hidden * V * 1 + V * 4 + V * 4 + 4
+    raise ValueError(precision)
+
+
+# ---- the tick ---------------------------------------------------------
+
+
+def _head_logits(head, h):
+    if "Wq" in head:
+        from deeplearning4j_tpu.ops.quantize import int8_dot
+        return int8_dot(h, head["Wq"], head["w_scale"],
+                        head["x_scale"]) + head["b"]
+    W = head["W"]
+    if W.dtype == jnp.bfloat16:
+        return (h.astype(jnp.bfloat16) @ W + head["b"]).astype(
+            jnp.float32)
+    return h @ W + head["b"]
+
+
+def _sample_one(key, logits, temp, top_k, greedy):
+    """One slot's sampling: greedy argmax, or temperature + top-k
+    categorical. ``top_k <= 0`` means no truncation. argmax is taken on
+    raw logits — identical to argmax of the model's softmax output, so
+    greedy decode is bitwise-comparable to the reference path."""
+    V = logits.shape[-1]
+    greedy_tok = jnp.argmax(logits).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temp, 1e-3)
+    k = jnp.clip(jnp.where(top_k <= 0, V, top_k), 1, V)
+    kth = jnp.sort(scaled)[::-1][k - 1]
+    masked = jnp.where(scaled >= kth, scaled, -jnp.inf)
+    tok = jax.random.categorical(key, masked).astype(jnp.int32)
+    return jnp.where(greedy, greedy_tok, tok)
+
+
+def build_tick(model, spec: DecodeSpec):
+    """The jittable single-tick decode step.
+
+    tick(dp, h, c, rng, tokens, reset, seeds, active, temp, top_k,
+    greedy) -> (h', c', rng', next_tokens)
+
+    - dp: committed decode params ({"lstm": [...], "head": {...}})
+    - h, c: per-layer lists of (S, H_l) f32 — device-resident carries
+    - rng: (S, 2) uint32 per-slot PRNG keys — device-resident
+    - tokens (S,) i32 in, reset/active (S,) bool, seeds (S,) u32,
+      temp (S,) f32, top_k (S,) i32, greedy (S,) bool — host controls
+    - next_tokens (S,) i32 — the streamed payload
+
+    A reset slot's carries are zeroed and its key re-derived from its
+    seed *inside* the tick; an inactive slot's state rows and token pass
+    through unchanged (masked-neutral), which is what makes each slot's
+    trajectory — including its PRNG stream, advanced exactly one split
+    per active tick — independent of its co-residents.
+    """
+    cores = _lstm_cores(model, spec)
+    V = spec.vocab_size
+
+    def tick(dp, h, c, rng, tokens, reset, seeds, active, temp, top_k,
+             greedy):
+        rmask = reset[:, None]
+        fresh = jax.vmap(jax.random.PRNGKey)(seeds)
+        rng_in = jnp.where(rmask, fresh, rng)
+        hs = [jnp.where(rmask, 0.0, hl) for hl in h]
+        cs = [jnp.where(rmask, 0.0, cl) for cl in c]
+        x = jax.nn.one_hot(tokens, V, dtype=jnp.float32)
+        h_new, c_new = [], []
+        for i, core in enumerate(cores):
+            hy, cy = core.step_one(dp["lstm"][i], x, (hs[i], cs[i]))
+            h_new.append(hy)
+            c_new.append(cy)
+            x = hy
+        logits = _head_logits(dp["head"], x)
+        split = jax.vmap(lambda k: jax.random.split(k, 2))(rng_in)
+        sampled = jax.vmap(_sample_one)(
+            split[:, 1], logits, temp, top_k, greedy)
+        amask = active[:, None]
+        h_out = [jnp.where(amask, hn, hi)
+                 for hn, hi in zip(h_new, hs)]
+        c_out = [jnp.where(amask, cn, ci)
+                 for cn, ci in zip(c_new, cs)]
+        rng_out = jnp.where(amask, split[:, 0], rng_in)
+        next_tokens = jnp.where(active, sampled, tokens)
+        return h_out, c_out, rng_out, next_tokens
+
+    return tick
+
+
+def zero_carries(spec: DecodeSpec, n_slots: int):
+    """Fresh device state for a bucket: zero carries + zero PRNG rows
+    (every slot is reseeded through its reset flag before first use)."""
+    h = [jnp.zeros((n_slots, hd), jnp.float32)
+         for hd in spec.hidden_sizes]
+    c = [jnp.zeros((n_slots, hd), jnp.float32)
+         for hd in spec.hidden_sizes]
+    rng = jnp.zeros((n_slots, 2), jnp.uint32)
+    return h, c, rng
+
+
+def build_resize(spec: DecodeSpec, src: int, dst: int):
+    """Jittable bucket resize for the device state. Growing zero-pads
+    new slot rows (they get reseeded on join); shrinking slices — the
+    scheduler only shrinks when no active slot lives above ``dst``.
+    AOT-warmed like the tick so a mid-flight resize never live-compiles.
+    """
+    def resize(h, c, rng):
+        if dst > src:
+            pad = dst - src
+            h2 = [jnp.pad(hl, ((0, pad), (0, 0))) for hl in h]
+            c2 = [jnp.pad(cl, ((0, pad), (0, 0))) for cl in c]
+            r2 = jnp.pad(rng, ((0, pad), (0, 0)))
+        else:
+            h2 = [hl[:dst] for hl in h]
+            c2 = [cl[:dst] for cl in c]
+            r2 = rng[:dst]
+        return h2, c2, r2
+
+    return resize
+
+
+# ---- reference decode (test/bench oracle) ----------------------------
+
+
+def reference_decode(model, prompt_ids: Sequence[int], max_new: int,
+                     stop_id: Optional[int] = None) -> List[int]:
+    """Greedy single-sequence decode through the model's own
+    ``rnn_time_step`` path, one token per call — the oracle the
+    continuous-batched engine must match bitwise in greedy mode. A
+    host loop by design (it is the test reference, not the serving
+    path), hence the pragmas."""
+    spec = extract_decode_spec(model)
+    if not prompt_ids:
+        raise ValueError("reference_decode needs a non-empty prompt")
+    carries = None
+    out: List[int] = []
+    feed = list(prompt_ids)
+    pos = 0
+    tok = feed[pos]
+    pos += 1
+    while len(out) < max_new:
+        x = np.zeros((1, spec.vocab_size), np.float32)
+        x[0, tok] = 1.0
+        probs, carries = model.rnn_time_step(x, carries)
+        if pos < len(feed):       # still consuming the prompt
+            tok = feed[pos]
+            pos += 1
+            continue
+        nxt = int(np.asarray(probs).argmax())  # host-sync-ok: test oracle host loop, not the serving path
+        out.append(nxt)
+        if stop_id is not None and nxt == stop_id:
+            break
+        tok = nxt
+    return out
+
+
+# ---- int8 head calibration + decode-level quant gate -----------------
+
+
+def probe_head(model, spec: DecodeSpec, probe_ids: Sequence[int],
+               free_run: int = 32):
+    """Greedy f32 probe drive: consume ``probe_ids`` then free-run
+    ``free_run`` ticks, collecting the head's input activations (the
+    last LSTM's h — bounded in (-1, 1) since h = o*tanh(c)) and the f32
+    logits at every position. Feeds both the int8 activation-scale
+    calibration and the decode-level quant gate. Host loop by design:
+    runs once at engine init, pre-traffic."""
+    if not probe_ids:
+        raise ValueError("probe needs a non-empty id stream")
+    last = spec.lstm_names[-1]
+    p = model.train_state.params
+    W = np.array(p[spec.head_name]["W"], np.float32, copy=True)
+    b = np.array(p[spec.head_name]["b"], np.float32, copy=True)
+    carries = None
+    hs: List[np.ndarray] = []
+    feed = list(probe_ids)
+    pos = 0
+    tok = feed[pos]
+    pos += 1
+    total = len(feed) - 1 + free_run
+    for _ in range(total):
+        x = np.zeros((1, spec.vocab_size), np.float32)
+        x[0, tok] = 1.0
+        probs, carries = model.rnn_time_step(x, carries)
+        hs.append(np.asarray(carries[last][0][0]))  # host-sync-ok: init-time calibration probe, pre-traffic
+        if pos < len(feed):
+            tok = feed[pos]
+            pos += 1
+        else:
+            tok = int(np.asarray(probs).argmax())  # host-sync-ok: init-time calibration probe, pre-traffic
+    h_stream = np.stack(hs)                        # (T, H)
+    logits_f32 = h_stream @ W + b                  # (T, V)
+    return h_stream, logits_f32
+
+
+def int8_head_gate(model, spec: DecodeSpec, probe_ids: Sequence[int],
+                   top1_budget: float = 0.03, logit_budget: float = 0.25,
+                   free_run: int = 32, model_name: str = "generate",
+                   registry=None):
+    """Calibrate the int8 head and gate it at the decode level: next-
+    token (argmax) agreement against the f32 head over the probe
+    trajectory must stay within ``top1_budget``. Reuses the PTQ gate's
+    result/error types so callers get the same summary surface as the
+    predict-path quant gate. Returns (x_scale, GateResult); raises
+    QuantGateError on a miss."""
+    from deeplearning4j_tpu.evaluation.quant_gate import (
+        GateResult, QuantGateError)
+    from deeplearning4j_tpu.ops.quantize import (
+        activation_scale, int8_dot, quantize_weight)
+    h_stream, logits_f32 = probe_head(model, spec, probe_ids, free_run)
+    amax = float(np.abs(h_stream).max())  # host-sync-ok: init-time calibration probe, pre-traffic
+    x_scale = activation_scale(amax)
+    p = model.train_state.params
+    W = np.array(p[spec.head_name]["W"], np.float32, copy=True)
+    b = np.array(p[spec.head_name]["b"], np.float32, copy=True)
+    w_q, w_scale = quantize_weight(W, reduce_axes=(0,))
+    logits_q = np.asarray(int8_dot(  # host-sync-ok: init-time gate evaluation, pre-traffic
+        jnp.asarray(h_stream), jnp.asarray(w_q), jnp.asarray(w_scale),
+        jnp.asarray(np.float32(x_scale)))) + b
+    agree = float((logits_q.argmax(-1) == logits_f32.argmax(-1)).mean())  # host-sync-ok: init-time gate evaluation, pre-traffic
+    delta = np.abs(logits_q - logits_f32)
+    denom = float(np.abs(logits_f32).mean()) or 1.0  # host-sync-ok: init-time gate evaluation, pre-traffic
+    rel = float(np.linalg.norm(logits_q - logits_f32)  # host-sync-ok: init-time gate evaluation, pre-traffic
+                / (np.linalg.norm(logits_f32) or 1.0))
+    result = GateResult(
+        model=model_name, n_examples=int(h_stream.shape[0]),
+        n_positions=int(h_stream.shape[0]),
+        top1_agreement=agree, top1_delta=1.0 - agree,
+        max_logit_delta=float(delta.max()) / denom,  # host-sync-ok: init-time gate evaluation, pre-traffic
+        mean_logit_delta=float(delta.mean()) / denom,  # host-sync-ok: init-time gate evaluation, pre-traffic
+        top1_budget=top1_budget, logit_budget=logit_budget,
+        layer_errors={spec.head_name: rel}, fallback=[],
+        passed=(1.0 - agree) <= top1_budget)
+    if registry is not None:
+        registry.gauge(
+            "dl4j_gen_int8_agreement",
+            "decode-level next-token agreement, int8 head vs f32"
+        ).set(agree, model=model_name)
+    if not result.passed:
+        raise QuantGateError(result)
+    return float(x_scale), result  # host-sync-ok: init-time calibration scalar, pre-traffic

@@ -1,0 +1,667 @@
+"""GenerationEngine: continuous-batching decode scheduler.
+
+The serving loop for autoregressive decode. A fixed array of *slots*
+holds in-flight sequences; every scheduler iteration dispatches ONE
+jitted tick over the whole slot batch, then routes each active slot's
+sampled token to its stream. Sequences join (taking the lowest free
+slot, carries zeroed + PRNG reseeded inside the tick via the reset
+mask) and retire (stop token, max length, cancel) mid-flight without
+ever draining the batch — the continuous-batching property that keeps
+the device busy at high sequence turnover.
+
+Device residency: the (h, c) carries and per-slot PRNG keys live on
+device across ticks and are never fetched. The per-tick host traffic is
+the small int32/bool control arrays in and the sampled tokens out —
+the tokens *are* the streamed response payload (pragma'd host
+boundary); graftlint's host-sync rule polices everything else.
+
+Compile discipline mirrors ``parallel/serving.py``: the tick is
+AOT-lowered per slot-count bucket (pow2 ladder up to ``max_slots``)
+and the bucket grow/shrink resize steps are AOT-warmed too, so after
+``_warmup_sweep`` a recompile is a bug — ``assert_warm()`` and the
+RecompileWatchdog both say so.
+
+Telemetry: the ``dl4j_gen_*`` family (tokens, per-token p50/p99,
+time-to-first-token, active slots, retired sequences by outcome,
+stream errors, compiles by phase) — see OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from deeplearning4j_tpu.generation import decode as D
+from deeplearning4j_tpu.observe.latency import LatencyRing
+from deeplearning4j_tpu.observe.recompile import RecompileWatchdog
+from deeplearning4j_tpu.observe.registry import default_registry
+
+log = logging.getLogger(__name__)
+
+_QUANTILES = (0.5, 0.95, 0.99)
+
+
+def _bucket_ladder(max_slots: int) -> List[int]:
+    out, b = [], 1
+    while b < max_slots:
+        out.append(b)
+        b <<= 1
+    out.append(max_slots)
+    return out
+
+
+class GenerationStream:
+    """One sequence's token stream: the scheduler produces events, one
+    consumer iterates them (the SSE writer, or ``result()``). Events
+    are plain dicts so the UI layer can serialize them as-is:
+    ``{"token": id, "text": ch, "i": n}`` per token, then a terminal
+    ``{"done": True, "reason": ..., "n": ..., "ttft_ms": ...}`` or
+    ``{"error": msg}``."""
+
+    _END = object()
+
+    def __init__(self, request: Dict[str, Any], buffer: int = 4096):
+        self.request = request
+        self.ids: List[int] = []
+        self.reason: Optional[str] = None
+        self.error: Optional[str] = None
+        self.ttft_ms: Optional[float] = None
+        self._q: "queue.Queue" = queue.Queue(maxsize=buffer)
+        self._done = threading.Event()
+        self._cancelled = threading.Event()
+        self._cb_lock = threading.Lock()
+        self._callbacks: List[Any] = []
+
+    # -- consumer side -------------------------------------------------
+
+    def __iter__(self):
+        while True:
+            ev = self._q.get()
+            if ev is self._END:
+                return
+            yield ev
+
+    def result(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Drain the stream and return the completed sequence."""
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            left = None if deadline is None else deadline - time.time()
+            if left is not None and left <= 0:
+                raise TimeoutError("generation stream timed out")
+            try:
+                ev = self._q.get(timeout=left)
+            except queue.Empty:
+                raise TimeoutError("generation stream timed out")
+            if ev is self._END:
+                break
+        if self.error is not None:
+            raise RuntimeError(self.error)
+        return {"ids": list(self.ids), "reason": self.reason,
+                "n": len(self.ids), "ttft_ms": self.ttft_ms}
+
+    def cancel(self):
+        """Ask the scheduler to retire this sequence early (client went
+        away mid-stream). Safe from any thread; idempotent."""
+        self._cancelled.set()
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def add_done_callback(self, fn):
+        with self._cb_lock:
+            if not self._done.is_set():
+                self._callbacks.append(fn)
+                return
+        try:
+            fn(self)
+        except Exception:
+            log.exception("generation stream callback failed")
+
+    # -- scheduler side ------------------------------------------------
+
+    def _push(self, ev: Dict[str, Any]) -> bool:
+        try:
+            self._q.put_nowait(ev)
+            return True
+        except queue.Full:
+            return False
+
+    def _finish(self, reason: str):
+        self.reason = reason
+        self._push({"done": True, "reason": reason, "n": len(self.ids),
+                    "ttft_ms": self.ttft_ms})
+        self._seal()
+
+    def _fail(self, msg: str):
+        self.error = msg
+        self.reason = "error"
+        self._push({"error": msg})
+        self._seal()
+
+    def _seal(self):
+        self._done.set()
+        try:
+            self._q.put_nowait(self._END)
+        except queue.Full:
+            # consumer is gone and the buffer is packed; drop one event
+            # to guarantee the END marker lands, else iterators hang
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._q.put_nowait(self._END)
+        with self._cb_lock:
+            cbs, self._callbacks = self._callbacks, []
+        for fn in cbs:
+            try:
+                fn(self)
+            except Exception:
+                log.exception("generation stream callback failed")
+
+
+class _Slot:
+    """Scheduler-private per-slot state (host side only)."""
+
+    __slots__ = ("stream", "prompt", "ppos", "next_input", "gen_count",
+                 "max_new", "stop_id", "seed", "temperature", "top_k",
+                 "greedy", "needs_reset", "t_join", "t_first")
+
+    def __init__(self, stream: GenerationStream, prompt: List[int],
+                 max_new: int, stop_id: Optional[int], seed: int,
+                 temperature: float, top_k: int, greedy: bool):
+        self.stream = stream
+        self.prompt = prompt
+        self.ppos = 1
+        self.next_input = prompt[0]
+        self.gen_count = 0
+        self.max_new = max_new
+        self.stop_id = stop_id
+        self.seed = seed
+        self.temperature = temperature
+        self.top_k = top_k
+        self.greedy = greedy
+        self.needs_reset = True
+        self.t_join = time.time()
+        self.t_first: Optional[float] = None
+
+
+class GenerationEngine:
+    """Continuous-batching decode serving over one committed model.
+
+    ``submit()`` returns a :class:`GenerationStream` immediately; the
+    background scheduler thread packs waiting sequences into free
+    slots, grows/shrinks the slot bucket along the AOT-warmed ladder,
+    and pushes sampled tokens into each stream as they decode.
+    """
+
+    def __init__(self, model, *, max_slots: int = 8,
+                 precision: Union[str, Any] = "f32",
+                 vocab: Optional[D.Vocab] = None,
+                 max_new_tokens: int = 256,
+                 stop_text: Optional[str] = "\n",
+                 queue_limit: int = 128,
+                 stream_buffer: int = 4096,
+                 int8_budget: float = 0.03,
+                 calibration_text: str = "the quick brown fox jumps "
+                                         "over the lazy dog\n",
+                 registry=None, watchdog=None,
+                 session_id: str = "generate"):
+        if max_slots < 1:
+            raise ValueError("max_slots must be >= 1")
+        self.model = model
+        self.spec = D.extract_decode_spec(model)
+        self.vocab = vocab if vocab is not None \
+            else D.Vocab.default_for(self.spec.vocab_size)
+        self.precision = getattr(precision, "mode", precision)
+        if self.precision not in ("f32", "bf16", "int8"):
+            raise ValueError(f"unknown precision {self.precision!r}")
+        self.max_slots = int(max_slots)
+        self.max_new_tokens = int(max_new_tokens)
+        self.queue_limit = int(queue_limit)
+        self.stream_buffer = int(stream_buffer)
+        self.session_id = session_id
+        self.stop_id: Optional[int] = None
+        if stop_text:
+            sid = self.vocab.stoi.get(stop_text)
+            if sid is not None:
+                self.stop_id = int(sid)
+
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self.watchdog = watchdog if watchdog is not None else \
+            RecompileWatchdog(self.registry, session_id=session_id)
+
+        # int8 head: calibrate + decode-level quant gate before commit
+        self.gate_result = None
+        x_scale = None
+        if self.precision == "int8":
+            probe = self.vocab.encode(calibration_text) or [0]
+            x_scale, self.gate_result = D.int8_head_gate(
+                model, self.spec, probe, top1_budget=int8_budget,
+                model_name=session_id, registry=self.registry)
+        self._dp = D.commit_decode_params(
+            model, self.spec, self.precision, x_scale=x_scale)
+
+        import jax
+        self._tick_jit = jax.jit(D.build_tick(model, self.spec))
+        self._resize_jit: Dict[tuple, Any] = {}
+        self.ladder = _bucket_ladder(self.max_slots)
+
+        # executables: ("tick", S) and ("resize", src, dst)
+        self._exe: Dict[tuple, Any] = {}
+        self._exe_lock = threading.Lock()
+        self._warmed = False
+        self._post_warmup_compiles = 0
+
+        # scheduler state — slot objects + device-resident carry/rng
+        self._cv = threading.Condition()
+        self._waiting: List[_Slot] = []
+        self._slots: List[Optional[_Slot]] = [None] * self.max_slots
+        self._bucket = self.ladder[0]
+        self._h, self._c, self._rng = D.zero_carries(
+            self.spec, self._bucket)
+        self._shrink_streak = 0
+        self._stop = threading.Event()
+
+        # accounting
+        self.token_ring = LatencyRing()
+        self.ttft_ring = LatencyRing()
+        self._submitted = 0
+        self._tokens_out = 0
+        self._prefill_ticks = 0
+        self._max_active = 0
+        self._outcomes: Dict[str, int] = {}
+        self._stream_errors = 0
+
+        r = self.registry
+        self._c_tokens = r.counter(
+            "dl4j_gen_tokens_total", "generated tokens streamed")
+        self._c_seqs = r.counter(
+            "dl4j_gen_sequences_total",
+            "retired sequences by outcome (stop|length|cancelled|error)")
+        self._c_compiles = r.counter(
+            "dl4j_gen_compiles_total",
+            "decode executable compiles by phase (warmup|live)")
+        self._c_stream_err = r.counter(
+            "dl4j_gen_stream_errors_total",
+            "streams dropped mid-flight (slow consumer / transport)")
+        self._g_active = r.gauge(
+            "dl4j_gen_active_slots", "sequences currently decoding")
+        self._g_bucket = r.gauge(
+            "dl4j_gen_slot_bucket", "current slot-count bucket")
+        self._g_queue = r.gauge(
+            "dl4j_gen_queue_depth", "sequences waiting for a slot")
+        self._g_token_ms = r.gauge(
+            "dl4j_gen_token_ms", "per-token decode latency quantiles")
+        self._g_ttft = r.gauge(
+            "dl4j_gen_ttft_ms", "time-to-first-token quantiles")
+        # pre-register healthy series so /metrics shows the family at 0
+        self._c_tokens.inc(0.0, session=session_id)
+        self._c_compiles.inc(0.0, session=session_id, phase="live")
+        self._c_stream_err.inc(0.0, session=session_id)
+        for oc in ("stop", "length", "cancelled", "error"):
+            self._c_seqs.inc(0.0, session=session_id, outcome=oc)
+        self._g_active.set(0.0, session=session_id)
+        self._g_bucket.set(float(self._bucket), session=session_id)  # host-sync-ok: python int gauge, no device value
+        self._g_queue.set(0.0, session=session_id)
+
+        t0 = time.time()
+        self._warmup_sweep()
+        self.warmup_s = time.time() - t0
+        self._warmed = True
+
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"generation-scheduler-{session_id}")
+        self._thread.start()
+
+    # ---- executables -------------------------------------------------
+
+    def _host_args(self, S: int):
+        return (np.zeros(S, np.int32), np.zeros(S, bool),
+                np.zeros(S, np.uint32), np.zeros(S, bool),
+                np.ones(S, np.float32), np.zeros(S, np.int32),
+                np.ones(S, bool))
+
+    def _compile(self, key: tuple):
+        phase = "warmup" if not self._warmed else "live"
+        if self._warmed:
+            self._post_warmup_compiles += 1
+            log.warning("generation: live compile for %s", key)
+        self._c_compiles.inc(1.0, session=self.session_id, phase=phase)
+        if key[0] == "tick":
+            S = key[1]
+            h, c, rng = D.zero_carries(self.spec, S)
+            try:
+                return self._tick_jit.lower(
+                    self._dp, h, c, rng, *self._host_args(S)).compile()
+            except Exception:
+                log.exception("AOT lower failed for %s; using jit", key)
+                return self._tick_jit
+        _, src, dst = key
+        rj = self._resize_jit.get((src, dst))
+        if rj is None:
+            import jax
+            rj = jax.jit(D.build_resize(self.spec, src, dst))
+            self._resize_jit[(src, dst)] = rj
+        h, c, rng = D.zero_carries(self.spec, src)
+        try:
+            return rj.lower(h, c, rng).compile()
+        except Exception:
+            log.exception("AOT lower failed for %s; using jit", key)
+            return rj
+
+    def _get_exe(self, key: tuple):
+        exe = self._exe.get(key)
+        if exe is None:
+            with self._exe_lock:
+                exe = self._exe.get(key)
+                if exe is None:
+                    exe = self._compile(key)
+                    self._exe[key] = exe
+        return exe
+
+    def _warmup_sweep(self):
+        """Compile + run the tick at every ladder bucket and EVERY
+        ordered grow/shrink pair — a demand burst can jump the bucket
+        several rungs at once (1 -> 8), so adjacent pairs alone would
+        leave live-compile holes. The ladder is short (log2 max_slots),
+        so all-pairs stays cheap."""
+        for S in self.ladder:
+            exe = self._get_exe(("tick", S))
+            h, c, rng = D.zero_carries(self.spec, S)
+            out = exe(self._dp, h, c, rng, *self._host_args(S))
+            out[3].block_until_ready()  # host-sync-ok: warmup sweep is pre-traffic by design
+        for src in self.ladder:
+            for dst in self.ladder:
+                if src == dst:
+                    continue
+                exe = self._get_exe(("resize", src, dst))
+                h, c, rng = D.zero_carries(self.spec, src)
+                out = exe(h, c, rng)
+                out[2].block_until_ready()  # host-sync-ok: warmup sweep is pre-traffic by design
+
+    # ---- public API --------------------------------------------------
+
+    def submit(self, prompt: Union[str, Sequence[int]], *,
+               max_new_tokens: Optional[int] = None, greedy: bool = True,
+               temperature: float = 1.0, top_k: int = 0, seed: int = 0,
+               stop: Optional[Union[str, int]] = None
+               ) -> GenerationStream:
+        """Queue one sequence; returns its stream immediately. Raises
+        RuntimeError when the waiting queue is at ``queue_limit`` —
+        FleetRouter admission turns that into a shed upstream."""
+        if self._stop.is_set():
+            raise RuntimeError("generation engine is shut down")
+        if isinstance(prompt, str):
+            ids = self.vocab.encode(prompt)
+        else:
+            ids = [int(t) for t in prompt]
+        if not ids:
+            ids = [self.stop_id if self.stop_id is not None else 0]
+        bad = [t for t in ids if not 0 <= t < self.spec.vocab_size]
+        if bad:
+            raise ValueError(f"prompt ids out of range: {bad[:5]}")
+        stop_id = self.stop_id
+        if isinstance(stop, str):
+            stop_id = self.vocab.stoi.get(stop, stop_id)
+        elif isinstance(stop, int):
+            stop_id = stop
+        req = {"prompt": list(ids), "greedy": bool(greedy),
+               "temperature": float(temperature), "top_k": int(top_k),  # host-sync-ok: request parsing, host scalars
+               "seed": int(seed), "stop_id": stop_id,
+               "max_new_tokens": int(max_new_tokens
+                                     if max_new_tokens is not None
+                                     else self.max_new_tokens)}
+        stream = GenerationStream(req, buffer=self.stream_buffer)
+        slot = _Slot(stream, req["prompt"], req["max_new_tokens"],
+                     stop_id, req["seed"], req["temperature"],
+                     req["top_k"], req["greedy"])
+        with self._cv:
+            if len(self._waiting) >= self.queue_limit:
+                raise RuntimeError("generation queue full")
+            self._waiting.append(slot)
+            self._submitted += 1
+            self._cv.notify()
+        return stream
+
+    def generate(self, prompt, **kw) -> Dict[str, Any]:
+        """Blocking convenience: submit and wait for the result."""
+        timeout = kw.pop("timeout", None)
+        res = self.submit(prompt, **kw).result(timeout=timeout)
+        res["text"] = self.vocab.decode(res["ids"])
+        return res
+
+    def pending_depth(self) -> int:
+        with self._cv:
+            return len(self._waiting) + sum(
+                1 for s in self._slots if s is not None)
+
+    def assert_warm(self):
+        if self._post_warmup_compiles:
+            raise RuntimeError(
+                f"{self._post_warmup_compiles} decode compile(s) after "
+                "warmup — the bucket ladder missed a live shape")
+        if self.watchdog.count() > 0:
+            raise RuntimeError(
+                "recompile watchdog observed signature drift in the "
+                "decode loop")
+
+    def stats(self) -> Dict[str, Any]:
+        tq = self.token_ring.quantiles(_QUANTILES)    # {q: seconds}
+        fq = self.ttft_ring.quantiles(_QUANTILES)
+        with self._cv:
+            active = sum(1 for s in self._slots if s is not None)
+            waiting = len(self._waiting)
+        return {
+            "session": self.session_id,
+            "precision": self.precision,
+            "slots": {"bucket": self._bucket, "max": self.max_slots,
+                      "active": active, "waiting": waiting,
+                      "max_active": self._max_active,
+                      "ladder": list(self.ladder)},
+            "sequences": {"submitted": self._submitted,
+                          "retired": dict(self._outcomes)},
+            "tokens": {"generated": self._tokens_out,
+                       "prefill_ticks": self._prefill_ticks},
+            "latency_ms": {
+                "token": {f"p{int(q * 100)}": v * 1e3
+                          for q, v in tq.items()},
+                "ttft": {f"p{int(q * 100)}": v * 1e3
+                         for q, v in fq.items()}},
+            "stream_errors": self._stream_errors,
+            "recompiles_after_warmup": self._post_warmup_compiles,
+            "warmup_s": round(self.warmup_s, 3),
+            "head_agreement": (self.gate_result.top1_agreement
+                               if self.gate_result else None),
+        }
+
+    def shutdown(self, timeout: float = 5.0):
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        self._thread.join(timeout=timeout)
+        with self._cv:
+            doomed = [s for s in self._slots if s is not None]
+            doomed += self._waiting
+            self._slots = [None] * self.max_slots
+            self._waiting = []
+        for s in doomed:
+            s.stream._fail("generation engine shut down")
+            self._retired(s, "error", count_metrics=False)
+
+    # ---- scheduler ----------------------------------------------------
+
+    def _retired(self, slot: _Slot, outcome: str,
+                 count_metrics: bool = True):
+        self._outcomes[outcome] = self._outcomes.get(outcome, 0) + 1
+        if count_metrics:
+            self._c_seqs.inc(1.0, session=self.session_id,
+                             outcome=outcome)
+
+    def _admit_locked(self):
+        """Pack waiting sequences into free slots, growing the bucket
+        along the ladder first when demand exceeds it. Called under
+        ``_cv``."""
+        active_idx = [i for i, s in enumerate(self._slots)
+                      if s is not None]
+        demand = len(active_idx) + len(self._waiting)
+        if demand > self._bucket and self._bucket < self.max_slots:
+            target = next((s for s in self.ladder
+                           if s >= min(demand, self.max_slots)),
+                          self.ladder[-1])
+            self._resize(target)
+        free = [i for i in range(self._bucket)
+                if self._slots[i] is None]
+        while self._waiting and free:
+            i = free.pop(0)
+            self._slots[i] = self._waiting.pop(0)
+        self._shrink_streak = 0 if self._waiting else self._shrink_streak
+
+    def _maybe_shrink_locked(self):
+        """Drop to the previous ladder bucket after a streak of ticks
+        where every active slot fits in it (hysteresis avoids thrash).
+        Slots are pinned — a sequence never migrates — so we only
+        shrink when the upper rows are empty."""
+        idx = self.ladder.index(self._bucket)
+        if idx == 0 or self._waiting:
+            return
+        prev = self.ladder[idx - 1]
+        if any(self._slots[i] is not None
+               for i in range(prev, self._bucket)):
+            self._shrink_streak = 0
+            return
+        self._shrink_streak += 1
+        if self._shrink_streak >= 16:
+            self._resize(prev)
+            self._shrink_streak = 0
+
+    def _resize(self, target: int):
+        if target == self._bucket:
+            return
+        exe = self._get_exe(("resize", self._bucket, target))
+        self._h, self._c, self._rng = exe(self._h, self._c, self._rng)
+        self._bucket = target
+        self._g_bucket.set(float(target), session=self.session_id)  # host-sync-ok: python int gauge, no device value
+
+    def _loop(self):
+        while not self._stop.is_set():
+            with self._cv:
+                while (not self._stop.is_set()
+                       and not self._waiting
+                       and all(s is None for s in self._slots)):
+                    self._cv.wait(timeout=0.25)
+                if self._stop.is_set():
+                    return
+                self._admit_locked()
+                S = self._bucket
+                slots = list(self._slots[:S])
+                self._g_queue.set(float(len(self._waiting)),  # host-sync-ok: python int gauge, no device value
+                                  session=self.session_id)
+            try:
+                self._tick_once(S, slots)
+            except Exception as e:  # a broken tick must not kill serving
+                log.exception("generation tick failed")
+                with self._cv:
+                    for i, s in enumerate(self._slots):
+                        if s is not None:
+                            s.stream._fail(f"decode tick failed: {e}")
+                            self._retired(s, "error")
+                            self._slots[i] = None
+
+    def _tick_once(self, S: int, slots: List[Optional[_Slot]]):
+        tokens = np.zeros(S, np.int32)
+        reset = np.zeros(S, bool)
+        seeds = np.zeros(S, np.uint32)
+        active = np.zeros(S, bool)
+        temp = np.ones(S, np.float32)
+        topk = np.zeros(S, np.int32)
+        greedy = np.ones(S, bool)
+        n_active = 0
+        for i, s in enumerate(slots):
+            if s is None:
+                continue
+            n_active += 1
+            tokens[i] = s.next_input
+            reset[i] = s.needs_reset
+            seeds[i] = np.uint32(s.seed & 0xFFFFFFFF)
+            active[i] = True
+            temp[i] = s.temperature
+            topk[i] = s.top_k
+            greedy[i] = s.greedy
+        self._max_active = max(self._max_active, n_active)
+        self._g_active.set(float(n_active), session=self.session_id)  # host-sync-ok: python int gauge, no device value
+
+        exe = self._get_exe(("tick", S))
+        self.watchdog.observe(f"gen_tick_{self.precision}_s{S}",
+                              tokens, reset, seeds, active, temp, topk,
+                              greedy)
+        t0 = time.time()
+        self._h, self._c, self._rng, out = exe(
+            self._dp, self._h, self._c, self._rng, tokens, reset, seeds,
+            active, temp, topk, greedy)
+        sampled = np.asarray(out)  # host-sync-ok: streaming egress — the sampled tokens ARE the response payload
+        dt = time.time() - t0
+        self.token_ring.record(dt)
+        now = time.time()
+
+        retire: List[tuple] = []
+        for i, s in enumerate(slots):
+            if s is None:
+                continue
+            s.needs_reset = False
+            if s.ppos < len(s.prompt):       # prefill: force next char
+                s.next_input = s.prompt[s.ppos]
+                s.ppos += 1
+                self._prefill_ticks += 1
+                continue
+            tok = int(sampled[i])
+            s.gen_count += 1
+            s.stream.ids.append(tok)
+            if s.t_first is None:
+                s.t_first = now
+                s.stream.ttft_ms = (now - s.t_join) * 1e3
+                self.ttft_ring.record(now - s.t_join)
+            ok = s.stream._push({"token": tok,
+                                 "text": self.vocab.itos[tok]
+                                 if tok < self.vocab.size else "�",
+                                 "i": s.gen_count - 1})
+            self._tokens_out += 1
+            self._c_tokens.inc(1.0, session=self.session_id)
+            if not ok:
+                self._stream_errors += 1
+                self._c_stream_err.inc(1.0, session=self.session_id)
+                s.stream._fail("stream buffer overflow "
+                               "(consumer too slow)")
+                retire.append((i, s, "error"))
+            elif s.stream._cancelled.is_set():
+                s.stream._finish("cancelled")
+                retire.append((i, s, "cancelled"))
+            elif s.stop_id is not None and tok == s.stop_id:
+                s.stream._finish("stop")
+                retire.append((i, s, "stop"))
+            elif s.gen_count >= s.max_new:
+                s.stream._finish("length")
+                retire.append((i, s, "length"))
+            else:
+                s.next_input = tok
+
+        if self._tokens_out and self._tokens_out % 64 == 0:
+            for q, v in self.token_ring.quantiles(_QUANTILES).items():
+                self._g_token_ms.set(v * 1e3, session=self.session_id,
+                                     quantile=str(q))
+            for q, v in self.ttft_ring.quantiles(_QUANTILES).items():
+                self._g_ttft.set(v * 1e3, session=self.session_id,
+                                 quantile=str(q))
+
+        with self._cv:
+            for i, s, outcome in retire:
+                self._retired(s, outcome)
+                self._slots[i] = None
+            self._maybe_shrink_locked()

@@ -233,6 +233,79 @@ class ModelPool:
         return out
 
 
+class GenerationPool:
+    """Admission-controlled front for one GenerationEngine (decode
+    serving — generation/engine.py). Shares ModelPool's AIMD controller
+    verbatim, but the latency signal is the engine's per-TOKEN ring and
+    the SLO is ``slo_token_ms``: decode sheds when the *token cadence*
+    degrades, not when whole-sequence wall time (which scales with
+    requested length) does. ``pending`` counts sequences from admission
+    until their stream finishes — a long-lived stream holds its
+    admission slot the whole way, so the queue bound caps concurrent
+    sequences, not just the submit burst.
+
+    The int8 accuracy story needs no gate here: the engine itself runs
+    the decode-level quant gate (next-token agreement vs the f32 head)
+    at construction and refuses to build on a miss, so an int8
+    generation pool that exists has already passed."""
+
+    def __init__(self, name: str, router: "FleetRouter", engine,
+                 slo_token_ms: Optional[float] = None):
+        self.name = name
+        self.router = router
+        self.engine = engine
+        self.slo_ms = slo_token_ms
+        self.ring = engine.token_ring   # recorded by the engine per tick
+        self.lock = threading.Lock()
+        self.pending = 0
+        self.shed_fraction = 0.0
+        self.windowed_p99_ms: Optional[float] = None
+        self._last_tick = time.monotonic()
+        self._rand = random.Random()
+
+    # same AIMD + admission body as ModelPool — the fields line up by
+    # construction, and sharing the code keeps the two front doors'
+    # shedding behavior from drifting apart
+    _tick_controller = ModelPool._tick_controller
+    admit = ModelPool.admit
+
+    def submit(self, prompt, **kw):
+        """Admit, then queue on the engine; returns the
+        GenerationStream. An engine-side queue-full becomes a
+        ``ShedError(reason="queue")`` like any other admission refusal.
+        """
+        self.admit()
+        r = self.router
+        try:
+            stream = self.engine.submit(prompt, **kw)
+        except BaseException as e:
+            with self.lock:
+                self.pending -= 1
+                r._g_depth.set(self.pending, model=self.name)
+            if isinstance(e, RuntimeError) and "queue full" in str(e):
+                r._c_shed.inc(1.0, model=self.name, reason="queue")
+                raise ShedError(self.name, "queue", str(e))
+            raise
+
+        def done(_s):
+            with self.lock:
+                self.pending -= 1
+                r._g_depth.set(self.pending, model=self.name)
+        stream.add_done_callback(done)
+        return stream
+
+    def stats(self) -> Dict[str, Any]:
+        with self.lock:
+            out = {
+                "pending": self.pending,
+                "shed_fraction": self.shed_fraction,
+                "windowed_token_p99_ms": self.windowed_p99_ms,
+                "slo_token_ms": self.slo_ms,
+            }
+        out["engine"] = self.engine.stats()
+        return out
+
+
 class FleetRouter:
     """Front door over named ModelPools. Thread-safe."""
 
@@ -255,6 +328,7 @@ class FleetRouter:
         self.max_pending = int(max_pending) if max_pending is not None \
             else int(_env_float("DL4J_FLEET_MAX_PENDING", 256))
         self._pools: Dict[str, ModelPool] = {}
+        self._gen_pools: Dict[str, GenerationPool] = {}
         self._pools_lock = threading.Lock()
         self._shutdown = False
 
@@ -387,6 +461,51 @@ class FleetRouter:
     def output(self, features, model: Optional[str] = None):
         return self.submit(features, model=model).result()
 
+    # ---- generative serving ----------------------------------------------
+    def add_generation_pool(self, name: str, engine, *,
+                            slo_token_ms: Optional[float] = None
+                            ) -> GenerationPool:
+        """Register a GenerationEngine behind the same admission front
+        door as the predict pools (shared ``dl4j_fleet_*`` series, same
+        env knobs). ``slo_token_ms`` arms AIMD shedding over the
+        engine's windowed per-token p99."""
+        with self._pools_lock:
+            if name in self._gen_pools or name in self._pools:
+                raise ValueError(f"pool {name!r} already exists")
+        pool = GenerationPool(name, self, engine,
+                              slo_token_ms=slo_token_ms)
+        with self._pools_lock:
+            self._gen_pools[name] = pool
+        self._g_depth.set(0.0, model=name)
+        self._c_admitted.inc(0.0, model=name)
+        return pool
+
+    def generation_pool(self, name: Optional[str] = None
+                        ) -> GenerationPool:
+        with self._pools_lock:
+            if name is None:
+                if len(self._gen_pools) != 1:
+                    raise ValueError(
+                        "model name required: the router serves "
+                        f"generation pools {sorted(self._gen_pools)}")
+                return next(iter(self._gen_pools.values()))
+            p = self._gen_pools.get(name)
+        if p is None:
+            raise ValueError(f"no generation pool named {name!r}; "
+                             f"have {sorted(self._gen_pools)}")
+        return p
+
+    @property
+    def generation_pools(self) -> Dict[str, GenerationPool]:
+        with self._pools_lock:
+            return dict(self._gen_pools)
+
+    def generate(self, prompt, model: Optional[str] = None, **kw):
+        """Admission-controlled decode submit; returns the stream."""
+        if self._shutdown:
+            raise RuntimeError("FleetRouter is shut down")
+        return self.generation_pool(model).submit(prompt, **kw)
+
     # ---- version lifecycle -----------------------------------------------
     def swap(self, name: str, model, version: str) -> ModelPool:
         """A/B weight swap: build + warm ``version``'s engines, switch
@@ -499,7 +618,7 @@ class FleetRouter:
 
     # ---- introspection ---------------------------------------------------
     def stats(self) -> Dict[str, Any]:
-        return {
+        out = {
             "session": self.session_id,
             "slo_ms": self.slo_ms,
             "max_pending": self.max_pending,
@@ -507,6 +626,11 @@ class FleetRouter:
             "pools": {name: p.stats()
                       for name, p in self.pools.items()},
         }
+        gen = self.generation_pools
+        if gen:
+            out["generation"] = {name: p.stats()
+                                 for name, p in gen.items()}
+        return out
 
     def assert_warm(self):
         """Every engine in every pool (active + standby) holds the
@@ -518,6 +642,8 @@ class FleetRouter:
                     engines += list(pool.standby[1])
             for e in engines:
                 e.assert_warm()
+        for gp in self.generation_pools.values():
+            gp.engine.assert_warm()
 
     # ---- lifecycle -------------------------------------------------------
     def shutdown(self):
@@ -530,6 +656,8 @@ class FleetRouter:
                 pool.standby = None
             for e in engines:
                 e.shutdown()
+        for gp in self.generation_pools.values():
+            gp.engine.shutdown()
 
     def __enter__(self):
         return self

@@ -1,0 +1,121 @@
+"""GenerationModule: the HTTP surface for decode serving.
+
+- ``POST /api/generate``  {"prompt": str|[ids], "max_new_tokens"?,
+  "greedy"?, "temperature"?, "top_k"?, "seed"?, "stop"?, "stream"?,
+  "model"?}
+
+  With ``"stream": true`` (the default) the response is a
+  ``text/event-stream``: one ``data:`` event per sampled token
+  (``{"token": id, "text": ch, "i": n}``) and a terminal
+  ``{"done": true, "reason": ..., "n": ..., "ttft_ms": ...}`` —
+  tokens arrive as they decode, riding ui/server.py's generator-payload
+  streaming. ``"stream": false`` blocks and answers one JSON object.
+
+  Behind a FleetRouter the submit passes admission control; a shed
+  answers **HTTP 503** + Retry-After exactly like the predict route,
+  BEFORE any stream bytes go out. An engine-only module maps its
+  queue-full refusal the same way.
+
+- ``GET /api/generation/stats``  engine snapshot: per-token p50/p99,
+  time-to-first-token, active/max slots, retirement outcomes, stream
+  errors, recompiles-after-warmup (plus admission state when routed).
+
+The ``dl4j_gen_*`` Prometheus family is scraped from the server's
+existing ``/metrics``; this module only adds the JSON/SSE ingress.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List
+
+from deeplearning4j_tpu.ui.modules import Route, UIModule
+
+_RESULT_TIMEOUT_S = 300.0
+
+
+class GenerationModule(UIModule):
+    """Routes for one GenerationEngine, optionally behind a
+    FleetRouter's admission control (pass ``router`` + the pool's
+    ``model`` name)."""
+
+    def __init__(self, engine=None, router=None, model=None):
+        if (engine is None) == (router is None):
+            raise ValueError("pass exactly one of engine= or router=")
+        self.engine = engine
+        self.router = router
+        self.model = model
+
+    def get_routes(self) -> List[Route]:
+        return [
+            Route("POST", "/api/generate", self._generate),
+            Route("GET", "/api/generation/stats", self._stats),
+        ]
+
+    def _submit(self, body):
+        kw = {}
+        for key in ("max_new_tokens", "top_k", "seed"):
+            if key in body:
+                kw[key] = int(body[key])
+        if "temperature" in body:
+            kw["temperature"] = float(body["temperature"])  # host-sync-ok: request parsing, host scalar
+        if "greedy" in body:
+            kw["greedy"] = bool(body["greedy"])
+        if "stop" in body:
+            kw["stop"] = body["stop"]
+        prompt = body.get("prompt", "")
+        if self.router is not None:
+            return self.router.generate(
+                prompt, model=body.get("model", self.model), **kw)
+        return self.engine.submit(prompt, **kw)
+
+    def _generate(self, ctx, query, body):
+        from deeplearning4j_tpu.parallel.fleet import ShedError
+        if not isinstance(body, dict):
+            raise ValueError('expected {"prompt": ...}')
+        try:
+            stream = self._submit(body)
+        except ShedError as e:
+            retry_after = max(1, int(math.ceil(
+                getattr(self.router, "window_s", 1.0))))
+            return ({"error": "shed", "model": e.model,
+                     "reason": e.reason},
+                    {"Retry-After": str(retry_after)}, 503)
+        except RuntimeError as e:
+            if "queue full" in str(e):
+                return ({"error": "shed", "reason": "queue"},
+                        {"Retry-After": "1"}, 503)
+            raise
+        if not body.get("stream", True):
+            res = stream.result(timeout=_RESULT_TIMEOUT_S)
+            vocab = self._vocab()
+            res["text"] = vocab.decode(res["ids"]) if vocab else None
+            return res
+        return self._sse(stream)
+
+    def _vocab(self):
+        if self.engine is not None:
+            return self.engine.vocab
+        try:
+            return self.router.generation_pool(self.model).engine.vocab
+        except Exception:
+            return None
+
+    def _sse(self, stream):
+        """Generator payload for ui/server.py's event-stream path. The
+        server close()s this generator when the client disconnects
+        mid-stream; the finally turns that into a cancel so the engine
+        retires the slot instead of decoding into the void."""
+        def events():
+            try:
+                for ev in stream:
+                    yield ev
+            finally:
+                if not stream.done:
+                    stream.cancel()
+        return events()
+
+    def _stats(self, ctx, query, body):
+        if self.router is not None:
+            return self.router.generation_pool(self.model).stats()
+        return self.engine.stats()

@@ -534,9 +534,16 @@ class _Handler(BaseHTTPRequestHandler):
         ctx = UIModuleContext(storage=self.storage, server=self.server)
         status = 200
         extra_headers = None
+        stream = None
         try:
             out = route.handler(ctx, q, body)
-            if isinstance(out, tuple) and len(out) == 3 \
+            if self._is_stream(out):
+                # generator/iterator payload: stream it as SSE below,
+                # outside this try — once headers go out, a producer
+                # error can't become a 500 JSON anyway
+                stream = out
+                payload = ctype = None
+            elif isinstance(out, tuple) and len(out) == 3 \
                     and isinstance(out[0], dict):
                 # (dict, headers_or_None, status): JSON with an
                 # explicit HTTP status and optional extra headers —
@@ -567,6 +574,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._json({"error": "module route failed: "
                                  f"{type(e).__name__}"}, 500)
             return
+        if stream is not None:
+            self._send_event_stream(stream)
+            return
         if payload is not None:
             self.send_response(status)
             self.send_header("Content-Type", ctype)
@@ -575,6 +585,63 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(payload)
         else:
             self._json(out, status, extra_headers)
+
+    @staticmethod
+    def _is_stream(out) -> bool:
+        """A module route payload that should stream: any iterable that
+        is not one of the fixed return forms (dict / tuple / str /
+        bytes / list). Covers generators and stream objects exposing
+        ``__iter__`` (e.g. GenerationStream)."""
+        return (not isinstance(out, (dict, tuple, list, str, bytes))
+                and (hasattr(out, "__next__") or hasattr(out, "__iter__")))
+
+    def _send_event_stream(self, events):
+        """Stream a module route's generator/iterator payload as
+        Server-Sent Events. The response stays HTTP/1.0 with
+        ``Connection: close`` — no Content-Length, EOF delimits the
+        stream — so long-lived token streams need no chunked-framing
+        change to every other route. Each yielded item becomes one
+        ``data:`` event (dicts are JSON-encoded, strings pass through).
+
+        Drain correctness: this runs inside ``_do_post``, so the
+        server's active_requests counter covers the stream's whole
+        lifetime — a drain() lets in-flight streams finish (PR 11's
+        contract) while the drain gate 503s new ones.
+        """
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        self.close_connection = True
+        try:
+            for ev in events:
+                data = ev if isinstance(ev, str) else json.dumps(ev)
+                for line in data.splitlines() or [""]:
+                    self.wfile.write(b"data: " + line.encode("utf-8")
+                                     + b"\n")
+                self.wfile.write(b"\n")
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # client went away mid-stream; closing the generator below
+            # lets the producer cancel its sequence
+            _log.info("event-stream client disconnected: %s %s",
+                      self.command, self.path)
+        except Exception:
+            _log.exception("event-stream producer failed mid-stream")
+            try:
+                self.wfile.write(b"event: error\ndata: "
+                                 b"{\"error\": \"stream failed\"}\n\n")
+                self.wfile.flush()
+            except OSError:
+                pass
+        finally:
+            close = getattr(events, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    _log.exception("event-stream close() failed")
 
     def _session(self, u) -> Optional[str]:
         q = parse_qs(u.query)
@@ -604,10 +671,12 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         path = urlparse(self.path).path
         if getattr(self.server, "draining", False) \
-                and path == "/api/predict":
+                and path in getattr(self.server, "drain_paths",
+                                    ("/api/predict", "/api/generate")):
             # graceful drain: stop ADMITTING new work; requests already
-            # inside _do_post keep running to completion (tracked by
-            # active_requests, which drain() waits on)
+            # inside _do_post — including long-lived token streams —
+            # keep running to completion (tracked by active_requests,
+            # which drain() waits on)
             self._json({"error": "draining"}, 503,
                        {"Retry-After": "1"})
             return
@@ -790,6 +859,7 @@ class UIServer:
         # can wait for responses to finish SERIALIZING, not just for
         # the engine queue to empty
         self._httpd.draining = False
+        self._httpd.drain_paths = {"/api/predict", "/api/generate"}
         self._httpd.active_requests = 0
         self._httpd.active_lock = threading.Lock()
         self.port = self._httpd.server_address[1]   # resolves port 0
@@ -846,9 +916,11 @@ class UIServer:
         return f"http://127.0.0.1:{self.port}"
 
     def drain(self):
-        """Stop admitting /api/predict requests (they get 503 +
-        Retry-After); everything already in flight keeps running.
-        Idempotent; ``active_requests`` reports what is left."""
+        """Stop admitting ingress requests (``drain_paths``, by default
+        /api/predict and /api/generate — they get 503 + Retry-After);
+        everything already in flight, including long-lived token
+        streams, keeps running. Idempotent; ``active_requests`` reports
+        what is left."""
         if self._httpd is not None:
             self._httpd.draining = True
         return self

@@ -57,4 +57,11 @@ JAX_PLATFORMS=cpu python -m benchmarks.elastic --smoke
 # rejected, a forced degrade is sentinel-rolled-back to bitwise params,
 # and client p99 stays bounded through every swap
 JAX_PLATFORMS=cpu python -m benchmarks.online --smoke
+# generation tier: continuous-batching decode — 16 Poisson-staggered
+# SSE streams through POST /api/generate, every greedy output bitwise-
+# equal to the sequential reference decode with slots reused mid-flight,
+# zero live compiles after warmup (watchdog-asserted), token p99 + TTFT
+# under the CPU bounds, and the pretrained int8 head strictly fewer
+# bytes/token than bf16 within the next-token agreement budget
+JAX_PLATFORMS=cpu python -m benchmarks.generation --smoke
 exec python -m pytest tests/ -q "$@"

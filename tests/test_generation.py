@@ -1,0 +1,372 @@
+"""Generative serving tests (ISSUE 12).
+
+The correctness core: continuous batching must be *invisible* — a
+sequence decoded in a shared slot batch with co-residents joining and
+retiring around it is bitwise-identical to the same sequence decoded
+alone through ``rnn_time_step`` (greedy), and a seeded sampling run
+reproduces exactly. Plus the serving surface: stop/length retirement,
+admission shedding through GenerationPool, SSE streaming + drain over
+the UI server, and the decode-level int8 head gate.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from deeplearning4j_tpu.generation import (
+    GenerationEngine,
+    Vocab,
+    extract_decode_spec,
+    head_bytes_per_token,
+    reference_decode,
+)
+from deeplearning4j_tpu.generation import decode as D
+from deeplearning4j_tpu.observe.registry import MetricsRegistry
+from deeplearning4j_tpu.parallel.fleet import FleetRouter, ShedError
+
+SMALL_VOCAB = 31
+
+
+def _small_model():
+    from deeplearning4j_tpu.zoo.models import TextGenerationLSTM
+    m = TextGenerationLSTM()
+    m.lstm_units = 32
+    m.vocab_size = SMALL_VOCAB
+    m.timesteps = 8
+    return m.init()
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _small_model()
+
+
+@pytest.fixture(scope="module")
+def engine(model):
+    eng = GenerationEngine(model, max_slots=4,
+                           registry=MetricsRegistry(),
+                           session_id="gen-test")
+    yield eng
+    eng.shutdown()
+
+
+# ---- decode parity ----------------------------------------------------
+
+
+def test_greedy_parity_static(engine, model):
+    prompts = [[1, 2, 3], [7, 11, 13, 17], [30]]
+    refs = [reference_decode(model, p, 20) for p in prompts]
+    streams = [engine.submit(p, max_new_tokens=20, greedy=True)
+               for p in prompts]
+    for s, ref in zip(streams, refs):
+        assert s.result(timeout=60)["ids"] == ref
+
+
+def test_greedy_parity_staggered_join_leave(engine, model):
+    import random
+    rng = random.Random(99)
+    cfgs = [([rng.randrange(SMALL_VOCAB)
+              for _ in range(rng.randrange(2, 7))],
+             rng.randrange(10, 30)) for _ in range(8)]
+    refs = [reference_decode(model, p, m) for p, m in cfgs]
+    streams = []
+    for i, (p, m) in enumerate(cfgs):
+        streams.append(engine.submit(p, max_new_tokens=m, greedy=True))
+        if i >= 4:          # first burst fills the 4 slots; the rest
+            time.sleep(0.002)       # join as retirements free slots
+    for i, (s, ref) in enumerate(zip(streams, refs)):
+        assert s.result(timeout=60)["ids"] == ref, f"sequence {i}"
+    assert engine.stats()["slots"]["max_active"] >= 2
+
+
+def test_bucket_jump_no_live_compile(model):
+    """A demand burst jumps the bucket several ladder rungs at once
+    (1 -> 8); the warmup sweep must have covered that resize."""
+    eng = GenerationEngine(model, max_slots=8,
+                           registry=MetricsRegistry(),
+                           session_id="gen-jump")
+    try:
+        streams = [eng.submit([i % SMALL_VOCAB], max_new_tokens=12)
+                   for i in range(8)]
+        for s in streams:
+            s.result(timeout=60)
+        eng.assert_warm()
+    finally:
+        eng.shutdown()
+
+
+def test_seeded_sampling_reproducible(engine):
+    kw = dict(greedy=False, temperature=0.8, top_k=10,
+              max_new_tokens=24)
+    a = engine.generate([3, 1, 4], seed=7, **kw)
+    b = engine.generate([3, 1, 4], seed=7, **kw)
+    c = engine.generate([3, 1, 4], seed=8, **kw)
+    assert a["ids"] == b["ids"]
+    assert a["ids"] != c["ids"]
+
+
+# ---- retirement -------------------------------------------------------
+
+
+def test_stop_token_retirement(engine, model):
+    prompt = [5, 9]
+    free = reference_decode(model, prompt, 30)
+    stop = free[3]      # a token greedy decode actually produces
+    ref = reference_decode(model, prompt, 30, stop_id=stop)
+    res = engine.generate(prompt, max_new_tokens=30, stop=int(stop))
+    assert res["reason"] == "stop"
+    assert res["ids"] == ref
+    assert res["ids"][-1] == stop
+
+
+def test_max_length_retirement(engine):
+    res = engine.generate([2], max_new_tokens=9)
+    assert res["reason"] == "length"
+    assert len(res["ids"]) == 9
+    assert res["ttft_ms"] is not None and res["ttft_ms"] >= 0.0
+
+
+def test_invalid_prompt_rejected(engine):
+    with pytest.raises(ValueError):
+        engine.submit([SMALL_VOCAB + 5])
+
+
+def test_engine_warm_after_traffic(engine):
+    engine.assert_warm()
+    st = engine.stats()
+    assert st["recompiles_after_warmup"] == 0
+    assert st["tokens"]["generated"] > 0
+
+
+# ---- admission: GenerationPool sheds like the predict pools -----------
+
+
+def test_generation_pool_shed(model):
+    eng = GenerationEngine(model, max_slots=1,
+                           registry=MetricsRegistry(),
+                           session_id="gen-shed")
+    fleet = FleetRouter(max_pending=1, registry=MetricsRegistry(),
+                        session_id="gen-shed")
+    fleet.add_generation_pool("gen", eng)
+    try:
+        first = fleet.generate([1], max_new_tokens=200)
+        with pytest.raises(ShedError) as exc:
+            fleet.generate([2], max_new_tokens=5)
+        assert exc.value.reason == "queue"
+        first.cancel()
+        first.result(timeout=60)
+        # the done callback releases the admission slot
+        deadline = time.time() + 10
+        while fleet.generation_pool("gen").pending and \
+                time.time() < deadline:
+            time.sleep(0.01)
+        assert fleet.generate([2], max_new_tokens=5).result(
+            timeout=60)["reason"] == "length"
+        st = fleet.stats()["generation"]["gen"]
+        assert st["pending"] == 0
+        assert st["engine"]["slots"]["max"] == 1
+    finally:
+        fleet.shutdown()
+
+
+# ---- HTTP surface: SSE streaming, stats, drain ------------------------
+
+
+def _read_sse(url, payload, timeout=60.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    events = []
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        ctype = r.headers.get("Content-Type", "")
+        for raw in r:
+            line = raw.decode().strip()
+            if line.startswith("data:"):
+                events.append(json.loads(line[5:].strip()))
+    return ctype, events
+
+
+def test_sse_stream_over_http(model):
+    from deeplearning4j_tpu.ui.generation_module import GenerationModule
+    from deeplearning4j_tpu.ui.server import UIServer
+    from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+    eng = GenerationEngine(model, max_slots=2,
+                           registry=MetricsRegistry(),
+                           session_id="gen-http")
+    fleet = FleetRouter(registry=MetricsRegistry(),
+                        session_id="gen-http")
+    fleet.add_generation_pool("gen", eng)
+    server = UIServer(port=0)
+    server.attach(InMemoryStatsStorage())
+    server.register_module(GenerationModule(router=fleet, model="gen"))
+    server.start()
+    try:
+        prompt = [4, 8, 15]
+        ref = reference_decode(model, prompt, 16)
+        ctype, events = _read_sse(
+            server.url + "/api/generate",
+            {"prompt": prompt, "max_new_tokens": 16, "greedy": True})
+        assert ctype.startswith("text/event-stream")
+        toks = [e["token"] for e in events if "token" in e]
+        assert toks == ref
+        assert events[-1]["done"] and events[-1]["reason"] == "length"
+        # non-streamed mode answers one JSON object
+        req = urllib.request.Request(
+            server.url + "/api/generate",
+            data=json.dumps({"prompt": prompt, "max_new_tokens": 16,
+                             "stream": False}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            res = json.loads(r.read())
+        assert res["ids"] == ref
+        with urllib.request.urlopen(
+                server.url + "/api/generation/stats", timeout=60) as r:
+            st = json.loads(r.read())
+        assert st["engine"]["slots"]["max"] == 2
+    finally:
+        server.stop()
+        fleet.shutdown()
+
+
+from deeplearning4j_tpu.ui.modules import Route, UIModule  # noqa: E402
+
+
+class _GatedStream(UIModule):
+    """UI module whose generator blocks on an event — controls exactly
+    when an in-flight stream finishes, for the drain test."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.started = threading.Event()
+
+    def get_routes(self):
+        return [Route("POST", "/api/generate", self._gen)]
+
+    def _gen(self, ctx, query, body):
+        def events():
+            yield {"token": 1}
+            self.started.set()
+            self.gate.wait(timeout=30)
+            yield {"done": True}
+        return events()
+
+
+def test_drain_lets_inflight_streams_finish():
+    from deeplearning4j_tpu.ui.server import UIServer
+    from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+    mod = _GatedStream()
+    server = UIServer(port=0)
+    server.attach(InMemoryStatsStorage())
+    server.register_module(mod)
+    server.start()
+    try:
+        got = {}
+
+        def client():
+            got["ctype"], got["events"] = _read_sse(
+                server.url + "/api/generate", {"prompt": "x"})
+
+        t = threading.Thread(target=client)
+        t.start()
+        assert mod.started.wait(timeout=30)
+        assert server.active_requests == 1
+        server.drain()      # long-lived stream keeps running...
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(urllib.request.Request(
+                server.url + "/api/generate",
+                data=b'{"prompt": "y"}',
+                headers={"Content-Type": "application/json"}),
+                timeout=30)
+        assert exc.value.code == 503       # ...but new ingress is gated
+        exc.value.read()
+        mod.gate.set()
+        t.join(timeout=30)
+        assert [e for e in got["events"] if "done" in e]
+        deadline = time.time() + 10
+        while server.active_requests and time.time() < deadline:
+            time.sleep(0.01)
+        assert server.active_requests == 0
+    finally:
+        mod.gate.set()
+        server.stop()
+
+
+def test_generic_generator_payload_streams():
+    """Any module route returning a generator rides the event-stream
+    path — dicts JSON-encoded, strings passed through."""
+    from deeplearning4j_tpu.ui.server import UIServer
+    from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+    class Mod(UIModule):
+        def get_routes(self):
+            return [Route("POST", "/api/things", self._go)]
+
+        def _go(self, ctx, query, body):
+            return iter([{"a": 1}, {"b": 2}])
+
+    server = UIServer(port=0)
+    server.attach(InMemoryStatsStorage())
+    server.register_module(Mod())
+    server.start()
+    try:
+        ctype, events = _read_sse(server.url + "/api/things", {})
+        assert ctype.startswith("text/event-stream")
+        assert events == [{"a": 1}, {"b": 2}]
+    finally:
+        server.stop()
+
+
+# ---- int8 head gate ----------------------------------------------------
+
+
+def test_int8_gate_mechanism(model):
+    from deeplearning4j_tpu.evaluation.quant_gate import QuantGateError
+    spec = extract_decode_spec(model)
+    probe = list(range(10))
+    x_scale, result = D.int8_head_gate(model, spec, probe,
+                                       top1_budget=1.0)
+    assert x_scale > 0.0
+    assert result.passed
+    assert 0.0 <= result.top1_agreement <= 1.0
+    with pytest.raises(QuantGateError):
+        # impossible budget: the gate must refuse, not clamp
+        D.int8_head_gate(model, spec, probe, top1_budget=-0.1)
+
+
+def test_int8_engine_decodes(model):
+    eng = GenerationEngine(model, max_slots=2, precision="int8",
+                           int8_budget=1.0,
+                           registry=MetricsRegistry(),
+                           session_id="gen-int8")
+    try:
+        res = eng.generate([1, 2], max_new_tokens=12)
+        assert len(res["ids"]) == 12
+        assert eng.stats()["head_agreement"] is not None
+        eng.assert_warm()
+    finally:
+        eng.shutdown()
+
+
+def test_head_bytes_per_token_ordering(model):
+    spec = extract_decode_spec(model)
+    h = spec.hidden_sizes[-1]
+    f32 = head_bytes_per_token(spec, h, "f32")
+    bf16 = head_bytes_per_token(spec, h, "bf16")
+    int8 = head_bytes_per_token(spec, h, "int8")
+    assert int8 < bf16 < f32
+
+
+# ---- vocab -------------------------------------------------------------
+
+
+def test_vocab_identity_and_committed():
+    v = Vocab.identity(5)
+    assert v.decode([0, 4]) == "��"
+    assert v.encode("ab") == [0, 0]
+    committed = Vocab.load()
+    text = "the quick fox"
+    assert committed.decode(committed.encode(text)) == text

@@ -55,6 +55,15 @@ HOT_PATHS = (
     # between-steps snapshot copies, the stream serde boundary and the
     # scoring result fetch (pragma'd at each site)
     "deeplearning4j_tpu/online",
+    # the decode tick loop: the (h, c) carry and PRNG state must stay
+    # device-resident across ticks — the only legitimate fetches are
+    # the sampled-tokens egress (the streamed payload itself), the
+    # pre-traffic warmup sweep, and the init-time int8 calibration
+    # probe (each pragma'd in place)
+    "deeplearning4j_tpu/generation",
+    # its HTTP ingress: SSE serialization is a host boundary like the
+    # predict module's request decode
+    "deeplearning4j_tpu/ui/generation_module.py",
 )
 
 PATTERNS = (
