@@ -8,7 +8,12 @@ Subcommands (each prints one JSON line):
   bert_finetune   — imported-BERT fine-tune tokens/s (grafted head)
   inception_train — imported-InceptionV3 fine-tune img/s (299x299)
   word2vec   — SGNS + HS tokens/s at 100k vocab (corpus-shaped workload)
+               [--pairgen=auto|numpy|legacy selects the producer]
   lstm       — TextGenerationLSTM train tokens/s (2xLSTM-512; [f32|bf16])
+  doc2vec_producer — DBOW host pair-generation rate, dispatch no-op'd;
+               --native-ab [--smoke] instead runs the native-vs-fallback
+               A/B gate (native >= fallback tokens/s AND bitwise-equal
+               dispatch streams; exits 1 on violation)
 
 Run: python benchmarks/baseline_suite.py <subcommand>
 """
@@ -462,6 +467,10 @@ def word2vec():
     from deeplearning4j_tpu.nlp.word2vec import Word2Vec
 
     v, n_tokens = 100_000, 3_000_000
+    pairgen = "auto"
+    for a in sys.argv[2:]:
+        if a.startswith("--pairgen="):
+            pairgen = a.split("=", 1)[1]
     rng = np.random.default_rng(0)
     # zipf-ish draw over a 100k vocab, chunked into 40-token sentences
     freq = 1.0 / np.arange(1, v + 1) ** 1.05
@@ -481,7 +490,8 @@ def word2vec():
         for _trial in range(2):
             model = Word2Vec(layer_size=128, window_size=5, negative=5,
                              min_word_frequency=1, epochs=1,
-                             batch_size=65536, seed=3, **kw)
+                             batch_size=65536, seed=3, pairgen=pairgen,
+                             **kw)
             model.build_vocab(seqs)
             t0 = time.perf_counter()
             model.fit(seqs)
@@ -506,6 +516,7 @@ def word2vec():
             "pipeline_value": round(n_tokens / pipe_times[1], 1),
             "unit": "tokens/sec (warm, device-drained; pipeline_value ="
                     " fit-return rate, the non-tunnel bound)",
+            "pairgen": pairgen,
             "vocab": int(model.vocab.num_words())}))
 
 
@@ -514,14 +525,25 @@ def doc2vec_producer():
     tokens/s fit-return, "per-doc host pairgen bound") at the r5
     geometry — 20k docs × 100 tokens, 50k vocab. Device dispatch is
     no-op'd so both numbers isolate the HOST producer: the round-6
-    corpus-level walk (_window_slabs + per-slot label gathers) vs the
-    r5 per-doc loop it replaced (inlined here as the baseline)."""
+    corpus-level walk (_window_slabs + per-slot label gathers,
+    ``pairgen="legacy"`` pinned for metric continuity) vs the r5
+    per-doc loop it replaced (inlined here as the baseline).
+
+    ``--native-ab`` runs the round-11 CI gate instead: interleaved
+    native-vs-numpy A/B of the FUSED producer (nlp/pairgen.py), failing
+    (exit 1) unless native >= fallback tokens/s AND both arms hand the
+    device a bitwise-identical dispatch stream (sha256 over every prep
+    array). ``--smoke`` shrinks the geometry for the runtests.sh tier."""
     from deeplearning4j_tpu.nlp import skipgram as sk
     from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
     from deeplearning4j_tpu.nlp.sentence_iterators import LabelledDocument
     from deeplearning4j_tpu.nlp.sequence_vectors import _PairStream
 
-    v, n_docs, doc_len = 50_000, 20_000, 100
+    native_ab = "--native-ab" in sys.argv[2:]
+    if "--smoke" in sys.argv[2:]:
+        v, n_docs, doc_len = 5_000, 2_000, 60
+    else:
+        v, n_docs, doc_len = 50_000, 20_000, 100
     rng = np.random.default_rng(0)
     freq = 1.0 / np.arange(1, v + 1) ** 1.05
     freq /= freq.sum()
@@ -553,18 +575,26 @@ def doc2vec_producer():
                 stream.seen += n
         stream.finish()
 
-    out = {}
-    for label in ("corpus_level", "per_doc_r5"):
+    def make_pv(pairgen):
         pv = ParagraphVectors(dm=False, layer_size=128, window_size=5,
                               negative=5, min_word_frequency=1, epochs=1,
                               batch_size=65536, seed=3,
-                              overlap_pairgen=False)
+                              overlap_pairgen=False, pairgen=pairgen)
         tokenized = [(d.content.split(), d.labels) for d in docs]
         pv._label_set = {lb for _t, lbs in tokenized for lb in lbs}
         pv.build_vocab([t for t, _ in tokenized],
                        special_tokens=sorted(pv._label_set))
         pv._init_tables()
         pv._dispatch_chunks = lambda prep: None   # host producer only
+        return pv, tokenized
+
+    if native_ab:
+        _doc2vec_native_ab(make_pv, n_tokens)
+        return
+
+    out = {}
+    for label in ("corpus_level", "per_doc_r5"):
+        pv, tokenized = make_pv("legacy")
         total = max(1, n_tokens * 2)
         best = np.inf
         for _trial in range(2):
@@ -584,6 +614,53 @@ def doc2vec_producer():
         "speedup": round(out["corpus_level"] / out["per_doc_r5"], 2),
         "unit": "tokens/sec (host pair generation only, dispatch "
                 "no-op'd; 20k docs x 100 tokens, 50k vocab)"}))
+
+
+def _doc2vec_native_ab(make_pv, n_tokens):
+    """The --native-ab gate body: bitwise stream equality (one hashed
+    pass per arm) then interleaved best-of-2 timing with the dispatch
+    no-op'd. Skips cleanly (exit 0) when the native library is absent —
+    runtests.sh runs this tier only after a successful build, but a
+    toolchain-less checkout must still pass the suite."""
+    import hashlib
+    from deeplearning4j_tpu.utils import native as native_lib
+
+    if not native_lib.pairgen_available():
+        print(json.dumps({"metric": "doc2vec_producer_native_ab",
+                          "skipped": "native pairgen unavailable"}))
+        return
+    total = max(1, n_tokens * 2)
+    arms = {}
+    for pairgen in ("auto", "numpy"):
+        pv, tokenized = make_pv(pairgen)
+        h = hashlib.sha256()
+
+        def hash_sink(prep, _h=h):
+            for a in prep[1:]:
+                _h.update(np.ascontiguousarray(a).tobytes())
+        pv._dispatch_chunks = hash_sink
+        pv._fit_fast_dbow(tokenized, total)
+        pv._dispatch_chunks = lambda prep: None
+        arms[pairgen] = (pv, tokenized, h.hexdigest())
+    best = {p: np.inf for p in arms}
+    for _trial in range(2):              # interleaved A/B
+        for p, (pv, tokenized, _hx) in arms.items():
+            t0 = time.perf_counter()
+            pv._fit_fast_dbow(tokenized, total)
+            best[p] = min(best[p], time.perf_counter() - t0)
+    rate = {p: n_tokens / best[p] for p in best}
+    bitwise_equal = arms["auto"][2] == arms["numpy"][2]
+    ok = bitwise_equal and rate["auto"] >= rate["numpy"]
+    print(json.dumps({
+        "metric": "doc2vec_producer_native_ab",
+        "native_tokens_per_sec": round(rate["auto"], 1),
+        "fallback_tokens_per_sec": round(rate["numpy"], 1),
+        "speedup": round(rate["auto"] / rate["numpy"], 2),
+        "bitwise_equal": bitwise_equal,
+        "ok": ok,
+        "unit": "tokens/sec (fused producer, dispatch no-op'd)"}))
+    if not ok:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

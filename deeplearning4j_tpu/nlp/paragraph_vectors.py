@@ -135,28 +135,78 @@ class ParagraphVectors(Word2Vec):
             extras.append(lab[seq_all])
         extras = tuple(extras)
 
-        def produce(sink):
-            stream = _PairStream(self, chunk, total, sink=sink)
-            for ids, lo, hi, grid, valid, labs in self._window_slabs(
-                    ids_all, seq_all, extras=extras):
-                ids_slab = ids[lo:hi]
-                for lab in labs:
-                    lm = lab >= 0
-                    # per-doc accounting advanced n tokens per label
-                    # slot; spread the same progress over these pairs.
-                    # All-labeled slabs (the common single-label-per-doc
-                    # corpus) skip the two boolean gathers.
-                    if lm.all():
-                        stream.push(lab, ids_slab, tokens=len(lab))
+        if self.pairgen != "legacy":
+            from deeplearning4j_tpu.nlp import pairgen as pg
+            walker = pg.CorpusWalker(
+                self, ids_all, seq_all, extras=extras,
+                force_numpy=self.pairgen == "numpy")
+            n_neg = self._fused_n_neg(chunk)
+
+            def produce(sink):
+                stream = _PairStream(self, chunk, total, sink=sink,
+                                     n_neg=n_neg)
+                for ep in range(self.epochs):
+                    view = walker.epoch(ep)
+                    # one global pair counter per epoch, advanced in
+                    # emission order: per slab the label rows (slot by
+                    # slot), then the word-window pairs — so every pair
+                    # owns a unique NEG-stream counter range
+                    pair_base = 0
+                    bounds = (view.slab_bounds() if view.n >= 2
+                              else [(0, view.n)])
+                    for lo, hi in bounds:
+                        ids_slab = view.ids[lo:hi]
+                        for lab in view.extras or ():
+                            lab_s = lab[lo:hi]
+                            lm = lab_s >= 0
+                            if lm.all():
+                                cen, ctx, tk = lab_s, ids_slab, \
+                                    len(lab_s)
+                            else:
+                                cen, ctx, tk = lab_s[lm], \
+                                    ids_slab[lm], int(lm.sum())
+                            negs = (view.negatives(ctx, n_neg,
+                                                   pair_base)
+                                    if n_neg and len(ctx) else None)
+                            pair_base += len(cen)
+                            stream.push(cen, ctx, tokens=tk,
+                                        negs=negs)
+                        if view.n >= 2:
+                            c, x, negs = view.walk(lo, hi,
+                                                   n_neg=n_neg,
+                                                   pair_base=pair_base)
+                            pair_base += len(c)
+                            stream.push(c, x, tokens=hi - lo,
+                                        negs=negs)
+                        else:
+                            stream.seen += hi - lo
+                stream.finish()
+        else:
+            def produce(sink):
+                stream = _PairStream(self, chunk, total, sink=sink)
+                for ids, lo, hi, grid, valid, labs in \
+                        self._window_slabs(ids_all, seq_all,
+                                           extras=extras):
+                    ids_slab = ids[lo:hi]
+                    for lab in labs:
+                        lm = lab >= 0
+                        # per-doc accounting advanced n tokens per label
+                        # slot; spread the same progress over these
+                        # pairs. All-labeled slabs (the common
+                        # single-label-per-doc corpus) skip the two
+                        # boolean gathers.
+                        if lm.all():
+                            stream.push(lab, ids_slab, tokens=len(lab))
+                        else:
+                            stream.push(lab[lm], ids_slab[lm],
+                                        tokens=int(lm.sum()))
+                    if valid is not None:
+                        stream.push(
+                            np.repeat(ids_slab, valid.sum(axis=1)),
+                            ids[grid[valid]], tokens=hi - lo)
                     else:
-                        stream.push(lab[lm], ids_slab[lm],
-                                    tokens=int(lm.sum()))
-                if valid is not None:
-                    stream.push(np.repeat(ids_slab, valid.sum(axis=1)),
-                                ids[grid[valid]], tokens=hi - lo)
-                else:
-                    stream.seen += hi - lo
-            stream.finish()
+                        stream.seen += hi - lo
+                stream.finish()
 
         if self.overlap_pairgen:
             self._run_overlapped(produce)
@@ -221,7 +271,7 @@ class ParagraphVectors(Word2Vec):
         vec = jnp.array(((rng.random(self.layer_size) - 0.5)
                          / self.layer_size).astype(np.float32))
         if not idxs:
-            return np.asarray(vec)
+            return np.asarray(vec)  # host-sync-ok: user egress
         k = self._k()
         # pad rows to a power-of-two bucket so infer_step compiles once
         # per bucket, not once per distinct text length
@@ -243,13 +293,13 @@ class ParagraphVectors(Word2Vec):
             vec = sk.infer_step(vec, self.syn1, jnp.asarray(targets),
                                 jnp.asarray(labels), jnp.asarray(mask),
                                 jnp.float32(lr))
-        return np.asarray(vec)
+        return np.asarray(vec)  # host-sync-ok: user-facing egress
 
     def similarity_to_label(self, text: str, label: str) -> float:
         v = self.infer_vector(text)
         lv = self.get_label_vector(label)
         den = np.linalg.norm(v) * np.linalg.norm(lv)
-        return float(v @ lv / den) if den else 0.0
+        return float(v @ lv / den) if den else 0.0  # host-sync-ok: host numpy
 
     def predict(self, text: str) -> str:
         """Nearest label for unseen text (reference:
@@ -259,7 +309,7 @@ class ParagraphVectors(Word2Vec):
         for lb in self.labels():
             lv = self.get_label_vector(lb)
             den = np.linalg.norm(v) * np.linalg.norm(lv)
-            s = float(v @ lv / den) if den else 0.0
+            s = float(v @ lv / den) if den else 0.0  # host-sync-ok: host numpy
             if s > best_sim:
                 best, best_sim = lb, s
         return best

@@ -106,3 +106,78 @@ class SentenceLabelledIterator(LabelAwareIterator):
     def __iter__(self) -> Iterator[LabelledDocument]:
         for i, s in enumerate(self._sentences):
             yield LabelledDocument(s, [f"{self._prefix}{i}"])
+
+
+#: end-of-stream marker frame for broker-fed sentence topics
+SENTENCE_EOS = b""
+
+
+def publish_sentences(transport, sentences: Iterable[str],
+                      topic: str = "sentences", *,
+                      eos: bool = True) -> int:
+    """Feed a sentence stream into a broker topic, one UTF-8 frame per
+    sentence; ``eos=True`` appends the empty end-of-stream frame so a
+    ``StreamingSentenceIterator`` terminates instead of idling out.
+    Returns the number of sentences published."""
+    n = 0
+    for s in sentences:
+        s = s.strip()
+        if not s:
+            continue
+        transport.publish(topic, s.encode("utf-8"))
+        n += 1
+    if eos:
+        transport.publish(topic, SENTENCE_EOS)
+    return n
+
+
+class StreamingSentenceIterator(SentenceIterator):
+    """Broker-backed unbounded sentence stream (streaming/broker.py):
+    one UTF-8 frame per sentence, over any Transport — InProcess for
+    tests, TcpTransport across processes (the DataVec-streaming shape,
+    SURVEY §2.11). Iteration ends on the empty end-of-stream frame, a
+    ``max_sentences`` cap, a set ``stop_event``, or ``idle_timeout_s``
+    with nothing arriving.
+
+    The stream is unbounded and consume-once: ``reset()`` is a no-op,
+    so this iterator feeds windowed consumers (``Word2Vec.fit_stream``)
+    or a ``CorpusShardWriter`` spool — not multi-pass ``fit``."""
+
+    def __init__(self, transport, topic: str = "sentences", *,
+                 poll_timeout_s: float = 0.2,
+                 idle_timeout_s: Optional[float] = None,
+                 max_sentences: Optional[int] = None,
+                 stop_event=None):
+        self.transport = transport
+        self.topic = topic
+        self.poll_timeout_s = float(poll_timeout_s)
+        self.idle_timeout_s = idle_timeout_s
+        self.max_sentences = max_sentences
+        self.stop_event = stop_event
+        self.consumed = 0
+
+    def __iter__(self) -> Iterator[str]:
+        import time
+        idle = 0.0
+        while True:
+            if self.stop_event is not None and self.stop_event.is_set():
+                return
+            if (self.max_sentences is not None
+                    and self.consumed >= self.max_sentences):
+                return
+            t0 = time.monotonic()
+            payload = self.transport.poll(self.topic,
+                                          self.poll_timeout_s)
+            if payload is None:
+                idle += time.monotonic() - t0
+                if (self.idle_timeout_s is not None
+                        and idle >= self.idle_timeout_s):
+                    return
+                continue
+            idle = 0.0
+            if payload == SENTENCE_EOS:
+                return
+            s = payload.decode("utf-8", errors="replace").strip()
+            if s:
+                self.consumed += 1
+                yield s

@@ -54,14 +54,28 @@ class _PairStream:
     DEPTH = 8
 
     def __init__(self, model, chunk: int, total_words: int,
-                 depth: int = DEPTH, sink=None):
+                 depth: int = DEPTH, sink=None, n_neg: int = 0):
         self.m = model
         self.chunk = chunk
         self.depth = depth
         self.total = total_words
         self.seen = 0
         self.cen = np.zeros((depth, chunk), np.int32)
-        self.ctx = np.zeros((depth, chunk), np.int32)
+        # n_neg > 0: the fused producers (nlp/pairgen.py) push their
+        # stream-drawn per-pair negatives alongside the pairs. They are
+        # buffered interleaved in the device-shaped (1 + n_neg) target
+        # rows (context in column 0), so _flush forwards ONE copy
+        # instead of re-assembling the rows — and skips its own
+        # draw_negatives pass.
+        self.n_neg = n_neg
+        if n_neg > 0:
+            self.tgt = np.zeros((depth, chunk, 1 + n_neg), np.int32)
+            self.ctx = self.tgt[..., 0]
+            self.neg = self.tgt[..., 1:]
+        else:
+            self.tgt = None
+            self.ctx = np.zeros((depth, chunk), np.int32)
+            self.neg = None
         self.nv = np.zeros(depth, np.int32)
         self.lrs = np.zeros(depth, np.float32)
         self.d = 0          # chunks filled
@@ -76,13 +90,15 @@ class _PairStream:
             model._ensure_hs_matrices()
 
     def push(self, centers: np.ndarray, contexts: np.ndarray,
-             tokens: float = 0.0):
+             tokens: float = 0.0, negs: np.ndarray = None):
         """``tokens`` spreads that many corpus tokens' worth of
         lr-anneal progress evenly over these pairs, so producers that
         batch many sequences per push (the round-4 slab path) keep the
         same smooth decay the per-sequence producer had — advancing
         ``seen`` up front would snap small corpora straight to
-        min_learning_rate (code-review r4)."""
+        min_learning_rate (code-review r4). ``negs``: per-pair
+        (n, n_neg) fused negative draws (requires n_neg at
+        construction)."""
         if len(centers) == 0:
             self.seen += tokens
             return
@@ -95,6 +111,9 @@ class _PairStream:
                 centers[p:p + take]
             self.ctx[self.d, self.fill:self.fill + take] = \
                 contexts[p:p + take]
+            if negs is not None:
+                self.neg[self.d, self.fill:self.fill + take] = \
+                    negs[p:p + take]
             self.fill += take
             p += take
             if self.fill == self.chunk:
@@ -136,12 +155,19 @@ class _PairStream:
                     self.nv.copy(), self.lrs.copy(), negs)
         else:
             k = 1 + m.negative
-            tgt = np.zeros((self.depth, self.chunk, k), np.int32)
-            tgt[..., 0] = self.ctx
-            flat = tgt.reshape(-1, k)
-            flat[:, 1:] = sk.draw_negatives(
-                m._rng, m._table, flat[:, 0:1], k - 1,
-                m.vocab.num_words())
+            if self.n_neg:
+                # fused producers already drew per-pair negatives on
+                # their counter streams and pushed them interleaved
+                # into self.tgt; rows past nv are inert (stale but
+                # always-valid indices under the nv mask)
+                tgt = self.tgt.copy()
+            else:
+                tgt = np.zeros((self.depth, self.chunk, k), np.int32)
+                tgt[..., 0] = self.ctx
+                flat = tgt.reshape(-1, k)
+                flat[:, 1:] = sk.draw_negatives(
+                    m._rng, m._table, flat[:, 0:1], k - 1,
+                    m.vocab.num_words())
             prep = ("perpair", self.cen.copy(), tgt,
                     self.nv.copy(), self.lrs.copy())
         self.d = 0
@@ -169,7 +195,8 @@ class SequenceVectors:
                  use_cbow: bool = False,
                  device_pair_generation: bool = False,
                  shared_negatives: bool = True,
-                 overlap_pairgen: bool = True):
+                 overlap_pairgen: bool = True,
+                 pairgen: str = "auto"):
         self.layer_size = layer_size
         self.window_size = window_size
         self.min_word_frequency = min_word_frequency
@@ -202,6 +229,20 @@ class SequenceVectors:
         # while the device trains on N. Identical math (same rng call
         # order); False restores the strictly serial loop.
         self.overlap_pairgen = overlap_pairgen
+        # Host pair-generation backend (PERF r11 / ROADMAP #3):
+        #   "auto"   — the fused subsample+walk+negatives pass
+        #              (native/dl4j_native.cpp when built, else its
+        #              bitwise-identical numpy fallback)
+        #   "numpy"  — the fused pass, fallback pinned (the A/B bench's
+        #              reference arm)
+        #   "legacy" — the r6 separate-stage numpy producer
+        # The fused backends own a counter-based splitmix64 stream
+        # seeded off ``seed`` (nlp/pairgen.py), so they are seeded-
+        # reproducible but not pair-for-pair identical to "legacy".
+        if pairgen not in ("auto", "numpy", "legacy"):
+            raise ValueError(f"pairgen must be auto|numpy|legacy, "
+                             f"got {pairgen!r}")
+        self.pairgen = pairgen
 
         self.vocab: Optional[VocabCache] = None
         self.syn0: Optional[jax.Array] = None
@@ -347,7 +388,9 @@ class SequenceVectors:
         S = int(np.clip(est_rows // 64, 4, budget_rows))
         buf = np.zeros((S, L), np.int32)
         lens = np.zeros(S, np.int32)
-        table_dev = jnp.asarray(np.asarray(self._table, np.int32))
+        # host table -> device, once per fit
+        table_dev = jnp.asarray(np.asarray(  # host-sync-ok: one-time
+            self._table, np.int32))
         key = jax.random.PRNGKey(self.seed ^ 0x5EED)
         fill = 0
         seen = 0
@@ -373,7 +416,8 @@ class SequenceVectors:
 
         for _epoch in range(self.epochs):
             for seq in seqs:
-                idxs = np.asarray(self._indices(seq), np.int32)
+                idxs = np.asarray(  # host-sync-ok: host token encode
+                    self._indices(seq), np.int32)
                 seen += len(idxs)
                 for lo in range(0, len(idxs), L):
                     piece = idxs[lo:lo + L]
@@ -585,6 +629,18 @@ class SequenceVectors:
                 else:
                     yield ids, lo, hi, grid, valid
 
+    def _fused_n_neg(self, chunk: int) -> int:
+        """Per-pair negative count the FUSED producers draw on their
+        counter streams — 0 when the flush-time path owns negatives
+        (HS has none; the shared-negatives mode keeps its grouped
+        ``_rng`` draws, which turn negative work into MXU matmuls)."""
+        if self.use_hs or self.negative <= 0:
+            return 0
+        if getattr(self, "shared_negatives", False) \
+                and chunk % sk.SHARED_NEG_GROUP == 0:
+            return 0
+        return self.negative
+
     def _fit_fast_sgns(self, seqs, total_words: int):
         """Whole-corpus vectorized skip-gram (negative sampling OR
         hierarchical softmax): ONE vocab-lookup pass flattens the corpus
@@ -594,21 +650,49 @@ class SequenceVectors:
         table gather per chunk, Huffman paths are gathered on device
         from precomputed matrices; each superchunk is a single donated
         scanned device step — the TPU-shaped version of the reference's
-        AggregateSkipGram batching (SkipGram.java:176-186)."""
+        AggregateSkipGram batching (SkipGram.java:176-186).
+
+        ``pairgen != "legacy"`` swaps the producer for the fused
+        subsample+walk+negatives pass (nlp/pairgen.py, native when
+        built) — same _PairStream consumer, same anneal accounting."""
         W = self.window_size
         chunk = self._pair_chunk_size(total_words * (W + 1))
         ids_all, seq_all = self._encode_corpus_flat(seqs)
 
-        def produce(sink):
-            stream = _PairStream(self, chunk, total_words, sink=sink)
-            for ids, lo, hi, grid, valid in self._window_slabs(
-                    ids_all, seq_all):
-                if valid is None:
-                    stream.seen += hi - lo
-                    continue
-                centers = np.repeat(ids[lo:hi], valid.sum(axis=1))
-                stream.push(centers, ids[grid[valid]], tokens=hi - lo)
-            stream.finish()
+        if self.pairgen != "legacy":
+            from deeplearning4j_tpu.nlp import pairgen as pg
+            walker = pg.CorpusWalker(
+                self, ids_all, seq_all,
+                force_numpy=self.pairgen == "numpy")
+            n_neg = self._fused_n_neg(chunk)
+
+            def produce(sink):
+                stream = _PairStream(self, chunk, total_words,
+                                     sink=sink, n_neg=n_neg)
+                for ep in range(self.epochs):
+                    view = walker.epoch(ep)
+                    if view.n < 2:
+                        stream.seen += view.n
+                        continue
+                    pair_base = 0       # NEG streams are per-epoch
+                    for lo, hi in view.slab_bounds():
+                        c, x, negs = view.walk(lo, hi, n_neg=n_neg,
+                                               pair_base=pair_base)
+                        pair_base += len(c)
+                        stream.push(c, x, tokens=hi - lo, negs=negs)
+                stream.finish()
+        else:
+            def produce(sink):
+                stream = _PairStream(self, chunk, total_words, sink=sink)
+                for ids, lo, hi, grid, valid in self._window_slabs(
+                        ids_all, seq_all):
+                    if valid is None:
+                        stream.seen += hi - lo
+                        continue
+                    centers = np.repeat(ids[lo:hi], valid.sum(axis=1))
+                    stream.push(centers, ids[grid[valid]],
+                                tokens=hi - lo)
+                stream.finish()
 
         if self.overlap_pairgen:
             self._run_overlapped(produce)
@@ -692,7 +776,7 @@ class SequenceVectors:
     # ---- lookup API (reference: WordVectors interface) -------------------
     @property
     def word_vectors_matrix(self) -> np.ndarray:
-        return np.asarray(self.syn0)
+        return np.asarray(self.syn0)  # host-sync-ok: user-facing egress
 
     def has_word(self, word: str) -> bool:
         return self.vocab is not None and self.vocab.contains_word(word)
@@ -701,14 +785,14 @@ class SequenceVectors:
         idx = self.vocab.index_of(word)
         if idx < 0:
             raise KeyError(word)
-        return np.asarray(self.syn0[idx])
+        return np.asarray(self.syn0[idx])  # host-sync-ok: user egress
 
     def similarity(self, a: str, b: str) -> float:
         va, vb = self.get_word_vector(a), self.get_word_vector(b)
         na, nb = np.linalg.norm(va), np.linalg.norm(vb)
         if na == 0 or nb == 0:
             return 0.0
-        return float(va @ vb / (na * nb))
+        return float(va @ vb / (na * nb))  # host-sync-ok: host numpy
 
     def words_nearest(self, word, top_n: int = 10) -> List[str]:
         """Cosine top-k on device (reference: wordsNearest via
@@ -717,12 +801,14 @@ class SequenceVectors:
             v = jnp.asarray(self.get_word_vector(word))
             exclude = {self.vocab.index_of(word)}
         else:
-            v = jnp.asarray(np.asarray(word, np.float32))
+            v = jnp.asarray(np.asarray(  # host-sync-ok: caller vec
+                word, np.float32))
             exclude = set()
         m = self.syn0 / jnp.maximum(
             jnp.linalg.norm(self.syn0, axis=1, keepdims=True), 1e-9)
         sims = m @ (v / jnp.maximum(jnp.linalg.norm(v), 1e-9))
-        order = np.asarray(jnp.argsort(-sims))
+        order = np.asarray(  # host-sync-ok: user-facing top-k egress
+            jnp.argsort(-sims))
         out = []
         for idx in order:
             if int(idx) in exclude:

@@ -48,6 +48,42 @@ class Word2Vec(SequenceVectors):
                                 Iterable[Sequence[str]]]):
         return super().fit(self._tokenize(corpus))
 
+    def fit_stream(self, sentences: Iterable[str], *,
+                   window_sentences: int = 1000,
+                   max_windows: Optional[int] = None,
+                   on_window=None) -> "Word2Vec":
+        """Train from an UNBOUNDED sentence stream (a
+        StreamingSentenceIterator or a follow-mode
+        CorpusDataSetIterator) in windows of ``window_sentences``:
+
+        - the FIRST window builds the vocab, which is then fixed —
+          stable syn0/syn1 geometry means downstream consumers
+          (OnlineServing promotion) never see a shape change, so
+          refreshed embeddings hot-swap with zero recompiles; later
+          out-of-vocab tokens are dropped like any sub-min-frequency
+          word
+        - every window then runs one full ``fit`` pass over its
+          sentences (``epochs`` per window, lr re-annealing per window
+          — the streaming analog of restarting the linear decay each
+          corpus revision)
+        - ``on_window(model, index, n_sentences)`` fires after each
+          window — the hot-promotion hook
+
+        Consumes until the stream ends (EOS / idle timeout / stream
+        cap) or ``max_windows`` windows. Returns self."""
+        import itertools
+        it = iter(sentences)
+        wi = 0
+        while max_windows is None or wi < max_windows:
+            batch = list(itertools.islice(it, window_sentences))
+            if not batch:
+                break
+            self.fit(batch)
+            wi += 1
+            if on_window is not None:
+                on_window(self, wi - 1, len(batch))
+        return self
+
     def build_vocab(self, corpus, special_tokens: Iterable[str] = ()):
         return super().build_vocab(self._tokenize(corpus),
                                    special_tokens=special_tokens)
@@ -156,6 +192,13 @@ class Word2Vec(SequenceVectors):
             self._ensure_hs_matrices()
         table = self._table
         n_words = self.vocab.num_words()
+        # fused pairgen covers plain CBOW only (DM's per-doc label
+        # columns keep the per-sequence producer); with NS its per-row
+        # negatives ride the counter streams instead of flush-time draws
+        fused = self.pairgen != "legacy" and not max_extra
+        n_negf = 0 if (hs or not fused) else k - 1
+        negs_buf = (np.zeros((depth, chunk, n_negf), np.int32)
+                    if n_negf else None)
         d = 0
         fill = 0
         seen = 0
@@ -177,9 +220,14 @@ class Word2Vec(SequenceVectors):
                 else:
                     tgt = np.zeros((depth, chunk, k), np.int32)
                     tgt[..., 0] = cen_buf
-                    flat = tgt.reshape(-1, k)
-                    flat[:, 1:] = sk.draw_negatives(
-                        rng, table, flat[:, 0:1], k - 1, n_words)
+                    if negs_buf is not None:
+                        # fused counter-stream draws (nlp/pairgen.py);
+                        # rows past nv are inert under the mask
+                        tgt[..., 1:] = negs_buf
+                    else:
+                        flat = tgt.reshape(-1, k)
+                        flat[:, 1:] = sk.draw_negatives(
+                            rng, table, flat[:, 0:1], k - 1, n_words)
                     prep = ("cbow_ns", ctx_buf.copy(),
                             cmask_buf.copy(), tgt, nv.copy(),
                             lrs.copy())
@@ -197,7 +245,7 @@ class Word2Vec(SequenceVectors):
                 if d == depth:
                     flush()
 
-            def push_rows(cens, ctxs, valids, tokens=0.0):
+            def push_rows(cens, ctxs, valids, tokens=0.0, negs=None):
                 """``tokens`` of anneal progress spreads evenly over the
                 rows (the _PairStream.push contract — advancing ``seen``
                 up front snaps small corpora straight to
@@ -216,6 +264,8 @@ class Word2Vec(SequenceVectors):
                     ctx_buf[d, sl] = ctxs[p:p + take]
                     cmask_buf[d, sl] = \
                         valids[p:p + take].astype(np.float32)
+                    if negs is not None:
+                        negs_buf[d, sl] = negs[p:p + take]
                     fill += take
                     p += take
                     if fill == chunk:
@@ -225,14 +275,16 @@ class Word2Vec(SequenceVectors):
                 # DM: per-sequence loop (label columns vary per doc)
                 for _epoch in range(self.epochs):
                     for si, seq in enumerate(seqs):
-                        idxs = np.asarray(self._indices(seq), np.int32)
+                        idxs = np.asarray(  # host-sync-ok: host encode
+                            self._indices(seq), np.int32)
                         n = len(idxs)
                         # even a 1-token doc trains its label vector
                         if n < 1:
                             continue
                         grid, valid = sk.window_grid(n, W, rng)
                         ctx = idxs[np.clip(grid, 0, n - 1)]
-                        e = np.asarray(extra_per_seq[si], np.int32)
+                        e = np.asarray(  # host-sync-ok: host label ids
+                            extra_per_seq[si], np.int32)
                         pad = np.zeros(max_extra - len(e), np.int32)
                         ctx = np.concatenate(
                             [ctx,
@@ -244,6 +296,27 @@ class Word2Vec(SequenceVectors):
                         valid = np.concatenate(
                             [valid, np.tile(evalid, (n, 1))], axis=1)
                         push_rows(idxs, ctx, valid, tokens=n)
+            elif fused:
+                # fused pairgen (nlp/pairgen.py): subsample + window
+                # rows + negatives in one native (or bitwise-equal
+                # numpy) pass, row counter = emitted rows per epoch
+                from deeplearning4j_tpu.nlp import pairgen as pg
+                ids_all, seq_all = self._encode_corpus_flat(seqs)
+                walker = pg.CorpusWalker(
+                    self, ids_all, seq_all,
+                    force_numpy=self.pairgen == "numpy")
+                for ep in range(self.epochs):
+                    view = walker.epoch(ep)
+                    if view.n < 2:
+                        seen += view.n
+                        continue
+                    row_base = 0
+                    for lo, hi in view.slab_bounds():
+                        ctx, cmask, cens, negs = view.walk_cbow(
+                            lo, hi, n_neg=n_negf, row_base=row_base)
+                        row_base += len(cens)
+                        push_rows(cens, ctx, cmask, tokens=hi - lo,
+                                  negs=negs)
             else:
                 # plain CBOW (round 5): corpus-level numpy via the SAME
                 # window walk the SGNS fast path uses (_window_slabs) —
@@ -312,7 +385,8 @@ class StaticWord2Vec:
     def __init__(self, words: List[str], vectors: np.ndarray):
         self._index = {w: i for i, w in enumerate(words)}
         self._words = list(words)
-        self._vectors = np.asarray(vectors, np.float32)
+        self._vectors = np.asarray(  # host-sync-ok: one-time snapshot
+            vectors, np.float32)
 
     @classmethod
     def from_model(cls, w2v: SequenceVectors) -> "StaticWord2Vec":
@@ -327,4 +401,4 @@ class StaticWord2Vec:
     def similarity(self, a: str, b: str) -> float:
         va, vb = self.get_word_vector(a), self.get_word_vector(b)
         den = np.linalg.norm(va) * np.linalg.norm(vb)
-        return float(va @ vb / den) if den else 0.0
+        return float(va @ vb / den) if den else 0.0  # host-sync-ok: host numpy

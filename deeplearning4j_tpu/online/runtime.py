@@ -132,6 +132,17 @@ class OnlineServing:
     def output(self, features):
         return self.router.output(features, model=self.model_name)
 
+    def promote_params(self, params, model_state=None, *,
+                       version: Optional[str] = None):
+        """Hot-swap externally refreshed params into the warm serving
+        pool (FleetRouter.promote_params: structure-validated,
+        param-only, zero recompiles) — the path for weights trained
+        OUTSIDE the broker-fed learner, e.g. embeddings refreshed by
+        ``Word2Vec.fit_stream`` from a corpus stream. Bypasses the
+        gated promoter deliberately: the caller owns quality gating."""
+        return self.router.promote_params(self.model_name, params,
+                                          model_state, version=version)
+
     # ---- introspection ---------------------------------------------------
     def stats(self) -> Dict[str, Any]:
         return {

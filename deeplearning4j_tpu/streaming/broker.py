@@ -46,19 +46,39 @@ class Transport:
 
 class InProcessTransport(Transport):
     """Thread-safe per-topic queues; every subscriber pool shares one
-    stream (competing consumers, like one Kafka consumer group)."""
+    stream (competing consumers, like one Kafka consumer group).
 
-    def __init__(self, max_queue: int = 1024):
+    ``publish`` is BOUNDED: it waits up to ``put_timeout_s`` for a slot,
+    then sheds the message and increments
+    ``dl4j_stream_dropped_total{topic}`` — a slow (or dead) consumer
+    must never wedge the publisher. Before this, a full topic queue
+    blocked ``publish`` forever, which through the TCP broker's handler
+    thread also wedged every other client on that connection."""
+
+    def __init__(self, max_queue: int = 1024,
+                 put_timeout_s: float = 1.0, registry=None):
         self._queues: Dict[str, queue.Queue] = defaultdict(
             lambda: queue.Queue(maxsize=max_queue))
         self._lock = threading.Lock()
+        self.put_timeout_s = float(put_timeout_s)
+        self.dropped = 0
+        from deeplearning4j_tpu.observe.registry import default_registry
+        reg = registry if registry is not None else default_registry()
+        self._c_dropped = reg.counter(
+            "dl4j_stream_dropped_total",
+            "messages shed by a full bounded topic queue (slow "
+            "consumer), by topic")
 
     def _q(self, topic: str) -> queue.Queue:
         with self._lock:
             return self._queues[topic]
 
     def publish(self, topic: str, payload: bytes) -> None:
-        self._q(topic).put(payload)
+        try:
+            self._q(topic).put(payload, timeout=self.put_timeout_s)
+        except queue.Full:
+            self.dropped += 1
+            self._c_dropped.inc(1.0, topic=topic)
 
     def poll(self, topic: str, timeout: float = 1.0) -> Optional[bytes]:
         try:

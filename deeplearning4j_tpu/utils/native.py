@@ -45,17 +45,39 @@ def _try_build() -> bool:
         return False
 
 
+def _disabled() -> bool:
+    """DL4J_NATIVE=0 is the kill switch: every wrapper reports the
+    library unavailable, so callers take their mandatory numpy
+    fallback. Checked on every call (not cached) so tests and
+    operators can flip it mid-process."""
+    return os.environ.get("DL4J_NATIVE", "").strip() == "0"
+
+
+def _stale() -> bool:
+    """True when the shared object predates its source — a stale
+    binary would silently miss newly added entry points."""
+    src = os.path.join(_NATIVE_DIR, "dl4j_native.cpp")
+    try:
+        return os.path.getmtime(_SO_PATH) < os.path.getmtime(src)
+    except OSError:
+        return False
+
+
 def get_lib() -> Optional[ctypes.CDLL]:
-    """The loaded library, building it on first use; None if unavailable."""
+    """The loaded library, building it on first use; None if unavailable
+    or killed via DL4J_NATIVE=0."""
     global _lib, _load_failed
+    if _disabled():
+        return None
     if _lib is not None or _load_failed:
         return _lib
     with _lib_lock:
         if _lib is not None or _load_failed:
             return _lib
-        if not os.path.exists(_SO_PATH) and not _try_build():
-            _load_failed = True
-            return None
+        if (not os.path.exists(_SO_PATH) or _stale()) and not _try_build():
+            if not os.path.exists(_SO_PATH):
+                _load_failed = True
+                return None
         try:
             lib = ctypes.CDLL(_SO_PATH)
         except OSError as e:
@@ -87,6 +109,31 @@ def get_lib() -> Optional[ctypes.CDLL]:
                                         ctypes.POINTER(i64),
                                         ctypes.POINTER(i64)]
         lib.dl4j_idx_decode.restype = i64
+        # pairgen entry points are newer than the codec: a stale
+        # prebuilt .so without them still serves the codec paths,
+        # pairgen_available() just reports False
+        if hasattr(lib, "dl4j_pairgen_walk"):
+            u64, u8pp, i32 = (ctypes.c_uint64,
+                              ctypes.POINTER(ctypes.c_uint8),
+                              ctypes.c_int32)
+            u64p = ctypes.POINTER(u64)
+            lib.dl4j_sm64_fill.argtypes = [u64, i64, i64, u64p]
+            lib.dl4j_sm64_fill.restype = None
+            f64p = ctypes.POINTER(ctypes.c_double)
+            lib.dl4j_pairgen_subsample.argtypes = [i32p, i64, f64p, u64,
+                                                   u8pp]
+            lib.dl4j_pairgen_subsample.restype = i64
+            lib.dl4j_pairgen_negatives.argtypes = [
+                i32p, i64, i32p, i64, i32, i32, u64, u64, i64, i32p]
+            lib.dl4j_pairgen_negatives.restype = None
+            lib.dl4j_pairgen_walk.argtypes = [
+                i32p, i32p, i32p, i64, i64, i32, u64, i32p, i64, i32,
+                i32, u64, u64, i64, i32p, i32p, i32p]
+            lib.dl4j_pairgen_walk.restype = i64
+            lib.dl4j_pairgen_walk_cbow.argtypes = [
+                i32p, i32p, i32p, i64, i64, i64, i32, u64, i32p, i64,
+                i32, i32, u64, u64, i64, i32p, f32p, i32p, i32p]
+            lib.dl4j_pairgen_walk_cbow.restype = i64
         _lib = lib
     return _lib
 
@@ -196,3 +243,100 @@ def decode_idx(raw: bytes) -> Optional[Tuple[np.ndarray, Tuple[int, ...]]]:
         raise ValueError("malformed IDX file")
     shape = tuple(int(d) for d in dims[:ndims.value])
     return out[:n].reshape(shape), shape
+
+
+# -------------------------------------------------------------------------
+# Fused pair generation (the Word2Vec/ParagraphVectors host producer).
+# Thin ctypes shims — the walk semantics and the bitwise-identical numpy
+# fallback live in deeplearning4j_tpu/nlp/pairgen.py.
+# -------------------------------------------------------------------------
+
+def pairgen_available() -> bool:
+    """True when the loaded library carries the pairgen entry points
+    (a stale .so without them still serves the codec)."""
+    lib = get_lib()
+    return lib is not None and hasattr(lib, "dl4j_pairgen_walk")
+
+
+def _u8p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def sm64_fill(seed: int, start: int, n: int) -> Optional[np.ndarray]:
+    """Raw counter-based splitmix64 draws (parity probe)."""
+    if not pairgen_available():
+        return None
+    out = np.empty(n, np.uint64)
+    get_lib().dl4j_sm64_fill(
+        ctypes.c_uint64(seed), start, n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+    return out
+
+
+def pairgen_subsample(ids: np.ndarray, keep_p: np.ndarray,
+                      seed: int) -> Optional[np.ndarray]:
+    """Boolean keep mask for the flat corpus; None without the lib."""
+    if not pairgen_available():
+        return None
+    ids = np.ascontiguousarray(ids, np.int32)
+    keep_p = np.ascontiguousarray(keep_p, np.float64)
+    out = np.empty(len(ids), np.uint8)
+    get_lib().dl4j_pairgen_subsample(
+        _i32p(ids), len(ids),
+        keep_p.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_uint64(seed), _u8p(out))
+    return out.view(bool)
+
+
+def pairgen_negatives(table: np.ndarray, positive: np.ndarray,
+                      n_neg: int, n_words: int, nseed: int, n2seed: int,
+                      pair_base: int) -> Optional[np.ndarray]:
+    """(n, n_neg) fused negative-table draws; None without the lib."""
+    if not pairgen_available() or n_neg <= 0:
+        return None
+    positive = np.ascontiguousarray(positive, np.int32)
+    out = np.empty((len(positive), n_neg), np.int32)
+    get_lib().dl4j_pairgen_negatives(
+        _i32p(table), len(table), _i32p(positive), len(positive),
+        n_neg, n_words, ctypes.c_uint64(nseed), ctypes.c_uint64(n2seed),
+        pair_base, _i32p(out))
+    return out
+
+
+def pairgen_walk(ids: np.ndarray, pos: np.ndarray, length: np.ndarray,
+                 lo: int, hi: int, window: int, wseed: int,
+                 table: Optional[np.ndarray], n_neg: int, n_words: int,
+                 nseed: int, n2seed: int, pair_base: int,
+                 out_center: np.ndarray, out_context: np.ndarray,
+                 out_negs: Optional[np.ndarray]) -> Optional[int]:
+    """Fused SGNS/HS/DBOW window walk into caller-owned slab buffers;
+    returns the pair count, or None without the lib."""
+    if not pairgen_available():
+        return None
+    tbl = table if table is not None else np.empty(1, np.int32)
+    return get_lib().dl4j_pairgen_walk(
+        _i32p(ids), _i32p(pos), _i32p(length), lo, hi, window,
+        ctypes.c_uint64(wseed), _i32p(tbl), len(tbl), n_neg, n_words,
+        ctypes.c_uint64(nseed), ctypes.c_uint64(n2seed), pair_base,
+        _i32p(out_center), _i32p(out_context),
+        _i32p(out_negs if out_negs is not None else out_center))
+
+
+def pairgen_walk_cbow(ids: np.ndarray, pos: np.ndarray,
+                      length: np.ndarray, lo: int, hi: int, window: int,
+                      wseed: int, table: Optional[np.ndarray],
+                      n_neg: int, n_words: int, nseed: int, n2seed: int,
+                      row_base: int, out_ctx: np.ndarray,
+                      out_cmask: np.ndarray, out_center: np.ndarray,
+                      out_negs: Optional[np.ndarray]) -> Optional[int]:
+    """Fused CBOW row walk into caller-owned slab buffers; returns the
+    row count, or None without the lib."""
+    if not pairgen_available():
+        return None
+    tbl = table if table is not None else np.empty(1, np.int32)
+    return get_lib().dl4j_pairgen_walk_cbow(
+        _i32p(ids), _i32p(pos), _i32p(length), len(ids), lo, hi, window,
+        ctypes.c_uint64(wseed), _i32p(tbl), len(tbl), n_neg, n_words,
+        ctypes.c_uint64(nseed), ctypes.c_uint64(n2seed), row_base,
+        _i32p(out_ctx), _f32p(out_cmask), _i32p(out_center),
+        _i32p(out_negs if out_negs is not None else out_center))

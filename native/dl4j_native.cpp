@@ -254,4 +254,220 @@ int64_t dl4j_idx_decode(const uint8_t* data, int64_t len, float* out,
     return total;
 }
 
+// ---------------------------------------------------------------------------
+// Fused pair generation for the Word2Vec/ParagraphVectors host producer
+// (the work SequenceVectors._window_slabs + skipgram.draw_negatives do in
+// numpy — the reference keeps this loop native too, SkipGram.java:176).
+//
+// All randomness is COUNTER-BASED splitmix64: draw k of a stream is
+// mix(seed + (k+1)*GOLDEN), so the numpy fallback
+// (deeplearning4j_tpu/nlp/pairgen.py) reproduces the exact same stream
+// with vectorized uint64 ops — native and fallback are bitwise-equal by
+// construction, and a slab can be regenerated from (seed, indices) alone.
+// Draw-index contract (per epoch, shared with the Python fallback):
+//   subsample: token's corpus index; window: kept-token index;
+//   negatives: pair_index * n_neg + slot (primary stream), same index on
+//   the redraw stream; a double collision cycles to (positive+1)%vocab —
+//   skipgram.draw_negatives' policy.
+// ---------------------------------------------------------------------------
+
+static inline uint64_t sm_mix(uint64_t z) {
+    z ^= z >> 30;
+    z *= 0xBF58476D1CE4E5B9ULL;
+    z ^= z >> 27;
+    z *= 0x94D049BB133111EBULL;
+    z ^= z >> 31;
+    return z;
+}
+
+static inline uint64_t sm_draw(uint64_t seed, uint64_t k) {
+    return sm_mix(seed + (k + 1) * 0x9E3779B97F4A7C15ULL);
+}
+
+// 53-bit uniform in [0,1) — numpy's random() construction, so the
+// fallback's (draw >> 11) * 2**-53 compares bitwise-equal.
+static inline double sm_unit(uint64_t x) {
+    return (double)(x >> 11) * (1.0 / 9007199254740992.0);
+}
+
+// Range reduction into [0, m), m < 2^32: multiply-shift on the draw's
+// top 32 bits instead of '%', which costs a hardware divide per draw
+// on the hot path. (top32 * m) < 2^64, so the numpy fallback computes
+// the identical value in plain uint64 arithmetic.
+static inline uint64_t sm_range(uint64_t draw, uint64_t m) {
+    return ((draw >> 32) * m) >> 32;
+}
+
+// Raw draws out[i] = draw(seed, start+i) — the parity-test probe.
+void dl4j_sm64_fill(uint64_t seed, int64_t start, int64_t n,
+                    uint64_t* out) {
+    for (int64_t i = 0; i < n; ++i)
+        out[i] = sm_draw(seed, (uint64_t)(start + i));
+}
+
+// Frequent-word subsampling over the flat encoded corpus: keep token i
+// iff unit(draw(seed, i)) < keep_p[ids[i]]. Writes a 0/1 mask, returns
+// the kept count.
+int64_t dl4j_pairgen_subsample(const int32_t* ids, int64_t n,
+                               const double* keep_p, uint64_t seed,
+                               uint8_t* out_keep) {
+    int64_t kept = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        uint8_t k =
+            sm_unit(sm_draw(seed, (uint64_t)i)) < keep_p[ids[i]] ? 1 : 0;
+        out_keep[i] = k;
+        kept += k;
+    }
+    return kept;
+}
+
+// Negative-table draws for pairs [pair_base, pair_base+n): n_neg per
+// pair, collision with the pair's positive redrawn once from the second
+// stream, a double collision cycled to (positive+1) % max(n_words, 2).
+void dl4j_pairgen_negatives(const int32_t* table, int64_t tlen,
+                            const int32_t* positive, int64_t n,
+                            int32_t n_neg, int32_t n_words,
+                            uint64_t nseed, uint64_t n2seed,
+                            int64_t pair_base, int32_t* out) {
+    int32_t cyc = n_words > 2 ? n_words : 2;
+    for (int64_t i = 0; i < n; ++i) {
+        int32_t pos = positive[i];
+        int32_t* row = out + i * n_neg;
+        uint64_t q0 = (uint64_t)((pair_base + i) * n_neg);
+        for (int32_t k = 0; k < n_neg; ++k) {
+            uint64_t q = q0 + (uint64_t)k;
+            int32_t neg = table[(int64_t)
+                sm_range(sm_draw(nseed, q), (uint64_t)tlen)];
+            if (neg == pos) {
+                neg = table[(int64_t)
+                    sm_range(sm_draw(n2seed, q), (uint64_t)tlen)];
+                if (neg == pos) neg = (pos + 1) % cyc;
+            }
+            row[k] = neg;
+        }
+    }
+    return;
+}
+
+// The fused SGNS/HS/DBOW window walk over kept-token slab [lo, hi):
+// per center t an effective window b = 1 + range(draw(wseed, t), window)
+// (word2vec.c's randomized b), pairs emitted in ascending-offset order
+// (-b..-1, 1..b) clipped to the sequence — identical to the numpy
+// producer's offsets-grid flatten. ids/pos/len span the WHOLE kept
+// corpus (contexts cross slab bounds, never sequence bounds). With
+// n_neg > 0 the negative-table draws are fused into the same pass
+// (out_negs row-major [n_pairs, n_neg]). Returns the pair count;
+// caller sizes outputs for (hi-lo) * 2*window.
+int64_t dl4j_pairgen_walk(const int32_t* ids, const int32_t* pos,
+                          const int32_t* len, int64_t lo, int64_t hi,
+                          int32_t window, uint64_t wseed,
+                          const int32_t* table, int64_t tlen,
+                          int32_t n_neg, int32_t n_words,
+                          uint64_t nseed, uint64_t n2seed,
+                          int64_t pair_base,
+                          int32_t* out_center, int32_t* out_context,
+                          int32_t* out_negs) {
+    int64_t n_pairs = 0;
+    int32_t cyc = n_words > 2 ? n_words : 2;
+    for (int64_t t = lo; t < hi; ++t) {
+        int32_t b = window > 1
+            ? (int32_t)(1 + sm_range(sm_draw(wseed, (uint64_t)t),
+                                     (uint64_t)window))
+            : 1;
+        int32_t p = pos[t];
+        int32_t L = len[t];
+        int32_t c = ids[t];
+        int32_t o_lo = (-b > -p) ? -b : -p;             // max(-b, -p)
+        int32_t o_hi = (b < L - 1 - p) ? b : L - 1 - p;  // min(b, ...)
+        for (int32_t o = o_lo; o <= o_hi; ++o) {
+            if (o == 0) continue;
+            int32_t ctx = ids[t + o];
+            out_center[n_pairs] = c;
+            out_context[n_pairs] = ctx;
+            if (n_neg > 0) {
+                int32_t* row = out_negs + n_pairs * n_neg;
+                uint64_t q0 =
+                    (uint64_t)((pair_base + n_pairs) * n_neg);
+                for (int32_t k = 0; k < n_neg; ++k) {
+                    uint64_t q = q0 + (uint64_t)k;
+                    int32_t neg = table[(int64_t)
+                        sm_range(sm_draw(nseed, q), (uint64_t)tlen)];
+                    if (neg == ctx) {
+                        neg = table[(int64_t)
+                            sm_range(sm_draw(n2seed, q),
+                                     (uint64_t)tlen)];
+                        if (neg == ctx) neg = (ctx + 1) % cyc;
+                    }
+                    row[k] = neg;
+                }
+            }
+            ++n_pairs;
+        }
+    }
+    return n_pairs;
+}
+
+// CBOW row walk: one row per center with >= 1 valid context. Row
+// layout matches the numpy producer exactly: column j holds
+// ids[clip(t + offset_j, 0, n_total-1)] for offsets (-W..-1, 1..W)
+// with a 0/1 float mask (clipped out-of-window columns carry the
+// clipped id under mask 0, as numpy's grid-clip does). Negatives
+// (n_neg > 0) use the ROW index as the pair counter, positive = the
+// center. Returns the row count; caller sizes outputs for hi-lo rows.
+int64_t dl4j_pairgen_walk_cbow(const int32_t* ids, const int32_t* pos,
+                               const int32_t* len, int64_t n_total,
+                               int64_t lo, int64_t hi, int32_t window,
+                               uint64_t wseed, const int32_t* table,
+                               int64_t tlen, int32_t n_neg,
+                               int32_t n_words, uint64_t nseed,
+                               uint64_t n2seed, int64_t row_base,
+                               int32_t* out_ctx, float* out_cmask,
+                               int32_t* out_center, int32_t* out_negs) {
+    int32_t cw = 2 * window;
+    int32_t cyc = n_words > 2 ? n_words : 2;
+    int64_t r = 0;
+    for (int64_t t = lo; t < hi; ++t) {
+        int32_t b = window > 1
+            ? (int32_t)(1 + sm_range(sm_draw(wseed, (uint64_t)t),
+                                     (uint64_t)window))
+            : 1;
+        int32_t p = pos[t];
+        int32_t L = len[t];
+        int32_t* ctxrow = out_ctx + r * cw;
+        float* mrow = out_cmask + r * cw;
+        int32_t n_valid = 0;
+        for (int32_t j = 0; j < cw; ++j) {
+            int32_t o = j < window ? j - window : j - window + 1;
+            int64_t gi = t + o;
+            if (gi < 0) gi = 0;
+            if (gi > n_total - 1) gi = n_total - 1;
+            ctxrow[j] = ids[gi];
+            int32_t po = p + o;
+            bool ok = (o >= -b && o <= b && po >= 0 && po < L);
+            mrow[j] = ok ? 1.0f : 0.0f;
+            n_valid += ok;
+        }
+        if (n_valid == 0) continue;        // centers without context
+        out_center[r] = ids[t];
+        if (n_neg > 0) {
+            int32_t c = ids[t];
+            int32_t* row = out_negs + r * n_neg;
+            uint64_t q0 = (uint64_t)((row_base + r) * n_neg);
+            for (int32_t k = 0; k < n_neg; ++k) {
+                uint64_t q = q0 + (uint64_t)k;
+                int32_t neg = table[(int64_t)
+                    sm_range(sm_draw(nseed, q), (uint64_t)tlen)];
+                if (neg == c) {
+                    neg = table[(int64_t)
+                        sm_range(sm_draw(n2seed, q), (uint64_t)tlen)];
+                    if (neg == c) neg = (c + 1) % cyc;
+                }
+                row[k] = neg;
+            }
+        }
+        ++r;
+    }
+    return r;
+}
+
 }  // extern "C"

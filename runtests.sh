@@ -64,4 +64,20 @@ JAX_PLATFORMS=cpu python -m benchmarks.online --smoke
 # under the CPU bounds, and the pretrained int8 head strictly fewer
 # bytes/token than bf16 within the next-token agreement budget
 JAX_PLATFORMS=cpu python -m benchmarks.generation --smoke
+# native tier: build the C kernels when a toolchain exists, then gate
+# the fused pair producer — native must be >= the numpy fallback in
+# tokens/s AND hand the device a bitwise-identical dispatch stream
+# (toolchain-less checkouts skip the build; the fallback tier below
+# still proves the numpy path)
+if command -v c++ >/dev/null 2>&1 || command -v g++ >/dev/null 2>&1; then
+  make -s -C native
+  JAX_PLATFORMS=cpu python -m benchmarks.baseline_suite \
+    doc2vec_producer --native-ab --smoke
+else
+  echo "native tier: no C++ toolchain, skipping build + A/B gate"
+fi
+# fallback-forced tier: the pairgen suite re-run with the native
+# library kill-switched off (DL4J_NATIVE=0) — the numpy producer must
+# train every mode end-to-end on its own
+DL4J_NATIVE=0 JAX_PLATFORMS=cpu python -m pytest tests/test_pairgen.py -q
 exec python -m pytest tests/ -q "$@"

@@ -93,3 +93,38 @@ def test_compression_module_uses_native(rng):
     signs = rng.choice([-1, 0, 1], size=129).astype(np.int8)
     msg = C.encode(signs)
     np.testing.assert_array_equal(C.decode(msg), signs)
+
+
+@pytest.mark.parametrize("n", [1, 15, 17, 100, 993])
+def test_codec_tail_lengths(rng, n):
+    """n % 16 != 0: the bitmap codec's word-packing tail must agree
+    with numpy bit for bit (the historical class of codec bugs)."""
+    signs = rng.choice([-1, 0, 0, 1], size=n).astype(np.int8)
+    msg_native = native.encode(signs)
+    msg_numpy = (C.encode_bitmap(signs)
+                 if int(msg_native[0]) == C.BITMAP_ENCODING
+                 else C.encode_flexible(signs))
+    np.testing.assert_array_equal(msg_native, msg_numpy)
+    np.testing.assert_array_equal(native.decode(msg_native), signs)
+
+
+def test_codec_all_zero_signs():
+    signs = np.zeros(65, np.int8)
+    msg = native.encode(signs)
+    np.testing.assert_array_equal(native.decode(msg), signs)
+
+
+def test_dl4j_native_kill_switch(monkeypatch):
+    """DL4J_NATIVE=0 disables the library for the CALL, not the
+    process: every wrapper reports unavailable / returns None, and
+    clearing the variable restores the loaded library without a
+    reload."""
+    assert native.available()
+    monkeypatch.setenv("DL4J_NATIVE", "0")
+    assert not native.available()
+    assert not native.pairgen_available()
+    assert native.encode(np.zeros(8, np.int8)) is None
+    assert native.sm64_fill(1, 0, 4) is None
+    monkeypatch.delenv("DL4J_NATIVE")
+    assert native.available()
+    assert native.encode(np.zeros(8, np.int8)) is not None

@@ -1,0 +1,309 @@
+"""Streaming corpus ingestion for the embedding trainers (ISSUE 13).
+
+The broker -> object store -> trainer pipeline: sentences published on
+a Transport topic feed a ``StreamingSentenceIterator``, spool into an
+``ArtifactStore`` corpus bucket (``CorpusShardWriter``), and train
+``Word2Vec.fit_stream`` in windows — with refreshed embeddings
+hot-promoting into a warm ``OnlineServing`` pool with ZERO live
+recompiles (the end-to-end soak).
+
+Also the broker backpressure contract: a full bounded topic queue
+sheds frames and counts them in ``dl4j_stream_dropped_total{topic}``
+instead of wedging the publisher.
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.corpus import (
+    CorpusDataSetIterator,
+    CorpusShardWriter,
+    spool_stream,
+)
+from deeplearning4j_tpu.nlp.sentence_iterators import (
+    StreamingSentenceIterator,
+    publish_sentences,
+)
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.observe.registry import MetricsRegistry
+from deeplearning4j_tpu.online import OnlineServing
+from deeplearning4j_tpu.parallel.aot_cache import ArtifactStore
+from deeplearning4j_tpu.streaming.broker import (
+    InProcessTransport,
+    TcpTransport,
+)
+
+N_IN = 5
+
+
+def _sentences(rng, n, vocab=30):
+    words = [f"w{i}" for i in range(vocab)]
+    return [" ".join(rng.choice(words, rng.integers(4, 11)))
+            for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# broker-fed sentence iterator
+# ---------------------------------------------------------------------------
+
+class TestStreamingSentenceIterator:
+    def test_publish_consume_eos(self, rng):
+        t = InProcessTransport(registry=MetricsRegistry())
+        sents = _sentences(rng, 10)
+        assert publish_sentences(t, sents, "s") == 10
+        it = StreamingSentenceIterator(t, "s", poll_timeout_s=0.05)
+        assert list(it) == sents          # EOS frame terminates
+        assert it.consumed == 10
+
+    def test_max_sentences(self, rng):
+        t = InProcessTransport(registry=MetricsRegistry())
+        publish_sentences(t, _sentences(rng, 20), "s", eos=False)
+        it = StreamingSentenceIterator(t, "s", max_sentences=7,
+                                       poll_timeout_s=0.05)
+        assert len(list(it)) == 7
+
+    def test_idle_timeout(self):
+        t = InProcessTransport(registry=MetricsRegistry())
+        t.publish("s", b"only one")
+        it = StreamingSentenceIterator(t, "s", poll_timeout_s=0.02,
+                                       idle_timeout_s=0.1)
+        assert list(it) == ["only one"]   # no EOS: idles out
+
+    def test_stop_event(self):
+        t = InProcessTransport(registry=MetricsRegistry())
+        stop = threading.Event()
+        stop.set()
+        it = StreamingSentenceIterator(t, "s", stop_event=stop)
+        assert list(it) == []
+
+
+class TestBrokerBackpressure:
+    def test_bounded_publish_sheds_and_counts(self):
+        reg = MetricsRegistry()
+        t = InProcessTransport(max_queue=4, put_timeout_s=0.01,
+                               registry=reg)
+        for i in range(50):
+            t.publish("t", b"m%d" % i)
+        assert t.dropped == 46            # 4 queued, the rest shed
+        c = reg.counter("dl4j_stream_dropped_total")
+        assert c.get(topic="t") == 46.0
+        # the queued head survives untouched
+        assert t.poll("t", 0.05) == b"m0"
+
+
+# ---------------------------------------------------------------------------
+# object-store corpus shards
+# ---------------------------------------------------------------------------
+
+class TestCorpusStore:
+    def test_writer_reader_snapshot_reiterates(self, rng, tmp_path):
+        store = ArtifactStore(str(tmp_path))
+        sents = _sentences(rng, 90)
+        w = CorpusShardWriter(store, "corp", shard_sentences=25)
+        w.extend(sents)
+        w.close()
+        m = store.manifest("corp")
+        assert m["kind"] == "corpus" and m["complete"]
+        assert m["sentences"] == 90 and len(m["shards"]) == 4
+        it = CorpusDataSetIterator(store, "corp")
+        assert list(it) == sents
+        assert list(it) == sents          # snapshot replays (multi-pass)
+        assert it.consumed == 180
+
+    def test_spool_stream_roundtrip(self, rng, tmp_path):
+        store = ArtifactStore(str(tmp_path))
+        t = InProcessTransport(registry=MetricsRegistry())
+        sents = _sentences(rng, 30)
+        publish_sentences(t, sents, "s")
+        src = StreamingSentenceIterator(t, "s", poll_timeout_s=0.05)
+        assert spool_stream(src, store, "corp",
+                            shard_sentences=8) == 30
+        assert list(CorpusDataSetIterator(store, "corp")) == sents
+
+    def test_rejects_foreign_manifest_kind(self, tmp_path):
+        store = ArtifactStore(str(tmp_path))
+        d = store.cache_dir("notcorpus")
+        import json
+        import os
+        with open(os.path.join(d, "manifest.json"), "w") as f:
+            json.dump({"kind": "aot_cache", "buckets": []}, f)
+        with pytest.raises(ValueError, match="not a corpus"):
+            list(CorpusDataSetIterator(store, "notcorpus"))
+
+    def test_follow_mode_tails_live_writer(self, rng, tmp_path):
+        store = ArtifactStore(str(tmp_path))
+        sents = _sentences(rng, 120)
+        w = CorpusShardWriter(store, "corp", shard_sentences=20)
+
+        def write():
+            for s in sents:
+                w.append(s)
+                time.sleep(0.0005)
+            w.close()
+
+        wt = threading.Thread(target=write, daemon=True)
+        wt.start()
+        got = list(CorpusDataSetIterator(store, "corp", follow=True,
+                                         poll_interval_s=0.01))
+        wt.join(10)
+        assert got == sents               # complete manifest terminates
+
+    def test_follow_mode_idles_out_on_stalled_writer(self, rng,
+                                                     tmp_path):
+        store = ArtifactStore(str(tmp_path))
+        w = CorpusShardWriter(store, "corp", shard_sentences=5)
+        w.extend(_sentences(rng, 10))     # 2 sealed shards, NO close
+        got = list(CorpusDataSetIterator(store, "corp", follow=True,
+                                         poll_interval_s=0.01,
+                                         idle_timeout_s=0.1))
+        assert len(got) == 10
+
+
+# ---------------------------------------------------------------------------
+# windowed streaming fit
+# ---------------------------------------------------------------------------
+
+class TestFitStreamWindows:
+    def test_windows_and_fixed_vocab(self, rng):
+        # first window builds the vocab; a later window full of unseen
+        # words must NOT grow it (stable syn0 geometry is what makes
+        # the promotion path recompile-free)
+        first = _sentences(rng, 100, vocab=25)
+        later = [" ".join(f"zz{i}_{j}" for j in range(6))
+                 for i in range(50)]
+        seen = []
+
+        def on_window(model, idx, n):
+            seen.append((idx, n, model.vocab.num_words(),
+                         np.asarray(model.syn0).shape))
+
+        m = Word2Vec(layer_size=8, window_size=2, min_word_frequency=1,
+                     epochs=1, seed=7, batch_size=256)
+        m.fit_stream(iter(first + later), window_sentences=50,
+                     on_window=on_window)
+        assert [(i, n) for i, n, _v, _s in seen] == [
+            (0, 50), (1, 50), (2, 50)]
+        vocabs = {v for _i, _n, v, _s in seen}
+        shapes = {s for _i, _n, _v, s in seen}
+        assert len(vocabs) == 1 and len(shapes) == 1
+
+    def test_max_windows(self, rng):
+        sents = _sentences(rng, 200)
+        seen = []
+        m = Word2Vec(layer_size=8, window_size=2, min_word_frequency=1,
+                     epochs=1, seed=7, batch_size=256)
+        m.fit_stream(iter(sents), window_sentences=40, max_windows=2,
+                     on_window=lambda _m, i, n: seen.append((i, n)))
+        assert seen == [(0, 40), (1, 40)]
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end soak: TCP broker -> spool -> follow-mode corpus ->
+# fit_stream -> hot promotion into warm serving, zero live recompiles
+# ---------------------------------------------------------------------------
+
+def _tiny_model(seed=1):
+    from deeplearning4j_tpu.models.multi_layer_network import (
+        MultiLayerNetwork)
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+    from deeplearning4j_tpu.ops.losses import LossFunction
+    from deeplearning4j_tpu.optimize.updaters import Adam
+    conf = (NeuralNetConfiguration.Builder().seed(seed)
+            .updater(Adam(1e-2)).list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT))
+            .set_input_type(InputType.feed_forward(N_IN)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestStreamingSoak:
+    def test_tcp_corpus_to_hot_promoted_serving(self, rng, tmp_path):
+        n_sent = 200
+        server = TcpTransport().serve()
+        client = TcpTransport(port=server.port)
+        try:
+            sents = _sentences(rng, n_sent)
+            # unbounded-stream face: the TCP framing can't carry the
+            # empty EOS frame, so the reader bounds itself by count
+            assert publish_sentences(server, sents, "sentences",
+                                     eos=False) == n_sent
+            src = StreamingSentenceIterator(
+                client, "sentences", poll_timeout_s=0.1,
+                max_sentences=n_sent, idle_timeout_s=10.0)
+            store = ArtifactStore(str(tmp_path))
+
+            spooled = []
+            spool = threading.Thread(
+                target=lambda: spooled.append(spool_stream(
+                    src, store, "stream-corpus", shard_sentences=50)),
+                daemon=True)
+            spool.start()
+
+            online = OnlineServing(
+                _tiny_model(), InProcessTransport(
+                    registry=MetricsRegistry()),
+                topic="train", model_name="m", feature_shape=(N_IN,),
+                batch_limit=8, registry=MetricsRegistry())
+            try:
+                windows = []
+
+                def on_window(model, idx, n):
+                    windows.append((idx, n))
+                    syn0 = np.asarray(model.syn0)
+                    params, state = \
+                        online.pool.engines[0].committed_host()
+                    hits = []
+
+                    def repl(leaf):
+                        a = np.asarray(leaf)
+                        if a.shape == (N_IN, 8):
+                            hits.append(1)
+                            return syn0[:N_IN].astype(a.dtype)
+                        return a
+
+                    params = jax.tree_util.tree_map(repl, params)
+                    assert len(hits) == 1
+                    online.promote_params(params, state,
+                                          version=f"w2v-{idx}")
+
+                reader = CorpusDataSetIterator(
+                    store, "stream-corpus", follow=True,
+                    poll_interval_s=0.02, idle_timeout_s=15.0)
+                w2v = Word2Vec(layer_size=8, window_size=2,
+                               min_word_frequency=1, epochs=1, seed=7,
+                               batch_size=256)
+                w2v.fit_stream(reader, window_sentences=60,
+                               on_window=on_window)
+                spool.join(15)
+                assert spooled == [n_sent]
+                assert store.manifest("stream-corpus")["complete"]
+                assert len(windows) >= 3
+                assert sum(n for _i, n in windows) == n_sent
+                # the last promotion is live and serves
+                assert (online.pool.active_version
+                        == f"w2v-{windows[-1][0]}")
+                params, _state = \
+                    online.pool.engines[0].committed_host()
+                leaves = [np.asarray(a) for a in
+                          jax.tree_util.tree_leaves(params)
+                          if np.asarray(a).shape == (N_IN, 8)]
+                np.testing.assert_array_equal(
+                    leaves[0], np.asarray(w2v.syn0)[:N_IN])
+                out = np.asarray(online.output(
+                    rng.normal(size=(4, N_IN)).astype(np.float32)))
+                assert out.shape == (4, 3)
+                assert np.isfinite(out).all()
+                # the acceptance gate: every swap was param-only
+                online.router.assert_warm()
+            finally:
+                online.router.shutdown()
+        finally:
+            client.close()
+            server.close()

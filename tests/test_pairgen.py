@@ -1,0 +1,273 @@
+"""Fused pair generation (nlp/pairgen.py + native/dl4j_native.cpp).
+
+The contract under test is the one the A/B bench gate enforces in CI:
+the native C walk and the numpy fallback are BITWISE-equal — same
+splitmix64 counter streams, same pair order, same negative draws — so
+``pairgen="auto"`` and ``pairgen="numpy"`` train identical models.
+Kernel-level parity is checked per entry point (including slab-split
+invariance), then end to end across every training mode, plus the
+seeded-reproducibility and lr-anneal regressions the fused producer
+must preserve from the legacy path.
+
+Run under ``DL4J_NATIVE=0`` (runtests.sh's fallback-forced tier) the
+parity tests skip and the rest prove the numpy path stands alone.
+"""
+
+import hashlib
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import pairgen as pg
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+from deeplearning4j_tpu.nlp.sentence_iterators import (
+    SentenceLabelledIterator,
+)
+from deeplearning4j_tpu.nlp.sequence_vectors import _corpus_positions
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.utils import native
+
+needs_native = pytest.mark.skipif(
+    not native.pairgen_available(),
+    reason="native pairgen unavailable (no toolchain or DL4J_NATIVE=0)")
+
+
+def _sentences(rng, n_words=120, n_seq=150):
+    words = [f"w{i}" for i in range(n_words)]
+    return [" ".join(rng.choice(words, rng.integers(3, 13)))
+            for _ in range(n_seq)]
+
+
+def _w2v(pairgen, sents, **kw):
+    kw.setdefault("negative", 5)
+    m = Word2Vec(layer_size=16, window_size=3, min_word_frequency=1,
+                 epochs=2, seed=11, batch_size=64, pairgen=pairgen, **kw)
+    m.fit(sents)
+    return m
+
+
+def _pv(pairgen, sents, **kw):
+    kw.setdefault("negative", 5)
+    m = ParagraphVectors(layer_size=16, window_size=3, dm=False,
+                         min_word_frequency=1, epochs=2, seed=11,
+                         batch_size=64, pairgen=pairgen, **kw)
+    m.fit(SentenceLabelledIterator(sents))
+    return m
+
+
+# ---------------------------------------------------------------------------
+# Kernel-level parity: each native entry point vs its numpy fallback.
+# ---------------------------------------------------------------------------
+
+@needs_native
+class TestKernelParity:
+    def _geom(self, rng, n=4000, vocab=400, seqs=90):
+        ids = rng.integers(0, vocab, n).astype(np.int32)
+        bounds = np.sort(rng.choice(np.arange(1, n), seqs, replace=False))
+        seq_id = np.searchsorted(bounds, np.arange(n), side="right")
+        pos, length = _corpus_positions(seq_id.astype(np.int64))
+        table = rng.integers(0, vocab, 50_000).astype(np.int32)
+        return ids, pos, length, table, vocab
+
+    def test_sm64_fill(self):
+        a = pg.sm64_fill(0xDEADBEEF, 1000, 4096)
+        b = pg.sm64_fill(0xDEADBEEF, 1000, 4096, force_numpy=True)
+        np.testing.assert_array_equal(a, b)
+
+    def test_subsample(self, rng):
+        ids = rng.integers(0, 50, 5000).astype(np.int32)
+        keep_p = rng.random(50)
+        a = pg.subsample(ids, keep_p, 42)
+        b = pg.subsample(ids, keep_p, 42, force_numpy=True)
+        np.testing.assert_array_equal(a, b)
+
+    def test_negatives(self, rng):
+        ids, _pos, _length, table, vocab = self._geom(rng)
+        a = pg.negatives(table, ids[:2000], 7, vocab, 5, 6, 123)
+        b = pg.negatives(table, ids[:2000], 7, vocab, 5, 6, 123,
+                         force_numpy=True)
+        np.testing.assert_array_equal(a, b)
+
+    def test_negatives_double_collision_cycles(self):
+        # a single-word table forces the redraw AND the cycle fallback
+        table = np.zeros(8, np.int32)
+        positive = np.zeros(16, np.int32)
+        for force in (False, True):
+            neg = pg.negatives(table, positive, 3, 5, 1, 2, 0,
+                               force_numpy=force)
+            np.testing.assert_array_equal(neg, np.ones((16, 3), np.int32))
+
+    @pytest.mark.parametrize("window,n_neg", [(1, 0), (3, 0), (5, 5)])
+    def test_walk(self, rng, window, n_neg):
+        ids, pos, length, table, vocab = self._geom(rng)
+        kw = dict(table=table, n_neg=n_neg, n_words=vocab, nseed=77,
+                  n2seed=88, pair_base=13)
+        a = pg.walk(ids, pos, length, 0, len(ids), window, 999, **kw)
+        b = pg.walk(ids, pos, length, 0, len(ids), window, 999,
+                    force_numpy=True, **kw)
+        np.testing.assert_array_equal(a[0], b[0])
+        np.testing.assert_array_equal(a[1], b[1])
+        if n_neg:
+            np.testing.assert_array_equal(a[2], b[2])
+
+    def test_walk_slab_split_invariant(self, rng):
+        # one full walk == concatenated slab walks with the pair_base
+        # threaded through — the property the producer loop relies on
+        ids, pos, length, table, vocab = self._geom(rng)
+        kw = dict(table=table, n_neg=4, n_words=vocab, nseed=7,
+                  n2seed=8)
+        full = pg.walk(ids, pos, length, 0, len(ids), 4, 555,
+                       pair_base=0, **kw)
+        for force in (False, True):
+            parts, base = [], 0
+            for lo in range(0, len(ids), 1024):
+                hi = min(len(ids), lo + 1024)
+                part = pg.walk(ids, pos, length, lo, hi, 4, 555,
+                               pair_base=base, force_numpy=force, **kw)
+                base += len(part[0])
+                parts.append(part)
+            np.testing.assert_array_equal(
+                full[0], np.concatenate([p[0] for p in parts]))
+            np.testing.assert_array_equal(
+                full[1], np.concatenate([p[1] for p in parts]))
+            np.testing.assert_array_equal(
+                full[2], np.concatenate([p[2] for p in parts]))
+
+    @pytest.mark.parametrize("window", [1, 4])
+    def test_walk_cbow(self, rng, window):
+        ids, pos, length, table, vocab = self._geom(rng)
+        kw = dict(table=table, n_neg=4, n_words=vocab, nseed=1,
+                  n2seed=2, row_base=3)
+        a = pg.walk_cbow(ids, pos, length, 0, len(ids), window, 31, **kw)
+        b = pg.walk_cbow(ids, pos, length, 0, len(ids), window, 31,
+                         force_numpy=True, **kw)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: every mode trains the SAME model on either backend.
+# ---------------------------------------------------------------------------
+
+@needs_native
+class TestModeParity:
+    @pytest.mark.parametrize("kw", [
+        {},                                          # SGNS
+        {"sampling": 1e-3},                          # SGNS + subsample
+        {"use_hierarchic_softmax": True, "negative": 0},
+        {"use_cbow": True},
+        {"use_cbow": True, "use_hierarchic_softmax": True,
+         "negative": 0},
+    ], ids=["sgns", "sgns-sub", "hs", "cbow", "cbow-hs"])
+    def test_word2vec(self, rng, kw):
+        sents = _sentences(rng)
+        np.testing.assert_array_equal(
+            np.asarray(_w2v("auto", sents, **kw).syn0),
+            np.asarray(_w2v("numpy", sents, **kw).syn0))
+
+    @pytest.mark.parametrize("kw", [{}, {"sampling": 1e-3}],
+                             ids=["dbow", "dbow-sub"])
+    def test_dbow(self, rng, kw):
+        sents = _sentences(rng)
+        np.testing.assert_array_equal(
+            np.asarray(_pv("auto", sents, **kw).syn0),
+            np.asarray(_pv("numpy", sents, **kw).syn0))
+
+
+# ---------------------------------------------------------------------------
+# Regressions the fused producer must preserve (any backend).
+# ---------------------------------------------------------------------------
+
+class TestProducerContracts:
+    def test_pairgen_knob_validated(self):
+        with pytest.raises(ValueError):
+            Word2Vec(layer_size=8, pairgen="nope")
+
+    def test_seeded_reproducibility_in_process(self, rng):
+        sents = _sentences(rng, n_seq=60)
+        a = _w2v("auto", sents, sampling=1e-3)
+        b = _w2v("auto", sents, sampling=1e-3)
+        np.testing.assert_array_equal(np.asarray(a.syn0),
+                                      np.asarray(b.syn0))
+
+    def test_seeded_reproducibility_two_process(self):
+        # a second PROCESS must converge to the bitwise-same weights:
+        # no hidden dependence on hash seeds, dict order or library
+        # load order
+        script = (
+            "import numpy as np, hashlib\n"
+            "from deeplearning4j_tpu.nlp.word2vec import Word2Vec\n"
+            "rng = np.random.default_rng(21)\n"
+            "words = ['w%d' % i for i in range(120)]\n"
+            "sents = [' '.join(rng.choice(words, rng.integers(3, 13)))\n"
+            "         for _ in range(150)]\n"
+            "m = Word2Vec(layer_size=16, window_size=3,\n"
+            "             min_word_frequency=1, epochs=2, seed=11,\n"
+            "             batch_size=64, negative=5, sampling=1e-3,\n"
+            "             pairgen='auto')\n"
+            "m.fit(sents)\n"
+            "print(hashlib.sha256(np.ascontiguousarray(\n"
+            "    np.asarray(m.syn0)).tobytes()).hexdigest())\n")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=os.path.dirname(os.path.dirname(
+                       os.path.abspath(__file__))))
+        out = subprocess.run([sys.executable, "-c", script], env=env,
+                             capture_output=True, text=True, timeout=300)
+        assert out.returncode == 0, out.stderr
+        child_hash = out.stdout.strip().splitlines()[-1]
+        rng2 = np.random.default_rng(21)
+        words = [f"w{i}" for i in range(120)]
+        sents = [" ".join(rng2.choice(words, rng2.integers(3, 13)))
+                 for _ in range(150)]
+        m = _w2v("auto", sents, sampling=1e-3)
+        mine = hashlib.sha256(np.ascontiguousarray(
+            np.asarray(m.syn0)).tobytes()).hexdigest()
+        assert mine == child_hash
+
+    def test_overlap_vs_serial_bitwise(self, rng):
+        # the producer-thread overlap must make the same counter-stream
+        # draws in the same order as the serial path
+        sents = _sentences(rng, n_seq=80)
+        a = _w2v("auto", sents, overlap_pairgen=True)
+        b = _w2v("auto", sents, overlap_pairgen=False)
+        np.testing.assert_array_equal(np.asarray(a.syn0),
+                                      np.asarray(b.syn0))
+
+    def test_dbow_lr_anneals_within_one_slab(self, rng):
+        # the fused slab producer spreads lr-anneal progress over the
+        # slab's chunks (via _PairStream tokens accounting) — a
+        # regression here snaps small corpora straight to min_lr
+        sents = _sentences(rng, n_seq=200)
+        pv = ParagraphVectors(layer_size=8, window_size=3, dm=False,
+                              negative=3, min_word_frequency=1,
+                              epochs=1, seed=5, batch_size=64,
+                              overlap_pairgen=False, pairgen="auto")
+        docs = list(SentenceLabelledIterator(sents))
+        tokenized = [(d.content.split(), d.labels) for d in docs]
+        labels = sorted({lb for _t, lbs in tokenized for lb in lbs})
+        pv.build_vocab(([t for t, _l in tokenized]),
+                       special_tokens=labels)
+        pv._init_tables()
+        preps = []
+        pv._dispatch_chunks = preps.append
+        per_epoch = sum(len(t) for t, _l in tokenized)
+        pv._fit_fast_dbow(tokenized, max(1, per_epoch * 2))
+        lrs = np.concatenate([p[4][p[3] > 0] for p in preps])
+        assert len(lrs) >= 3
+        assert np.all(np.diff(lrs) <= 0)            # monotone decay
+        assert len(np.unique(lrs)) >= 3             # within-slab anneal
+        assert lrs[-1] >= pv.min_learning_rate - 1e-9
+
+    def test_fused_sgns_telemetry_counts_tokens(self, rng):
+        from deeplearning4j_tpu.observe.registry import default_registry
+        reg = default_registry()
+        c = reg.counter("dl4j_pairgen_tokens_total", "")
+        sents = _sentences(rng, n_seq=40)
+        m = _w2v("auto", sents)
+        path = "native" if native.pairgen_available() else "numpy"
+        got = c.get(path=path)
+        assert got is not None and got > 0
+        assert np.isfinite(np.asarray(m.syn0)).all()

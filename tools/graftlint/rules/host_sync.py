@@ -64,6 +64,18 @@ HOT_PATHS = (
     # its HTTP ingress: SSE serialization is a host boundary like the
     # predict module's request decode
     "deeplearning4j_tpu/ui/generation_module.py",
+    # the embedding producers feed the device pair stream: any host
+    # sync here stalls pair generation, the measured bound the fused
+    # native pairgen exists to raise. Legitimate reads (the lr-anneal
+    # scalars, static vocab precomputes, telemetry counts) are pragma'd
+    # in place.
+    "deeplearning4j_tpu/nlp/sequence_vectors.py",
+    "deeplearning4j_tpu/nlp/word2vec.py",
+    "deeplearning4j_tpu/nlp/paragraph_vectors.py",
+    "deeplearning4j_tpu/nlp/pairgen.py",
+    # the ctypes loader runs host-side by definition, but sits on the
+    # producer path — keep it clean of accidental device fetches
+    "deeplearning4j_tpu/utils/native.py",
 )
 
 PATTERNS = (
