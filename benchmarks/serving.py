@@ -885,6 +885,251 @@ def run_cluster(args, smoke: bool) -> int:
         shutil.rmtree(work, ignore_errors=True)
 
 
+# ---- chaos smoke: armed fault plan + deadline propagation ----------------
+
+_CHAOS_PLAN = ("seed={seed};"
+               "registry.write:torn_write(count=1,arg=node-a);"
+               "store.save:corrupt(count=1,arg=blob);"
+               "remote.send:delay(p=0.5,ms=5);"
+               "broker.publish:error(count=2)")
+
+
+def _chaos_pass(work, seed, model):
+    """One deterministic sweep over the four fault seams under an armed
+    plan; returns (observations, replay signature). Two passes with the
+    same seed must agree bitwise on both."""
+    from deeplearning4j_tpu.chaos import plan as chaosplan
+    from deeplearning4j_tpu.parallel.node import NodeRegistry
+    from deeplearning4j_tpu.parallel.remote import RemoteDispatcher
+    from deeplearning4j_tpu.streaming.broker import TcpTransport
+
+    plan = chaosplan.arm(
+        chaosplan.parse_plan(_CHAOS_PLAN.format(seed=seed)))
+    obs = {}
+    try:
+        # registry: torn heartbeat record -> classified dead, next
+        # clean beat heals it
+        nreg = NodeRegistry(os.path.join(work, "reg"))
+        nreg.write("node-a", "http://a")            # torn (count=1)
+        rec = nreg.snapshot()["node-a"]
+        nreg.write("node-a", "http://a")            # clean overwrite
+        obs["registry"] = (rec["health"], bool(rec.get("corrupt")),
+                           nreg.snapshot()["node-a"]["health"])
+
+        # store: first process saves the AOT cache with one blob
+        # corrupted in flight; a joining process must quarantine it,
+        # live-compile that bucket, and still answer bitwise-correctly
+        cache = os.path.join(work, "aot")
+        e1 = make_engine(model, pipelined=True, session="chaos-save",
+                         batch_limit=4, aot_cache_dir=cache)
+        try:
+            e1.assert_warm()
+        finally:
+            e1.shutdown()
+        e2 = make_engine(model, pipelined=True, session="chaos-join",
+                         batch_limit=4, aot_cache_dir=cache)
+        try:
+            e2.assert_warm()
+            rng = np.random.default_rng(0)
+            x = rng.normal(size=(4, FEATURES)).astype(np.float32)
+            bitwise = np.array_equal(np.asarray(e2.output(x)),
+                                     np.asarray(model.output(x)))
+            st = e2.stats()["aot_cache"]
+            obs["store"] = (st["quarantined"], st["state"], bitwise)
+        finally:
+            e2.shutdown()
+
+        # remote: a chaos-delayed node is absorbed by the dispatcher —
+        # every client call still succeeds (zero-error budget)
+        nreg.write("n1", "http://n1")
+        nreg.write("n2", "http://n2")
+        calls = []
+        ok_body = json.dumps({"output": [[0.0]], "n": 1}).encode()
+
+        def transport(url, body, timeout_s):
+            calls.append(url)
+            return 200, {}, ok_body
+
+        disp = RemoteDispatcher(nreg, transport=transport,
+                                metrics=MetricsRegistry(),
+                                snapshot_ttl_s=0.0,
+                                sleep=lambda s: None, seed=0, retries=2)
+        try:
+            served = sum(disp.predict([[1.0]])["n"] for _ in range(20))
+        finally:
+            disp.shutdown()
+        obs["remote"] = (served, len(calls))
+
+        # broker: injected connection drops ride the reconnect path;
+        # then a REAL broker restart on the same port is survived too
+        t = TcpTransport(backoff_base_s=0.01, registry=MetricsRegistry())
+        t.serve()
+        try:
+            t.publish("chaos", b"m1")       # 2 injected drops, lands
+            got1 = t.poll("chaos", timeout=2.0)
+            rec_injected = t.reconnects
+            port = t.port
+            t._server.shutdown()            # kill the broker...
+            t._server.server_close()
+            t._server = None
+            restarted = TcpTransport(port=port)
+            restarted.serve()               # ...and restart, same port
+            try:
+                t.poll("chaos", timeout=0.05)  # flush the stale conn
+                t.publish("chaos", b"m2")
+                got2 = t.poll("chaos", timeout=2.0)
+            finally:
+                restarted.close()
+            obs["broker"] = (got1, rec_injected, got2)
+        finally:
+            t.close()
+
+        return obs, plan.replay_signature()
+    finally:
+        chaosplan.disarm()
+
+
+def run_chaos(args, smoke: bool = True) -> int:
+    """CI chaos gate: deterministic fault sweep (armed plan over the
+    registry / artifact-store / remote-dispatch / broker seams, replayed
+    bitwise), deadline propagation through the HTTP front door (expired
+    -> 504, never dispatched), and an empty graftlint baseline."""
+    import shutil
+    import tempfile
+    import urllib.error
+    import urllib.request
+    from deeplearning4j_tpu.parallel.fleet import FleetRouter
+    from deeplearning4j_tpu.ui.server import UIServer
+    from deeplearning4j_tpu.ui.serving_module import FleetModule
+    from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+    width = 32 if smoke else args.width
+    seed_a, seed_b = 42 + args.seed, 43 + args.seed
+    model = build_model(width=width)
+    work = tempfile.mkdtemp(prefix="dl4j-chaos-")
+    failures = []
+    try:
+        print(f"chaos smoke: plan '{_CHAOS_PLAN.format(seed=seed_a)}'")
+        obs1, sig1 = _chaos_pass(os.path.join(work, "p1"), seed_a, model)
+        obs2, sig2 = _chaos_pass(os.path.join(work, "p2"), seed_a, model)
+        obs3, sig3 = _chaos_pass(os.path.join(work, "p3"), seed_b, model)
+
+        torn, corrupt, healed = obs1["registry"]
+        quarantined, state, bitwise = obs1["store"]
+        served, calls = obs1["remote"]
+        got1, rec_injected, got2 = obs1["broker"]
+        fired = {(s, k) for s, k, _, _ in sig1}
+        print(f"  registry: torn record -> {torn} (corrupt={corrupt}), "
+              f"next beat -> {healed}")
+        print(f"  store:    quarantined={quarantined} "
+              f"state={state} bitwise={bitwise}")
+        print(f"  remote:   {served}/20 served across {calls} sends "
+              "(delays absorbed, zero client errors)")
+        print(f"  broker:   injected drops -> {rec_injected} reconnects"
+              f", delivered={got1 == b'm1'}; restart survived="
+              f"{got2 == b'm2'}")
+        print(f"  replay:   {len(sig1)} injections; same-seed pass "
+              f"identical={(obs1, sig1) == (obs2, sig2)}; "
+              f"seed+1 differs={sig3 != sig1}")
+        if (torn, corrupt, healed) != ("dead", True, "alive"):
+            failures.append(
+                f"torn registry record not dead->alive: {obs1['registry']}")
+        if quarantined != 1 or state != "warm" or not bitwise:
+            failures.append(
+                "joining engine did not quarantine the corrupt blob and "
+                f"live-compile warm: {obs1['store']}")
+        if served != 20:
+            failures.append(
+                f"remote tier lost requests under injected delay: "
+                f"{served}/20")
+        if got1 != b"m1" or rec_injected != 2 or got2 != b"m2":
+            failures.append(
+                f"broker drops/restart not absorbed: {obs1['broker']}")
+        if (obs1, sig1) != (obs2, sig2):
+            failures.append("same-seed chaos pass not bitwise identical")
+        if sig3 == sig1:
+            failures.append("different seed replayed the same signature")
+        missing = {("registry.write", "torn_write"),
+                   ("store.save", "corrupt"), ("remote.send", "delay"),
+                   ("broker.publish", "error")} - fired
+        if missing:
+            failures.append(f"plan clauses never fired: {sorted(missing)}")
+
+        # deadline propagation through the real front door (disarmed)
+        reg = MetricsRegistry()
+        fleet = FleetRouter(slo_ms=args.slo_ms, window_s=0.5,
+                            registry=reg)
+        fleet.add_pool("bench", model, pool_size=1, batch_limit=4,
+                       feature_shape=(FEATURES,))
+        server = UIServer(port=0)
+        server.attach(InMemoryStatsStorage())
+        server.register_module(FleetModule(fleet))
+        server.start()
+        try:
+            fleet.assert_warm()
+            url = server.url + "/api/predict"
+            rng = np.random.default_rng(1)
+            body = json.dumps({"features": rng.normal(
+                size=(1, FEATURES)).tolist()}).encode()
+
+            def post(deadline_ms):
+                req = urllib.request.Request(
+                    url, data=body,
+                    headers={"Content-Type": "application/json",
+                             "X-Deadline-Ms": deadline_ms})
+                try:
+                    with urllib.request.urlopen(req, timeout=30) as r:
+                        return r.status, r.read()
+                except urllib.error.HTTPError as e:
+                    return e.code, e.read()
+
+            def admitted():
+                m = reg.get_metric("dl4j_fleet_admitted_total")
+                return sum(m.series().values()) if m is not None else 0.0
+
+            before = admitted()
+            code, payload = post("0.000001")       # expired at ingress
+            expired_ok = (code == 504
+                          and json.loads(payload).get("error")
+                          == "deadline" and admitted() == before)
+            code2, _ = post("30000")               # generous budget
+            print(f"  deadline: expired -> HTTP {code} "
+                  f"(dispatched={admitted() != before and code != 504}),"
+                  f" fresh budget -> HTTP {code2}")
+            if not expired_ok:
+                failures.append(
+                    f"expired deadline not shed pre-dispatch: HTTP "
+                    f"{code}, admitted {before}->{admitted()}")
+            if code2 != 200:
+                failures.append(
+                    f"request with fresh budget failed: HTTP {code2}")
+            shed = reg.get_metric("dl4j_fleet_shed_total")
+            if shed is None or shed.get(model="bench",
+                                        reason="deadline") != 1.0:
+                failures.append(
+                    "dl4j_fleet_shed_total{reason=deadline} != 1")
+        finally:
+            server.stop()
+            fleet.shutdown()
+
+        # hot paths must stay chaos-clean (zero-overhead contract)
+        lint = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", "--baseline",
+             os.path.join("tools", "graftlint", "baseline.json")],
+            cwd=_ROOT, capture_output=True, text=True, timeout=900)
+        print("  graftlint: baseline "
+              + ("empty" if lint.returncode == 0 else "VIOLATED"))
+        if lint.returncode != 0:
+            failures.append("graftlint baseline not empty:\n"
+                            + lint.stdout[-2000:] + lint.stderr[-2000:])
+
+        for f in failures:
+            print(f"FAIL: {f}")
+        return 1 if failures else 0
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--clients", type=int, default=8,
@@ -960,6 +1205,14 @@ def main(argv=None) -> int:
     ap.add_argument("--cluster-p99-ms", type=float, default=2000.0,
                     help="served-p99 gate through the kill+join "
                     "(CPU-calibrated; retries ride the backoff curve)")
+    # fault-injection smoke (deterministic armed chaos plan)
+    ap.add_argument("--smoke-chaos", action="store_true",
+                    help="CI gate: deterministic fault sweep under an "
+                    "armed DL4J_CHAOS plan (torn registry record, "
+                    "corrupted AOT blob, delayed remote sends, broker "
+                    "drops + restart), bitwise same-seed replay, "
+                    "expired-deadline -> 504 without device dispatch, "
+                    "empty graftlint baseline")
     ap.add_argument("--seed", type=int, default=0)
     # internal child modes (spawned by --cold-start / --*-fleet)
     ap.add_argument("--cold-start-child", action="store_true",
@@ -982,6 +1235,8 @@ def main(argv=None) -> int:
         return run_fleet(args, smoke=args.smoke_fleet)
     if args.smoke_cluster or args.soak_cluster:
         return run_cluster(args, smoke=args.smoke_cluster)
+    if args.smoke_chaos:
+        return run_chaos(args, smoke=True)
     return run_smoke(args) if args.smoke else run_timed(args)
 
 

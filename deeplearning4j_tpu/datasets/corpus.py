@@ -115,6 +115,14 @@ class CorpusDataSetIterator:
       ``complete`` (all shards drained), ``idle_timeout_s`` passes
       with no growth, or ``stop_event`` is set — the unbounded-stream
       face consumed by ``fit_stream``.
+
+    A dead store is NOT a quiet writer: in follow mode, a manifest that
+    VANISHES after having been seen, or a listed shard that can no
+    longer be read, terminates immediately with ``termination_reason =
+    "store_dead"`` (error text in ``store_error``) instead of idling
+    until ``idle_timeout_s``. ``termination_reason`` after a follow
+    iteration is one of ``"complete"`` | ``"stopped"`` |
+    ``"idle_timeout"`` | ``"store_dead"`` (snapshot mode: ``"eos"``).
     """
 
     def __init__(self, store: ArtifactStore, key: str, *,
@@ -129,14 +137,16 @@ class CorpusDataSetIterator:
         self.idle_timeout_s = idle_timeout_s
         self.stop_event = stop_event
         self.consumed = 0
+        self.termination_reason: Optional[str] = None
+        self.store_error: Optional[str] = None
 
-    def _manifest(self) -> dict:
+    def _manifest(self) -> Optional[dict]:
         m = self.store.manifest(self.key)
         if m is not None and m.get("kind") != CORPUS_KIND:
             raise ValueError(
                 f"artifact key {self.key!r} holds a "
                 f"{m.get('kind', 'unknown')!r} manifest, not a corpus")
-        return m or {"shards": [], "complete": False}
+        return m
 
     def _read_shard(self, name: str) -> Iterator[str]:
         path = os.path.join(self.store.cache_dir(self.key), name)
@@ -148,29 +158,57 @@ class CorpusDataSetIterator:
                     yield line
 
     def __iter__(self) -> Iterator[str]:
+        self.termination_reason = None
+        self.store_error = None
         if not self.follow:
-            for name in self._manifest()["shards"]:
+            m = self._manifest() or {"shards": []}
+            for name in m["shards"]:
                 yield from self._read_shard(name)
+            self.termination_reason = "eos"
             return
         done = 0
         idle = 0.0
+        seen = False
         while True:
             if self.stop_event is not None and self.stop_event.is_set():
+                self.termination_reason = "stopped"
                 return
             m = self._manifest()
+            if m is None:
+                if seen:
+                    # the bucket existed and is now gone — the store
+                    # died under us; idling until idle_timeout would
+                    # hide that from the consumer
+                    self.termination_reason = "store_dead"
+                    self.store_error = (
+                        f"manifest for {self.key!r} vanished after "
+                        f"{done} shard(s)")
+                    return
+                m = {"shards": [], "complete": False}
+            else:
+                seen = True
             shards = m["shards"]
             if done < len(shards):
                 idle = 0.0
                 for name in shards[done:]:
-                    yield from self._read_shard(name)
-                done = len(shards)
+                    try:
+                        yield from self._read_shard(name)
+                    except OSError as e:
+                        # manifest-listed shard unreadable: a sealed
+                        # shard never disappears in a healthy store
+                        self.termination_reason = "store_dead"
+                        self.store_error = str(e)
+                        return
+                    done += 1
                 continue
             if m.get("complete"):
+                self.termination_reason = "complete"
                 return
             time.sleep(self.poll_interval_s)
             idle += self.poll_interval_s
             if (self.idle_timeout_s is not None
                     and idle >= self.idle_timeout_s):
+                self.termination_reason = "idle_timeout"
                 return
 
     def reset(self):

@@ -40,6 +40,7 @@ from deeplearning4j_tpu.generation import decode as D
 from deeplearning4j_tpu.observe.latency import LatencyRing
 from deeplearning4j_tpu.observe.recompile import RecompileWatchdog
 from deeplearning4j_tpu.observe.registry import default_registry
+from deeplearning4j_tpu.parallel.deadline import Deadline, DeadlineExceeded
 
 log = logging.getLogger(__name__)
 
@@ -170,11 +171,13 @@ class _Slot:
 
     __slots__ = ("stream", "prompt", "ppos", "next_input", "gen_count",
                  "max_new", "stop_id", "seed", "temperature", "top_k",
-                 "greedy", "needs_reset", "t_join", "t_first")
+                 "greedy", "needs_reset", "t_join", "t_first",
+                 "deadline")
 
     def __init__(self, stream: GenerationStream, prompt: List[int],
                  max_new: int, stop_id: Optional[int], seed: int,
-                 temperature: float, top_k: int, greedy: bool):
+                 temperature: float, top_k: int, greedy: bool,
+                 deadline: Optional[Deadline] = None):
         self.stream = stream
         self.prompt = prompt
         self.ppos = 1
@@ -189,6 +192,7 @@ class _Slot:
         self.needs_reset = True
         self.t_join = time.time()
         self.t_first: Optional[float] = None
+        self.deadline = deadline
 
 
 class GenerationEngine:
@@ -284,13 +288,23 @@ class GenerationEngine:
             "dl4j_gen_tokens_total", "generated tokens streamed")
         self._c_seqs = r.counter(
             "dl4j_gen_sequences_total",
-            "retired sequences by outcome (stop|length|cancelled|error)")
+            "retired sequences by outcome "
+            "(stop|length|cancelled|error|deadline)")
         self._c_compiles = r.counter(
             "dl4j_gen_compiles_total",
             "decode executable compiles by phase (warmup|live)")
         self._c_stream_err = r.counter(
             "dl4j_gen_stream_errors_total",
             "streams dropped mid-flight (slow consumer / transport)")
+        self._c_deadline = r.counter(
+            "dl4j_gen_deadline_shed_total",
+            "sequences shed because their deadline expired; stage="
+            "ingress (refused at submit) | queue (dropped while "
+            "waiting for a slot) | decode (retired mid-decode)")
+        self._c_disconnect = r.counter(
+            "dl4j_gen_client_disconnect_total",
+            "sequences cancelled because the streaming client "
+            "disconnected mid-generation")
         self._g_active = r.gauge(
             "dl4j_gen_active_slots", "sequences currently decoding")
         self._g_bucket = r.gauge(
@@ -305,8 +319,11 @@ class GenerationEngine:
         self._c_tokens.inc(0.0, session=session_id)
         self._c_compiles.inc(0.0, session=session_id, phase="live")
         self._c_stream_err.inc(0.0, session=session_id)
-        for oc in ("stop", "length", "cancelled", "error"):
+        for oc in ("stop", "length", "cancelled", "error", "deadline"):
             self._c_seqs.inc(0.0, session=session_id, outcome=oc)
+        for stage in ("ingress", "queue", "decode"):
+            self._c_deadline.inc(0.0, session=session_id, stage=stage)
+        self._c_disconnect.inc(0.0, session=session_id)
         self._g_active.set(0.0, session=session_id)
         self._g_bucket.set(float(self._bucket), session=session_id)  # host-sync-ok: python int gauge, no device value
         self._g_queue.set(0.0, session=session_id)
@@ -392,13 +409,21 @@ class GenerationEngine:
     def submit(self, prompt: Union[str, Sequence[int]], *,
                max_new_tokens: Optional[int] = None, greedy: bool = True,
                temperature: float = 1.0, top_k: int = 0, seed: int = 0,
-               stop: Optional[Union[str, int]] = None
+               stop: Optional[Union[str, int]] = None,
+               deadline: Optional[Deadline] = None
                ) -> GenerationStream:
         """Queue one sequence; returns its stream immediately. Raises
         RuntimeError when the waiting queue is at ``queue_limit`` —
-        FleetRouter admission turns that into a shed upstream."""
+        FleetRouter admission turns that into a shed upstream. An
+        already-expired ``deadline`` raises ``DeadlineExceeded``
+        synchronously — the sequence never queues, never decodes."""
         if self._stop.is_set():
             raise RuntimeError("generation engine is shut down")
+        if deadline is not None and deadline.expired:
+            self._c_deadline.inc(1.0, session=self.session_id,
+                                 stage="ingress")
+            raise DeadlineExceeded(
+                "generation: deadline expired at ingress")
         if isinstance(prompt, str):
             ids = self.vocab.encode(prompt)
         else:
@@ -422,7 +447,7 @@ class GenerationEngine:
         stream = GenerationStream(req, buffer=self.stream_buffer)
         slot = _Slot(stream, req["prompt"], req["max_new_tokens"],
                      stop_id, req["seed"], req["temperature"],
-                     req["top_k"], req["greedy"])
+                     req["top_k"], req["greedy"], deadline=deadline)
         with self._cv:
             if len(self._waiting) >= self.queue_limit:
                 raise RuntimeError("generation queue full")
@@ -437,6 +462,31 @@ class GenerationEngine:
         res = self.submit(prompt, **kw).result(timeout=timeout)
         res["text"] = self.vocab.decode(res["ids"])
         return res
+
+    def cancel(self, stream: GenerationStream, *,
+               disconnect: bool = False) -> bool:
+        """Retire a submitted sequence early and free its slot. A
+        sequence still in the waiting queue is finished ``cancelled``
+        immediately; one decoding in a slot is flagged and the
+        scheduler retires it on its next pass over the slot (prefill
+        or decode — it never runs the sequence to completion first).
+        ``disconnect=True`` marks the cancel as a client disconnect
+        (the SSE writer's path) on
+        ``dl4j_gen_client_disconnect_total``. Returns True when the
+        sequence was still live."""
+        if disconnect and not stream.done:
+            self._c_disconnect.inc(1.0, session=self.session_id)
+        stream.cancel()
+        with self._cv:
+            for idx, s in enumerate(self._waiting):
+                if s.stream is stream:
+                    self._waiting.pop(idx)
+                    stream._finish("cancelled")
+                    self._retired(s, "cancelled")
+                    return True
+            live = not stream.done
+            self._cv.notify()
+        return live
 
     def pending_depth(self) -> int:
         with self._cv:
@@ -507,8 +557,23 @@ class GenerationEngine:
 
     def _admit_locked(self):
         """Pack waiting sequences into free slots, growing the bucket
-        along the ladder first when demand exceeds it. Called under
-        ``_cv``."""
+        along the ladder first when demand exceeds it. Expired or
+        cancelled waiters are dropped here — they never take a slot,
+        never touch the device. Called under ``_cv``."""
+        if self._waiting:
+            live: List[_Slot] = []
+            for s in self._waiting:
+                if s.stream._cancelled.is_set():
+                    s.stream._finish("cancelled")
+                    self._retired(s, "cancelled")
+                elif s.deadline is not None and s.deadline.expired:
+                    self._c_deadline.inc(1.0, session=self.session_id,
+                                         stage="queue")
+                    s.stream._finish("deadline")
+                    self._retired(s, "deadline")
+                else:
+                    live.append(s)
+            self._waiting = live  # graftlint: disable=thread-discipline: caller holds _cv (same lock shutdown takes)
         active_idx = [i for i, s in enumerate(self._slots)
                       if s is not None]
         demand = len(active_idx) + len(self._waiting)
@@ -616,6 +681,20 @@ class GenerationEngine:
             if s is None:
                 continue
             s.needs_reset = False
+            # cancel/deadline retire BEFORE the prefill branch: a
+            # sequence whose client hung up (or whose budget ran out)
+            # during prompt ingestion must not keep burning ticks until
+            # sampling starts — this was exactly the prefill blind spot
+            if s.stream._cancelled.is_set():
+                s.stream._finish("cancelled")
+                retire.append((i, s, "cancelled"))
+                continue
+            if s.deadline is not None and s.deadline.expired:
+                self._c_deadline.inc(1.0, session=self.session_id,
+                                     stage="decode")
+                s.stream._finish("deadline")
+                retire.append((i, s, "deadline"))
+                continue
             if s.ppos < len(s.prompt):       # prefill: force next char
                 s.next_input = s.prompt[s.ppos]
                 s.ppos += 1

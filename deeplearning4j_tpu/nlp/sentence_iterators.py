@@ -141,7 +141,17 @@ class StreamingSentenceIterator(SentenceIterator):
 
     The stream is unbounded and consume-once: ``reset()`` is a no-op,
     so this iterator feeds windowed consumers (``Word2Vec.fit_stream``)
-    or a ``CorpusShardWriter`` spool — not multi-pass ``fit``."""
+    or a ``CorpusShardWriter`` spool — not multi-pass ``fit``.
+
+    A dead broker is NOT a quiet topic: a transport whose retries are
+    exhausted (``ConnectionError``/``OSError`` out of ``poll``)
+    terminates the stream immediately with ``termination_reason =
+    "transport_dead"`` (and the error text in ``transport_error``)
+    instead of idling silently until ``idle_timeout_s`` — before this,
+    the two cases were indistinguishable to the consumer.
+    ``termination_reason`` after iteration is one of ``"eos"`` |
+    ``"max_sentences"`` | ``"stopped"`` | ``"idle_timeout"`` |
+    ``"transport_dead"``."""
 
     def __init__(self, transport, topic: str = "sentences", *,
                  poll_timeout_s: float = 0.2,
@@ -155,27 +165,40 @@ class StreamingSentenceIterator(SentenceIterator):
         self.max_sentences = max_sentences
         self.stop_event = stop_event
         self.consumed = 0
+        self.termination_reason: Optional[str] = None
+        self.transport_error: Optional[str] = None
 
     def __iter__(self) -> Iterator[str]:
         import time
+        self.termination_reason = None
+        self.transport_error = None
         idle = 0.0
         while True:
             if self.stop_event is not None and self.stop_event.is_set():
+                self.termination_reason = "stopped"
                 return
             if (self.max_sentences is not None
                     and self.consumed >= self.max_sentences):
+                self.termination_reason = "max_sentences"
                 return
             t0 = time.monotonic()
-            payload = self.transport.poll(self.topic,
-                                          self.poll_timeout_s)
+            try:
+                payload = self.transport.poll(self.topic,
+                                              self.poll_timeout_s)
+            except (ConnectionError, OSError) as e:
+                self.termination_reason = "transport_dead"
+                self.transport_error = str(e)
+                return
             if payload is None:
                 idle += time.monotonic() - t0
                 if (self.idle_timeout_s is not None
                         and idle >= self.idle_timeout_s):
+                    self.termination_reason = "idle_timeout"
                     return
                 continue
             idle = 0.0
             if payload == SENTENCE_EOS:
+                self.termination_reason = "eos"
                 return
             s = payload.decode("utf-8", errors="replace").strip()
             if s:

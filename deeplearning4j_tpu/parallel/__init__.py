@@ -8,6 +8,7 @@ from deeplearning4j_tpu.parallel.cluster import (
     CollectiveWatchdog,
     classify_heartbeat_age,
 )
+from deeplearning4j_tpu.parallel.deadline import Deadline, DeadlineExceeded
 from deeplearning4j_tpu.parallel.fleet import FleetRouter, ShedError
 from deeplearning4j_tpu.parallel.inference import (
     InferenceMode,
@@ -42,6 +43,8 @@ __all__ = [
     "CalibrationResult",
     "CircuitBreaker",
     "CollectiveWatchdog",
+    "Deadline",
+    "DeadlineExceeded",
     "ElasticOptions",
     "FleetRouter",
     "InferenceMode",

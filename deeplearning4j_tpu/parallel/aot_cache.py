@@ -55,6 +55,8 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.chaos.hook import chaos_site
+
 MANIFEST = "manifest.json"
 FORMAT_VERSION = 2          # 2: per-precision entries + calibration hash
 
@@ -215,6 +217,8 @@ class AOTExecutableCache:
         self.reason: Optional[str] = None
         self.hits = 0            # buckets served from a loaded blob
         self.misses = 0          # buckets that fell through to live trace
+        self.quarantined = 0     # blobs failing their content checksum
+        self._chaos_save = chaos_site("store.save")
         self.xla_cache_enabled = enable_xla_cache(str(self.dir / "xla"))
         try:
             from jax import export  # noqa: F401  (jax >= 0.4.34)
@@ -278,11 +282,21 @@ class AOTExecutableCache:
             self.reason = _mismatch_reason(fp, got_fp, diff)
             return {}
         loaded: Dict[int, Any] = {}
+        checksums = entry.get("checksums") or {}
         for bucket in entry.get("buckets", []):
             blob_path = self.dir / self._blob_name(bucket, precision)
             try:
-                blob = bytearray(blob_path.read_bytes())
-                loaded[int(bucket)] = self._export.deserialize(blob)
+                raw = blob_path.read_bytes()
+                want = checksums.get(str(bucket))
+                if want is not None and \
+                        hashlib.sha256(raw).hexdigest() != want:
+                    # torn or bit-rotted blob: quarantine it and fall
+                    # through to live compile — a warming node must
+                    # NEVER crash (or serve garbage) on store corruption
+                    self._quarantine(blob_path, bucket, "checksum")
+                    continue
+                loaded[int(bucket)] = self._export.deserialize(
+                    bytearray(raw))
             except Exception as e:
                 # one bad blob falls through to live compile; the rest
                 # of the table still loads
@@ -290,6 +304,29 @@ class AOTExecutableCache:
                 self.reason = f"bucket {bucket}: {type(e).__name__}"
         self.state = "warm" if loaded else "mismatch"
         return loaded
+
+    def _quarantine(self, blob_path: Path, bucket, why: str) -> None:
+        """Move a corrupt blob aside (``.quarantine`` suffix) so later
+        loads don't re-pay the checksum failure and a later ``save``
+        republishes a clean blob under the original name."""
+        self.misses += 1
+        self.quarantined += 1
+        self.reason = f"bucket {bucket}: quarantined ({why})"
+        try:
+            os.replace(blob_path,
+                       str(blob_path) + ".quarantine")
+        except OSError:
+            pass
+        try:
+            from deeplearning4j_tpu.observe.registry import (
+                default_registry)
+            default_registry().counter(
+                "dl4j_aot_quarantined_total",
+                "corrupt AOT cache blobs moved aside (content checksum "
+                "or deserialize failure); each falls through to live "
+                "compile").inc(1.0, bucket=str(bucket), reason=why)
+        except Exception:
+            pass
 
     # ---- save ------------------------------------------------------------
     def save(self, jit_fn, committed, fp: Dict, ladder, example) -> int:
@@ -306,15 +343,22 @@ class AOTExecutableCache:
         precision = self._precision_of(fp)
         params, mstate = committed
         saved = []
+        checksums: Dict[str, str] = {}
         for bucket in ladder:
             x = np.zeros((int(bucket),) + tuple(example.shape[1:]),
                          example.dtype)
             try:
                 exp = self._export.export(jit_fn)(params, mstate, x)
-                blob = exp.serialize()
+                blob = bytes(exp.serialize())
+                # checksum of the TRUE bytes: corruption between save
+                # and load (torn write, bit rot — or an armed chaos
+                # plan mangling the write below) is caught at load
+                checksums[str(int(bucket))] = hashlib.sha256(
+                    blob).hexdigest()
+                if self._chaos_save is not None:
+                    blob, _ = self._chaos_save.mangle(blob, arg="blob")
                 (self.dir / self._blob_name(bucket,
-                                            precision)).write_bytes(
-                    bytes(blob))
+                                            precision)).write_bytes(blob)
                 # prime: the loading process compiles jit(exp.call), a
                 # different cache key than jit_fn's — pay it here, once,
                 # so the fresh process's compile is a disk hit
@@ -330,17 +374,22 @@ class AOTExecutableCache:
                 entries = dict(manifest.get("entries") or {})
             except Exception:
                 pass
-            entries[precision] = {"fingerprint": fp, "buckets": saved}
-            tmp = self.dir / (MANIFEST + ".tmp")
-            tmp.write_text(json.dumps(
+            entries[precision] = {"fingerprint": fp, "buckets": saved,
+                                  "checksums": checksums}
+            data = json.dumps(
                 {"format_version": FORMAT_VERSION, "entries": entries},
-                indent=2))
+                indent=2).encode("utf-8")
+            if self._chaos_save is not None:
+                data, _ = self._chaos_save.mangle(data, arg="manifest")
+            tmp = self.dir / (MANIFEST + ".tmp")
+            tmp.write_bytes(data)
             os.replace(tmp, self.dir / MANIFEST)
         return len(saved)
 
     def stats(self) -> Dict[str, Any]:
         return {"state": self.state, "reason": self.reason,
                 "hits": self.hits, "misses": self.misses,
+                "quarantined": self.quarantined,
                 "dir": str(self.dir),
                 "xla_cache": self.xla_cache_enabled}
 

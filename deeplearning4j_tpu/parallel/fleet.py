@@ -38,7 +38,7 @@ Environment knobs (all read at router construction; OBSERVABILITY.md):
 
 Prometheus series (rides the PR 2 registry, scraped at ``/metrics``):
 ``dl4j_fleet_admitted_total{model}``, ``dl4j_fleet_shed_total{model,
-reason=queue|slo}``, ``dl4j_fleet_swap_total{model, event=swap|
+reason=queue|slo|deadline}``, ``dl4j_fleet_swap_total{model, event=swap|
 rollback|param_swap|param_rollback}``, ``dl4j_fleet_pool_depth{model}``,
 ``dl4j_fleet_shed_fraction{model}``, ``dl4j_fleet_p99_ms{model}``,
 ``dl4j_fleet_pool_engines{model}``.
@@ -55,6 +55,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from deeplearning4j_tpu.observe.latency import LatencyRing
 from deeplearning4j_tpu.observe.registry import default_registry
+from deeplearning4j_tpu.parallel.deadline import Deadline
 from deeplearning4j_tpu.parallel.serving import ServingEngine
 
 
@@ -62,7 +63,9 @@ class ShedError(RuntimeError):
     """Request refused by admission control — raised synchronously from
     ``submit``/``output`` so a shed caller fails fast instead of holding
     a Future that will never resolve. ``reason`` is ``"queue"`` (pool
-    pending bound hit) or ``"slo"`` (p99-over-SLO shedding)."""
+    pending bound hit), ``"slo"`` (p99-over-SLO shedding), or
+    ``"deadline"`` (the request's deadline already expired at the front
+    door — it never touches an engine queue, let alone the device)."""
 
     def __init__(self, model: str, reason: str, detail: str):
         super().__init__(
@@ -157,9 +160,17 @@ class ModelPool:
                     self.shed_fraction = 0.0
         r._g_shed_fraction.set(self.shed_fraction, model=self.name)
 
-    def admit(self):
-        """Raise ``ShedError`` or return (never blocks, never queues)."""
+    def admit(self, deadline: Optional[Deadline] = None):
+        """Raise ``ShedError`` or return (never blocks, never queues).
+        An already-expired ``deadline`` sheds here — reason
+        ``"deadline"`` — before the request can consume a pending slot
+        or an engine queue entry."""
         r = self.router
+        if deadline is not None and deadline.expired:
+            r._c_shed.inc(1.0, model=self.name, reason="deadline")
+            raise ShedError(
+                self.name, "deadline",
+                "deadline expired before admission")
         with self.lock:
             self._tick_controller(time.monotonic())
             if self.pending >= r.max_pending:
@@ -184,11 +195,12 @@ class ModelPool:
         with self.lock:
             return min(self.engines, key=lambda e: e.inflight)
 
-    def submit(self, features) -> Future:
-        self.admit()
+    def submit(self, features,
+               deadline: Optional[Deadline] = None) -> Future:
+        self.admit(deadline)
         t0 = time.perf_counter()
         try:
-            f = self.least_loaded().submit(features)
+            f = self.least_loaded().submit(features, deadline=deadline)
         except BaseException:
             with self.lock:
                 self.pending -= 1
@@ -269,15 +281,15 @@ class GenerationPool:
     _tick_controller = ModelPool._tick_controller
     admit = ModelPool.admit
 
-    def submit(self, prompt, **kw):
+    def submit(self, prompt, deadline: Optional[Deadline] = None, **kw):
         """Admit, then queue on the engine; returns the
         GenerationStream. An engine-side queue-full becomes a
         ``ShedError(reason="queue")`` like any other admission refusal.
         """
-        self.admit()
+        self.admit(deadline)
         r = self.router
         try:
-            stream = self.engine.submit(prompt, **kw)
+            stream = self.engine.submit(prompt, deadline=deadline, **kw)
         except BaseException as e:
             with self.lock:
                 self.pending -= 1
@@ -339,7 +351,8 @@ class FleetRouter:
         self._c_shed = reg.counter(
             "dl4j_fleet_shed_total",
             "requests shed by admission control, per model; reason="
-            "queue (pending bound) | slo (p99-over-SLO shedding)")
+            "queue (pending bound) | slo (p99-over-SLO shedding) | "
+            "deadline (expired before admission)")
         self._c_swap = reg.counter(
             "dl4j_fleet_swap_total",
             "model-version swaps, per model; event=swap|rollback")
@@ -453,13 +466,16 @@ class FleetRouter:
             return dict(self._pools)
 
     # ---- serving ---------------------------------------------------------
-    def submit(self, features, model: Optional[str] = None) -> Future:
+    def submit(self, features, model: Optional[str] = None,
+               deadline: Optional[Deadline] = None) -> Future:
         if self._shutdown:
             raise RuntimeError("FleetRouter is shut down")
-        return self.pool(model).submit(features)
+        return self.pool(model).submit(features, deadline=deadline)
 
-    def output(self, features, model: Optional[str] = None):
-        return self.submit(features, model=model).result()
+    def output(self, features, model: Optional[str] = None,
+               deadline: Optional[Deadline] = None):
+        return self.submit(features, model=model,
+                           deadline=deadline).result()
 
     # ---- generative serving ----------------------------------------------
     def add_generation_pool(self, name: str, engine, *,
@@ -500,11 +516,13 @@ class FleetRouter:
         with self._pools_lock:
             return dict(self._gen_pools)
 
-    def generate(self, prompt, model: Optional[str] = None, **kw):
+    def generate(self, prompt, model: Optional[str] = None,
+                 deadline: Optional[Deadline] = None, **kw):
         """Admission-controlled decode submit; returns the stream."""
         if self._shutdown:
             raise RuntimeError("FleetRouter is shut down")
-        return self.generation_pool(model).submit(prompt, **kw)
+        return self.generation_pool(model).submit(
+            prompt, deadline=deadline, **kw)
 
     # ---- version lifecycle -----------------------------------------------
     def swap(self, name: str, model, version: str) -> ModelPool:

@@ -48,6 +48,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from deeplearning4j_tpu.chaos.hook import chaos_site
 from deeplearning4j_tpu.parallel.cluster import classify_heartbeat_age
 
 #: Node gossip states. ``draining`` nodes are alive (they still answer
@@ -80,6 +81,7 @@ class NodeRegistry:
         self.stale_after_s = float(stale_after_s)  # host-sync-ok: python config scalar
         self.dead_after_s = float(dead_after_s)  # host-sync-ok: python config scalar
         os.makedirs(self.dir, exist_ok=True)
+        self._chaos_write = chaos_site("registry.write")
 
     def _path(self, node_id: str) -> str:
         return os.path.join(self.dir, f"node_{node_id}.json")
@@ -93,11 +95,19 @@ class NodeRegistry:
         payload = json.dumps({
             "node_id": node_id, "url": url, "pid": os.getpid(),
             "state": state, "time": time.time() if now is None else now,
-            "stats": stats or {}})
+            "stats": stats or {}}).encode("utf-8")
+        if self._chaos_write is not None:
+            try:
+                # torn_write truncates the record (readers classify it
+                # dead), delay stalls the beat, error loses it entirely
+                payload, _ = self._chaos_write.mangle(payload,
+                                                      arg=node_id)
+            except Exception:
+                return      # injected write failure: this beat is lost
         try:
             fd, tmp = tempfile.mkstemp(dir=self.dir,
                                        prefix=f".node_{node_id}_")
-            with os.fdopen(fd, "w") as f:
+            with os.fdopen(fd, "wb") as f:
                 f.write(payload)
             os.replace(tmp, self._path(node_id))
         except OSError:
@@ -124,7 +134,17 @@ class NodeRegistry:
                     rec = json.load(f)
                 out[str(rec["node_id"])] = rec
             except (OSError, ValueError, KeyError):
-                continue    # torn/garbage record: invisible this read
+                # torn/garbage record (interrupted writer, bit rot):
+                # surface it as a DEAD placeholder keyed by filename —
+                # never raise, never silently hide a node whose record
+                # exists. ``time: None`` makes snapshot() classify it
+                # dead; the next healthy beat overwrites it whole.
+                nid = name[len("node_"):-len(".json")]
+                if nid:
+                    out.setdefault(nid, {
+                        "node_id": nid, "url": "", "pid": None,
+                        "state": NODE_UP, "time": None, "stats": {},
+                        "corrupt": True})
         return out
 
     def snapshot(self, now: Optional[float] = None

@@ -46,6 +46,8 @@ import urllib.request
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from deeplearning4j_tpu.chaos.hook import chaos_site
+from deeplearning4j_tpu.parallel.deadline import Deadline, DeadlineExceeded
 from deeplearning4j_tpu.parallel.node import NodeRegistry
 
 
@@ -204,8 +206,23 @@ class RemoteDispatcher:
         self.wait_for_nodes_s = float(wait_for_nodes_s)  # host-sync-ok: python config scalar
         self.transport = transport if transport is not None \
             else _http_transport
+        # chaos sites bind once here; disarmed runs hold None and the
+        # send path pays a single is-None test per attempt
+        self._chaos_send = chaos_site("remote.send")
+        _chaos_clock = chaos_site("remote.clock")
+        if _chaos_clock is not None:
+            _base_clock = clock
+            self._clock_skew_s = 0.0
+
+            def _skewed_clock():
+                self._clock_skew_s += _chaos_clock.skew()
+                return _base_clock() + self._clock_skew_s
+            clock = _skewed_clock
         self.clock = clock
         self.sleep = sleep
+        # EWMA of attempt wall time: the budget gate below refuses a
+        # retry the remaining deadline can't plausibly cover
+        self._attempt_ewma_s = 0.0
         self._rand = random.Random(seed)
         self._lock = threading.Lock()
         self._inflight: Dict[str, int] = {}
@@ -232,6 +249,13 @@ class RemoteDispatcher:
         self._c_hedges = reg.counter(
             "dl4j_cluster_hedges_total",
             "hedged duplicate requests; outcome=fired|won")
+        self._c_bad_ra = reg.counter(
+            "dl4j_remote_bad_retry_after_total",
+            "malformed Retry-After headers (non-numeric, non-finite, "
+            "negative, or absurd) ignored in favor of the backoff curve")
+        self._c_deadline = reg.counter(
+            "dl4j_remote_deadline_total",
+            "dispatches given up on deadline; stage=ingress|retry")
 
     # ---- membership view -------------------------------------------------
     def _breaker(self, node_id: str) -> CircuitBreaker:
@@ -305,7 +329,25 @@ class RemoteDispatcher:
         return candidates[0][4]
 
     # ---- one attempt -----------------------------------------------------
-    def _send(self, rec: Dict[str, Any], body: bytes) -> _Attempt:
+    _RETRY_AFTER_CAP_S = 3600.0
+
+    def _parse_retry_after(self, v) -> Optional[float]:
+        """Defensive Retry-After parse: a malformed value (non-numeric,
+        NaN/inf, negative, or over an hour) must fall back to the
+        backoff curve, never drive the pause — one bad node header
+        can't stall the whole client."""
+        try:
+            ra = float(v)  # host-sync-ok: HTTP header scalar
+        except (TypeError, ValueError):
+            ra = None
+        if ra is None or ra != ra or ra < 0 \
+                or ra > self._RETRY_AFTER_CAP_S:
+            self._c_bad_ra.inc(1.0)
+            return None
+        return ra
+
+    def _send(self, rec: Dict[str, Any], body: bytes,
+              timeout_s: Optional[float] = None) -> _Attempt:
         nid = rec["node_id"]
         br = self._breaker(nid)
         if not br.allow():
@@ -315,8 +357,13 @@ class RemoteDispatcher:
         with self._lock:
             self._inflight[nid] = self._inflight.get(nid, 0) + 1
         try:
+            if self._chaos_send is not None:
+                # delay sleeps here; error/timeout raise and land in
+                # the except arm exactly like an organic transport fault
+                self._chaos_send.fail(arg=nid)
             status, headers, payload = self.transport(
-                url, body, self.timeout_s)
+                url, body,
+                self.timeout_s if timeout_s is None else timeout_s)
         except Exception as e:
             br.record_failure()
             self._g_breaker.set(_BREAKER_GAUGE[br.state], node=nid)
@@ -346,10 +393,7 @@ class RemoteDispatcher:
             ra = None
             for k, v in headers.items():
                 if k.lower() == "retry-after":
-                    try:
-                        ra = float(v)  # host-sync-ok: HTTP header scalar
-                    except ValueError:
-                        ra = None
+                    ra = self._parse_retry_after(v)
             return _Attempt(False, None, retriable=True,
                             retry_after=ra, reason="shed(503)")
         if status >= 500:
@@ -367,14 +411,23 @@ class RemoteDispatcher:
                         f"{payload[:200].decode('utf-8', 'replace')}")
 
     def _send_hedged(self, rec: Dict[str, Any], body: bytes,
-                     tried: set) -> _Attempt:
+                     tried: set,
+                     deadline: Optional[Deadline] = None) -> _Attempt:
         """Primary send with an optional hedge: when the primary has
         not answered within ``hedge_after_s``, fire a duplicate at a
         different node; first OK wins, the loser's answer is discarded
-        (predict is idempotent)."""
-        if self.hedge_after_s is None:
-            return self._send(rec, body)
-        primary = self._pool.submit(self._send, rec, body)
+        (predict is idempotent). A deadline caps the per-attempt
+        transport timeout and suppresses the hedge when the remaining
+        budget can't cover waiting for it."""
+        timeout_s = None if deadline is None \
+            else max(deadline.cap_timeout(self.timeout_s), 1e-3)
+        if self.hedge_after_s is None or (
+                deadline is not None
+                and deadline.remaining_s()
+                < self.hedge_after_s + max(self._attempt_ewma_s,
+                                           self.hedge_after_s)):
+            return self._send(rec, body, timeout_s)
+        primary = self._pool.submit(self._send, rec, body, timeout_s)
         done, _ = wait([primary], timeout=self.hedge_after_s)
         if done:
             return primary.result()
@@ -383,7 +436,8 @@ class RemoteDispatcher:
             return primary.result()
         tried.add(hedge_rec["node_id"])
         self._c_hedges.inc(1.0, outcome="fired")
-        hedge = self._pool.submit(self._send, hedge_rec, body)
+        hedge = self._pool.submit(self._send, hedge_rec, body,
+                                  timeout_s)
         pending = {primary, hedge}
         first_failure = None
         while pending:
@@ -398,16 +452,29 @@ class RemoteDispatcher:
         return first_failure
 
     # ---- public API ------------------------------------------------------
-    def predict(self, features, timeout_s: Optional[float] = None):
+    def predict(self, features, timeout_s: Optional[float] = None,
+                deadline: Optional[Deadline] = None):
         """Dispatch one predict; returns the decoded JSON answer dict
         (``{"output": ..., "n": ...}``). Raises :class:`NoNodesError`
         when the registry has nothing dispatchable, :class:`RemoteError`
-        when every attempt failed."""
+        when every attempt failed, :class:`DeadlineExceeded` when the
+        caller's budget (``deadline``, or ``timeout_s`` from now) ran
+        out — expired requests shed synchronously, and the retry/hedge
+        loop stops as soon as the remaining budget can't cover a
+        typical attempt."""
         if hasattr(features, "tolist"):
             features = features.tolist()  # host-sync-ok: HTTP request body must be host JSON
         body = json.dumps({"features": features}).encode()
-        deadline = None if timeout_s is None \
-            else self.clock() + float(timeout_s)  # host-sync-ok: python config scalar
+        if timeout_s is not None:
+            d2 = Deadline.after_ms(float(timeout_s) * 1e3,  # host-sync-ok: config scalar, host time arithmetic
+                                   clock=self.clock)
+            if deadline is None \
+                    or d2.remaining_s() < deadline.remaining_s():
+                deadline = d2
+        if deadline is not None and deadline.expired:
+            self._c_deadline.inc(1.0, stage="ingress")
+            raise DeadlineExceeded(
+                "remote predict: deadline expired before dispatch")
         tried: set = set()
         attempts: List[Tuple[str, str]] = []
         delay = self.backoff_s
@@ -418,7 +485,11 @@ class RemoteDispatcher:
             if rec is None:
                 break
             tried.add(rec["node_id"])
-            att = self._send_hedged(rec, body, tried)
+            t_att0 = self.clock()
+            att = self._send_hedged(rec, body, tried, deadline)
+            dt = max(self.clock() - t_att0, 0.0)
+            self._attempt_ewma_s = dt if self._attempt_ewma_s == 0.0 \
+                else 0.8 * self._attempt_ewma_s + 0.2 * dt
             if att.ok:
                 return att.value
             attempts.append((rec["node_id"], att.reason))
@@ -436,8 +507,14 @@ class RemoteDispatcher:
             else:
                 pause = delay * (0.5 + self._rand.random())
                 delay = min(delay * 2.0, self.backoff_max_s)
-            if deadline is not None and self.clock() + pause > deadline:
-                break
+            if deadline is not None and pause + max(
+                    self._attempt_ewma_s, 0.0) >= deadline.remaining_s():
+                # the pause plus a typical attempt would blow the
+                # budget: give up NOW and hand the budget back as 504
+                self._c_deadline.inc(1.0, stage="retry")
+                raise DeadlineExceeded(
+                    "remote predict: budget exhausted after "
+                    + "; ".join(f"{n}: {r}" for n, r in attempts))
             if pause > 0:
                 self.sleep(min(pause, self.backoff_max_s * 4))
             self._c_retries.inc(1.0)
@@ -467,10 +544,12 @@ class RemoteDispatcher:
                 return rec
         return None
 
-    def output(self, features, timeout_s: Optional[float] = None):
+    def output(self, features, timeout_s: Optional[float] = None,
+               deadline: Optional[Deadline] = None):
         """Like :meth:`predict` but returns just the output list — the
         remote spelling of ``FleetRouter.output``."""
-        return self.predict(features, timeout_s=timeout_s)["output"]
+        return self.predict(features, timeout_s=timeout_s,
+                            deadline=deadline)["output"]
 
     def shutdown(self):
         self._pool.shutdown(wait=False)

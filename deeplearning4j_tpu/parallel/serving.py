@@ -65,19 +65,24 @@ from typing import Any, Dict, List, NamedTuple, Optional, Tuple, Union
 
 import numpy as np
 
+from deeplearning4j_tpu.chaos.hook import chaos_site
 from deeplearning4j_tpu.observe.latency import LatencyRing
 from deeplearning4j_tpu.observe.recompile import RecompileWatchdog
 from deeplearning4j_tpu.observe.registry import default_registry
 from deeplearning4j_tpu.observe.tracer import NULL_TRACER
+from deeplearning4j_tpu.parallel.deadline import (Deadline,
+                                                  DeadlineExceeded)
 
 MESH = "mesh"            # dispatch-target key for the sharded full bucket
 
 
 class _Request(NamedTuple):
-    """One enqueued chunk: host features, its waiter, arrival time."""
+    """One enqueued chunk: host features, its waiter, arrival time,
+    and the caller's remaining-budget deadline (None = unbounded)."""
     x: np.ndarray
     future: Future
     t_enqueue: float
+    deadline: Optional[Deadline] = None
 
 
 class _InFlight(NamedTuple):
@@ -243,6 +248,10 @@ class ServingEngine:
             "per-layer relative L2 quantization error observed on the "
             "calibration probe batch (int8 engines only; layers over "
             "the policy budget fell back to f32)")
+        self._c_deadline_shed = reg.counter(
+            "dl4j_serving_deadline_shed_total",
+            "requests shed because their deadline expired before "
+            "device dispatch; stage=ingress|batch")
         self._c_requests.inc(0.0, session=session_id, precision=self._ptag)
         self._c_batches.inc(0.0, session=session_id, precision=self._ptag)
         self._c_compiles.inc(0.0, session=session_id, precision=self._ptag, phase="live")
@@ -354,6 +363,7 @@ class ServingEngine:
         # f32 executables of co-resident engines can never collide
         self._exe: Dict[Tuple[int, Union[int, str], str], Any] = {}
         self._exe_lock = threading.Lock()
+        self._chaos_dispatch = chaos_site("serve.dispatch")
         self._warmed = False
         self._post_warmup_compiles = 0
         self.param_swaps = 0
@@ -507,10 +517,14 @@ class ServingEngine:
         return exe(params, mstate, self._place(x, where))
 
     # ---- public API ------------------------------------------------------
-    def submit(self, features) -> Future:
+    def submit(self, features,
+               deadline: Optional[Deadline] = None) -> Future:
         """Enqueue a request; the Future resolves to the (N, ...) host
         output. Oversized requests split across dispatches and
-        reassemble transparently."""
+        reassemble transparently. An expired ``deadline`` sheds
+        synchronously (DeadlineExceeded, never enqueued); one that
+        expires while queued sheds at batch forming — either way the
+        request never reaches the device."""
         x = np.asarray(features)  # host-sync-ok: serving ingress stages request features on host
         if x.ndim == 0 or x.shape[0] == 0:
             raise ValueError(
@@ -529,6 +543,12 @@ class ServingEngine:
             x = x.astype(self.dtype)
         if self._shutdown.is_set():
             raise RuntimeError("ServingEngine is shut down")
+        if deadline is not None and deadline.expired:
+            self._c_deadline_shed.inc(1.0, session=self.session_id,
+                                      precision=self._ptag,
+                                      stage="ingress")
+            raise DeadlineExceeded(
+                "serving: deadline expired at ingress")
         chunks = [x[i:i + self.batch_limit]
                   for i in range(0, x.shape[0], self.batch_limit)]
         self._c_requests.inc(1.0, session=self.session_id, precision=self._ptag)
@@ -536,19 +556,21 @@ class ServingEngine:
             self._inflight_count += 1
             self._g_inflight.set(self._inflight_count,
                                  session=self.session_id, precision=self._ptag)
-        futures = [self._enqueue(c) for c in chunks]
+        futures = [self._enqueue(c, deadline) for c in chunks]
         if len(futures) == 1:
             self._track(futures[0])
             return futures[0]
         return self._join_futures(futures)
 
-    def output(self, features) -> np.ndarray:
+    def output(self, features,
+               deadline: Optional[Deadline] = None) -> np.ndarray:
         """Blocking inference (reference: ParallelInference.output:113)."""
-        return self.submit(features).result()
+        return self.submit(features, deadline=deadline).result()
 
-    def _enqueue(self, chunk: np.ndarray) -> Future:
+    def _enqueue(self, chunk: np.ndarray,
+                 deadline: Optional[Deadline] = None) -> Future:
         f: Future = Future()
-        req = _Request(chunk, f, time.perf_counter())
+        req = _Request(chunk, f, time.perf_counter(), deadline)
         while True:
             if self._shutdown.is_set():
                 raise RuntimeError("ServingEngine is shut down")
@@ -824,10 +846,30 @@ class ServingEngine:
             total += item.x.shape[0]
         return batch
 
+    def _shed_expired(self,
+                      batch: List[_Request]) -> List[_Request]:
+        """Drop requests whose deadline expired while they queued —
+        the last gate before the device; the waiter gets
+        DeadlineExceeded instead of a stale answer."""
+        live = []
+        for req in batch:
+            if req.deadline is not None and req.deadline.expired:
+                self._c_deadline_shed.inc(
+                    1.0, session=self.session_id,
+                    precision=self._ptag, stage="batch")
+                if not req.future.done():
+                    req.future.set_exception(DeadlineExceeded(
+                        "serving: deadline expired while queued"))
+            else:
+                live.append(req)
+        return live
+
     def _dispatch_loop(self):
         while not self._shutdown.is_set():
             t_form0 = time.perf_counter()
             batch = self._form_batch()
+            if batch:
+                batch = self._shed_expired(batch)
             if not batch:
                 continue
             self._g_queue.set(self._queue.qsize(),
@@ -883,6 +925,8 @@ class ServingEngine:
         tracer.add_span("batch_form", t_form0, t_formed, cat="serve",
                         n=n, bucket=bucket)
         where = self._target_for(bucket)
+        if self._chaos_dispatch is not None:
+            self._chaos_dispatch.fail(arg=str(where))
         out = self._run(x, bucket, where)
         t_dispatched = time.perf_counter()
         tracer.add_span("dispatch", t_formed, t_dispatched, cat="serve",

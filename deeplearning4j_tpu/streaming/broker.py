@@ -28,6 +28,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.chaos.hook import chaos_site
 from deeplearning4j_tpu.streaming.serde import NDArrayMessage
 
 
@@ -157,6 +158,10 @@ class TcpTransport(Transport):
         self._sock: Optional[socket.socket] = None
         self._server = None
         self._lock = threading.Lock()
+        # chaos faults here surface as ConnectionError so the reconnect
+        # machinery under test treats them exactly like a dropped peer
+        self._chaos_pub = chaos_site("broker.publish")
+        self._chaos_poll = chaos_site("broker.poll")
         from deeplearning4j_tpu.observe.registry import default_registry
         reg = registry if registry is not None else default_registry()
         self._c_reconnects = reg.counter(
@@ -218,6 +223,8 @@ class TcpTransport(Transport):
         frame = struct.pack("<BII", 0, len(tb), len(payload)) + tb + payload
 
         def send():
+            if self._chaos_pub is not None:
+                self._chaos_pub.fail(arg=topic, raise_as=ConnectionError)
             self._conn().sendall(frame)
         self._with_retry("publish", send)
 
@@ -225,6 +232,9 @@ class TcpTransport(Transport):
         tb = topic.encode("utf-8")
 
         def exchange():
+            if self._chaos_poll is not None:
+                self._chaos_poll.fail(arg=topic,
+                                      raise_as=ConnectionError)
             s = self._conn()
             # socket deadline must outlast the server-side poll wait, or a
             # mid-exchange timeout desyncs the framed protocol

@@ -14,7 +14,12 @@
   Behind a FleetRouter the submit passes admission control; a shed
   answers **HTTP 503** + Retry-After exactly like the predict route,
   BEFORE any stream bytes go out. An engine-only module maps its
-  queue-full refusal the same way.
+  queue-full refusal the same way. An ``X-Deadline-Ms`` header or
+  ``"deadline_ms"`` body field arms an end-to-end deadline: expired at
+  submit → **HTTP 504** ``{"error": "deadline"}`` before any stream
+  bytes; expired mid-decode → the sequence retires with reason
+  ``"deadline"``. A client that disconnects mid-stream cancels its
+  sequence and frees the slot (``dl4j_gen_client_disconnect_total``).
 
 - ``GET /api/generation/stats``  engine snapshot: per-token p50/p99,
   time-to-first-token, active/max slots, retirement outcomes, stream
@@ -29,6 +34,7 @@ from __future__ import annotations
 import math
 from typing import List
 
+from deeplearning4j_tpu.parallel.deadline import Deadline, DeadlineExceeded
 from deeplearning4j_tpu.ui.modules import Route, UIModule
 
 _RESULT_TIMEOUT_S = 300.0
@@ -52,7 +58,7 @@ class GenerationModule(UIModule):
             Route("GET", "/api/generation/stats", self._stats),
         ]
 
-    def _submit(self, body):
+    def _submit(self, body, deadline=None):
         kw = {}
         for key in ("max_new_tokens", "top_k", "seed"):
             if key in body:
@@ -66,16 +72,24 @@ class GenerationModule(UIModule):
         prompt = body.get("prompt", "")
         if self.router is not None:
             return self.router.generate(
-                prompt, model=body.get("model", self.model), **kw)
-        return self.engine.submit(prompt, **kw)
+                prompt, model=body.get("model", self.model),
+                deadline=deadline, **kw)
+        return self.engine.submit(prompt, deadline=deadline, **kw)
 
     def _generate(self, ctx, query, body):
         from deeplearning4j_tpu.parallel.fleet import ShedError
         if not isinstance(body, dict):
             raise ValueError('expected {"prompt": ...}')
+        deadline = Deadline.from_ingress(getattr(ctx, "headers", None), body)
         try:
-            stream = self._submit(body)
+            stream = self._submit(body, deadline=deadline)
+        except DeadlineExceeded:
+            return ({"error": "deadline", "reason": "deadline"},
+                    None, 504)
         except ShedError as e:
+            if e.reason == "deadline":
+                return ({"error": "deadline", "model": e.model,
+                         "reason": "deadline"}, None, 504)
             retry_after = max(1, int(math.ceil(
                 getattr(self.router, "window_s", 1.0))))
             return ({"error": "shed", "model": e.model,
@@ -90,29 +104,43 @@ class GenerationModule(UIModule):
             res = stream.result(timeout=_RESULT_TIMEOUT_S)
             vocab = self._vocab()
             res["text"] = vocab.decode(res["ids"]) if vocab else None
+            if res.get("reason") == "deadline":
+                # budget ran out mid-decode: the partial result ships,
+                # but under 504 so the caller knows it was truncated
+                return (res, None, 504)
             return res
         return self._sse(stream)
 
-    def _vocab(self):
+    def _engine(self):
         if self.engine is not None:
-            return self.engine.vocab
+            return self.engine
         try:
-            return self.router.generation_pool(self.model).engine.vocab
+            return self.router.generation_pool(self.model).engine
         except Exception:
             return None
+
+    def _vocab(self):
+        eng = self._engine()
+        return eng.vocab if eng is not None else None
 
     def _sse(self, stream):
         """Generator payload for ui/server.py's event-stream path. The
         server close()s this generator when the client disconnects
-        mid-stream; the finally turns that into a cancel so the engine
-        retires the slot instead of decoding into the void."""
+        mid-stream; the finally turns that into an engine-level cancel
+        (``dl4j_gen_client_disconnect_total``) so the scheduler retires
+        the slot — even one still in prefill — and frees it for the
+        next sequence instead of decoding into the void."""
         def events():
             try:
                 for ev in stream:
                     yield ev
             finally:
                 if not stream.done:
-                    stream.cancel()
+                    eng = self._engine()
+                    if eng is not None:
+                        eng.cancel(stream, disconnect=True)
+                    else:
+                        stream.cancel()
         return events()
 
     def _stats(self, ctx, query, body):

@@ -37,9 +37,13 @@ class Route:
 
 @dataclasses.dataclass
 class UIModuleContext:
-    """What a handler sees: the attached storage + the live server."""
+    """What a handler sees: the attached storage + the live server,
+    plus the request headers (an ``email.message.Message``-like mapping,
+    or None in direct-call tests) so handlers can read per-request
+    metadata like ``X-Deadline-Ms``."""
     storage: object
     server: object
+    headers: object = None
 
 
 class UIModule:

@@ -528,14 +528,22 @@ class _Handler(BaseHTTPRequestHandler):
     def _run_module_route(self, route, u, body) -> None:
         """Dispatch to a registered UIModule route (the UIModule.java
         SPI); built-in routes have already had their chance, so core
-        paths cannot be shadowed."""
+        paths cannot be shadowed. A ``DeadlineExceeded`` escaping the
+        handler answers **504** with ``{"error": "deadline"}`` — the
+        request's budget ran out, which is neither a module bug (500)
+        nor an overload shed (503)."""
+        from deeplearning4j_tpu.parallel.deadline import DeadlineExceeded
         from deeplearning4j_tpu.ui.modules import UIModuleContext
         q = {k: v[0] for k, v in parse_qs(u.query).items()}
-        ctx = UIModuleContext(storage=self.storage, server=self.server)
+        ctx = UIModuleContext(storage=self.storage, server=self.server,
+                              headers=self.headers)
         status = 200
         extra_headers = None
         stream = None
         try:
+            chaos = getattr(self.server, "chaos_request", None)
+            if chaos is not None:
+                chaos.fail(arg=u.path)
             out = route.handler(ctx, q, body)
             if self._is_stream(out):
                 # generator/iterator payload: stream it as SSE below,
@@ -566,6 +574,9 @@ class _Handler(BaseHTTPRequestHandler):
                     "module route handler must return a dict or a "
                     f"(payload, content_type) tuple, got "
                     f"{type(out).__name__}")
+        except DeadlineExceeded:
+            self._json({"error": "deadline", "reason": "deadline"}, 504)
+            return
         except Exception as e:                # module bug ≠ server crash
             # full detail stays in the server log; HTTP clients only
             # learn the exception class (no message text leaks)
@@ -862,6 +873,11 @@ class UIServer:
         self._httpd.drain_paths = {"/api/predict", "/api/generate"}
         self._httpd.active_requests = 0
         self._httpd.active_lock = threading.Lock()
+        # fault injection on the ingress edge (chaos/plan.py site
+        # "ui.request"): resolved ONCE here — None when disarmed, so
+        # per-request dispatch pays a single attribute probe
+        from deeplearning4j_tpu.chaos.hook import chaos_site
+        self._httpd.chaos_request = chaos_site("ui.request")
         self.port = self._httpd.server_address[1]   # resolves port 0
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True)

@@ -22,6 +22,12 @@ lifecycle surface:
   through the router's admission control; a shed request answers **HTTP
   503** with ``{"error": "shed", "model": ..., "reason": "queue"|"slo"}``
   so external load balancers can react (retry-after, spillover).
+
+Both predict routes honor an end-to-end deadline: ``X-Deadline-Ms``
+header or ``"deadline_ms"`` body field (body wins). An expired budget
+answers **HTTP 504** ``{"error": "deadline"}`` — shed synchronously at
+whichever tier noticed first (admission, batch forming, dispatch), so
+an expired request never reaches the device.
 - ``GET /api/fleet/stats``  router snapshot: per-pool active/standby
   version, pending depth, shed fraction, windowed p99.
 - ``POST /api/fleet/swap``  {"model": name, "version": v, "path": zip}
@@ -40,7 +46,18 @@ from typing import List
 
 import numpy as np
 
+from deeplearning4j_tpu.parallel.deadline import Deadline, DeadlineExceeded
 from deeplearning4j_tpu.ui.modules import Route, UIModule
+
+
+def _deadline_response(model=None):
+    """504 Gateway Timeout: the request's own deadline ran out — not an
+    overload (503, retryable here) and not a bug (500). No Retry-After:
+    re-sending the same expired budget cannot succeed."""
+    out = {"error": "deadline", "reason": "deadline"}
+    if model is not None:
+        out["model"] = model
+    return (out, None, 504)
 
 
 class ServingModule(UIModule):
@@ -56,9 +73,16 @@ class ServingModule(UIModule):
     def _predict(self, ctx, query, body):
         if not isinstance(body, dict) or "features" not in body:
             raise ValueError('expected {"features": [[...], ...]}')
+        deadline = Deadline.from_ingress(getattr(ctx, "headers", None), body)
         x = np.asarray(body["features"],  # host-sync-ok: decoding the JSON request body, already host data
                        dtype=self.engine.dtype)
-        out = self.engine.output(x)
+        try:
+            # forward the deadline only when the client sent one, so
+            # duck-typed engines without the kwarg keep working
+            out = (self.engine.output(x, deadline=deadline)
+                   if deadline is not None else self.engine.output(x))
+        except DeadlineExceeded:
+            return _deadline_response()
         return {"output": np.asarray(out).tolist(),  # host-sync-ok: HTTP response must be host JSON
                 "n": int(x.shape[0])}
 
@@ -84,10 +108,20 @@ class FleetModule(UIModule):
         from deeplearning4j_tpu.parallel.fleet import ShedError
         if not isinstance(body, dict) or "features" not in body:
             raise ValueError('expected {"features": [[...], ...]}')
+        deadline = Deadline.from_ingress(getattr(ctx, "headers", None), body)
         x = np.asarray(body["features"], dtype=np.float32)  # host-sync-ok: decoding the JSON request body, already host data
         try:
-            out = self.router.output(x, model=body.get("model"))
+            # forward the deadline only when the client sent one, so
+            # duck-typed routers without the kwarg keep working
+            out = (self.router.output(x, model=body.get("model"),
+                                      deadline=deadline)
+                   if deadline is not None
+                   else self.router.output(x, model=body.get("model")))
+        except DeadlineExceeded:
+            return _deadline_response(model=body.get("model"))
         except ShedError as e:
+            if e.reason == "deadline":
+                return _deadline_response(model=e.model)
             # 503 = "overloaded, retry elsewhere/later" — distinct from
             # a 500 module bug, and the worker/soak driver counts it.
             # Retry-After tells remote retries to back off instead of

@@ -47,6 +47,14 @@ JAX_PLATFORMS=cpu python -m benchmarks.serving --smoke-fleet
 # SIGTERM-drained (finishes in-flight, deregisters, exits 0); client
 # errors bounded by the killed node's in-flight window, p99 gated
 JAX_PLATFORMS=cpu python -m benchmarks.serving --smoke-cluster
+# chaos tier: deterministic fault injection under an armed DL4J_CHAOS
+# plan — torn registry record classified dead then healed, corrupted
+# AOT blob quarantined + live-compiled warm, chaos-delayed remote sends
+# absorbed with zero client errors, broker drops + restart survived,
+# same-seed replay bitwise identical; plus expired-deadline requests
+# answered 504 at the front door WITHOUT device dispatch, and the
+# graftlint chaos-hygiene baseline stays empty
+JAX_PLATFORMS=cpu python -m benchmarks.serving --smoke-chaos
 # elastic tier: with one straggler, bounded-staleness ASYNC_ELASTIC
 # sustains >=1.5x the SYNC round rate with divergence under the
 # hard-sync threshold, and reduces exactly to AVERAGING without one

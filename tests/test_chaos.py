@@ -1,0 +1,908 @@
+"""Deterministic fault injection + end-to-end deadline tests (PR 14).
+
+The contracts under test:
+
+- **plan grammar + determinism** (chaos/plan.py): the ``DL4J_CHAOS``
+  string parses into seeded FaultSpecs (malformed input raises), and a
+  plan's injection sequence is a pure function of (seed, hit order) —
+  two identical drives produce bitwise-equal ``replay_signature()``s.
+- **chaos matrix** (the satellite sweep): {delay, error, torn-write,
+  corrupt-blob, clock-skew} x {artifact-store warm, registry scan,
+  remote dispatch, broker publish} each degrade along the documented
+  tier (quarantine-and-miss, dead-classify, retry-onto-other-node,
+  reconnect) instead of crashing or hanging — and the whole sweep
+  replays bitwise under the same seed.
+- **deadlines** (parallel/deadline.py + every tier): ``from_ingress``
+  parsing (body beats header, garbage degrades to None), and an
+  expired budget sheds SYNCHRONOUSLY at fleet admission (ShedError
+  reason ``deadline``), serving ingress, remote ingress + retry gate,
+  generation ingress/queue/decode — never reaching the device — with
+  the ui tier mapping all of it to HTTP 504.
+- **satellites**: malformed ``Retry-After`` falls back to the backoff
+  curve (counted), streaming/corpus iterators distinguish a dead
+  transport/store from a quiet topic via ``termination_reason``, SSE
+  client disconnect frees the generation slot (counted), and the
+  ``chaos-hygiene`` graftlint rule rejects plan imports / per-loop
+  site resolution on hot paths.
+
+Everything runs on injected clocks/transports where possible; the only
+real compiles are the tiny store-tier exports.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.chaos import plan as chaosplan
+from deeplearning4j_tpu.chaos.hook import chaos_site
+from deeplearning4j_tpu.chaos.plan import (
+    ChaosError,
+    FaultPlan,
+    FaultSpec,
+    parse_plan,
+    site_seed,
+)
+from deeplearning4j_tpu.datasets.corpus import (
+    CorpusDataSetIterator,
+    CorpusShardWriter,
+)
+from deeplearning4j_tpu.nlp.sentence_iterators import (
+    StreamingSentenceIterator,
+)
+from deeplearning4j_tpu.observe.registry import MetricsRegistry
+from deeplearning4j_tpu.parallel.aot_cache import (
+    AOTExecutableCache,
+    ArtifactStore,
+    fingerprint,
+)
+from deeplearning4j_tpu.parallel.deadline import Deadline, DeadlineExceeded
+from deeplearning4j_tpu.parallel.fleet import FleetRouter, ModelPool, ShedError
+from deeplearning4j_tpu.parallel.node import NodeRegistry
+from deeplearning4j_tpu.parallel.remote import RemoteDispatcher
+from deeplearning4j_tpu.streaming.broker import TcpTransport
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OK_BODY = json.dumps({"output": [[0.0]], "n": 1}).encode()
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    """No chaos test may leak an armed plan into the rest of the
+    suite."""
+    yield
+    chaosplan.disarm()
+
+
+def _arm(text: str, registry=None) -> FaultPlan:
+    return chaosplan.arm(
+        parse_plan(text, registry=registry or MetricsRegistry()))
+
+
+class Clock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# plan grammar
+# ---------------------------------------------------------------------------
+
+
+class TestPlanGrammar:
+    def test_full_clause(self):
+        p = parse_plan(
+            "seed=42;remote.send:delay(p=0.25,ms=40);"
+            "store.save:corrupt(count=1,after=2,arg=blob)",
+            registry=MetricsRegistry())
+        assert p.seed == 42
+        assert len(p.specs) == 2
+        d, c = p.specs
+        assert (d.site, d.kind, d.p, d.ms) == ("remote.send", "delay",
+                                               0.25, 40.0)
+        assert (c.site, c.kind, c.count, c.after, c.arg) == \
+            ("store.save", "corrupt", 1, 2, "blob")
+
+    def test_hex_seed_and_empty_clauses(self):
+        p = parse_plan("seed=0x10;;broker.publish:error;",
+                       registry=MetricsRegistry())
+        assert p.seed == 16
+        assert [s.kind for s in p.specs] == ["error"]
+
+    @pytest.mark.parametrize("bad", [
+        "remote.send",                       # no :kind
+        ":error",                            # no site
+        "remote.send:error(p=0.5",           # unbalanced parens
+        "remote.send:error(p)",              # param without =
+        "remote.send:error(bogus=1)",        # unknown param
+        "remote.send:frobnicate",            # unknown kind
+        "remote.send:error(p=1.5)",          # p out of [0, 1]
+        "seed=nope",                         # unparseable seed
+    ])
+    def test_malformed_raises(self, bad):
+        with pytest.raises(ValueError):
+            parse_plan(bad, registry=MetricsRegistry())
+
+    def test_unknown_kind_raises_in_spec(self):
+        with pytest.raises(ValueError):
+            FaultSpec(site="x", kind="explode")
+
+
+# ---------------------------------------------------------------------------
+# determinism
+# ---------------------------------------------------------------------------
+
+
+def _drive_probabilistic(seed: int):
+    p = parse_plan(f"seed={seed};s.x:delay(p=0.5,ms=0)",
+                   registry=MetricsRegistry())
+    site = p.site("s.x")
+    for _ in range(256):
+        site.hit()
+    return p.replay_signature()
+
+
+class TestDeterminism:
+    def test_same_seed_bitwise_identical(self):
+        s1, s2 = _drive_probabilistic(7), _drive_probabilistic(7)
+        assert s1 == s2
+        assert 0 < len(s1) < 256          # p=0.5 fired SOME of the time
+
+    def test_different_seed_differs(self):
+        assert _drive_probabilistic(7) != _drive_probabilistic(8)
+
+    def test_site_seeds_independent(self):
+        assert site_seed(42, "remote.send") != site_seed(42, "store.save")
+        assert site_seed(42, "remote.send") != site_seed(43, "remote.send")
+
+    def test_count_after_arg_discipline(self):
+        p = parse_plan("seed=1;s:error(count=2,after=3,arg=a)",
+                       registry=MetricsRegistry())
+        site = p.site("s")
+        fired = []
+        for i in range(10):
+            inj = site.hit(arg="a" if i % 2 == 0 else "b")
+            if inj is not None:
+                fired.append((i, inj.hit))
+        # after=3 skips hits 0..2; arg=a matches even hits only;
+        # count=2 caps the total
+        assert fired == [(4, 4), (6, 6)]
+
+    def test_unlisted_site_is_none(self):
+        p = parse_plan("s:error", registry=MetricsRegistry())
+        assert p.site("other") is None
+
+
+# ---------------------------------------------------------------------------
+# site act-out primitives
+# ---------------------------------------------------------------------------
+
+
+class TestSiteActions:
+    def test_error_raises_chaoserror(self):
+        p = parse_plan("s:error", registry=MetricsRegistry())
+        with pytest.raises(ChaosError, match="injected error at s"):
+            p.site("s").fail()
+
+    def test_error_raise_as(self):
+        p = parse_plan("s:error", registry=MetricsRegistry())
+        with pytest.raises(ConnectionError, match="chaos"):
+            p.site("s").fail(raise_as=ConnectionError)
+
+    def test_timeout_kind(self):
+        p = parse_plan("s:timeout", registry=MetricsRegistry())
+        with pytest.raises(TimeoutError):
+            p.site("s").fail()
+
+    def test_delay_returns_injection(self):
+        p = parse_plan("s:delay(ms=0)", registry=MetricsRegistry())
+        inj = p.site("s").fail()
+        assert inj is not None and inj.kind == "delay"
+
+    def test_mangle_torn_write_truncates(self):
+        p = parse_plan("s:torn_write", registry=MetricsRegistry())
+        data = bytes(range(64))
+        out, inj = p.site("s").mangle(data)
+        assert inj is not None and out == data[:32]
+
+    def test_mangle_corrupt_flips_one_draw_addressed_byte(self):
+        p = parse_plan("seed=9;s:corrupt", registry=MetricsRegistry())
+        data = bytes(range(64))
+        out, inj = p.site("s").mangle(data)
+        assert len(out) == len(data)
+        diff = [i for i in range(64) if out[i] != data[i]]
+        assert diff == [inj.draw % 64]
+        assert out[diff[0]] == data[diff[0]] ^ 0xFF
+
+    def test_mangle_passthrough_when_nothing_fires(self):
+        p = parse_plan("s:corrupt(count=1)", registry=MetricsRegistry())
+        site = p.site("s")
+        site.mangle(b"abc")                 # consumes the count
+        out, inj = site.mangle(b"abc")
+        assert out == b"abc" and inj is None
+
+    def test_skew(self):
+        p = parse_plan("c:clock_skew(skew_ms=5)",
+                       registry=MetricsRegistry())
+        assert p.site("c").skew() == pytest.approx(0.005)
+
+    def test_injected_counts_and_metric(self):
+        reg = MetricsRegistry()
+        p = parse_plan("s:error(count=3)", registry=reg)
+        site = p.site("s")
+        for _ in range(5):
+            try:
+                site.fail()
+            except ChaosError:
+                pass
+        assert p.injected() == {("s", "error"): 3}
+        c = reg.get_metric("dl4j_chaos_injected_total")
+        assert c.get(site="s", kind="error") == 3.0
+
+
+# ---------------------------------------------------------------------------
+# arming / disarming / the hot-path hook
+# ---------------------------------------------------------------------------
+
+
+class TestArming:
+    def test_arm_and_disarm(self):
+        _arm("remote.send:error")
+        assert chaosplan.active_plan() is not None
+        assert chaosplan.site("remote.send") is not None
+        assert chaos_site("remote.send") is not None
+        chaosplan.disarm()
+        assert chaosplan.active_plan() is None
+        assert chaosplan.site("remote.send") is None
+        assert chaos_site("remote.send") is None
+
+    def test_disarm_blocks_env_rearm(self, monkeypatch):
+        monkeypatch.setenv("DL4J_CHAOS", "remote.send:error")
+        chaosplan.disarm()
+        assert chaosplan.site("remote.send") is None
+        assert chaos_site("remote.send") is None
+
+    def test_arm_from_env(self, monkeypatch):
+        monkeypatch.setenv("DL4J_CHAOS", "seed=3;broker.publish:delay(ms=1)")
+        p = chaosplan.arm()
+        assert p.seed == 3
+        assert p.specs[0].site == "broker.publish"
+
+    def test_arm_without_plan_or_env_raises(self, monkeypatch):
+        monkeypatch.delenv("DL4J_CHAOS", raising=False)
+        with pytest.raises(ValueError):
+            chaosplan.arm()
+
+    def test_disarmed_process_never_imports_plan(self):
+        """The zero-overhead contract: a process that never arms chaos
+        must never import chaos.plan — the hook answers None from the
+        env/sys.modules probe alone."""
+        code = (
+            "import sys\n"
+            "import deeplearning4j_tpu.streaming.broker\n"
+            "from deeplearning4j_tpu.chaos.hook import chaos_site\n"
+            "assert chaos_site('broker.publish') is None\n"
+            "assert 'deeplearning4j_tpu.chaos.plan' not in sys.modules\n")
+        env = {k: v for k, v in os.environ.items() if k != "DL4J_CHAOS"}
+        r = subprocess.run([sys.executable, "-c", code], cwd=_ROOT,
+                           env=env, capture_output=True, text=True,
+                           timeout=120)
+        assert r.returncode == 0, r.stderr
+
+
+# ---------------------------------------------------------------------------
+# the chaos matrix (satellite 4)
+# ---------------------------------------------------------------------------
+
+# one plan exercising every kind across the four tiers; ``after=2`` on
+# the manifest clause separates the two store cycles (cycle 1: blob
+# corrupt, clean manifest; cycle 2: clean blob, torn manifest)
+_MATRIX = ("seed={seed};"
+           "registry.write:torn_write(count=1);"
+           "store.save:corrupt(count=1,arg=blob);"
+           "store.save:torn_write(count=1,after=2,arg=manifest);"
+           "remote.send:error(count=1);"
+           "remote.send:delay(ms=1,count=1);"
+           "remote.clock:clock_skew(skew_ms=5,count=2);"
+           "broker.publish:error(count=2)")
+
+
+def _store_cycle(base_dir):
+    """Tiny export -> save -> fresh-cache load. Returns the loader."""
+    import jax
+    import jax.numpy as jnp
+
+    def fwd(params, mstate, x):
+        return x * params["w"], mstate
+
+    params = {"w": jnp.asarray(2.0, jnp.float32)}
+    fp = fingerprint(params, {}, feature_shape=(3,), dtype=np.float32,
+                     ladder=[2])
+    saver = AOTExecutableCache(str(base_dir))
+    n = saver.save(jax.jit(fwd), (params, {}), fp, [2],
+                   np.zeros((1, 3), np.float32))
+    assert n == 1
+    loader = AOTExecutableCache(str(base_dir))
+    loaded = loader.try_load(fp)
+    return loader, loaded
+
+
+def _drive_matrix(tmp, seed):
+    """One deterministic pass over all four tiers under the armed
+    matrix plan; returns (observations, replay signature)."""
+    plan = _arm(_MATRIX.format(seed=seed))
+    out = {}
+
+    # -- registry scan tier: torn record -> classified dead ---------------
+    nreg = NodeRegistry(str(tmp / "reg"))
+    nreg.write("a", "http://a")                    # torn (count=1)
+    rec = nreg.snapshot()["a"]
+    out["torn"] = (rec["health"], rec.get("corrupt", False))
+    nreg.write("a", "http://a")                    # clean overwrite
+    out["healed"] = nreg.snapshot()["a"]["health"]
+
+    # -- store warm tier: corrupt blob -> quarantine; torn manifest -------
+    l1, loaded1 = _store_cycle(tmp / "aot1")
+    out["quarantine"] = (l1.quarantined, sorted(loaded1),
+                         "quarantined" in (l1.reason or ""))
+    assert os.path.exists(
+        str(tmp / "aot1" / "bucket_2.f32.stablehlo.quarantine"))
+    l2, loaded2 = _store_cycle(tmp / "aot2")
+    out["torn_manifest"] = (l2.state, sorted(loaded2),
+                            (l2.reason or "").startswith(
+                                "unreadable manifest"))
+
+    # -- remote dispatch tier: injected send error -> retry elsewhere -----
+    nreg.write("b", "http://b")
+    calls = []
+
+    def transport(url, body, timeout_s):
+        calls.append(url)
+        return 200, {}, OK_BODY
+
+    metrics = MetricsRegistry()
+    disp = RemoteDispatcher(nreg, transport=transport, metrics=metrics,
+                            snapshot_ttl_s=0.0, sleep=lambda s: None,
+                            seed=0, retries=2)
+    try:
+        res = disp.predict([[1.0]])
+    finally:
+        disp.shutdown()
+    retries = metrics.get_metric("dl4j_cluster_retries_total").get()
+    out["remote"] = (res["n"], len(calls), retries)
+
+    # -- broker publish tier: injected ConnectionError -> reconnect -------
+    t = TcpTransport(backoff_base_s=0.001, registry=MetricsRegistry())
+    t.serve()
+    try:
+        t.publish("s", b"hello")       # 2 injected drops, then lands
+        out["broker"] = (t.poll("s", timeout=2.0), t.reconnects)
+    finally:
+        t.close()
+
+    sig = plan.replay_signature()
+    chaosplan.disarm()
+    return out, sig
+
+
+class TestChaosMatrix:
+    def test_tiered_degradation_and_bitwise_replay(self, tmp_path):
+        out1, sig1 = _drive_matrix(tmp_path / "r1", seed=42)
+        out2, sig2 = _drive_matrix(tmp_path / "r2", seed=42)
+        out3, sig3 = _drive_matrix(tmp_path / "r3", seed=43)
+
+        # degradation, tier by tier
+        assert out1["torn"] == ("dead", True)       # torn -> dead, never up
+        assert out1["healed"] == "alive"            # next beat overwrites
+        q, loaded, reasoned = out1["quarantine"]
+        assert q == 1 and loaded == [] and reasoned
+        state, loaded2, unreadable = out1["torn_manifest"]
+        assert state == "mismatch" and loaded2 == [] and unreadable
+        n, transport_calls, retries = out1["remote"]
+        # first attempt dies on the injected error BEFORE the transport
+        # runs; the retry lands on the other node and succeeds
+        assert n == 1 and transport_calls == 1 and retries == 1.0
+        assert out1["broker"] == (b"hello", 2)
+
+        # bitwise replay: same seed, same driver -> identical trace
+        assert sig1 == sig2 and out1 == out2
+        assert sig1 != sig3
+        kinds = {(s, k) for s, k, _, _ in sig1}
+        assert kinds == {
+            ("registry.write", "torn_write"),
+            ("store.save", "corrupt"), ("store.save", "torn_write"),
+            ("remote.send", "error"), ("remote.send", "delay"),
+            ("remote.clock", "clock_skew"),
+            ("broker.publish", "error"),
+        }
+
+    def test_clock_skew_accumulates_on_dispatcher_clock(self, tmp_path):
+        _arm("seed=5;remote.clock:clock_skew(skew_ms=5,count=2)")
+        base = Clock(100.0)
+        disp = RemoteDispatcher(
+            NodeRegistry(str(tmp_path / "reg")),
+            transport=lambda *a: (200, {}, OK_BODY),
+            metrics=MetricsRegistry(), clock=base, sleep=lambda s: None)
+        try:
+            for _ in range(5):
+                disp.clock()
+            assert disp.clock() == pytest.approx(100.010)
+        finally:
+            disp.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# deadlines: parsing
+# ---------------------------------------------------------------------------
+
+
+class TestDeadlineParsing:
+    def test_body_beats_header(self):
+        clk = Clock()
+        d = Deadline.from_ingress({"X-Deadline-Ms": "50"},
+                                  {"deadline_ms": 10000}, clock=clk)
+        assert d.remaining_s() == pytest.approx(10.0)
+
+    def test_header_only(self):
+        clk = Clock()
+        d = Deadline.from_ingress({"X-Deadline-Ms": "250"}, {}, clock=clk)
+        assert d.remaining_s() == pytest.approx(0.25)
+
+    @pytest.mark.parametrize("raw", ["abc", "-5", "0", "inf", "nan", ""])
+    def test_garbage_degrades_to_none(self, raw):
+        assert Deadline.from_ingress({"X-Deadline-Ms": raw}, {},
+                                     clock=Clock()) is None
+        assert Deadline.from_ingress(None, {"deadline_ms": raw},
+                                     clock=Clock()) is None
+
+    def test_absent_is_none(self):
+        assert Deadline.from_ingress({}, {}, clock=Clock()) is None
+        assert Deadline.from_ingress(None, None, clock=Clock()) is None
+
+    def test_cap_timeout(self):
+        clk = Clock()
+        d = Deadline.after_ms(100, clock=clk)
+        assert d.cap_timeout(5.0) == pytest.approx(0.1)
+        assert d.cap_timeout(0.05) == pytest.approx(0.05)
+        assert d.cap_timeout(None) == pytest.approx(0.1)
+        clk.advance(1.0)
+        assert d.cap_timeout(5.0) == 0.0
+
+    def test_check_raises_with_detail(self):
+        clk = Clock()
+        d = Deadline(clk.t - 1.0, clock=clk)
+        assert d.expired
+        with pytest.raises(DeadlineExceeded, match="too slow"):
+            d.check("too slow")
+        Deadline(clk.t + 1.0, clock=clk).check()    # no raise
+
+
+# ---------------------------------------------------------------------------
+# deadlines: tier-by-tier synchronous shed
+# ---------------------------------------------------------------------------
+
+
+class TestDeadlineTiers:
+    def test_fleet_admission_sheds_expired(self):
+        reg = MetricsRegistry()
+        router = FleetRouter(registry=reg, max_pending=4)
+        pool = ModelPool("m", router, {}, 1, None)
+        clk = Clock()
+        with pytest.raises(ShedError) as ei:
+            pool.admit(Deadline(clk.t - 0.1, clock=clk))
+        assert ei.value.reason == "deadline"
+        assert pool.pending == 0            # never consumed a slot
+        assert reg.get_metric("dl4j_fleet_shed_total").get(
+            model="m", reason="deadline") == 1.0
+        pool.admit(Deadline(clk.t + 10.0, clock=clk))
+        assert pool.pending == 1
+
+    def test_remote_ingress_sheds_before_any_dispatch(self, tmp_path):
+        reg = MetricsRegistry()
+
+        def transport(*a):
+            raise AssertionError("expired request reached the transport")
+
+        disp = RemoteDispatcher(NodeRegistry(str(tmp_path / "r")),
+                                transport=transport, metrics=reg,
+                                sleep=lambda s: None)
+        clk = Clock()
+        try:
+            with pytest.raises(DeadlineExceeded):
+                disp.predict([[1.0]],
+                             deadline=Deadline(clk.t - 1, clock=clk))
+        finally:
+            disp.shutdown()
+        assert reg.get_metric("dl4j_remote_deadline_total").get(
+            stage="ingress") == 1.0
+
+    def test_remote_retry_gate_respects_budget(self, tmp_path):
+        """A 503 whose Retry-After overshoots the remaining budget must
+        504 NOW instead of sleeping into a guaranteed timeout."""
+        nreg = NodeRegistry(str(tmp_path / "r"))
+        nreg.write("a", "http://a")
+        nreg.write("b", "http://b")
+        reg = MetricsRegistry()
+        clk = Clock()
+        disp = RemoteDispatcher(
+            nreg, metrics=reg, snapshot_ttl_s=0.0, clock=clk,
+            sleep=lambda s: None, seed=0, retries=3,
+            transport=lambda *a: (503, {"Retry-After": "30"}, b""))
+        try:
+            with pytest.raises(DeadlineExceeded, match="budget"):
+                disp.predict([[1.0]],
+                             deadline=Deadline(clk.t + 1.0, clock=clk))
+        finally:
+            disp.shutdown()
+        assert reg.get_metric("dl4j_remote_deadline_total").get(
+            stage="retry") == 1.0
+
+    def test_serving_ingress_sheds_expired(self):
+        from deeplearning4j_tpu.parallel.serving import ServingEngine
+        reg = MetricsRegistry()
+        eng = ServingEngine(_tiny_model(), batch_limit=4,
+                            feature_shape=(5,), registry=reg,
+                            session_id="chaos-t")
+        clk = Clock()
+        try:
+            with pytest.raises(DeadlineExceeded):
+                eng.submit(np.zeros((1, 5), np.float32),
+                           deadline=Deadline(clk.t - 1, clock=clk))
+        finally:
+            eng.shutdown()
+        shed = reg.get_metric("dl4j_serving_deadline_shed_total")
+        assert sum(v for key, v in shed.series().items()
+                   if ("stage", "ingress") in key) == 1.0
+
+    def test_ui_serving_module_maps_deadline_to_504(self):
+        from deeplearning4j_tpu.parallel.serving import ServingEngine
+        from deeplearning4j_tpu.ui.modules import UIModuleContext
+        from deeplearning4j_tpu.ui.serving_module import ServingModule
+        eng = ServingEngine(_tiny_model(), batch_limit=4,
+                            feature_shape=(5,),
+                            registry=MetricsRegistry())
+        try:
+            mod = ServingModule(eng)
+            handler = {r.path: r.handler
+                       for r in mod.get_routes()}["/api/predict"]
+            ctx = UIModuleContext(storage=None, server=None,
+                                  headers={"X-Deadline-Ms": "1e-06"})
+            body, hdrs, status = handler(
+                ctx, {}, {"features": [[0.0] * 5]})
+        finally:
+            eng.shutdown()
+        assert status == 504
+        assert body == {"error": "deadline", "reason": "deadline"}
+
+
+def _tiny_model(seed: int = 1):
+    from deeplearning4j_tpu.models.multi_layer_network import (
+        MultiLayerNetwork)
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+    from deeplearning4j_tpu.ops.losses import LossFunction
+    from deeplearning4j_tpu.optimize.updaters import Adam
+    conf = (NeuralNetConfiguration.Builder().seed(seed)
+            .updater(Adam(1e-2)).list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT))
+            .set_input_type(InputType.feed_forward(5)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+# ---------------------------------------------------------------------------
+# generation: deadline + client disconnect (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def gen_setup():
+    from deeplearning4j_tpu.generation import GenerationEngine
+    from deeplearning4j_tpu.zoo.models import TextGenerationLSTM
+    m = TextGenerationLSTM()
+    m.lstm_units = 16
+    m.vocab_size = 31
+    m.timesteps = 8
+    reg = MetricsRegistry()
+    eng = GenerationEngine(m.init(), max_slots=2, registry=reg,
+                           session_id="chaos-gen")
+    eng.submit([1, 2], max_new_tokens=2,
+               greedy=True).result(timeout=120)    # pay the compile once
+    yield eng, reg
+    eng.shutdown()
+
+
+class TestGenerationDeadlineAndDisconnect:
+    def test_ingress_shed(self, gen_setup):
+        eng, reg = gen_setup
+        clk = Clock()
+        with pytest.raises(DeadlineExceeded):
+            eng.submit([1, 2, 3], max_new_tokens=5,
+                       deadline=Deadline(clk.t - 1, clock=clk))
+        assert reg.get_metric("dl4j_gen_deadline_shed_total").get(
+            session="chaos-gen", stage="ingress") == 1.0
+
+    def test_expires_mid_flight(self, gen_setup):
+        eng, reg = gen_setup
+        s = eng.submit([1, 2, 3], max_new_tokens=5000, greedy=True,
+                       deadline=Deadline.after_ms(30))
+        res = s.result(timeout=60)
+        assert res["reason"] == "deadline"
+        m = reg.get_metric("dl4j_gen_deadline_shed_total")
+        assert (m.get(session="chaos-gen", stage="queue") or 0.0) \
+            + (m.get(session="chaos-gen", stage="decode") or 0.0) >= 1.0
+
+    def test_client_disconnect_cancels_and_counts(self, gen_setup):
+        eng, reg = gen_setup
+        before = reg.get_metric(
+            "dl4j_gen_client_disconnect_total").get(
+                session="chaos-gen") or 0.0
+        s = eng.submit([3, 4, 5], max_new_tokens=5000, greedy=True)
+        assert eng.cancel(s, disconnect=True) in (True, False)
+        assert s.result(timeout=60)["reason"] == "cancelled"
+        assert reg.get_metric(
+            "dl4j_gen_client_disconnect_total").get(
+                session="chaos-gen") == before + 1.0
+        # a finished stream is NOT a disconnect
+        done = eng.submit([1], max_new_tokens=1, greedy=True)
+        done.result(timeout=60)
+        eng.cancel(done, disconnect=True)
+        assert reg.get_metric(
+            "dl4j_gen_client_disconnect_total").get(
+                session="chaos-gen") == before + 1.0
+
+
+# ---------------------------------------------------------------------------
+# Retry-After hardening (satellite 3)
+# ---------------------------------------------------------------------------
+
+
+class TestRetryAfterHardening:
+    def _disp(self, tmp_path, reg):
+        return RemoteDispatcher(NodeRegistry(str(tmp_path / "r")),
+                                transport=lambda *a: (200, {}, OK_BODY),
+                                metrics=reg, sleep=lambda s: None)
+
+    @pytest.mark.parametrize("bad", ["abc", "nan", "inf", "-1", "1e9",
+                                     None, [2]])
+    def test_malformed_rejected_and_counted(self, tmp_path, bad):
+        reg = MetricsRegistry()
+        disp = self._disp(tmp_path, reg)
+        try:
+            assert disp._parse_retry_after(bad) is None
+        finally:
+            disp.shutdown()
+        assert reg.get_metric(
+            "dl4j_remote_bad_retry_after_total").get() == 1.0
+
+    @pytest.mark.parametrize("ok,want", [("2.5", 2.5), ("0", 0.0),
+                                         (7, 7.0), ("3600", 3600.0)])
+    def test_wellformed_accepted(self, tmp_path, ok, want):
+        reg = MetricsRegistry()
+        disp = self._disp(tmp_path, reg)
+        try:
+            assert disp._parse_retry_after(ok) == want
+        finally:
+            disp.shutdown()
+        assert reg.get_metric(
+            "dl4j_remote_bad_retry_after_total").get() is None
+
+    def test_malformed_header_falls_back_to_backoff(self, tmp_path):
+        """One bad node header must not stall the client: the pause
+        comes from the backoff curve, not the garbage value."""
+        nreg = NodeRegistry(str(tmp_path / "r"))
+        nreg.write("a", "http://a")
+        nreg.write("b", "http://b")
+        answers = iter([(503, {"Retry-After": "garbage"}, b""),
+                        (200, {}, OK_BODY)])
+        sleeps = []
+        reg = MetricsRegistry()
+        disp = RemoteDispatcher(
+            nreg, transport=lambda *a: next(answers), metrics=reg,
+            snapshot_ttl_s=0.0, sleep=sleeps.append, seed=0, retries=2,
+            backoff_s=0.05, backoff_max_s=2.0)
+        try:
+            assert disp.predict([[1.0]])["n"] == 1
+        finally:
+            disp.shutdown()
+        assert reg.get_metric(
+            "dl4j_remote_bad_retry_after_total").get() == 1.0
+        assert len(sleeps) == 1 and 0.0 < sleeps[0] <= 2.0
+
+
+# ---------------------------------------------------------------------------
+# iterator termination reasons (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+class _ScriptedTransport:
+    """Poll answers from a script; a callable entry raises."""
+
+    def __init__(self, script):
+        self.script = list(script)
+
+    def poll(self, topic, timeout):
+        if not self.script:
+            return None
+        item = self.script.pop(0)
+        if callable(item):
+            raise item()
+        return item
+
+
+class TestStreamTermination:
+    def test_dead_transport_is_not_a_quiet_topic(self):
+        it = StreamingSentenceIterator(
+            _ScriptedTransport([b"one",
+                                lambda: ConnectionError("broker gone")]),
+            poll_timeout_s=0.01)
+        assert list(it) == ["one"]
+        assert it.termination_reason == "transport_dead"
+        assert "broker gone" in it.transport_error
+
+    def test_quiet_topic_idles_out(self):
+        it = StreamingSentenceIterator(
+            _ScriptedTransport([]), poll_timeout_s=0.01,
+            idle_timeout_s=0.0)
+        assert list(it) == []
+        assert it.termination_reason == "idle_timeout"
+        assert it.transport_error is None
+
+    def test_eos_frame(self):
+        it = StreamingSentenceIterator(
+            _ScriptedTransport([b"a", b""]), poll_timeout_s=0.01)
+        assert list(it) == ["a"]
+        assert it.termination_reason == "eos"
+
+    def test_max_sentences_and_stop(self):
+        it = StreamingSentenceIterator(
+            _ScriptedTransport([b"a", b"b", b"c"]),
+            poll_timeout_s=0.01, max_sentences=2)
+        assert list(it) == ["a", "b"]
+        assert it.termination_reason == "max_sentences"
+        ev = threading.Event()
+        ev.set()
+        it2 = StreamingSentenceIterator(
+            _ScriptedTransport([b"a"]), poll_timeout_s=0.01,
+            stop_event=ev)
+        assert list(it2) == []
+        assert it2.termination_reason == "stopped"
+
+
+class TestCorpusTermination:
+    def _spool(self, tmp_path, n=3, complete=True):
+        store = ArtifactStore(str(tmp_path / "store"))
+        w = CorpusShardWriter(store, "corpus", shard_sentences=2)
+        for i in range(n):
+            w.append(f"sentence {i}")
+        if complete:
+            w.close()
+        else:
+            w._seal_shard()
+        return store, w
+
+    def test_snapshot_eos(self, tmp_path):
+        store, _ = self._spool(tmp_path)
+        it = CorpusDataSetIterator(store, "corpus")
+        assert len(list(it)) == 3
+        assert it.termination_reason == "eos"
+
+    def test_follow_complete(self, tmp_path):
+        store, _ = self._spool(tmp_path)
+        it = CorpusDataSetIterator(store, "corpus", follow=True,
+                                   poll_interval_s=0.01)
+        assert len(list(it)) == 3
+        assert it.termination_reason == "complete"
+
+    def test_follow_idle_timeout(self, tmp_path):
+        store, _ = self._spool(tmp_path, n=2, complete=False)
+        it = CorpusDataSetIterator(store, "corpus", follow=True,
+                                   poll_interval_s=0.01,
+                                   idle_timeout_s=0.03)
+        assert len(list(it)) == 2
+        assert it.termination_reason == "idle_timeout"
+        assert it.store_error is None
+
+    def test_vanished_manifest_is_store_dead(self, tmp_path):
+        store, _ = self._spool(tmp_path, n=2, complete=False)
+        it = CorpusDataSetIterator(store, "corpus", follow=True,
+                                   poll_interval_s=0.01,
+                                   idle_timeout_s=10.0)
+        g = iter(it)
+        got = [next(g), next(g)]             # drain the sealed shard
+        os.remove(os.path.join(store.cache_dir("corpus"),
+                               "manifest.json"))
+        with pytest.raises(StopIteration):
+            next(g)
+        assert got == ["sentence 0", "sentence 1"]
+        assert it.termination_reason == "store_dead"
+        assert "vanished" in it.store_error
+
+    def test_unreadable_shard_is_store_dead(self, tmp_path):
+        store, w = self._spool(tmp_path, n=2, complete=False)
+        it = CorpusDataSetIterator(store, "corpus", follow=True,
+                                   poll_interval_s=0.01,
+                                   idle_timeout_s=10.0)
+        g = iter(it)
+        got = [next(g), next(g)]
+        w.append("sentence 2")
+        w.append("sentence 3")               # seals shard_000001
+        os.remove(os.path.join(store.cache_dir("corpus"),
+                               "shard_000001.txt"))
+        with pytest.raises(StopIteration):
+            next(g)
+        assert got == ["sentence 0", "sentence 1"]
+        assert it.termination_reason == "store_dead"
+        assert it.store_error
+
+
+# ---------------------------------------------------------------------------
+# graftlint chaos-hygiene rule (satellite: the contract is enforced)
+# ---------------------------------------------------------------------------
+
+
+def _lint(tmp_path, source, name="snippet.py"):
+    from tools.graftlint import get_rules, scan
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source), encoding="utf-8")
+    return scan([str(f)], rules=get_rules(["chaos-hygiene"]))
+
+
+class TestChaosHygieneRule:
+    def test_plan_import_flagged(self, tmp_path):
+        for src in (
+                "from deeplearning4j_tpu.chaos import arm\n",
+                "from deeplearning4j_tpu.chaos.plan import FaultPlan\n",
+                "import deeplearning4j_tpu.chaos.plan\n"):
+            findings = _lint(tmp_path, src)
+            assert len(findings) == 1
+            assert findings[0].rule == "chaos-hygiene"
+
+    def test_extra_hook_import_flagged(self, tmp_path):
+        findings = _lint(
+            tmp_path,
+            "from deeplearning4j_tpu.chaos.hook import chaos_site, os\n")
+        assert len(findings) == 1
+        assert "os" in findings[0].message
+
+    def test_per_loop_resolution_flagged(self, tmp_path):
+        findings = _lint(tmp_path, """
+            from deeplearning4j_tpu.chaos.hook import chaos_site
+
+            def f(xs):
+                for x in xs:
+                    h = chaos_site("remote.send")
+        """)
+        assert len(findings) == 1
+        assert "loop" in findings[0].message
+
+    def test_bind_once_pattern_is_clean(self, tmp_path):
+        findings = _lint(tmp_path, """
+            from deeplearning4j_tpu.chaos.hook import chaos_site
+
+            class Seam:
+                def __init__(self):
+                    self._chaos = chaos_site("remote.send")
+
+                def run(self, xs):
+                    for x in xs:
+                        if self._chaos is not None:
+                            self._chaos.fail(arg=x)
+        """)
+        assert findings == []

@@ -119,11 +119,22 @@ class TestNodeRegistry:
         assert got == ["alive", "slow"]     # alive first, slow last
         #                                     resort; dead/drain absent
 
-    def test_torn_record_is_invisible(self, tmp_path):
+    def test_torn_record_classified_dead(self, tmp_path):
+        """A torn record (interrupted writer, bit rot) surfaces as a
+        DEAD placeholder — visible in the ledger with ``corrupt: True``
+        so operators can see it, invisible to dispatch, healed whole by
+        the node's next clean beat."""
         reg = NodeRegistry(str(tmp_path / "r"))
         reg.write("good", "http://g")
         (tmp_path / "r" / "node_torn.json").write_text('{"node_id": "t')
-        assert list(reg.read_all()) == ["good"]
+        recs = reg.read_all()
+        assert sorted(recs) == ["good", "torn"]
+        assert recs["torn"]["corrupt"] is True
+        assert reg.snapshot()["torn"]["health"] == "dead"
+        assert [r["node_id"] for r in reg.dispatchable()] == ["good"]
+        reg.write("torn", "http://t")          # clean beat heals it
+        healed = reg.snapshot()["torn"]
+        assert healed["health"] == "alive" and "corrupt" not in healed
 
     def test_dead_before_slow_rejected(self, tmp_path):
         with pytest.raises(ValueError, match="dead before slow"):

@@ -7,13 +7,14 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence
 
 from tools.graftlint.rules.host_sync import HostSyncRule
+from tools.graftlint.rules.chaos_hygiene import ChaosHygieneRule
 from tools.graftlint.rules.donation_safety import DonationSafetyRule
 from tools.graftlint.rules.recompile_hazard import RecompileHazardRule
 from tools.graftlint.rules.thread_discipline import ThreadDisciplineRule
 from tools.graftlint.rules.tracer_leak import TracerLeakRule
 
-ALL_RULES = (HostSyncRule, DonationSafetyRule, RecompileHazardRule,
-             ThreadDisciplineRule, TracerLeakRule)
+ALL_RULES = (HostSyncRule, ChaosHygieneRule, DonationSafetyRule,
+             RecompileHazardRule, ThreadDisciplineRule, TracerLeakRule)
 
 RULES_BY_NAME: Dict[str, type] = {r.name: r for r in ALL_RULES}
 

@@ -1,0 +1,93 @@
+"""chaos-hygiene: keep the fault-injection layer off the hot paths.
+
+The chaos layer (deeplearning4j_tpu/chaos/) is built around a
+zero-overhead disarm contract: hot modules import ONLY the lazy probe
+``from deeplearning4j_tpu.chaos.hook import chaos_site``, bind each
+site handle ONCE at construction, and guard injection points with a
+``if self._chaos_x is not None`` test. When no plan is armed the hook
+returns None without ever importing ``chaos.plan`` — the per-request
+cost is one attribute probe and a None test.
+
+This rule polices the two ways that contract erodes:
+
+- importing anything from ``deeplearning4j_tpu.chaos`` other than the
+  hook's ``chaos_site`` inside a hot path (the package ``__init__`` and
+  ``chaos.plan`` pull in the full plan machinery — locks, registry,
+  splitmix draws — onto every import of the hot module, armed or not);
+- calling ``chaos_site()`` inside a ``for``/``while`` body (the probe
+  does an environ + sys.modules check; resolved per-iteration it puts
+  dict lookups back on the loop the None-handle pattern exists to
+  protect).
+
+Scope: the same ``HOT_PATHS`` the host-sync rule polices — everywhere
+a hidden per-iteration cost is a regression.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.graftlint.engine import Finding, ModuleContext, Project, Rule
+from tools.graftlint.rules.host_sync import HOT_PATHS
+
+_HOOK_MODULE = "deeplearning4j_tpu.chaos.hook"
+_CHAOS_PREFIX = "deeplearning4j_tpu.chaos"
+
+
+class ChaosHygieneRule(Rule):
+    name = "chaos-hygiene"
+    description = ("fault-injection layer leaking onto hot paths: "
+                   "non-hook chaos imports, or chaos_site() resolved "
+                   "inside a loop body")
+    paths = HOT_PATHS
+
+    def check(self, ctx: ModuleContext,
+              project: Project) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod == _HOOK_MODULE:
+                    for a in node.names:
+                        if a.name != "chaos_site":
+                            yield ctx.finding(
+                                self.name, node.lineno,
+                                f"import of {a.name!r} from the chaos "
+                                "hook — hot paths may import only "
+                                "chaos_site")
+                elif mod == _CHAOS_PREFIX \
+                        or mod.startswith(_CHAOS_PREFIX + "."):
+                    yield ctx.finding(
+                        self.name, node.lineno,
+                        f"hot path imports {mod!r} — only the lazy "
+                        f"probe 'from {_HOOK_MODULE} import "
+                        "chaos_site' is allowed (the plan machinery "
+                        "must stay un-imported while disarmed)")
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == _CHAOS_PREFIX \
+                            or a.name.startswith(_CHAOS_PREFIX + "."):
+                        yield ctx.finding(
+                            self.name, node.lineno,
+                            f"hot path imports {a.name!r} — only the "
+                            f"lazy probe 'from {_HOOK_MODULE} import "
+                            "chaos_site' is allowed")
+        seen = set()
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            for sub in ast.walk(loop):
+                if not isinstance(sub, ast.Call):
+                    continue
+                f = sub.func
+                fname = f.id if isinstance(f, ast.Name) else (
+                    f.attr if isinstance(f, ast.Attribute) else None)
+                if fname == "chaos_site" and sub.lineno not in seen:
+                    seen.add(sub.lineno)
+                    yield ctx.finding(
+                        self.name, sub.lineno,
+                        "chaos_site() resolved inside a loop body — "
+                        "bind the site handle once at construction "
+                        "and test 'if handle is not None' in the loop")
