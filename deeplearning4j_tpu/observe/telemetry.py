@@ -442,7 +442,7 @@ class TelemetryCollector:
         """Called after each dispatched train step (``steps`` > 1 for the
         scanned multi-step). Flushes when a full interval has
         accumulated; otherwise free — no device interaction."""
-        self._pending += int(steps)
+        self._pending += int(steps)  # graftlint: disable=release-discipline: flush-interval accumulator reset by flush(), not a capacity claim
         if self._pending >= self.flush_interval:
             self.flush(train_state)
 

@@ -357,7 +357,7 @@ class AOTExecutableCache:
                     blob).hexdigest()
                 if self._chaos_save is not None:
                     blob, _ = self._chaos_save.mangle(blob, arg="blob")
-                (self.dir / self._blob_name(bucket,
+                (self.dir / self._blob_name(bucket,  # graftlint: disable=atomic-write: blob bytes are sha256-checksummed and only become visible through the manifest's atomic os.replace; a torn blob quarantines at load
                                             precision)).write_bytes(blob)
                 # prime: the loading process compiles jit(exp.call), a
                 # different cache key than jit_fn's — pay it here, once,

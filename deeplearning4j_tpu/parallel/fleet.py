@@ -186,7 +186,7 @@ class ModelPool:
                     f"windowed p99 {self.windowed_p99_ms:.1f} ms over "
                     f"SLO {self.slo_ms:.1f} ms; shedding "
                     f"{self.shed_fraction:.0%} of arrivals")
-            self.pending += 1
+            self.pending += 1  # graftlint: disable=release-discipline: released by submit()'s error path and the completion callback in _dispatch (cross-method by design)
             r._g_depth.set(self.pending, model=self.name)
         r._c_admitted.inc(1.0, model=self.name)
 

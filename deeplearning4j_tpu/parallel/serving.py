@@ -553,10 +553,21 @@ class ServingEngine:
                   for i in range(0, x.shape[0], self.batch_limit)]
         self._c_requests.inc(1.0, session=self.session_id, precision=self._ptag)
         with self._count_lock:
-            self._inflight_count += 1
+            self._inflight_count += 1  # graftlint: disable=release-discipline: released by the _track/_join_futures done-callbacks (cross-method by design); the error edge below releases inline
             self._g_inflight.set(self._inflight_count,
                                  session=self.session_id, precision=self._ptag)
-        futures = [self._enqueue(c, deadline) for c in chunks]
+        try:
+            futures = [self._enqueue(c, deadline) for c in chunks]
+        except BaseException:
+            # _enqueue can raise on the shutdown race; without this
+            # release the count never comes down and least-loaded
+            # routing starves the engine forever
+            with self._count_lock:
+                self._inflight_count -= 1
+                self._g_inflight.set(self._inflight_count,
+                                     session=self.session_id,
+                                     precision=self._ptag)
+            raise
         if len(futures) == 1:
             self._track(futures[0])
             return futures[0]

@@ -1,0 +1,724 @@
+"""graftlint v2 tests: the interprocedural layer (summaries, call
+graph, fixed points), the distributed-systems rule pack
+(deadline-propagation, release-discipline, atomic-write,
+metric-hygiene), the chaos seam-coverage audit, the content-hash
+summary cache, and the SARIF report.
+
+True-positive fixtures reproduce the historical bug shapes verbatim:
+the PR 14 ui-ingress deadline drop and the PR 11 retry-loop inflight
+leak. Each has a matching false-positive guard showing the fixed
+shape stays clean.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import subprocess
+import sys
+import textwrap
+import time
+from pathlib import Path
+
+import pytest
+
+from tools.graftlint import REPO_ROOT, get_rules, scan
+from tools.graftlint.baseline import fingerprints
+from tools.graftlint.cache import SummaryCache
+from tools.graftlint.callgraph import CallGraph
+from tools.graftlint.engine import ModuleContext, Project
+from tools.graftlint.report import render_sarif
+from tools.graftlint.rules.chaos_hygiene import ChaosHygieneRule
+from tools.graftlint.summaries import build_module_summary
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+def lint(tmp_path: Path, source: str, rules, name="snippet.py"):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source), encoding="utf-8")
+    return scan([str(f)], rules=get_rules(rules))
+
+
+def corpus_scan(tmp_path: Path, files, rules):
+    """Write {relpath: source} under tmp_path and scan the tree with
+    root=tmp_path so cross-module imports resolve inside the
+    fixture corpus."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src), encoding="utf-8")
+    return scan([str(tmp_path)], rules=get_rules(rules), root=tmp_path)
+
+
+def summarize(module: str, rel: str, source: str):
+    text = textwrap.dedent(source)
+    return build_module_summary(ast.parse(text), text, module, rel)
+
+
+# ---------------------------------------------------------------------------
+# summary + call-graph layer
+# ---------------------------------------------------------------------------
+
+class TestSummaryLayer:
+    def test_deadline_taint_through_derived_timeout(self):
+        ms = summarize("m", "m.py", """
+            def caller(x, deadline):
+                budget = deadline.remaining_s()
+                capped = min(budget, 5.0)
+                return post(x, timeout=capped)
+
+            def post(x, timeout=None):
+                return x
+        """)
+        cs = [c for c in ms.functions["caller"].calls
+              if c.callee == "post"]
+        assert len(cs) == 1
+        # timeout=capped is derived from the deadline two assignments
+        # deep: the taint closure must mark the site as forwarding
+        assert cs[0].passes_deadline
+
+    def test_explicit_deadline_kwarg_and_star_kw(self):
+        ms = summarize("m", "m.py", """
+            def a(x, deadline):
+                return post(x, deadline=deadline)
+
+            def b(x, deadline, **kw):
+                return post(x, **kw)
+        """)
+        [ca] = ms.functions["a"].calls
+        assert ca.passes_deadline
+        [cb] = ms.functions["b"].calls
+        assert cb.has_star_kw and not cb.passes_deadline
+
+    def test_exception_edge_leaves_resource_held(self):
+        ms = summarize("m", "m.py", """
+            class P:
+                def leak(self, item):
+                    self._sem.acquire()
+                    handle(item)
+                    self._sem.release()
+
+                def ok(self, item):
+                    self._sem.acquire()
+                    try:
+                        handle(item)
+                    finally:
+                        self._sem.release()
+        """)
+        leak = ms.functions["P.leak"].resource_issues
+        assert any(ri.kind == "exception" for ri in leak)
+        assert ms.functions["P.ok"].resource_issues == ()
+
+    def test_local_tallies_are_not_resources(self):
+        ms = summarize("m", "m.py", """
+            def count(items):
+                pending = 0
+                for it in items:
+                    pending = pending + 1
+                return pending
+        """)
+        assert ms.functions["count"].resource_issues == ()
+
+    def test_cross_module_resolution_via_from_import(self):
+        mods = {
+            "a": summarize("a", "a.py", """
+                from b import helper
+
+                def caller(x):
+                    return helper(x)
+            """),
+            "b": summarize("b", "b.py", """
+                def helper(x):
+                    return x
+            """),
+        }
+        cg = CallGraph(mods)
+        assert cg.resolve("a", "caller", "helper") == ("b::helper",)
+
+    def test_fixed_point_terminates_on_mutual_recursion(self):
+        mods = {
+            "a": summarize("a", "a.py", """
+                from b import pong
+
+                def ping(n):
+                    return pong(n - 1)
+            """),
+            "b": summarize("b", "b.py", """
+                from a import ping
+
+                def pong(n):
+                    if n > 0:
+                        return ping(n)
+                    return seam()
+
+                def seam():
+                    return 0
+            """),
+        }
+        cg = CallGraph(mods)
+        reach = cg.reaching({"b::seam"})
+        # both halves of the cycle reach the seam; the worklist must
+        # terminate despite a::ping <-> b::pong
+        assert {"a::ping", "b::pong", "b::seam"} <= reach
+        fwd = cg.reachable_from({"a::ping"})
+        assert {"a::ping", "b::pong", "b::seam"} <= fwd
+
+
+# ---------------------------------------------------------------------------
+# deadline-propagation (the PR 14 shape)
+# ---------------------------------------------------------------------------
+
+PR14_SEAM = """
+    class RemoteDispatcher:
+        def predict(self, x, deadline=None):
+            return x
+
+    _DISP = RemoteDispatcher()
+
+    def run_infer(x, deadline=None):
+        return _DISP.predict(x, deadline=deadline)
+"""
+
+
+class TestDeadlinePropagation:
+    def test_pr14_ui_drop_flagged(self, tmp_path):
+        findings = corpus_scan(tmp_path, {
+            "gw.py": PR14_SEAM,
+            "ui/handlers.py": """
+                from gw import run_infer
+
+                def handle(req, deadline):
+                    # the PR 14 first-draft bug: ingress parses the
+                    # deadline then forgets it one hop in
+                    return run_infer(req)
+            """,
+        }, rules=["deadline-propagation"])
+        assert len(findings) == 1
+        assert findings[0].path.name == "handlers.py"
+        assert "without it" in findings[0].message
+
+    def test_forwarded_deadline_is_clean(self, tmp_path):
+        findings = corpus_scan(tmp_path, {
+            "gw.py": PR14_SEAM,
+            "ui/handlers.py": """
+                from gw import run_infer
+
+                def handle(req, deadline):
+                    return run_infer(req, deadline=deadline)
+            """,
+        }, rules=["deadline-propagation"])
+        assert findings == []
+
+    def test_derived_timeout_counts_as_forwarding(self, tmp_path):
+        findings = corpus_scan(tmp_path, {
+            "gw.py": """
+                class ServingEngine:
+                    def submit(self, x, deadline=None):
+                        return x
+
+                _E = ServingEngine()
+
+                def run_infer(x, timeout=None, deadline=None):
+                    return _E.submit(x, deadline=deadline)
+            """,
+            "ui/handlers.py": """
+                from gw import run_infer
+
+                def handle(req, deadline):
+                    budget = deadline.remaining_s()
+                    return run_infer(req, timeout=budget)
+            """,
+        }, rules=["deadline-propagation"])
+        assert findings == []
+
+    def test_callee_that_cannot_carry_flagged(self, tmp_path):
+        findings = corpus_scan(tmp_path, {
+            "gw.py": """
+                class ServingEngine:
+                    def submit(self, x, deadline=None):
+                        return x
+
+                _E = ServingEngine()
+
+                def run_nc(x):
+                    return _E.submit(x)
+            """,
+            "ui/handlers.py": """
+                from gw import run_nc
+
+                def handle(req, deadline):
+                    return run_nc(req)
+            """,
+        }, rules=["deadline-propagation"])
+        assert len(findings) == 1
+        assert "cannot carry" in findings[0].message
+
+    def test_off_path_deadline_holder_is_clean(self, tmp_path):
+        # a deadline-holding function NOT reachable from any ui
+        # ingress (e.g. an executor helper) must not be flagged even
+        # though its callee reaches a seam
+        findings = corpus_scan(tmp_path, {
+            "gw.py": PR14_SEAM,
+            "worker.py": """
+                from gw import run_infer
+
+                def background(req, deadline):
+                    return run_infer(req)
+            """,
+        }, rules=["deadline-propagation"])
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# release-discipline (the PR 11 shape)
+# ---------------------------------------------------------------------------
+
+PR11_SHAPE = """
+    class Dispatcher:
+        # the PR 11 inflight-accounting bug: increment, transport
+        # raises, retry increments the NEXT node — the first node's
+        # count never comes down and least-loaded routing starves it
+        def send(self, nodes, payload):
+            for n in nodes:
+                self._inflight[n] = self._inflight.get(n, 0) + 1
+                try:
+                    return self._post(n, payload)
+                except OSError:
+                    continue
+"""
+
+
+class TestReleaseDiscipline:
+    def test_pr11_retry_reacquire_flagged(self, tmp_path):
+        findings = lint(tmp_path, PR11_SHAPE,
+                        rules=["release-discipline"])
+        assert any("re-acquires" in f.message for f in findings)
+
+    def test_finally_release_before_retry_is_clean(self, tmp_path):
+        findings = lint(tmp_path, """
+            class Dispatcher:
+                def send(self, nodes, payload):
+                    for n in nodes:
+                        self._inflight[n] = \\
+                            self._inflight.get(n, 0) + 1
+                        try:
+                            return self._post(n, payload)
+                        except OSError:
+                            continue
+                        finally:
+                            self._inflight[n] = \\
+                                self._inflight.get(n, 0) - 1
+        """, rules=["release-discipline"])
+        assert findings == []
+
+    def test_exception_edge_flagged_at_acquire_line(self, tmp_path):
+        findings = lint(tmp_path, """
+            class Pool:
+                def submit(self, item):
+                    self._sem.acquire()
+                    out = self._process(item)
+                    self._sem.release()
+                    return out
+        """, rules=["release-discipline"])
+        assert len(findings) == 1
+        assert "exception edge" in findings[0].message
+        assert "acquire()" in findings[0].snippet
+
+    def test_exit_path_flagged(self, tmp_path):
+        findings = lint(tmp_path, """
+            class Pool:
+                def claim(self, ok):
+                    self._sem.acquire()
+                    if ok:
+                        self._sem.release()
+                        return True
+                    return False
+        """, rules=["release-discipline"])
+        assert len(findings) == 1
+        assert "return/fall-through" in findings[0].message
+
+    def test_pragma_documents_cross_method_handoff(self, tmp_path):
+        findings = lint(tmp_path, """
+            class Pool:
+                def submit(self, item):
+                    self._sem.acquire()  # graftlint: disable=release-discipline: released by the done-callback
+                    return self._spawn(item)
+        """, rules=["release-discipline"])
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# atomic-write
+# ---------------------------------------------------------------------------
+
+class TestAtomicWrite:
+    def test_direct_shared_write_flagged(self, tmp_path):
+        findings = lint(tmp_path, """
+            import json
+
+            def publish(path, records):
+                with open(path, "w") as f:
+                    json.dump(records, f)
+        """, rules=["atomic-write"])
+        assert len(findings) == 1
+        assert "torn record" in findings[0].message
+
+    def test_tmp_then_replace_is_clean(self, tmp_path):
+        findings = lint(tmp_path, """
+            import json
+            import os
+            import tempfile
+
+            def publish(path, records):
+                fd, tmp = tempfile.mkstemp(
+                    dir=os.path.dirname(path))
+                with os.fdopen(fd, "w") as f:
+                    json.dump(records, f)
+                os.replace(tmp, path)
+        """, rules=["atomic-write"])
+        assert findings == []
+
+    def test_read_modes_ignored(self, tmp_path):
+        findings = lint(tmp_path, """
+            def load(path):
+                with open(path) as f:
+                    return f.read()
+        """, rules=["atomic-write"])
+        assert findings == []
+
+    def test_scoped_to_shared_path_modules_in_repo(self):
+        # ui/stats.py is inside the repo but off the shared-path
+        # list: the rule must skip it entirely
+        findings = scan(["deeplearning4j_tpu/ui/stats.py"],
+                        rules=get_rules(["atomic-write"]))
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# metric-hygiene
+# ---------------------------------------------------------------------------
+
+class TestMetricHygiene:
+    def test_label_drift_vs_catalog_flagged(self, tmp_path):
+        (tmp_path / "OBSERVABILITY.md").write_text(
+            "- `dl4j_fix_hits_total{session, node}` — per-node hits\n",
+            encoding="utf-8")
+        findings = corpus_scan(tmp_path, {
+            "metrics.py": """
+                def report(reg, session):
+                    reg.counter("dl4j_fix_hits_total", "h").inc(
+                        1.0, session=session)
+            """,
+        }, rules=["metric-hygiene"])
+        assert len(findings) == 1
+        assert "cataloged as" in findings[0].message
+
+    def test_matching_catalog_entry_is_clean(self, tmp_path):
+        (tmp_path / "OBSERVABILITY.md").write_text(
+            "- `dl4j_fix_hits_total{session}` — hits\n",
+            encoding="utf-8")
+        findings = corpus_scan(tmp_path, {
+            "metrics.py": """
+                def report(reg, session):
+                    reg.counter("dl4j_fix_hits_total", "h").inc(
+                        1.0, session=session)
+            """,
+        }, rules=["metric-hygiene"])
+        assert findings == []
+
+    def test_uncataloged_series_flagged(self, tmp_path):
+        (tmp_path / "OBSERVABILITY.md").write_text(
+            "- `dl4j_other_total{}` — something else\n",
+            encoding="utf-8")
+        findings = corpus_scan(tmp_path, {
+            "metrics.py": """
+                def report(reg):
+                    reg.counter("dl4j_fix_orphan_total", "h").inc(1.0)
+            """,
+        }, rules=["metric-hygiene"])
+        assert len(findings) == 1
+        assert "not in OBSERVABILITY.md" in findings[0].message
+
+    def test_malformed_catalog_token_is_a_finding(self, tmp_path):
+        (tmp_path / "OBSERVABILITY.md").write_text(
+            "- `dl4j_bad{session` — truncated braces\n",
+            encoding="utf-8")
+        findings = corpus_scan(tmp_path, {
+            "metrics.py": "X = 1\n",
+        }, rules=["metric-hygiene"])
+        assert len(findings) == 1
+        assert findings[0].path.name == "OBSERVABILITY.md"
+        assert "unparseable" in findings[0].message
+
+    def test_cross_site_drift_without_catalog(self, tmp_path):
+        # no OBSERVABILITY.md in the fixture corpus: fall back to
+        # cross-site consistency, majority label set wins
+        findings = corpus_scan(tmp_path, {
+            "a.py": """
+                def r1(reg, s, n):
+                    reg.counter("dl4j_fix_total", "h").inc(
+                        1.0, session=s, node=n)
+
+                def r2(reg, s, n):
+                    reg.counter("dl4j_fix_total", "h").inc(
+                        1.0, session=s, node=n)
+            """,
+            "b.py": """
+                def r3(reg, s):
+                    reg.counter("dl4j_fix_total", "h").inc(
+                        1.0, session=s)
+            """,
+        }, rules=["metric-hygiene"])
+        assert len(findings) == 1
+        assert findings[0].path.name == "b.py"
+        assert "other" in findings[0].message
+
+    def test_self_attr_handle_resolved_across_methods(self, tmp_path):
+        (tmp_path / "OBSERVABILITY.md").write_text(
+            "- `dl4j_fix_depth{session}` — queue depth\n",
+            encoding="utf-8")
+        findings = corpus_scan(tmp_path, {
+            "engine.py": """
+                class Engine:
+                    def __init__(self, reg):
+                        self._g_depth = reg.gauge(
+                            "dl4j_fix_depth", "queue depth")
+
+                    def tick(self, s, n):
+                        self._g_depth.set(3.0, session=s, node=n)
+            """,
+        }, rules=["metric-hygiene"])
+        assert len(findings) == 1
+        assert "dl4j_fix_depth" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# chaos seam-coverage audit (opt-in)
+# ---------------------------------------------------------------------------
+
+UNCOVERED_TRANSPORT = """
+    import urllib.request
+
+    class Transport:
+        def post(self, url):
+            with urllib.request.urlopen(url) as r:
+                return r.read()
+"""
+
+
+class TestChaosAudit:
+    def audit(self, tmp_path, source, name="snippet.py"):
+        f = tmp_path / name
+        f.write_text(textwrap.dedent(source), encoding="utf-8")
+        return scan([str(f)], rules=[ChaosHygieneRule(
+            audit_seams=True)])
+
+    def test_uncovered_socket_seam_flagged(self, tmp_path):
+        findings = self.audit(tmp_path, UNCOVERED_TRANSPORT)
+        assert len(findings) == 1
+        assert "fault injection cannot reach" in findings[0].message
+
+    def test_chaos_site_bound_class_is_covered(self, tmp_path):
+        findings = self.audit(tmp_path, """
+            import urllib.request
+            from deeplearning4j_tpu.chaos.hook import chaos_site
+
+            class Transport:
+                def __init__(self):
+                    self._chaos = chaos_site("transport.post")
+
+                def post(self, url):
+                    with urllib.request.urlopen(url) as r:
+                        return r.read()
+        """)
+        assert findings == []
+
+    def test_audit_off_by_default(self, tmp_path):
+        f = tmp_path / "snippet.py"
+        f.write_text(textwrap.dedent(UNCOVERED_TRANSPORT),
+                     encoding="utf-8")
+        findings = scan([str(f)], rules=[ChaosHygieneRule()])
+        assert findings == []
+
+    def test_pragma_documents_uncovered_seam(self, tmp_path):
+        findings = self.audit(tmp_path, """
+            import urllib.request
+
+            class Transport:
+                def post(self, url):
+                    with urllib.request.urlopen(url) as r:  # graftlint: disable=chaos-hygiene: loopback test server
+                        return r.read()
+        """)
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# summary cache
+# ---------------------------------------------------------------------------
+
+def _write_corpus(tmp_path: Path, n_modules=40, n_funcs=25):
+    for i in range(n_modules):
+        body = "".join(
+            f"def f{j}(x, deadline=None):\n"
+            f"    y = x + {j}\n"
+            f"    return f{(j + 1) % n_funcs}(y)\n\n"
+            for j in range(n_funcs))
+        (tmp_path / f"mod{i:02d}.py").write_text(body,
+                                                 encoding="utf-8")
+
+
+class TestSummaryCache:
+    def test_counters_and_per_file_invalidation(self, tmp_path):
+        _write_corpus(tmp_path, n_modules=6, n_funcs=4)
+        paths = sorted(tmp_path.glob("*.py"))
+        cp = tmp_path / "cache.json"
+
+        def build(cache):
+            ctxs = [ModuleContext(p, root=tmp_path) for p in paths]
+            Project(ctxs, root=tmp_path, cache=cache)
+            cache.save()
+
+        cold = SummaryCache(cp)
+        build(cold)
+        assert (cold.misses, cold.hits) == (6, 0)
+
+        warm = SummaryCache(cp)
+        build(warm)
+        assert (warm.misses, warm.hits) == (0, 6)
+
+        # touching one file invalidates exactly that file
+        p0 = paths[0]
+        p0.write_text(p0.read_text(encoding="utf-8") + "Z = 1\n",
+                      encoding="utf-8")
+        third = SummaryCache(cp)
+        build(third)
+        assert (third.misses, third.hits) == (1, 5)
+
+    def test_warm_scan_is_faster_and_identical(self, tmp_path):
+        _write_corpus(tmp_path)
+        cp = tmp_path / "cache.json"
+        rules = ["release-discipline"]
+
+        t0 = time.perf_counter()
+        cold = scan([str(tmp_path)], rules=get_rules(rules),
+                    root=tmp_path, cache_path=cp)
+        t_cold = time.perf_counter() - t0
+        assert cp.exists()
+
+        t0 = time.perf_counter()
+        warm = scan([str(tmp_path)], rules=get_rules(rules),
+                    root=tmp_path, cache_path=cp)
+        t_warm = time.perf_counter() - t0
+
+        assert [(f.rel, f.line, f.rule) for f in warm] == \
+            [(f.rel, f.line, f.rule) for f in cold]
+        # the warm pass skips 1000 function summarizations; even with
+        # timer noise it must not be slower than the cold pass
+        assert t_warm < t_cold
+
+    def test_cacheless_scan_unchanged(self, tmp_path):
+        _write_corpus(tmp_path, n_modules=2, n_funcs=3)
+        a = scan([str(tmp_path)], root=tmp_path)
+        b = scan([str(tmp_path)], root=tmp_path,
+                 cache_path=tmp_path / "cache.json")
+        assert [(f.rel, f.line, f.rule) for f in a] == \
+            [(f.rel, f.line, f.rule) for f in b]
+
+
+# ---------------------------------------------------------------------------
+# SARIF report
+# ---------------------------------------------------------------------------
+
+def _validate_sarif(doc):
+    """Hand-rolled structural validation against the SARIF 2.1.0
+    required-field subset (no jsonschema dependency)."""
+    assert doc["version"] == "2.1.0"
+    assert doc["$schema"].endswith("sarif-schema-2.1.0.json")
+    assert isinstance(doc["runs"], list) and len(doc["runs"]) == 1
+    run = doc["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "graftlint"
+    rule_ids = set()
+    for rule in driver["rules"]:
+        assert rule["id"]
+        assert rule["shortDescription"]["text"]
+        rule_ids.add(rule["id"])
+    for res in run["results"]:
+        assert res["ruleId"] in rule_ids
+        assert res["level"] in ("error", "note")
+        assert res["message"]["text"]
+        [loc] = res["locations"]
+        phys = loc["physicalLocation"]
+        assert phys["artifactLocation"]["uri"]
+        assert isinstance(phys["region"]["startLine"], int)
+        assert res["partialFingerprints"]["graftlint/v1"]
+
+
+class TestSarif:
+    def _findings(self, tmp_path):
+        f = tmp_path / "bad.py"
+        f.write_text("def hot(loss):\n    return float(loss)\n",
+                     encoding="utf-8")
+        return scan([str(f)], rules=get_rules(["host-sync"]))
+
+    def test_render_sarif_structure(self, tmp_path):
+        findings = self._findings(tmp_path)
+        assert len(findings) == 1
+        buf = io.StringIO()
+        render_sarif(findings, [], [], 1, 0.5, stream=buf)
+        doc = json.loads(buf.getvalue())
+        _validate_sarif(doc)
+        [res] = doc["runs"][0]["results"]
+        assert res["ruleId"] == "host-sync"
+        assert res["level"] == "error"
+        assert res["partialFingerprints"]["graftlint/v1"] == \
+            fingerprints(findings)[0]
+
+    def test_baselined_findings_are_notes(self, tmp_path):
+        findings = self._findings(tmp_path)
+        buf = io.StringIO()
+        render_sarif([], findings, [], 1, 0.5, stream=buf)
+        doc = json.loads(buf.getvalue())
+        _validate_sarif(doc)
+        [res] = doc["runs"][0]["results"]
+        assert res["level"] == "note"
+
+
+# ---------------------------------------------------------------------------
+# CLI plumbing
+# ---------------------------------------------------------------------------
+
+class TestCLIv2:
+    def run_cli(self, *args):
+        return subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", *args],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+
+    def test_sarif_format(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("def hot(loss):\n    return float(loss)\n",
+                       encoding="utf-8")
+        r = self.run_cli(str(bad), "--format", "sarif", "--no-cache")
+        assert r.returncode == 1
+        doc = json.loads(r.stdout)
+        _validate_sarif(doc)
+        assert len(doc["runs"][0]["results"]) == 1
+
+    def test_chaos_audit_flag(self, tmp_path):
+        fix = tmp_path / "transport.py"
+        fix.write_text(textwrap.dedent(UNCOVERED_TRANSPORT),
+                       encoding="utf-8")
+        off = self.run_cli(str(fix), "--no-cache")
+        assert off.returncode == 0, off.stderr
+        on = self.run_cli(str(fix), "--chaos-audit", "--no-cache")
+        assert on.returncode == 1
+        assert "fault injection cannot reach" in on.stderr
+
+    def test_new_rules_listed(self):
+        r = self.run_cli("--list-rules")
+        assert r.returncode == 0
+        for rule in ("deadline-propagation", "release-discipline",
+                     "atomic-write", "metric-hygiene"):
+            assert rule in r.stdout

@@ -8,6 +8,9 @@ Usage::
     python -m tools.graftlint --baseline tools/graftlint/baseline.json
     python -m tools.graftlint --write-baseline      # triage snapshot
     python -m tools.graftlint --format json
+    python -m tools.graftlint --format sarif        # CI annotations
+    python -m tools.graftlint --chaos-audit         # seam coverage
+    python -m tools.graftlint --no-cache            # force cold scan
     python -m tools.graftlint --list-rules
 
 Exit status: 0 clean (baselined findings don't fail), 1 when
@@ -25,8 +28,11 @@ from pathlib import Path
 from tools.graftlint.baseline import (
     DEFAULT_BASELINE, load_baseline, split_baselined, write_baseline)
 from tools.graftlint.engine import REPO_ROOT, iter_files, scan
-from tools.graftlint.report import render_human, render_json
+from tools.graftlint.cache import DEFAULT_CACHE
+from tools.graftlint.report import (render_human, render_json,
+                                    render_sarif)
 from tools.graftlint.rules import ALL_RULES, get_rules
+from tools.graftlint.rules.chaos_hygiene import ChaosHygieneRule
 from tools.graftlint.rules.host_sync import HOT_PATHS
 
 # the package plus the out-of-package files the host-sync rule covers
@@ -52,11 +58,18 @@ def main(argv=None) -> int:
                     help="write the current findings to the baseline "
                          "file (default tools/graftlint/baseline.json, "
                          "or --baseline's path) and exit 0")
-    ap.add_argument("--format", choices=("human", "json"),
+    ap.add_argument("--format", choices=("human", "json", "sarif"),
                     default="human")
     ap.add_argument("--max-seconds", type=float, default=None,
                     help="fail if the scan takes longer than this "
                          "(the CI wall-clock budget)")
+    ap.add_argument("--chaos-audit", action="store_true",
+                    help="also audit fault-injection seam coverage: "
+                         "flag network/file side-effects in chaos-"
+                         "instrumented classes that no chaos_site "
+                         "guards")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="skip the on-disk summary cache (scan cold)")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -72,8 +85,15 @@ def main(argv=None) -> int:
         print(f"graftlint: {e}", file=sys.stderr)
         return 2
 
+    if args.chaos_audit:
+        for r in rules:
+            if isinstance(r, ChaosHygieneRule):
+                r.audit_seams = True
+
     t0 = time.perf_counter()
-    findings = scan(args.paths, rules)
+    findings = scan(args.paths, rules,
+                    cache_path=None if args.no_cache
+                    else DEFAULT_CACHE)
     n_files = len(iter_files(args.paths))
     seconds = time.perf_counter() - t0
 
@@ -97,6 +117,8 @@ def main(argv=None) -> int:
 
     if args.format == "json":
         render_json(new, baselined, stale, n_files, seconds)
+    elif args.format == "sarif":
+        render_sarif(new, baselined, stale, n_files, seconds)
     else:
         render_human(new, baselined, stale, n_files, seconds)
 

@@ -1,0 +1,80 @@
+"""Content-hash summary cache.
+
+Persisted beside ``baseline.json`` (``tools/graftlint/cache.json``,
+gitignored) so the project-wide interprocedural pass stays inside the
+``--max-seconds`` CI budget as the tree grows: a file whose sha256 is
+unchanged skips parsing-independent summarization entirely and loads
+its :class:`~tools.graftlint.summaries.ModuleSummary` from disk.
+
+Invalidation is per file by content hash — no mtimes, so the cache
+survives checkouts/touches and never serves stale analysis after an
+edit. A version bump in either the cache layout or the summary schema
+drops the whole cache.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from pathlib import Path
+from typing import Dict, Optional
+
+from tools.graftlint.summaries import SUMMARY_VERSION, ModuleSummary
+
+CACHE_VERSION = 1
+
+# where the CLI persists the cache (beside baseline.json, gitignored)
+DEFAULT_CACHE = Path(__file__).parent / "cache.json"
+
+
+def sha_of(text: str) -> str:
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()
+
+
+class SummaryCache:
+    def __init__(self, path: Optional[Path] = None):
+        self.path = path
+        self._files: Dict[str, dict] = {}
+        self.hits = 0
+        self.misses = 0
+        self._dirty = False
+        if path is not None and path.exists():
+            try:
+                data = json.loads(path.read_text(encoding="utf-8"))
+                if (data.get("cache_version") == CACHE_VERSION
+                        and data.get("summary_version")
+                        == SUMMARY_VERSION):
+                    self._files = data.get("files", {})
+            except (OSError, ValueError):
+                self._files = {}
+
+    def get(self, rel: str, sha: str) -> Optional[ModuleSummary]:
+        ent = self._files.get(rel)
+        if ent is None or ent.get("sha") != sha:
+            self.misses += 1
+            return None
+        try:
+            ms = ModuleSummary.from_dict(ent["summary"])
+        except (KeyError, TypeError):
+            self.misses += 1
+            return None
+        self.hits += 1
+        return ms
+
+    def put(self, rel: str, sha: str, summary: ModuleSummary) -> None:
+        self._files[rel] = {"sha": sha, "summary": summary.to_dict()}
+        self._dirty = True
+
+    def save(self) -> None:
+        if self.path is None or not self._dirty:
+            return
+        data = {"cache_version": CACHE_VERSION,
+                "summary_version": SUMMARY_VERSION,
+                "files": self._files}
+        tmp = self.path.with_suffix(".json.tmp")
+        try:
+            tmp.write_text(json.dumps(data), encoding="utf-8")
+            import os
+            os.replace(tmp, self.path)
+        except OSError:
+            pass                    # a read-only checkout is fine

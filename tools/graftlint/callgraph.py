@@ -1,0 +1,157 @@
+"""Cross-module call graph over function summaries.
+
+Resolution generalizes the import-table pattern donation-safety uses:
+
+- a bare name resolves through the module's own functions, then its
+  ``from x import f`` table;
+- ``alias.f`` resolves when ``alias`` names an imported module;
+- ``self.m`` resolves to the enclosing class's method, falling back
+  to the project-wide method index;
+- any other ``obj.m`` / ``a.b.m`` resolves by final attribute name
+  against the method index (a deliberate may-alias over-approximation:
+  good for reachability, so rules that need precision must check
+  ``unambiguous()``).
+
+``reaching(seeds)`` runs the cycle-safe fixed point: the set of
+functions from which any seed is transitively callable. Monotone set
+growth terminates on arbitrary recursion, mutual or otherwise.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Sequence, Set, Tuple
+
+from tools.graftlint.summaries import FunctionSummary, ModuleSummary
+
+
+class CallGraph:
+    def __init__(self, modules: Dict[str, ModuleSummary]):
+        self.modules = modules
+        # flat qname key ("mod::Class.method") -> summary
+        self.functions: Dict[str, FunctionSummary] = {}
+        # method/function final name -> all qname keys defining it
+        self.method_index: Dict[str, List[str]] = {}
+        for ms in modules.values():
+            for s in ms.functions.values():
+                self.functions[s.key] = s
+                final = s.qname.split(".")[-1]
+                self.method_index.setdefault(final, []).append(s.key)
+        for keys in self.method_index.values():
+            keys.sort()
+        self._cache: Dict[Tuple[str, str], Tuple[str, ...]] = {}
+
+    # -- resolution ------------------------------------------------------
+
+    def resolve(self, module: str, caller_qname: str,
+                callee: str) -> Tuple[str, ...]:
+        """Candidate summary keys for a dotted callee as written in
+        ``module`` inside ``caller_qname``. Empty when unknown
+        (builtins, third-party, dynamic)."""
+        ck = (f"{module}::{caller_qname}", callee)
+        hit = self._cache.get(ck)
+        if hit is not None:
+            return hit
+        out = tuple(dict.fromkeys(
+            self._resolve(module, caller_qname, callee)))
+        self._cache[ck] = out
+        return out
+
+    def _resolve(self, module: str, caller_qname: str,
+                 callee: str) -> List[str]:
+        ms = self.modules.get(module)
+        parts = callee.split(".")
+        final = parts[-1]
+        if ms is not None and len(parts) == 1:
+            # module-local function (incl. sibling methods named
+            # without self — rare) then from-imports
+            local = f"{module}::{callee}"
+            if local in self.functions:
+                return [local]
+            tgt = ms.imports.get(callee)
+            if tgt is not None:
+                key = self._dotted_to_key(tgt)
+                if key is not None:
+                    return [key]
+            return []
+        if parts[0] == "self" and len(parts) == 2:
+            cls_prefix = caller_qname.rsplit(".", 1)[0] \
+                if "." in caller_qname else ""
+            if cls_prefix:
+                key = f"{module}::{cls_prefix}.{final}"
+                if key in self.functions:
+                    return [key]
+            return list(self.method_index.get(final, []))
+        if ms is not None and parts[0] in ms.imports:
+            # alias.f / alias.sub.f through an imported module
+            tgt = ms.imports[parts[0]] + "." + ".".join(parts[1:])
+            key = self._dotted_to_key(tgt)
+            if key is not None:
+                return [key]
+        # fall back to the project-wide method index by final name
+        return list(self.method_index.get(final, []))
+
+    def _dotted_to_key(self, dotted: str) -> "str | None":
+        """``pkg.mod.func`` or ``pkg.mod.Class.method`` -> summary key
+        when some split into (module, qname) exists."""
+        parts = dotted.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            mod = ".".join(parts[:i])
+            if mod in self.modules:
+                qname = ".".join(parts[i:])
+                key = f"{mod}::{qname}"
+                if key in self.functions:
+                    return key
+                return None
+        return None
+
+    def unambiguous(self, keys: Sequence[str]) -> bool:
+        return len(keys) == 1
+
+    # -- fixed point -----------------------------------------------------
+
+    def reaching(self, seeds: Iterable[str]) -> Set[str]:
+        """Keys of every function from which a seed is transitively
+        reachable through resolvable calls (seeds included).
+
+        Plain monotone worklist over the reverse graph — the set only
+        grows, so mutual recursion and cycles terminate."""
+        reach: Set[str] = {s for s in seeds if s in self.functions}
+        # precompute forward edges once
+        edges: Dict[str, Set[str]] = {}
+        for key, s in self.functions.items():
+            tgt: Set[str] = set()
+            for cs in s.calls:
+                tgt.update(self.resolve(s.module, s.qname, cs.callee))
+            edges[key] = tgt
+        rev: Dict[str, Set[str]] = {}
+        for src, tgts in edges.items():
+            for t in tgts:
+                rev.setdefault(t, set()).add(src)
+        work = list(reach)
+        while work:
+            cur = work.pop()
+            for caller in rev.get(cur, ()):
+                if caller not in reach:
+                    reach.add(caller)
+                    work.append(caller)
+        return reach
+
+    def seeds_matching(self, pred: Callable[[FunctionSummary], bool]
+                       ) -> Set[str]:
+        return {k for k, s in self.functions.items() if pred(s)}
+
+    def reachable_from(self, seeds: Iterable[str]) -> Set[str]:
+        """Forward closure: every function transitively callable from
+        the seeds (seeds included). Same monotone worklist, forward
+        edges."""
+        reach: Set[str] = {s for s in seeds if s in self.functions}
+        work = list(reach)
+        while work:
+            cur = work.pop()
+            s = self.functions[cur]
+            for cs in s.calls:
+                for tgt in self.resolve(s.module, s.qname, cs.callee):
+                    if tgt not in reach:
+                        reach.add(tgt)
+                        work.append(tgt)
+        return reach

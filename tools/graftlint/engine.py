@@ -68,6 +68,7 @@ class ModuleContext:
         except ValueError:
             self.rel = str(path)
         self.text = path.read_text(encoding="utf-8")
+        self._sha: Optional[str] = None
         self.lines: List[str] = self.text.splitlines()
         self.tree: Optional[ast.Module] = None
         self.parse_error: Optional[SyntaxError] = None
@@ -94,6 +95,13 @@ class ModuleContext:
                 out.setdefault(i, set()).add("host-sync")
         return out
 
+    @property
+    def sha(self) -> str:
+        if self._sha is None:
+            from tools.graftlint.cache import sha_of
+            self._sha = sha_of(self.text)
+        return self._sha
+
     def suppressed(self, rule: str, line: int) -> bool:
         d = self._disabled.get(line)
         return bool(d) and (ALL in d or rule in d)
@@ -115,10 +123,18 @@ class Project:
     (``deeplearning4j_tpu.nlp.skipgram``) to their contexts so rules can
     resolve imports; rules stash their own project-wide tables in
     ``facts[rule_name]``.
+
+    The interprocedural layer lives here too: ``summaries`` (dotted
+    module name -> ModuleSummary, see tools/graftlint/summaries.py)
+    and ``callgraph`` (import-resolved, with the cycle-safe
+    ``reaching`` fixed point). Pass a SummaryCache to skip re-analysis
+    of files whose content hash is unchanged.
     """
 
     def __init__(self, contexts: Sequence[ModuleContext],
-                 root: Path = REPO_ROOT):
+                 root: Path = REPO_ROOT, cache=None):
+        from tools.graftlint.callgraph import CallGraph
+        from tools.graftlint.summaries import build_module_summary
         self.root = root
         self.contexts = list(contexts)
         self.modules: Dict[str, ModuleContext] = {}
@@ -127,6 +143,26 @@ class Project:
             if name:
                 self.modules[name] = ctx
         self.facts: Dict[str, object] = {}
+        self.summaries = {}
+        for ctx in self.contexts:
+            if ctx.tree is None:
+                continue
+            mod = module_name_of(ctx.rel) or ctx.rel
+            ms = cache.get(ctx.rel, ctx.sha) if cache is not None \
+                else None
+            if ms is None:
+                ms = build_module_summary(ctx.tree, ctx.text, mod,
+                                          ctx.rel)
+                if cache is not None:
+                    cache.put(ctx.rel, ctx.sha, ms)
+            self.summaries[mod] = ms
+        self.callgraph = CallGraph(self.summaries)
+
+    def context_for(self, path: Path) -> Optional[ModuleContext]:
+        for ctx in self.contexts:
+            if ctx.path == path:
+                return ctx
+        return None
 
 
 def module_name_of(rel: str) -> Optional[str]:
@@ -169,9 +205,14 @@ def iter_files(paths: Iterable[str], root: Path = REPO_ROOT
 
 
 def scan(paths: Iterable[str], rules: Sequence = None,
-         root: Path = REPO_ROOT) -> List[Finding]:
+         root: Path = REPO_ROOT, cache_path: Optional[Path] = None
+         ) -> List[Finding]:
     """Run ``rules`` (default: every registered rule) over ``paths``;
-    returns pragma-filtered findings sorted by (path, line, rule)."""
+    returns pragma-filtered findings sorted by (path, line, rule).
+
+    ``cache_path`` (optional) enables the content-hash summary cache:
+    unchanged files skip the interprocedural summarization pass and
+    the cache is re-persisted after the scan."""
     from tools.graftlint.rules import get_rules
     if rules is None:
         rules = get_rules()
@@ -182,7 +223,13 @@ def scan(paths: Iterable[str], rules: Sequence = None,
         except OSError as e:
             print(f"graftlint: warning: cannot read {f}: {e}",
                   file=sys.stderr)
-    project = Project(contexts, root)
+    cache = None
+    if cache_path is not None:
+        from tools.graftlint.cache import SummaryCache
+        cache = SummaryCache(cache_path)
+    project = Project(contexts, root, cache=cache)
+    if cache is not None:
+        cache.save()
     for rule in rules:
         prepare = getattr(rule, "prepare", None)
         if prepare is not None:
@@ -195,6 +242,18 @@ def scan(paths: Iterable[str], rules: Sequence = None,
             for f in rule.check(ctx, project):
                 if not ctx.suppressed(f.rule, f.line):
                     findings.append(f)
+    # rules may report findings that belong to the project rather than
+    # any single module (e.g. metric-hygiene's catalog parse errors
+    # against OBSERVABILITY.md); pragma filtering still applies when
+    # the finding lands on a scanned module
+    for rule in rules:
+        hook = getattr(rule, "project_findings", None)
+        if hook is None:
+            continue
+        for f in hook(project):
+            ctx = project.context_for(f.path)
+            if ctx is None or not ctx.suppressed(f.rule, f.line):
+                findings.append(f)
     findings.sort(key=lambda f: (str(f.path), f.line, f.rule))
     return findings
 

@@ -1,4 +1,11 @@
-"""Human and JSON report rendering for graftlint findings."""
+"""Human, JSON and SARIF report rendering for graftlint findings.
+
+SARIF (Static Analysis Results Interchange Format 2.1.0) is the
+subset CI code-annotation surfaces consume: one run, the rule
+catalog under ``tool.driver.rules``, one ``result`` per finding with
+a physical location and the baseline fingerprint under
+``partialFingerprints``. Baselined findings are emitted at level
+``note`` (visible, non-blocking); new findings at ``error``."""
 
 from __future__ import annotations
 
@@ -66,6 +73,70 @@ def render_json(new: Sequence[Finding], baselined: Sequence[Finding],
             "seconds": round(seconds, 3),
             "by_rule": dict(Counter(f.rule for f in new)),
         },
+    }
+    json.dump(doc, stream, indent=2, sort_keys=True)
+    stream.write("\n")
+
+
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+
+def render_sarif(new: Sequence[Finding], baselined: Sequence[Finding],
+                 stale: Sequence[str], n_files: int, seconds: float,
+                 stream=None) -> None:
+    stream = stream if stream is not None else sys.stdout
+    from tools.graftlint.rules import ALL_RULES
+    used = {f.rule for f in new} | {f.rule for f in baselined}
+    rules_meta = [
+        {"id": cls.name,
+         "shortDescription": {"text": cls.description}}
+        for cls in ALL_RULES if cls.name in used]
+    # project-level findings (e.g. catalog parse errors) carry rule
+    # names no registered class owns only if a rule is renamed —
+    # keep the run valid anyway
+    known = {cls.name for cls in ALL_RULES}
+    for name in sorted(used - known):
+        rules_meta.append({"id": name,
+                           "shortDescription": {"text": name}})
+
+    def results(findings: Sequence[Finding], level: str) -> List[Dict]:
+        fps = fingerprints(findings)
+        out = []
+        for f, fp in zip(findings, fps):
+            out.append({
+                "ruleId": f.rule,
+                "level": level,
+                "message": {"text": f.message},
+                "locations": [{
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.rel.replace("\\", "/")},
+                        "region": {"startLine": f.line},
+                    },
+                }],
+                "partialFingerprints": {"graftlint/v1": fp},
+            })
+        return out
+
+    doc = {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri":
+                    "tools/graftlint/README.md",
+                "rules": rules_meta,
+            }},
+            "results": (results(new, "error")
+                        + results(baselined, "note")),
+            "properties": {
+                "files": n_files,
+                "seconds": round(seconds, 3),
+                "staleBaselineEntries": len(stale),
+            },
+        }],
     }
     json.dump(doc, stream, indent=2, sort_keys=True)
     stream.write("\n")

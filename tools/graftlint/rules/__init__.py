@@ -12,9 +12,17 @@ from tools.graftlint.rules.donation_safety import DonationSafetyRule
 from tools.graftlint.rules.recompile_hazard import RecompileHazardRule
 from tools.graftlint.rules.thread_discipline import ThreadDisciplineRule
 from tools.graftlint.rules.tracer_leak import TracerLeakRule
+from tools.graftlint.rules.deadline_propagation import \
+    DeadlinePropagationRule
+from tools.graftlint.rules.release_discipline import \
+    ReleaseDisciplineRule
+from tools.graftlint.rules.atomic_write import AtomicWriteRule
+from tools.graftlint.rules.metric_hygiene import MetricHygieneRule
 
 ALL_RULES = (HostSyncRule, ChaosHygieneRule, DonationSafetyRule,
-             RecompileHazardRule, ThreadDisciplineRule, TracerLeakRule)
+             RecompileHazardRule, ThreadDisciplineRule, TracerLeakRule,
+             DeadlinePropagationRule, ReleaseDisciplineRule,
+             AtomicWriteRule, MetricHygieneRule)
 
 RULES_BY_NAME: Dict[str, type] = {r.name: r for r in ALL_RULES}
 

@@ -1,0 +1,61 @@
+"""atomic-write: shared-store files land whole or not at all.
+
+The gossiped node registry, the AOT artifact store and the streaming
+corpus shards are all plain files read concurrently by other
+processes. The repo-wide protocol (parallel/node.py ``write``,
+parallel/aot_cache.py manifest save, datasets/corpus.py shards) is:
+write a ``tmp`` sibling in the same directory, then ``os.replace`` it
+into place — rename is atomic on POSIX, so a reader sees the old
+bytes or the new bytes, never a torn half-record. PR 14's fault
+injection made the torn-write fault class reproducible; this rule
+makes it unrepresentable in the shared-path modules.
+
+A write counts as protocol-conformant when its destination is the tmp
+half: bound from ``tempfile.*``, or an identifier/literal containing
+``tmp``. Any other ``open(p, "w")`` / ``Path.write_text`` /
+``Path.write_bytes`` in a scoped module is a finding. Deliberate
+direct writes (e.g. the AOT blob body, which is checksummed and only
+becomes visible through the manifest's atomic replace) carry a pragma
+explaining their safety argument.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from tools.graftlint.engine import (Finding, ModuleContext, Project,
+                                    Rule, module_name_of)
+
+
+class AtomicWriteRule(Rule):
+    name = "atomic-write"
+    description = ("writes under gossip/registry/artifact-store paths "
+                   "must use the tmp + os.replace protocol; a direct "
+                   "write to a shared path is a torn-write hazard")
+    # the modules whose files other processes read concurrently
+    paths = (
+        "deeplearning4j_tpu/parallel/node.py",
+        "deeplearning4j_tpu/parallel/cluster.py",
+        "deeplearning4j_tpu/parallel/aot_cache.py",
+        "deeplearning4j_tpu/parallel/checkpoint.py",
+        "deeplearning4j_tpu/datasets/corpus.py",
+    )
+
+    def check(self, ctx: ModuleContext,
+              project: Project) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return
+        mod = module_name_of(ctx.rel) or ctx.rel
+        ms = project.summaries.get(mod)
+        if ms is None:
+            return
+        for s in ms.functions.values():
+            for w in s.writes:
+                if w.tmp_like:
+                    continue
+                yield ctx.finding(
+                    self.name, w.lineno,
+                    f"{s.qname} writes {w.target!r} directly (via "
+                    f"{w.via}) on a shared path — a concurrent reader "
+                    f"can see a torn record; write a tmp sibling and "
+                    f"os.replace() it into place")

@@ -21,30 +21,72 @@ This rule polices the two ways that contract erodes:
 
 Scope: the same ``HOT_PATHS`` the host-sync rule polices — everywhere
 a hidden per-iteration cost is a regression.
+
+**Seam-coverage audit** (opt-in, ``--chaos-audit``): the inverse
+check. In the cluster modules whose faults the chaos plans exist to
+reproduce, a socket operation or file write inside a class that binds
+no ``chaos_site`` handle is a seam fault injection cannot reach — a
+blind spot in every soak run. Audit findings are advisory (the flag
+is off in CI); legitimately uncovered seams (e.g. loopback test
+servers) carry a pragma saying why.
 """
 
 from __future__ import annotations
 
 import ast
+from pathlib import Path
 from typing import Iterable
 
-from tools.graftlint.engine import Finding, ModuleContext, Project, Rule
+from tools.graftlint.engine import (REPO_ROOT, Finding, ModuleContext,
+                                    Project, Rule, module_name_of)
 from tools.graftlint.rules.host_sync import HOT_PATHS
 
 _HOOK_MODULE = "deeplearning4j_tpu.chaos.hook"
 _CHAOS_PREFIX = "deeplearning4j_tpu.chaos"
+
+# modules whose socket/file seams chaos plans are expected to cover
+AUDIT_PATHS = (
+    "deeplearning4j_tpu/parallel/node.py",
+    "deeplearning4j_tpu/parallel/remote.py",
+    "deeplearning4j_tpu/parallel/aot_cache.py",
+    "deeplearning4j_tpu/parallel/cluster.py",
+    "deeplearning4j_tpu/streaming/broker.py",
+)
+
+_SOCKET_SUFFIXES = ("urlopen", "create_connection", "socket.socket",
+                    "HTTPConnection", "HTTPSConnection", "getresponse")
 
 
 class ChaosHygieneRule(Rule):
     name = "chaos-hygiene"
     description = ("fault-injection layer leaking onto hot paths: "
                    "non-hook chaos imports, or chaos_site() resolved "
-                   "inside a loop body")
+                   "inside a loop body; with --chaos-audit, also "
+                   "socket/file-write seams lacking a chaos_site "
+                   "handle in the cluster modules")
     paths = HOT_PATHS
+
+    def __init__(self, audit_seams: bool = False):
+        self.audit_seams = audit_seams
+
+    def applies(self, ctx: ModuleContext) -> bool:
+        if super().applies(ctx):
+            return True
+        return self.audit_seams and self._audit_applies(ctx)
+
+    def _audit_applies(self, ctx: ModuleContext) -> bool:
+        rel = ctx.rel.replace("\\", "/")
+        if Path(rel).is_absolute() or ctx.root != REPO_ROOT:
+            return True         # fixture corpora: audit everything
+        return rel in AUDIT_PATHS
 
     def check(self, ctx: ModuleContext,
               project: Project) -> Iterable[Finding]:
         if ctx.tree is None:
+            return
+        if self.audit_seams and self._audit_applies(ctx):
+            yield from self._audit(ctx, project)
+        if not super().applies(ctx):
             return
         for node in ast.walk(ctx.tree):
             if isinstance(node, ast.ImportFrom):
@@ -91,3 +133,41 @@ class ChaosHygieneRule(Rule):
                         "chaos_site() resolved inside a loop body — "
                         "bind the site handle once at construction "
                         "and test 'if handle is not None' in the loop")
+
+    # -- seam-coverage audit (opt-in) ------------------------------------
+
+    def _audit(self, ctx: ModuleContext,
+               project: Project) -> Iterable[Finding]:
+        mod = module_name_of(ctx.rel) or ctx.rel
+        ms = project.summaries.get(mod)
+        if ms is None:
+            return
+        # which classes (and the module-function scope "") bind a
+        # chaos_site handle anywhere
+        covered = set()
+        for s in ms.functions.values():
+            scope = s.qname.rsplit(".", 1)[0] if "." in s.qname else ""
+            if any(c.callee.split(".")[-1] == "chaos_site"
+                   for c in s.calls):
+                covered.add(scope)
+        for s in ms.functions.values():
+            scope = s.qname.rsplit(".", 1)[0] if "." in s.qname else ""
+            if scope in covered:
+                continue
+            seam = None
+            for c in s.calls:
+                if any(c.callee == suf or c.callee.endswith("." + suf)
+                       or c.callee.endswith(suf)
+                       for suf in _SOCKET_SUFFIXES):
+                    seam = (c.lineno, f"socket op {c.callee}()")
+                    break
+            if seam is None and s.writes:
+                w = s.writes[0]
+                seam = (w.lineno, f"file write to {w.target!r}")
+            if seam is not None:
+                where = scope or "module scope"
+                yield ctx.finding(
+                    self.name, seam[0],
+                    f"audit: {s.qname} has a {seam[1]} but {where} "
+                    f"binds no chaos_site handle — fault injection "
+                    f"cannot reach this seam")

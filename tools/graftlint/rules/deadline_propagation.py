@@ -1,0 +1,112 @@
+"""deadline-propagation: the deadline kwarg must survive the whole
+ingress -> dispatch chain.
+
+PR 14 threaded an end-to-end ``Deadline`` from the ui ingress
+(``X-Deadline-Ms`` / ``deadline_ms``) through admission, batching and
+remote dispatch — and the very first ui module draft dropped it one
+hop in, so every tier below ran with no budget. The invariant is
+cross-module by construction, which is exactly what the summary layer
+exists for:
+
+- **seams** are the dispatch methods (``RemoteDispatcher.predict``,
+  ``ServingEngine.submit``, ...); the cycle-safe fixed point marks
+  every function that transitively reaches one;
+- **ingress** is any function defined in a ``ui`` package; the
+  forward closure from those marks the serving path;
+- on the intersection, any function holding a deadline (the
+  ``deadline`` parameter or a local bound from a ``Deadline``
+  constructor) must hand it to each seam-reaching callee at at least
+  one call site — as ``deadline=``, positionally, through ``**kw``,
+  or via any argument derived from it (a capped timeout counts).
+
+The "at least one site" form deliberately admits the duck-typing
+idiom ``f(x, deadline=d) if d is not None else f(x)``. A callee that
+reaches a seam but cannot carry a deadline at all (no ``deadline``
+parameter, no ``**kwargs``) is reported too when resolution is
+unambiguous — that hole cannot be fixed at the call site.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Set
+
+from tools.graftlint.engine import (Finding, ModuleContext, Project,
+                                    Rule, module_name_of)
+
+# dispatch seams: qname ("Class.method") exact matches
+SEAM_QNAMES = frozenset({
+    "RemoteDispatcher.predict", "RemoteDispatcher.output",
+    "RemoteDispatcher.send", "RemoteDispatcher._send",
+    "ServingEngine.submit", "ServingEngine.output",
+    "GenerationEngine.submit", "GenerationEngine.generate",
+    "FleetRouter.submit", "FleetRouter.output", "FleetRouter.generate",
+    "ModelPool.submit", "GenerationPool.submit",
+})
+
+
+def _is_ingress(summary) -> bool:
+    return "ui" in summary.module.split(".")
+
+
+class DeadlinePropagationRule(Rule):
+    name = "deadline-propagation"
+    description = ("a deadline in scope on the ui ingress -> dispatch "
+                   "path must be forwarded to every seam-reaching "
+                   "callee (kwarg, **kw, or a timeout derived from it)")
+
+    def prepare(self, project: Project) -> None:
+        cg = project.callgraph
+        seams = cg.seeds_matching(lambda s: s.qname in SEAM_QNAMES)
+        seam_reaching = cg.reaching(seams)
+        ingress = cg.seeds_matching(_is_ingress)
+        on_path = cg.reachable_from(ingress) & seam_reaching
+        project.facts[self.name] = {
+            "seam_reaching": seam_reaching, "on_path": on_path}
+
+    def check(self, ctx: ModuleContext,
+              project: Project) -> Iterable[Finding]:
+        facts = project.facts.get(self.name)
+        if not facts or ctx.tree is None:
+            return
+        mod = module_name_of(ctx.rel) or ctx.rel
+        ms = project.summaries.get(mod)
+        if ms is None:
+            return
+        cg = project.callgraph
+        seam_reaching: Set[str] = facts["seam_reaching"]
+        on_path: Set[str] = facts["on_path"]
+        for s in ms.functions.values():
+            if s.key not in on_path or not s.has_deadline:
+                continue
+            groups: Dict[str, list] = {}
+            for cs in s.calls:
+                groups.setdefault(cs.callee, []).append(cs)
+            for callee, sites in sorted(groups.items()):
+                cands = [c for c in cg.resolve(mod, s.qname, callee)
+                         if c in seam_reaching and c != s.key]
+                if not cands:
+                    continue
+                if any(cs.passes_deadline or cs.has_star_kw
+                       for cs in sites):
+                    continue
+                accepts = any(
+                    "deadline" in cg.functions[c].params
+                    or cg.functions[c].has_varkw for c in cands)
+                first = min(cs.lineno for cs in sites)
+                if accepts:
+                    yield ctx.finding(
+                        self.name, first,
+                        f"{s.qname} holds a deadline (line "
+                        f"{s.deadline_lineno}) but calls "
+                        f"{callee}() without it; the dispatch chain "
+                        f"below loses its budget — pass deadline= "
+                        f"(or derive the timeout from it)")
+                elif cg.unambiguous(cands):
+                    tgt = cg.functions[cands[0]]
+                    yield ctx.finding(
+                        self.name, first,
+                        f"{s.qname} holds a deadline but "
+                        f"{callee}() ({tgt.qname}) reaches a dispatch "
+                        f"seam and cannot carry one (no deadline "
+                        f"parameter, no **kwargs) — the budget stops "
+                        f"propagating here")

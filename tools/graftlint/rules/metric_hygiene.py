@@ -1,0 +1,268 @@
+"""metric-hygiene: one series, one label set, one catalog entry.
+
+Every ``dl4j_*`` Prometheus series the tree emits must (a) use a
+single consistent label set across all emission sites — a series
+scraped with ``{session, precision}`` here and ``{session}`` there
+splits into incompatible time series and silently breaks dashboards —
+and (b) appear in OBSERVABILITY.md's catalog with exactly that label
+set. Drift in either direction is a finding.
+
+The emission map comes from the summary layer and resolves the
+repo's three registration idioms:
+
+- handle on ``self`` bound in ``__init__`` and emitted from other
+  methods (``self._c_dispatch.inc(1.0, node=n, outcome=o)``);
+- the inline chain ``reg.gauge("dl4j_x", h).set(v, session=s)``;
+- name-through-parameter indirection
+  (``cluster.py::_bump_counter(name)``) — the interprocedural case:
+  the template's label set attaches to every literal series name a
+  resolved call site passes in.
+
+The catalog side is a **strict** parse of OBSERVABILITY.md: a series
+is cataloged by a backticked ``dl4j_name{label, label}`` token
+(``{}`` for label-less series); a backticked ``dl4j_*`` token with
+malformed braces is itself a finding (reported against the doc file),
+as is a series documented with two different label sets. Bare
+backticked names without braces are prose references, not entries.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from tools.graftlint.engine import (Finding, ModuleContext, Project,
+                                    Rule, module_name_of)
+
+CATALOG_NAME = "OBSERVABILITY.md"
+
+_TOKEN_RX = re.compile(r"`([^`]+)`")
+_ENTRY_RX = re.compile(r"^(dl4j_\w+)\{([^{}]*)\}$")
+_BARE_RX = re.compile(r"^(dl4j_\w+)$")
+_LABEL_RX = re.compile(r"^\w+$")
+
+
+def parse_catalog(text: str) -> Tuple[Dict[str, Tuple[str, ...]],
+                                      List[Tuple[int, str]]]:
+    """OBSERVABILITY.md text -> ({series: sorted label tuple},
+    [(lineno, error)]). Strict: malformed dl4j_ tokens and
+    conflicting duplicate entries are errors, not guesses."""
+    entries: Dict[str, Tuple[str, ...]] = {}
+    lines: Dict[str, int] = {}
+    errors: List[Tuple[int, str]] = []
+    for i, line in enumerate(text.splitlines(), 1):
+        for m in _TOKEN_RX.finditer(line):
+            tok = m.group(1).strip()
+            if not tok.startswith("dl4j_"):
+                continue
+            if "{" not in tok and "}" not in tok:
+                # bare names, `dl4j_foo_*` families, alert expressions:
+                # prose references, not catalog entries
+                continue
+            em = _ENTRY_RX.match(tok)
+            if em:
+                name = em.group(1)
+                raw = [p.strip() for p in em.group(2).split(",")
+                       if p.strip()]
+                bad = [p for p in raw
+                       if not _LABEL_RX.match(p.split("=")[0].strip())]
+                if bad:
+                    errors.append(
+                        (i, f"malformed label(s) {bad} in catalog "
+                            f"entry {tok!r}"))
+                    continue
+                labels = tuple(sorted(p.split("=")[0].strip()
+                                      for p in raw))
+                if name in entries and entries[name] != labels:
+                    errors.append(
+                        (i, f"{name} cataloged twice with different "
+                            f"label sets: {{{', '.join(entries[name])}}}"
+                            f" (line {lines[name]}) vs "
+                            f"{{{', '.join(labels)}}}"))
+                    continue
+                entries[name] = labels
+                lines.setdefault(name, i)
+            elif not _BARE_RX.match(tok):
+                errors.append(
+                    (i, f"unparseable dl4j_ token {tok!r} in catalog "
+                        f"— expected dl4j_name or dl4j_name{{labels}}"))
+    return entries, errors
+
+
+def _fmt(labels: Tuple[str, ...]) -> str:
+    return "{" + ", ".join(labels) + "}"
+
+
+class _Emission:
+    __slots__ = ("name", "labels", "has_star", "module", "rel",
+                 "lineno")
+
+    def __init__(self, name, labels, has_star, module, rel, lineno):
+        self.name = name
+        self.labels = labels
+        self.has_star = has_star
+        self.module = module
+        self.rel = rel
+        self.lineno = lineno
+
+
+class MetricHygieneRule(Rule):
+    name = "metric-hygiene"
+    description = ("every dl4j_* series must use one consistent label "
+                   "set across all emission sites and appear in "
+                   "OBSERVABILITY.md's catalog with that label set")
+
+    def prepare(self, project: Project) -> None:
+        catalog = None
+        errors: List[Tuple[int, str]] = []
+        cat_path = Path(project.root) / CATALOG_NAME
+        if cat_path.exists():
+            catalog, errors = parse_catalog(
+                cat_path.read_text(encoding="utf-8"))
+        emissions = self._emission_map(project)
+        # reference label set per series for cross-site consistency
+        # when the catalog has no entry: majority wins, earliest
+        # emission breaks ties (deterministic)
+        reference: Dict[str, Tuple[str, ...]] = {}
+        for name, ems in emissions.items():
+            votes: Dict[Tuple[str, ...], int] = {}
+            for e in ems:
+                if not e.has_star:
+                    votes[e.labels] = votes.get(e.labels, 0) + 1
+            if votes:
+                best = max(votes.values())
+                winners = [l for l, n in votes.items() if n == best]
+                order = {e.labels: i for i, e in
+                         enumerate(reversed(ems)) if not e.has_star}
+                winners.sort(key=lambda l: (order.get(l, 0), l))
+                reference[name] = winners[0]
+        project.facts[self.name] = {
+            "catalog": catalog, "errors": errors, "path": cat_path,
+            "emissions": emissions, "reference": reference}
+
+    # -- emission map ----------------------------------------------------
+
+    def _emission_map(self, project: Project
+                      ) -> Dict[str, List[_Emission]]:
+        cg = project.callgraph
+        # (module, Class, "self.attr") -> literal series name
+        attr_names: Dict[Tuple[str, str, str], str] = {}
+        # key of template fn -> (param index, emit labels, has_star)
+        templates: Dict[str, List[Tuple[int, Tuple[str, ...], bool]]] \
+            = {}
+        for ms in project.summaries.values():
+            for s in ms.functions.values():
+                cls = s.qname.rsplit(".", 1)[0] if "." in s.qname \
+                    else ""
+                for d in s.metric_defs:
+                    if d.name and d.binding \
+                            and d.binding.startswith("self."):
+                        attr_names[(s.module, cls, d.binding)] = d.name
+                for e in s.metric_emits:
+                    if e.name_param and e.name_param in s.params:
+                        templates.setdefault(s.key, []).append(
+                            (s.params.index(e.name_param), e.labels,
+                             e.has_star))
+        out: Dict[str, List[_Emission]] = {}
+
+        def add(name, labels, star, s, lineno, ms):
+            if name and name.startswith("dl4j_"):
+                out.setdefault(name, []).append(_Emission(
+                    name, labels, star, s.module, ms.rel, lineno))
+
+        for ms in project.summaries.values():
+            for s in ms.functions.values():
+                cls = s.qname.rsplit(".", 1)[0] if "." in s.qname \
+                    else ""
+                # local handle -> name, for same-function bindings
+                local = {d.binding: d.name for d in s.metric_defs
+                         if d.name and d.binding
+                         and not d.binding.startswith("self.")}
+                for e in s.metric_emits:
+                    if e.name:
+                        add(e.name, e.labels, e.has_star, s,
+                            e.lineno, ms)
+                    elif e.handle:
+                        name = local.get(e.handle) or attr_names.get(
+                            (s.module, cls, e.handle))
+                        if name:
+                            add(name, e.labels, e.has_star, s,
+                                e.lineno, ms)
+                # name-through-parameter: literal call sites into
+                # template functions
+                for cs in s.calls:
+                    for tgt in cg.resolve(s.module, s.qname,
+                                          cs.callee):
+                        for idx, labels, star in templates.get(
+                                tgt, ()):
+                            tparams = cg.functions[tgt].params
+                            if tparams and tparams[0] in ("self",
+                                                          "cls"):
+                                idx -= 1
+                            for j in (idx, idx + 1):
+                                if 0 <= j < len(cs.literal_args) \
+                                        and cs.literal_args[j]:
+                                    add(cs.literal_args[j], labels,
+                                        star, s, cs.lineno, ms)
+                                    break
+        for ems in out.values():
+            ems.sort(key=lambda e: (e.rel, e.lineno))
+        return out
+
+    # -- findings --------------------------------------------------------
+
+    def check(self, ctx: ModuleContext,
+              project: Project) -> Iterable[Finding]:
+        facts = project.facts.get(self.name)
+        if not facts or ctx.tree is None:
+            return
+        mod = module_name_of(ctx.rel) or ctx.rel
+        catalog: Optional[Dict[str, Tuple[str, ...]]] = \
+            facts["catalog"]
+        reference = facts["reference"]
+        for name, ems in sorted(facts["emissions"].items()):
+            for e in ems:
+                if e.module != mod or e.has_star:
+                    continue
+                if catalog is not None:
+                    if name not in catalog:
+                        yield ctx.finding(
+                            self.name, e.lineno,
+                            f"series {name} is not in "
+                            f"{CATALOG_NAME}'s catalog — document it "
+                            f"as `{name}{_fmt(e.labels)}` or drop the "
+                            f"emission")
+                        continue
+                    want = catalog[name]
+                    if e.labels != want:
+                        yield ctx.finding(
+                            self.name, e.lineno,
+                            f"series {name} emitted with labels "
+                            f"{_fmt(e.labels)} but cataloged as "
+                            f"{_fmt(want)} — dashboards split on "
+                            f"label drift")
+                elif reference.get(name) is not None \
+                        and e.labels != reference[name]:
+                    yield ctx.finding(
+                        self.name, e.lineno,
+                        f"series {name} emitted with labels "
+                        f"{_fmt(e.labels)} here but "
+                        f"{_fmt(reference[name])} at its other "
+                        f"sites — one series, one label set")
+
+    def project_findings(self, project: Project
+                         ) -> Iterable[Finding]:
+        facts = project.facts.get(self.name)
+        if not facts:
+            return
+        cat_path: Path = facts["path"]
+        lines: List[str] = []
+        if cat_path.exists():
+            lines = cat_path.read_text(
+                encoding="utf-8").splitlines()
+        for lineno, msg in facts["errors"]:
+            snippet = lines[lineno - 1].strip() \
+                if 0 < lineno <= len(lines) else ""
+            yield Finding(rule=self.name, path=cat_path,
+                          line=lineno, message=msg, snippet=snippet)

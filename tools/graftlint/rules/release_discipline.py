@@ -1,0 +1,81 @@
+"""release-discipline: what you acquire, you release — on every path.
+
+The PR 11 inflight-accounting bug: ``RemoteDispatcher`` incremented a
+node's inflight counter, the transport raised, the retry loop
+incremented the *next* node — and the first node's count never came
+back down, so least-loaded routing starved it forever. The fix moved
+the decrement into a ``finally`` that runs before any retry's
+increment; this rule machine-checks that shape.
+
+Tracked resources (from the summary layer's CFG-lite pass):
+
+- bare ``.acquire()`` on any receiver (locks/semaphores outside
+  ``with``);
+- attribute-based counter increments whose name is capacity-shaped
+  (``inflight``/``pending``/``active``/``slot``/``claim``/...) —
+  ``self._inflight[nid] = self._inflight.get(nid, 0) + 1`` and
+  friends. Function-local tallies are ignored; they die with the
+  frame.
+
+Findings, anchored at the acquire site so one pragma covers the
+resource:
+
+- **unreleased path** — some CFG path (an exception edge past the
+  acquire with no covering ``finally``/catch-all, or a plain
+  return/fall-through) leaves the resource held;
+- **re-acquire before release** — a loop's next iteration acquires
+  the same resource while the previous hold is still live: the retry
+  invariant at parallel/remote.py's ``_send``.
+
+Resources handed off by design (acquired in ``submit``, released by a
+completion callback) are cross-function and must carry a pragma
+saying who releases them — that is the documentation, not noise.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Tuple
+
+from tools.graftlint.engine import (Finding, ModuleContext, Project,
+                                    Rule, module_name_of)
+
+_KIND_TEXT = {"exception": "an exception edge",
+              "exit": "a return/fall-through path"}
+
+
+class ReleaseDisciplineRule(Rule):
+    name = "release-discipline"
+    description = ("acquired locks/semaphores and inflight-counter "
+                   "increments must be released on every CFG path "
+                   "(including exceptions), and released before any "
+                   "loop re-acquire")
+
+    def check(self, ctx: ModuleContext,
+              project: Project) -> Iterable[Finding]:
+        if ctx.tree is None:
+            return
+        mod = module_name_of(ctx.rel) or ctx.rel
+        ms = project.summaries.get(mod)
+        if ms is None:
+            return
+        for s in ms.functions.values():
+            grouped: Dict[Tuple[str, int], List[str]] = {}
+            for ri in s.resource_issues:
+                if ri.kind == "reacquire":
+                    yield ctx.finding(
+                        self.name, ri.lineno,
+                        f"{s.qname} re-acquires {ri.key} (held since "
+                        f"line {ri.acquire_lineno}) before releasing "
+                        f"it — release in a finally before the next "
+                        f"attempt, like RemoteDispatcher._send")
+                else:
+                    grouped.setdefault(
+                        (ri.key, ri.acquire_lineno), []).append(ri.kind)
+            for (key, acq), kinds in sorted(grouped.items()):
+                paths = " and ".join(
+                    _KIND_TEXT[k] for k in sorted(set(kinds)))
+                yield ctx.finding(
+                    self.name, acq,
+                    f"{s.qname} acquires {key} here but {paths} "
+                    f"leaves it held — release in a finally, or "
+                    f"pragma this line naming who releases it")

@@ -1,0 +1,798 @@
+"""Per-function summaries: the facts interprocedural rules run on.
+
+One summary is computed per function/method (a single AST pass per
+module) and captures everything the distributed-systems rule pack
+needs without re-walking the tree per rule:
+
+- **calls** — every call site with its dotted callee, keyword names,
+  ``**kwargs`` forwarding, and whether any argument is derived from a
+  deadline (`deadline-propagation`).
+- **resource issues** — a CFG-lite abstract interpretation over
+  ``.acquire()`` / inflight-counter increments: paths (including
+  exception edges) where the resource is not released, and
+  re-acquire-before-release in loops (`release-discipline`).
+- **file writes** — direct writes vs the ``tmp + os.replace``
+  protocol (`atomic-write`).
+- **metric defs/emits** — ``dl4j_*`` series registrations and their
+  emission label sets, including name-through-parameter indirection
+  (`metric-hygiene`).
+
+Summaries are plain dataclasses with a stable dict round-trip so the
+content-hash cache (tools/graftlint/cache.py) can persist them beside
+the baseline and skip re-analysis of unchanged files.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+SUMMARY_VERSION = 1
+
+# counter-shaped names that denote a capacity resource (released
+# elsewhere), as opposed to monotonic telemetry counters
+_RESOURCE_NAME_RX = re.compile(
+    r"(inflight|in_flight|pending|active|busy|claim|slot|lease|permit)",
+    re.IGNORECASE)
+
+# identifiers / literals that mark a write target as the tmp half of
+# the tmp + os.replace protocol
+_TMP_TEXT_RX = re.compile(r"tmp|temp", re.IGNORECASE)
+
+_DEADLINE_CTORS = ("Deadline", "Deadline.from_ingress",
+                   "Deadline.after_ms")
+
+
+@dataclass(frozen=True)
+class CallSite:
+    """One call expression inside a function body."""
+    callee: str                       # dotted name as written
+    lineno: int
+    kwnames: Tuple[str, ...] = ()
+    has_star_kw: bool = False         # **kwargs forwarded
+    passes_deadline: bool = False     # deadline kwarg or tainted arg
+    literal_args: Tuple[Optional[str], ...] = ()  # str consts by position
+
+
+@dataclass(frozen=True)
+class ResourceIssue:
+    """A path on which an acquired resource is not (yet) released."""
+    kind: str            # "exception" | "exit" | "reacquire"
+    key: str             # dotted resource, e.g. "self._inflight"
+    lineno: int          # where the problem manifests
+    acquire_lineno: int  # where the resource was acquired
+
+
+@dataclass(frozen=True)
+class FileWrite:
+    """A write landing on the filesystem (open/w, write_text, ...)."""
+    lineno: int
+    target: str          # source text of the destination expression
+    tmp_like: bool       # destination is the tmp half of the protocol
+    via: str             # "open" | "fdopen" | "write_text" | "write_bytes"
+
+
+@dataclass(frozen=True)
+class MetricDef:
+    """A ``registry.counter/gauge(name, help)`` registration."""
+    kind: str                      # "counter" | "gauge"
+    name: Optional[str]            # literal series name, if constant
+    name_param: Optional[str]      # enclosing-fn param carrying the name
+    binding: Optional[str]         # "self._c_x" / "g" the handle binds to
+    lineno: int = 0
+
+
+@dataclass(frozen=True)
+class MetricEmit:
+    """A ``handle.inc(...)`` / ``handle.set(...)`` emission site."""
+    name: Optional[str]            # resolved when chained on the def
+    name_param: Optional[str]
+    handle: Optional[str]          # dotted receiver when not inline
+    method: str                    # "inc" | "set"
+    labels: Tuple[str, ...] = ()
+    has_star: bool = False
+    lineno: int = 0
+
+
+@dataclass(frozen=True)
+class FunctionSummary:
+    """Everything the interprocedural pass knows about one function."""
+    qname: str                     # "Class.method" or "func"
+    module: str                    # dotted module name ("" if unknown)
+    lineno: int
+    params: Tuple[str, ...] = ()
+    has_varkw: bool = False
+    calls: Tuple[CallSite, ...] = ()
+    has_deadline: bool = False     # deadline param or local binding
+    deadline_lineno: int = 0
+    resource_issues: Tuple[ResourceIssue, ...] = ()
+    writes: Tuple[FileWrite, ...] = ()
+    metric_defs: Tuple[MetricDef, ...] = ()
+    metric_emits: Tuple[MetricEmit, ...] = ()
+
+    @property
+    def key(self) -> str:
+        return f"{self.module}::{self.qname}"
+
+
+@dataclass
+class ModuleSummary:
+    """All function summaries of one module plus its import table."""
+    module: str
+    rel: str
+    functions: Dict[str, FunctionSummary] = field(default_factory=dict)
+    # local alias -> dotted target ("pkg.mod" or "pkg.mod.attr")
+    imports: Dict[str, str] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"module": self.module, "rel": self.rel,
+                "imports": dict(self.imports),
+                "functions": {q: asdict(s)
+                              for q, s in self.functions.items()}}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ModuleSummary":
+        ms = cls(module=d["module"], rel=d["rel"],
+                 imports=dict(d.get("imports", {})))
+        for q, sd in d.get("functions", {}).items():
+            ms.functions[q] = FunctionSummary(
+                qname=sd["qname"], module=sd["module"],
+                lineno=sd["lineno"], params=tuple(sd["params"]),
+                has_varkw=sd["has_varkw"],
+                calls=tuple(CallSite(
+                    callee=c["callee"], lineno=c["lineno"],
+                    kwnames=tuple(c["kwnames"]),
+                    has_star_kw=c["has_star_kw"],
+                    passes_deadline=c["passes_deadline"],
+                    literal_args=tuple(c["literal_args"]))
+                    for c in sd["calls"]),
+                has_deadline=sd["has_deadline"],
+                deadline_lineno=sd["deadline_lineno"],
+                resource_issues=tuple(ResourceIssue(**r)
+                                      for r in sd["resource_issues"]),
+                writes=tuple(FileWrite(**w) for w in sd["writes"]),
+                metric_defs=tuple(MetricDef(**m)
+                                  for m in sd["metric_defs"]),
+                metric_emits=tuple(MetricEmit(
+                    **{**m, "labels": tuple(m["labels"])})
+                    for m in sd["metric_emits"]))
+        return ms
+
+
+# ---- module-level driver ------------------------------------------------
+
+def build_module_summary(tree: ast.Module, text: str, module: str,
+                         rel: str) -> ModuleSummary:
+    """One pass over a parsed module -> its ModuleSummary."""
+    ms = ModuleSummary(module=module or "", rel=rel)
+    ms.imports = _import_table(tree, module or "", rel)
+    for node in tree.body:
+        _collect(node, text, module or "", ms, prefix="")
+    return ms
+
+
+def _collect(node: ast.AST, text: str, module: str, ms: ModuleSummary,
+             prefix: str) -> None:
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        qname = f"{prefix}{node.name}"
+        ms.functions[qname] = _summarize_function(
+            node, text, module, qname)
+        # nested defs get their own (rarely-resolved) summaries too
+        for sub in node.body:
+            _collect(sub, text, module, ms, prefix=f"{qname}.")
+    elif isinstance(node, ast.ClassDef):
+        for sub in node.body:
+            _collect(sub, text, module, ms, prefix=f"{node.name}.")
+    elif isinstance(node, (ast.If, ast.Try)):
+        for sub in ast.iter_child_nodes(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                _collect(sub, text, module, ms, prefix=prefix)
+
+
+def _is_pkg(rel: str) -> bool:
+    return rel.replace("\\", "/").endswith("/__init__.py")
+
+
+def _import_table(tree: ast.Module, module: str, rel: str
+                  ) -> Dict[str, str]:
+    """Local alias -> dotted target, resolving relative imports the
+    same way donation-safety does."""
+    pkg_parts = module.split(".") if module else []
+    if module and not _is_pkg(rel):
+        pkg_parts = pkg_parts[:-1]
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = \
+                    a.name if a.asname else a.name.split(".")[0]
+                if a.asname:
+                    out[a.asname] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            base = _resolve_from(node, pkg_parts)
+            if base is None:
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                out[a.asname or a.name] = f"{base}.{a.name}"
+    return out
+
+
+def _resolve_from(node: ast.ImportFrom,
+                  pkg_parts: Sequence[str]) -> Optional[str]:
+    if node.level == 0:
+        return node.module
+    # relative import: strip (level - 1) trailing package components
+    up = node.level - 1
+    if up > len(pkg_parts):
+        return None
+    base = list(pkg_parts[:len(pkg_parts) - up])
+    if node.module:
+        base.extend(node.module.split("."))
+    return ".".join(base) if base else None
+
+
+# ---- per-function summarization -----------------------------------------
+
+def _summarize_function(fn, text: str, module: str,
+                        qname: str) -> FunctionSummary:
+    params = _param_names(fn)
+    tainted, dl_lineno = _deadline_taint(fn, params)
+    calls = _collect_calls(fn, tainted)
+    writes = _collect_writes(fn, text)
+    mdefs, memits = _collect_metrics(fn, params)
+    issues = _ResourceAnalyzer().run(fn)
+    return FunctionSummary(
+        qname=qname, module=module, lineno=fn.lineno,
+        params=params, has_varkw=fn.args.kwarg is not None,
+        calls=tuple(calls), has_deadline=bool(tainted),
+        deadline_lineno=dl_lineno, resource_issues=tuple(issues),
+        writes=tuple(writes), metric_defs=tuple(mdefs),
+        metric_emits=tuple(memits))
+
+
+def _param_names(fn) -> Tuple[str, ...]:
+    a = fn.args
+    names = [p.arg for p in
+             list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+    return tuple(names)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _walk_own(fn):
+    """Walk the function subtree, skipping nested class bodies (their
+    methods are summarized separately) but including closures (their
+    calls usually run on behalf of this function)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.ClassDef):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _deadline_taint(fn, params: Sequence[str]
+                    ) -> Tuple[Set[str], int]:
+    """Names in ``fn`` holding a deadline: the ``deadline`` parameter
+    plus locals (transitively) assigned from it or from a Deadline
+    constructor."""
+    tainted: Set[str] = set()
+    lineno = 0
+    if "deadline" in params:
+        tainted.add("deadline")
+        lineno = fn.lineno
+    assigns = [n for n in _walk_own(fn) if isinstance(n, ast.Assign)]
+    for _ in range(3):                      # tiny transitive closure
+        changed = False
+        for n in assigns:
+            if _mentions_tainted(n.value, tainted) \
+                    or _is_deadline_ctor(n.value):
+                for tgt in n.targets:
+                    if isinstance(tgt, ast.Name) \
+                            and tgt.id not in tainted:
+                        tainted.add(tgt.id)
+                        lineno = lineno or n.lineno
+                        changed = True
+        if not changed:
+            break
+    return tainted, lineno
+
+
+def _is_deadline_ctor(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            name = _dotted(sub.func)
+            if name and (name in _DEADLINE_CTORS
+                         or name.endswith(".Deadline")
+                         or any(name.endswith("." + c)
+                                for c in _DEADLINE_CTORS[1:])):
+                return True
+    return False
+
+
+def _mentions_tainted(node: ast.AST, tainted: Set[str]) -> bool:
+    if not tainted:
+        return False
+    return any(isinstance(sub, ast.Name) and sub.id in tainted
+               for sub in ast.walk(node))
+
+
+def _collect_calls(fn, tainted: Set[str]) -> List[CallSite]:
+    out: List[CallSite] = []
+    for node in _walk_own(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _dotted(node.func)
+        if callee is None:
+            continue
+        kwnames = tuple(kw.arg for kw in node.keywords if kw.arg)
+        has_star = any(kw.arg is None for kw in node.keywords)
+        passes = "deadline" in kwnames or any(
+            _mentions_tainted(a, tainted) for a in node.args) or any(
+            _mentions_tainted(kw.value, tainted) for kw in node.keywords)
+        lits = tuple(a.value if isinstance(a, ast.Constant)
+                     and isinstance(a.value, str) else None
+                     for a in node.args)
+        out.append(CallSite(callee=callee, lineno=node.lineno,
+                            kwnames=kwnames, has_star_kw=has_star,
+                            passes_deadline=passes, literal_args=lits))
+    out.sort(key=lambda c: c.lineno)
+    return out
+
+
+# ---- file-write protocol ------------------------------------------------
+
+_WRITE_MODES = ("w", "a", "x")
+
+
+def _collect_writes(fn, text: str) -> List[FileWrite]:
+    tmp_names = _tmp_tainted_names(fn)
+    out: List[FileWrite] = []
+    for node in _walk_own(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _dotted(node.func)
+        if callee in ("open", "io.open") and node.args:
+            mode = _open_mode(node)
+            if mode is None or not any(m in mode for m in _WRITE_MODES):
+                continue
+            tgt = node.args[0]
+            out.append(_mk_write(tgt, node.lineno, "open",
+                                 text, tmp_names))
+        elif callee in ("os.fdopen",) and node.args:
+            mode = _open_mode(node)
+            if mode is not None and not any(m in mode
+                                            for m in _WRITE_MODES):
+                continue
+            out.append(_mk_write(node.args[0], node.lineno, "fdopen",
+                                 text, tmp_names))
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("write_text", "write_bytes"):
+            out.append(_mk_write(node.func.value, node.lineno,
+                                 node.func.attr, text, tmp_names))
+    out.sort(key=lambda w: w.lineno)
+    return out
+
+
+def _open_mode(call: ast.Call) -> Optional[str]:
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant) \
+            and isinstance(call.args[1].value, str):
+        return call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    if len(call.args) == 1 and not any(kw.arg == "mode"
+                                       for kw in call.keywords):
+        return "r"
+    return None
+
+
+def _tmp_tainted_names(fn) -> Set[str]:
+    """Names bound from tempfile.* — always the tmp half."""
+    names: Set[str] = set()
+    for node in _walk_own(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        src = node.value
+        call = src if isinstance(src, ast.Call) else None
+        if call is None:
+            continue
+        callee = _dotted(call.func) or ""
+        if callee.startswith("tempfile.") or callee in (
+                "mkstemp", "mktemp", "NamedTemporaryFile"):
+            for tgt in node.targets:
+                for el in ([tgt] if isinstance(tgt, ast.Name)
+                           else getattr(tgt, "elts", [])):
+                    if isinstance(el, ast.Name):
+                        names.add(el.id)
+    return names
+
+
+def _mk_write(target: ast.AST, lineno: int, via: str, text: str,
+              tmp_names: Set[str]) -> FileWrite:
+    seg = None
+    try:
+        seg = ast.get_source_segment(text, target)
+    except Exception:
+        pass
+    if seg is None:
+        seg = _dotted(target) or "<expr>"
+    seg = " ".join(seg.split())
+    tmp_like = bool(_TMP_TEXT_RX.search(seg)) or any(
+        isinstance(sub, ast.Name) and sub.id in tmp_names
+        for sub in ast.walk(target))
+    return FileWrite(lineno=lineno, target=seg[:120],
+                     tmp_like=tmp_like, via=via)
+
+
+# ---- metric defs / emits ------------------------------------------------
+
+def _collect_metrics(fn, params: Sequence[str]
+                     ) -> Tuple[List[MetricDef], List[MetricEmit]]:
+    defs: List[MetricDef] = []
+    emits: List[MetricEmit] = []
+    param_set = set(params)
+    def_ids: Set[int] = set()      # def Call nodes consumed inline
+
+    # inline chains first: reg.counter("n", h).inc(...) — the emit
+    # carries the series name directly
+    for node in _walk_own(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        meth = node.func.attr if isinstance(node.func, ast.Attribute) \
+            else None
+        if meth not in ("inc", "set"):
+            continue
+        recv = node.func.value
+        d = _match_metric_def(recv, param_set)
+        labels = tuple(sorted(kw.arg for kw in node.keywords if kw.arg))
+        has_star = any(kw.arg is None for kw in node.keywords)
+        if d is not None:
+            def_ids.add(id(recv))
+            emits.append(MetricEmit(
+                name=d.name, name_param=d.name_param, handle=None,
+                method=meth, labels=labels, has_star=has_star,
+                lineno=node.lineno))
+        else:
+            handle = _dotted(recv)
+            if handle is not None:
+                emits.append(MetricEmit(
+                    name=None, name_param=None, handle=handle,
+                    method=meth, labels=labels, has_star=has_star,
+                    lineno=node.lineno))
+
+    # standalone defs (bound to a name / attribute, or bare)
+    for node in _walk_own(fn):
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call):
+            d = _match_metric_def(node.value, param_set)
+            if d is not None and id(node.value) not in def_ids:
+                binding = None
+                if len(node.targets) == 1:
+                    binding = _dotted(node.targets[0])
+                defs.append(MetricDef(kind=d.kind, name=d.name,
+                                      name_param=d.name_param,
+                                      binding=binding,
+                                      lineno=node.value.lineno))
+                def_ids.add(id(node.value))
+    for node in _walk_own(fn):
+        if isinstance(node, ast.Call) and id(node) not in def_ids:
+            d = _match_metric_def(node, param_set)
+            if d is not None:
+                defs.append(MetricDef(kind=d.kind, name=d.name,
+                                      name_param=d.name_param,
+                                      binding=None, lineno=node.lineno))
+                def_ids.add(id(node))
+    defs.sort(key=lambda m: m.lineno)
+    emits.sort(key=lambda m: m.lineno)
+    return defs, emits
+
+
+def _match_metric_def(node: ast.AST, params: Set[str]
+                      ) -> Optional[MetricDef]:
+    """``<recv>.counter(name, ...)`` / ``.gauge(name, ...)`` with a
+    string-literal or parameter name -> MetricDef, else None."""
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("counter", "gauge")
+            and node.args):
+        return None
+    first = node.args[0]
+    if isinstance(first, ast.Constant) and isinstance(first.value, str):
+        return MetricDef(kind=node.func.attr, name=first.value,
+                         name_param=None, binding=None,
+                         lineno=node.lineno)
+    if isinstance(first, ast.Name) and first.id in params:
+        return MetricDef(kind=node.func.attr, name=None,
+                         name_param=first.id, binding=None,
+                         lineno=node.lineno)
+    return None
+
+
+# ---- CFG-lite resource analysis -----------------------------------------
+
+_SAFE_CALL_SUFFIXES = (
+    ".get", ".keys", ".values", ".items", ".append", ".copy",
+    ".monotonic", ".time", ".perf_counter", ".acquire", ".release",
+    ".pop", ".format", ".join", ".split", ".strip", ".encode",
+    ".decode", ".setdefault", ".locked",
+)
+_SAFE_CALL_NAMES = {
+    "len", "int", "float", "str", "bool", "max", "min", "abs",
+    "isinstance", "getattr", "hasattr", "id", "repr", "list", "dict",
+    "tuple", "set", "sorted", "print",
+}
+
+
+class _Frame:
+    """One enclosing try: which keys its finally releases, whether a
+    catch-all handler stops propagation."""
+
+    def __init__(self, finally_rel: Set[str], catch_all: bool):
+        self.finally_rel = finally_rel
+        self.catch_all = catch_all
+
+
+class _ResourceAnalyzer:
+    """May-hold abstract interpretation over acquire/release events.
+
+    State maps resource key -> acquire lineno. Branches merge with
+    union (may-hold), loops run twice to catch re-acquire-before-
+    release across iterations, and try frames record which keys an
+    exception edge would still release (finally) or stop (catch-all
+    handler)."""
+
+    def run(self, fn) -> List[ResourceIssue]:
+        self.issues: List[ResourceIssue] = []
+        self._seen: Set[Tuple[str, str, int]] = set()
+        self.frames: List[_Frame] = []
+        end = self._block(list(fn.body), {})
+        if end is not None:
+            last = fn.body[-1].lineno if fn.body else fn.lineno
+            for key, ln in sorted(end.items()):
+                self._issue("exit", key, last, ln)
+        return self.issues
+
+    # -- events ----------------------------------------------------------
+
+    def _issue(self, kind: str, key: str, lineno: int,
+               acq: int) -> None:
+        mark = (kind, key, acq)
+        if mark in self._seen:
+            return
+        self._seen.add(mark)
+        self.issues.append(ResourceIssue(kind=kind, key=key,
+                                         lineno=lineno,
+                                         acquire_lineno=acq))
+
+    def _acquires(self, stmt: ast.AST) -> List[Tuple[str, int]]:
+        out = []
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "acquire":
+                key = _dotted(node.func.value)
+                if key:
+                    out.append((key, node.lineno))
+            elif isinstance(node, ast.AugAssign) \
+                    and isinstance(node.op, ast.Add):
+                key = self._counter_key(node.target)
+                if key:
+                    out.append((key, node.lineno))
+            elif isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.BinOp) \
+                    and isinstance(node.value.op, ast.Add):
+                key = self._counter_base(node.value.left)
+                if key:
+                    out.append((key, node.lineno))
+        return out
+
+    def _releases(self, stmt: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "release":
+                key = _dotted(node.func.value)
+                if key:
+                    out.add(key)
+            elif isinstance(node, ast.AugAssign) \
+                    and isinstance(node.op, ast.Sub):
+                key = self._counter_key(node.target)
+                if key:
+                    out.add(key)
+            elif isinstance(node, ast.BinOp) \
+                    and isinstance(node.op, ast.Sub):
+                key = self._counter_base(node.left)
+                if key:
+                    out.add(key)
+        return out
+
+    def _counter_key(self, target: ast.AST) -> Optional[str]:
+        """self._inflight += 1 / self._inflight[k] += 1 -> resource key
+        when the name is counter-shaped. Bare locals are excluded: a
+        function-local tally cannot leak past the frame."""
+        base = target.value if isinstance(target, ast.Subscript) \
+            else target
+        key = _dotted(base)
+        if key and "." in key and _RESOURCE_NAME_RX.search(key):
+            return key
+        return None
+
+    def _counter_base(self, left: ast.AST) -> Optional[str]:
+        """``X.get(k, 0) + 1`` / ``X[k] + 1`` / ``X + 1`` -> X when
+        counter-shaped."""
+        if isinstance(left, ast.Call) \
+                and isinstance(left.func, ast.Attribute) \
+                and left.func.attr == "get":
+            left = left.func.value
+        elif isinstance(left, ast.Subscript):
+            left = left.value
+        key = _dotted(left)
+        if key and "." in key and _RESOURCE_NAME_RX.search(key):
+            return key
+        return None
+
+    def _may_raise(self, stmt: ast.AST) -> bool:
+        if isinstance(stmt, (ast.Raise, ast.Assert)):
+            return True
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            if name is None:
+                return True
+            if name in _SAFE_CALL_NAMES:
+                continue
+            if any(name.endswith(s) for s in _SAFE_CALL_SUFFIXES):
+                continue
+            return True
+        return False
+
+    # -- interpretation --------------------------------------------------
+
+    def _block(self, stmts: List[ast.stmt],
+               state: Dict[str, int]) -> Optional[Dict[str, int]]:
+        """Run a statement list; returns the exit state, or None when
+        every path terminates (return/raise/break/continue)."""
+        for stmt in stmts:
+            state = self._stmt(stmt, state)
+            if state is None:
+                return None
+        return state
+
+    def _check_raise_edge(self, stmt: ast.AST,
+                          state: Dict[str, int]) -> None:
+        if not state or not self._may_raise(stmt):
+            return
+        covered: Set[str] = set()
+        stopped = any(f.catch_all for f in self.frames)
+        for f in self.frames:
+            covered |= f.finally_rel
+        if stopped:
+            return
+        for key, ln in sorted(state.items()):
+            if key not in covered:
+                self._issue("exception", key, stmt.lineno, ln)
+
+    def _finally_cover(self) -> Set[str]:
+        out: Set[str] = set()
+        for f in self.frames:
+            out |= f.finally_rel
+        return out
+
+    def _stmt(self, stmt: ast.stmt,
+              state: Dict[str, int]) -> Optional[Dict[str, int]]:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return state
+        if isinstance(stmt, ast.Return):
+            held = {k: v for k, v in state.items()
+                    if k not in self._finally_cover()}
+            for key, ln in sorted(held.items()):
+                self._issue("exit", key, stmt.lineno, ln)
+            return None
+        if isinstance(stmt, (ast.Break, ast.Continue)):
+            # held state survives into the next iteration / loop exit,
+            # which is exactly how re-acquire-before-release leaks
+            return state
+        if isinstance(stmt, ast.Raise):
+            self._check_raise_edge(stmt, state)
+            return None
+        if isinstance(stmt, ast.If):
+            self._check_raise_edge(stmt.test, state)
+            s1 = self._block(list(stmt.body), dict(state))
+            s2 = self._block(list(stmt.orelse), dict(state))
+            return self._merge(s1, s2)
+        if isinstance(stmt, (ast.While, ast.For)):
+            self._check_raise_edge(stmt, state)
+            s1 = self._block(list(stmt.body), dict(state))
+            base = dict(state) if s1 is None else s1
+            # second pass exposes re-acquire across iterations
+            s2 = self._block(list(stmt.body), dict(base))
+            out = self._merge(dict(state), self._merge(s1, s2))
+            if stmt.orelse and out is not None:
+                out = self._block(list(stmt.orelse), out)
+            return out if out is not None else dict(state)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._check_raise_edge(item.context_expr, state)
+            return self._block(list(stmt.body), state)
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, state)
+        # simple statement: releases, then raise edge, then acquires
+        for key in self._releases(stmt):
+            state.pop(key, None)
+        self._check_raise_edge(stmt, state)
+        for key, ln in self._acquires(stmt):
+            if key in state:
+                self._issue("reacquire", key, ln, state[key])
+            state[key] = ln
+        return state
+
+    def _try(self, stmt: ast.Try,
+             state: Dict[str, int]) -> Optional[Dict[str, int]]:
+        finally_rel: Set[str] = set()
+        for s in stmt.finalbody:
+            finally_rel |= self._releases(s)
+        catch_all = any(
+            h.type is None or (_dotted(h.type) or "").split(".")[-1]
+            in ("Exception", "BaseException")
+            for h in stmt.handlers)
+        entry = dict(state)
+        self.frames.append(_Frame(finally_rel, catch_all))
+        body_state = self._block(list(stmt.body), dict(state))
+        if body_state is not None and stmt.orelse:
+            body_state = self._block(list(stmt.orelse), body_state)
+        self.frames.pop()
+
+        # handler paths start from "anything the body may have
+        # acquired before failing"
+        body_acq: Dict[str, int] = dict(entry)
+        for s in stmt.body:
+            for key, ln in self._acquires(s):
+                body_acq.setdefault(key, ln)
+        self.frames.append(_Frame(finally_rel, False))
+        handler_states = []
+        for h in stmt.handlers:
+            hs = self._block(list(h.body), dict(body_acq))
+            handler_states.append(hs)
+        self.frames.pop()
+
+        out = body_state
+        for hs in handler_states:
+            out = self._merge(out, hs)
+        if out is None:
+            return None
+        for key in finally_rel:
+            out.pop(key, None)
+        return out
+
+    @staticmethod
+    def _merge(a: Optional[Dict[str, int]],
+               b: Optional[Dict[str, int]]
+               ) -> Optional[Dict[str, int]]:
+        if a is None:
+            return b
+        if b is None:
+            return a
+        out = dict(a)
+        for k, v in b.items():
+            out[k] = min(v, out.get(k, v))
+        return out
