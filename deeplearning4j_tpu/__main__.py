@@ -171,6 +171,37 @@ def _build_parser() -> argparse.ArgumentParser:
                    "on shed)")
     g.add_argument("--gen-queue-limit", type=int, default=128,
                    help="bound on sequences waiting for a slot")
+    g.add_argument("--gen-prefill-chunk", type=int, default=0,
+                   metavar="C",
+                   help="chunked prefill: consume prompts in jitted "
+                   "scans of up to C tokens (pow2 ladder, AOT-warmed) "
+                   "instead of one tick per char; 0 disables")
+    g.add_argument("--gen-speculative", type=int, default=0,
+                   metavar="K",
+                   help="speculative decode: n-gram draft proposes up "
+                   "to K tokens per slot, verified in one batched "
+                   "dispatch; accepted output stays bitwise-equal to "
+                   "plain decode. 0 disables")
+    g.add_argument("--gen-sampling", default=None,
+                   choices=["chain", "counter"],
+                   help="seeded-sampling key derivation: chain (legacy "
+                   "carried split chain) or counter (splitmix64 of "
+                   "(seed, position) — replayable anywhere; the "
+                   "default when --gen-speculative is on)")
+    g.add_argument("--gen-session-dir", default=None, metavar="DIR",
+                   help="enable resumable sessions, checkpointing "
+                   "carries into this shared ArtifactStore root so a "
+                   "session resumes on another node after a drain")
+    g.add_argument("--gen-session-cap", type=int, default=0,
+                   metavar="N",
+                   help="enable resumable sessions with N carries "
+                   "pinned device-side (LRU to host beyond that); "
+                   "local-only unless --gen-session-dir adds the "
+                   "cross-node checkpoint tier")
+    g.add_argument("--gen-carry-int8", action="store_true",
+                   help="store session carries int8-quantized "
+                   "(ops/quantize.py rows) — ~4x more resumable "
+                   "sessions per chip, trades away bitwise resume")
     return p
 
 
@@ -321,12 +352,29 @@ def cmd_serve(args, block: bool = True):
     gen_engine = None
     gen_router = None
     if getattr(args, "generate", False):
-        from deeplearning4j_tpu.generation import GenerationEngine
+        from deeplearning4j_tpu.generation import (
+            GenerationEngine, SessionStore, extract_decode_spec)
+        gen_store = None
+        if args.gen_session_dir or args.gen_session_cap:
+            art_store = None
+            if args.gen_session_dir:
+                from deeplearning4j_tpu.parallel.aot_cache import (
+                    ArtifactStore)
+                art_store = ArtifactStore(args.gen_session_dir)
+            gen_store = SessionStore(
+                extract_decode_spec(model),
+                device_capacity=args.gen_session_cap or 32,
+                store=art_store,
+                carry_dtype="int8" if args.gen_carry_int8 else "f32")
         gen_engine = GenerationEngine(
             model, max_slots=args.gen_slots,
             precision=args.gen_precision,
             max_new_tokens=args.gen_max_new,
-            queue_limit=args.gen_queue_limit)
+            queue_limit=args.gen_queue_limit,
+            prefill_chunk=args.gen_prefill_chunk,
+            speculative=args.gen_speculative,
+            sampling=args.gen_sampling,
+            session_store=gen_store)
         if fleet is not None or args.gen_slo_token_ms is not None:
             gen_router = fleet
             if gen_router is None:
@@ -379,7 +427,13 @@ def cmd_serve(args, block: bool = True):
         print(f"  generate: POST {server.url}/api/generate "
               '{"prompt": "...", "stream": true}  (SSE token stream, '
               f"slots={args.gen_slots}, "
-              f"precision={args.gen_precision})")
+              f"precision={args.gen_precision}"
+              + (f", prefill_chunk={args.gen_prefill_chunk}"
+                 if args.gen_prefill_chunk else "")
+              + (f", speculative={args.gen_speculative}"
+                 if args.gen_speculative else "")
+              + (", sessions=on" if gen_engine.session_store
+                 is not None else "") + ")")
         print(f"  genstats: GET  {server.url}/api/generation/stats")
     if not block:
         return front, server

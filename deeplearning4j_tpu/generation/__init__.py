@@ -7,16 +7,31 @@ across ticks, sequences join and leave the batch mid-flight, and the
 sampled tokens stream back to HTTP clients as they decode
 (``POST /api/generate``, SSE).
 
-- ``decode.py``   pure tick builder, vocab, reference decode, int8 head
-- ``engine.py``   GenerationEngine: slots, scheduler, AOT warmup, metrics
+v2 serving modes (opt-in per engine): chunked prefill (one jitted scan
+per prompt chunk instead of one tick per char), resumable sessions
+(retired carries pinned device-side, LRU-tiered to host, checkpointed
+into the shared ArtifactStore for cross-node resume), and speculative
+decode (n-gram draft + one-dispatch batched verify, bitwise-equal to
+plain decode under counter-based splitmix64 sampling keys).
+
+- ``decode.py``      pure tick/prefill builders, vocab, reference decode
+- ``engine.py``      GenerationEngine: slots, scheduler, AOT warmup
+- ``session.py``     SessionStore: tiered resumable carries
+- ``speculative.py`` NGramDraft + the batched verify step
 """
 
 from deeplearning4j_tpu.generation.decode import (
     DecodeSpec, Vocab, extract_decode_spec, head_bytes_per_token,
-    reference_decode)
+    prefill_chunk_ladder, reference_decode)
 from deeplearning4j_tpu.generation.engine import (
     GenerationEngine, GenerationStream)
+from deeplearning4j_tpu.generation.session import (
+    CarrySnapshot, SessionStore)
+from deeplearning4j_tpu.generation.speculative import (
+    NGramDraft, counter_keys)
 
 __all__ = ["DecodeSpec", "Vocab", "extract_decode_spec",
-           "head_bytes_per_token", "reference_decode",
-           "GenerationEngine", "GenerationStream"]
+           "head_bytes_per_token", "prefill_chunk_ladder",
+           "reference_decode", "GenerationEngine", "GenerationStream",
+           "CarrySnapshot", "SessionStore", "NGramDraft",
+           "counter_keys"]

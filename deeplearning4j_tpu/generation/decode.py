@@ -195,6 +195,20 @@ def head_bytes_per_token(spec: DecodeSpec, hidden: int,
 # ---- the tick ---------------------------------------------------------
 
 
+def _stack_step(cores, dp, x, hs, cs):
+    """One position through the stacked LSTM cells. Shared verbatim by
+    the tick, the chunked prefill scan and the speculative verify scan
+    so every path computes bitwise-identical carries and head inputs —
+    the parity guarantees all hang off this one function."""
+    h_new, c_new = [], []
+    for i, core in enumerate(cores):
+        hy, cy = core.step_one(dp["lstm"][i], x, (hs[i], cs[i]))
+        h_new.append(hy)
+        c_new.append(cy)
+        x = hy
+    return h_new, c_new, x
+
+
 def _head_logits(head, h):
     if "Wq" in head:
         from deeplearning4j_tpu.ops.quantize import int8_dot
@@ -226,13 +240,21 @@ def build_tick(model, spec: DecodeSpec):
     """The jittable single-tick decode step.
 
     tick(dp, h, c, rng, tokens, reset, seeds, active, temp, top_k,
-    greedy) -> (h', c', rng', next_tokens)
+    greedy, ext_key, use_ext) -> (h', c', rng', next_tokens)
 
     - dp: committed decode params ({"lstm": [...], "head": {...}})
     - h, c: per-layer lists of (S, H_l) f32 — device-resident carries
     - rng: (S, 2) uint32 per-slot PRNG keys — device-resident
     - tokens (S,) i32 in, reset/active (S,) bool, seeds (S,) u32,
       temp (S,) f32, top_k (S,) i32, greedy (S,) bool — host controls
+    - ext_key (S, 2) u32 + use_ext (S,) bool — counter-mode sampling
+      keys (splitmix64 of (seed, position), computed host-side by
+      ``speculative.counter_keys``). A slot with ``use_ext`` samples
+      with its externally-derived key instead of the carried split
+      chain, which is what lets the speculative verify step reproduce
+      any position's sampling without replaying the chain. The chain
+      still advances either way, so chain-mode slots are unaffected
+      by counter-mode co-residents.
     - next_tokens (S,) i32 — the streamed payload
 
     A reset slot's carries are zeroed and its key re-derived from its
@@ -245,23 +267,19 @@ def build_tick(model, spec: DecodeSpec):
     V = spec.vocab_size
 
     def tick(dp, h, c, rng, tokens, reset, seeds, active, temp, top_k,
-             greedy):
+             greedy, ext_key, use_ext):
         rmask = reset[:, None]
         fresh = jax.vmap(jax.random.PRNGKey)(seeds)
         rng_in = jnp.where(rmask, fresh, rng)
         hs = [jnp.where(rmask, 0.0, hl) for hl in h]
         cs = [jnp.where(rmask, 0.0, cl) for cl in c]
         x = jax.nn.one_hot(tokens, V, dtype=jnp.float32)
-        h_new, c_new = [], []
-        for i, core in enumerate(cores):
-            hy, cy = core.step_one(dp["lstm"][i], x, (hs[i], cs[i]))
-            h_new.append(hy)
-            c_new.append(cy)
-            x = hy
-        logits = _head_logits(dp["head"], x)
+        h_new, c_new, top = _stack_step(cores, dp, x, hs, cs)
+        logits = _head_logits(dp["head"], top)
         split = jax.vmap(lambda k: jax.random.split(k, 2))(rng_in)
+        key = jnp.where(use_ext[:, None], ext_key, split[:, 1])
         sampled = jax.vmap(_sample_one)(
-            split[:, 1], logits, temp, top_k, greedy)
+            key, logits, temp, top_k, greedy)
         amask = active[:, None]
         h_out = [jnp.where(amask, hn, hi)
                  for hn, hi in zip(h_new, hs)]
@@ -272,6 +290,101 @@ def build_tick(model, spec: DecodeSpec):
         return h_out, c_out, rng_out, next_tokens
 
     return tick
+
+
+# ---- chunked prefill ---------------------------------------------------
+
+
+def prefill_chunk_ladder(max_chunk: int) -> List[int]:
+    """pow2 chunk sizes up to ``max_chunk`` (always included) — the
+    prefill analog of the slot-bucket ladder, AOT-warmed the same way
+    so a live prompt of any length dispatches warm executables only."""
+    if max_chunk < 1:
+        raise ValueError("max_chunk must be >= 1")
+    out, b = [], 8
+    while b < max_chunk:
+        out.append(b)
+        b <<= 1
+    if not out or out[-1] != max_chunk:
+        out.append(max_chunk)
+    return sorted(set(out))
+
+
+def build_prefill(model, spec: DecodeSpec):
+    """The jittable multi-token prefill step: one ``lax.scan`` over a
+    padded (S, C) prompt chunk with per-slot valid lengths.
+
+    prefill(dp, h, c, rng, chunk, lens, reset, seeds, active)
+        -> (h', c', rng')
+
+    A slot consumes ``lens[i]`` tokens of its chunk row; positions past
+    its length (and inactive slots) are masked-neutral pass-throughs.
+    No head projection and no sampling — prefill only advances carries,
+    which is most of the win (the head matmul is the dominant per-tick
+    FLOP and prefill never needed it). The per-slot PRNG chain advances
+    exactly one split per consumed token, identical to feeding the same
+    tokens through the tick one at a time, so chunked and tick-at-a-time
+    prefill are bitwise-interchangeable mid-sequence.
+    """
+    cores = _lstm_cores(model, spec)
+    V = spec.vocab_size
+
+    def prefill(dp, h, c, rng, chunk, lens, reset, seeds, active):
+        rmask = reset[:, None]
+        fresh = jax.vmap(jax.random.PRNGKey)(seeds)
+        rng0 = jnp.where(rmask, fresh, rng)
+        h0 = [jnp.where(rmask, 0.0, hl) for hl in h]
+        c0 = [jnp.where(rmask, 0.0, cl) for cl in c]
+
+        def step(carry, xs):
+            hs, cs, r = carry
+            tok_t, t = xs
+            valid = active & (t < lens)
+            vmask = valid[:, None]
+            x = jax.nn.one_hot(tok_t, V, dtype=jnp.float32)
+            h_new, c_new, _ = _stack_step(cores, dp, x, hs, cs)
+            hs2 = [jnp.where(vmask, hn, hi)
+                   for hn, hi in zip(h_new, hs)]
+            cs2 = [jnp.where(vmask, cn, ci)
+                   for cn, ci in zip(c_new, cs)]
+            split = jax.vmap(lambda k: jax.random.split(k, 2))(r)
+            r2 = jnp.where(vmask, split[:, 0], r)
+            return (hs2, cs2, r2), None
+
+        C = chunk.shape[1]
+        xs = (jnp.transpose(chunk), jnp.arange(C, dtype=jnp.int32))
+        (h1, c1, rng1), _ = jax.lax.scan(step, (h0, c0, rng0), xs)
+        return h1, c1, rng1
+
+    return prefill
+
+
+# ---- per-slot carry extract/restore (session store) -------------------
+
+
+def build_slot_extract(spec: DecodeSpec):
+    """Jittable gather of one slot's device state rows — the capture
+    half of session resume. ``idx`` is traced, so one executable per
+    bucket covers every slot index (warmed like the tick)."""
+    def extract(h, c, rng, idx):
+        hr = [jnp.take(hl, idx, axis=0) for hl in h]
+        cr = [jnp.take(cl, idx, axis=0) for cl in c]
+        return hr, cr, jnp.take(rng, idx, axis=0)
+
+    return extract
+
+
+def build_slot_restore(spec: DecodeSpec):
+    """Jittable scatter of saved carry rows into one slot of the live
+    batch — the resume half. The joining slot skips its reset (its
+    state IS the restored rows) and continues the sequence as if it
+    had never retired."""
+    def restore(h, c, rng, hr, cr, rr, idx):
+        h2 = [hl.at[idx].set(r) for hl, r in zip(h, hr)]
+        c2 = [cl.at[idx].set(r) for cl, r in zip(c, cr)]
+        return h2, c2, rng.at[idx].set(rr)
+
+    return restore
 
 
 def zero_carries(spec: DecodeSpec, n_slots: int):
@@ -309,6 +422,21 @@ def build_resize(spec: DecodeSpec, src: int, dst: int):
 # ---- reference decode (test/bench oracle) ----------------------------
 
 
+def _jit_time_step(model):
+    """One jitted ``(features, carries) -> (probs, carries)`` step,
+    cached on the model instance. The oracle loops below call
+    ``rnn_time_step`` once per token, and the eager path re-lowers
+    every call — ~100 ms/step on CPU against ~40 µs for the step
+    itself. Keyed on the train state so a retrained model re-traces
+    instead of serving stale closed-over params."""
+    key = id(model.train_state)
+    cached = model.__dict__.get("_rnn_step_jit")
+    if cached is None or cached[0] != key:
+        cached = (key, jax.jit(model.rnn_time_step))
+        model.__dict__["_rnn_step_jit"] = cached
+    return cached[1]
+
+
 def reference_decode(model, prompt_ids: Sequence[int], max_new: int,
                      stop_id: Optional[int] = None) -> List[int]:
     """Greedy single-sequence decode through the model's own
@@ -319,6 +447,7 @@ def reference_decode(model, prompt_ids: Sequence[int], max_new: int,
     spec = extract_decode_spec(model)
     if not prompt_ids:
         raise ValueError("reference_decode needs a non-empty prompt")
+    step = _jit_time_step(model)
     carries = None
     out: List[int] = []
     feed = list(prompt_ids)
@@ -328,7 +457,7 @@ def reference_decode(model, prompt_ids: Sequence[int], max_new: int,
     while len(out) < max_new:
         x = np.zeros((1, spec.vocab_size), np.float32)
         x[0, tok] = 1.0
-        probs, carries = model.rnn_time_step(x, carries)
+        probs, carries = step(x, carries)
         if pos < len(feed):       # still consuming the prompt
             tok = feed[pos]
             pos += 1
@@ -358,6 +487,7 @@ def probe_head(model, spec: DecodeSpec, probe_ids: Sequence[int],
     p = model.train_state.params
     W = np.array(p[spec.head_name]["W"], np.float32, copy=True)
     b = np.array(p[spec.head_name]["b"], np.float32, copy=True)
+    step = _jit_time_step(model)
     carries = None
     hs: List[np.ndarray] = []
     feed = list(probe_ids)
@@ -368,7 +498,7 @@ def probe_head(model, spec: DecodeSpec, probe_ids: Sequence[int],
     for _ in range(total):
         x = np.zeros((1, spec.vocab_size), np.float32)
         x[0, tok] = 1.0
-        probs, carries = model.rnn_time_step(x, carries)
+        probs, carries = step(x, carries)
         hs.append(np.asarray(carries[last][0][0]))  # host-sync-ok: init-time calibration probe, pre-traffic
         if pos < len(feed):
             tok = feed[pos]

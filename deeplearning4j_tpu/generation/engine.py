@@ -37,6 +37,8 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 import numpy as np
 
 from deeplearning4j_tpu.generation import decode as D
+from deeplearning4j_tpu.generation import speculative as SP
+from deeplearning4j_tpu.generation.session import CarrySnapshot
 from deeplearning4j_tpu.observe.latency import LatencyRing
 from deeplearning4j_tpu.observe.recompile import RecompileWatchdog
 from deeplearning4j_tpu.observe.registry import default_registry
@@ -56,6 +58,21 @@ def _bucket_ladder(max_slots: int) -> List[int]:
     return out
 
 
+def _reachable_resize_pairs(ladder: List[int]) -> List[tuple]:
+    """The (src, dst) resize pairs the scheduler can actually request,
+    instead of the full quadratic ordered sweep. Grows jump to ANY
+    higher rung (``_admit_locked`` targets the first rung covering
+    demand, so a 1 -> 8 burst is one resize), but shrinks only ever
+    step to the ADJACENT lower rung (``_maybe_shrink_locked``), so the
+    downward pairs beyond distance one are unreachable dead warmup
+    weight — roughly half the all-pairs sweep for real ladders."""
+    pairs = [(src, dst)
+             for i, src in enumerate(ladder) for dst in ladder[i + 1:]]
+    pairs += [(ladder[i], ladder[i - 1])
+              for i in range(1, len(ladder))]
+    return pairs
+
+
 class GenerationStream:
     """One sequence's token stream: the scheduler produces events, one
     consumer iterates them (the SSE writer, or ``result()``). Events
@@ -72,6 +89,7 @@ class GenerationStream:
         self.reason: Optional[str] = None
         self.error: Optional[str] = None
         self.ttft_ms: Optional[float] = None
+        self.session: Optional[str] = None
         self._q: "queue.Queue" = queue.Queue(maxsize=buffer)
         self._done = threading.Event()
         self._cancelled = threading.Event()
@@ -102,8 +120,11 @@ class GenerationStream:
                 break
         if self.error is not None:
             raise RuntimeError(self.error)
-        return {"ids": list(self.ids), "reason": self.reason,
-                "n": len(self.ids), "ttft_ms": self.ttft_ms}
+        out = {"ids": list(self.ids), "reason": self.reason,
+               "n": len(self.ids), "ttft_ms": self.ttft_ms}
+        if self.session is not None:
+            out["session"] = self.session
+        return out
 
     def cancel(self):
         """Ask the scheduler to retire this sequence early (client went
@@ -135,8 +156,11 @@ class GenerationStream:
 
     def _finish(self, reason: str):
         self.reason = reason
-        self._push({"done": True, "reason": reason, "n": len(self.ids),
-                    "ttft_ms": self.ttft_ms})
+        ev = {"done": True, "reason": reason, "n": len(self.ids),
+              "ttft_ms": self.ttft_ms}
+        if self.session is not None:
+            ev["session"] = self.session
+        self._push(ev)
         self._seal()
 
     def _fail(self, msg: str):
@@ -172,12 +196,15 @@ class _Slot:
     __slots__ = ("stream", "prompt", "ppos", "next_input", "gen_count",
                  "max_new", "stop_id", "seed", "temperature", "top_k",
                  "greedy", "needs_reset", "t_join", "t_first",
-                 "deadline")
+                 "deadline", "session", "resume", "pos", "draft",
+                 "prefill_mode")
 
     def __init__(self, stream: GenerationStream, prompt: List[int],
                  max_new: int, stop_id: Optional[int], seed: int,
                  temperature: float, top_k: int, greedy: bool,
-                 deadline: Optional[Deadline] = None):
+                 deadline: Optional[Deadline] = None,
+                 session: Optional[str] = None,
+                 resume: Optional[CarrySnapshot] = None):
         self.stream = stream
         self.prompt = prompt
         self.ppos = 1
@@ -189,10 +216,17 @@ class _Slot:
         self.temperature = temperature
         self.top_k = top_k
         self.greedy = greedy
-        self.needs_reset = True
+        self.needs_reset = resume is None
         self.t_join = time.time()
         self.t_first: Optional[float] = None
         self.deadline = deadline
+        self.session = session
+        self.resume = resume
+        # absolute sequence position = tokens fed so far, the counter
+        # the splitmix64 sampling keys index (resumes continue it)
+        self.pos = resume.pos if resume is not None else 0
+        self.draft: Optional[SP.NGramDraft] = None
+        self.prefill_mode = "tick"
 
 
 class GenerationEngine:
@@ -215,7 +249,11 @@ class GenerationEngine:
                  calibration_text: str = "the quick brown fox jumps "
                                          "over the lazy dog\n",
                  registry=None, watchdog=None,
-                 session_id: str = "generate"):
+                 session_id: str = "generate",
+                 prefill_chunk: int = 0,
+                 speculative: int = 0,
+                 sampling: Optional[str] = None,
+                 session_store=None):
         if max_slots < 1:
             raise ValueError("max_slots must be >= 1")
         self.model = model
@@ -230,6 +268,23 @@ class GenerationEngine:
         self.queue_limit = int(queue_limit)
         self.stream_buffer = int(stream_buffer)
         self.session_id = session_id
+        # v2 serving modes (ISSUE 16): chunked prefill, speculative
+        # decode, counter-based sampling keys, resumable sessions
+        self._prefill_chunk = int(prefill_chunk)
+        self._spec_k = int(speculative)
+        if self._spec_k < 0 or self._prefill_chunk < 0:
+            raise ValueError("prefill_chunk/speculative must be >= 0")
+        # speculative acceptance needs position-addressable sampling
+        # keys, so it defaults the engine into counter mode; chain is
+        # the legacy split-chain default otherwise
+        self.sampling = sampling if sampling is not None \
+            else ("counter" if self._spec_k else "chain")
+        if self.sampling not in ("chain", "counter"):
+            raise ValueError(f"unknown sampling mode {self.sampling!r}")
+        self.session_store = session_store
+        self.chunk_ladder = (
+            D.prefill_chunk_ladder(self._prefill_chunk)
+            if self._prefill_chunk else [])
         self.stop_id: Optional[int] = None
         if stop_text:
             sid = self.vocab.stoi.get(stop_text)
@@ -254,6 +309,14 @@ class GenerationEngine:
 
         import jax
         self._tick_jit = jax.jit(D.build_tick(model, self.spec))
+        self._prefill_jit = (jax.jit(D.build_prefill(model, self.spec))
+                             if self._prefill_chunk else None)
+        self._spec_jit = (jax.jit(SP.build_spec_tick(
+            model, self.spec, self._spec_k)) if self._spec_k else None)
+        self._extract_jit = (jax.jit(D.build_slot_extract(self.spec))
+                             if session_store is not None else None)
+        self._restore_jit_fn = (jax.jit(D.build_slot_restore(self.spec))
+                                if session_store is not None else None)
         self._resize_jit: Dict[tuple, Any] = {}
         self.ladder = _bucket_ladder(self.max_slots)
 
@@ -276,9 +339,20 @@ class GenerationEngine:
         # accounting
         self.token_ring = LatencyRing()
         self.ttft_ring = LatencyRing()
+        # TTFT split by prefill mode: chunked dispatches vs the legacy
+        # one-tick-per-prompt-char path — the A/B the chunked ladder
+        # has to win
+        self.ttft_rings = {"chunked": LatencyRing(),
+                           "tick": LatencyRing()}
         self._submitted = 0
         self._tokens_out = 0
         self._prefill_ticks = 0
+        self._prefill_chunks = 0
+        self._prefill_chunk_tokens = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_dispatches = 0
+        self._flush_mark = 0
         self._max_active = 0
         self._outcomes: Dict[str, int] = {}
         self._stream_errors = 0
@@ -315,7 +389,33 @@ class GenerationEngine:
             "dl4j_gen_token_ms", "per-token decode latency quantiles")
         self._g_ttft = r.gauge(
             "dl4j_gen_ttft_ms", "time-to-first-token quantiles")
+        self._c_prefill_chunks = r.counter(
+            "dl4j_gen_prefill_chunks_total",
+            "chunked-prefill dispatches (one jitted scan consuming up "
+            "to prefill_chunk prompt tokens per in-prefill slot)")
+        self._c_prefill_tokens = r.counter(
+            "dl4j_gen_prefill_tokens_total",
+            "prompt tokens consumed, by prefill mode: chunked (scan "
+            "dispatches) vs tick (one batched tick per char)")
+        self._g_prefill_ttft = r.gauge(
+            "dl4j_gen_prefill_ttft_ms",
+            "time-to-first-token quantiles split by the prefill mode "
+            "the sequence took")
+        self._c_spec_proposed = r.counter(
+            "dl4j_gen_spec_proposed_total",
+            "draft tokens proposed by the n-gram table and attached "
+            "to speculative verify dispatches")
+        self._c_spec_accepted = r.counter(
+            "dl4j_gen_spec_accepted_total",
+            "draft tokens accepted (bitwise-equal to what plain decode "
+            "would have emitted at their position)")
         # pre-register healthy series so /metrics shows the family at 0
+        self._c_prefill_chunks.inc(0.0, session=session_id)
+        for mode in ("chunked", "tick"):
+            self._c_prefill_tokens.inc(0.0, session=session_id,
+                                       mode=mode)
+        self._c_spec_proposed.inc(0.0, session=session_id)
+        self._c_spec_accepted.inc(0.0, session=session_id)
         self._c_tokens.inc(0.0, session=session_id)
         self._c_compiles.inc(0.0, session=session_id, phase="live")
         self._c_stream_err.inc(0.0, session=session_id)
@@ -344,6 +444,20 @@ class GenerationEngine:
         return (np.zeros(S, np.int32), np.zeros(S, bool),
                 np.zeros(S, np.uint32), np.zeros(S, bool),
                 np.ones(S, np.float32), np.zeros(S, np.int32),
+                np.ones(S, bool), np.zeros((S, 2), np.uint32),
+                np.zeros(S, bool))
+
+    def _spec_args(self, S: int):
+        K1 = self._spec_k + 1
+        return (np.zeros((S, K1), np.int32), np.zeros(S, np.int32),
+                np.zeros(S, bool), np.zeros(S, np.uint32),
+                np.ones(S, bool), np.ones(S, np.float32),
+                np.zeros(S, np.int32), np.ones(S, bool),
+                np.zeros((S, K1, 2), np.uint32), np.zeros(S, bool))
+
+    def _prefill_args(self, S: int, C: int):
+        return (np.zeros((S, C), np.int32), np.zeros(S, np.int32),
+                np.zeros(S, bool), np.zeros(S, np.uint32),
                 np.ones(S, bool))
 
     def _compile(self, key: tuple):
@@ -361,6 +475,48 @@ class GenerationEngine:
             except Exception:
                 log.exception("AOT lower failed for %s; using jit", key)
                 return self._tick_jit
+        if key[0] == "spec":
+            S = key[1]
+            h, c, rng = D.zero_carries(self.spec, S)
+            try:
+                return self._spec_jit.lower(
+                    self._dp, h, c, rng, *self._spec_args(S)).compile()
+            except Exception:
+                log.exception("AOT lower failed for %s; using jit", key)
+                return self._spec_jit
+        if key[0] == "prefill":
+            _, S, C = key
+            h, c, rng = D.zero_carries(self.spec, S)
+            try:
+                return self._prefill_jit.lower(
+                    self._dp, h, c, rng,
+                    *self._prefill_args(S, C)).compile()
+            except Exception:
+                log.exception("AOT lower failed for %s; using jit", key)
+                return self._prefill_jit
+        if key[0] == "extract":
+            S = key[1]
+            h, c, rng = D.zero_carries(self.spec, S)
+            try:
+                return self._extract_jit.lower(
+                    h, c, rng, np.int32(0)).compile()
+            except Exception:
+                log.exception("AOT lower failed for %s; using jit", key)
+                return self._extract_jit
+        if key[0] == "restore":
+            S = key[1]
+            h, c, rng = D.zero_carries(self.spec, S)
+            hr = [np.zeros(hd, np.float32)
+                  for hd in self.spec.hidden_sizes]
+            cr = [np.zeros(hd, np.float32)
+                  for hd in self.spec.hidden_sizes]
+            rr = np.zeros(2, np.uint32)
+            try:
+                return self._restore_jit_fn.lower(
+                    h, c, rng, hr, cr, rr, np.int32(0)).compile()
+            except Exception:
+                log.exception("AOT lower failed for %s; using jit", key)
+                return self._restore_jit_fn
         _, src, dst = key
         rj = self._resize_jit.get((src, dst))
         if rj is None:
@@ -385,24 +541,47 @@ class GenerationEngine:
         return exe
 
     def _warmup_sweep(self):
-        """Compile + run the tick at every ladder bucket and EVERY
-        ordered grow/shrink pair — a demand burst can jump the bucket
-        several rungs at once (1 -> 8), so adjacent pairs alone would
-        leave live-compile holes. The ladder is short (log2 max_slots),
-        so all-pairs stays cheap."""
+        """Compile + run every executable a live request can reach, per
+        ladder bucket: the decode dispatch (the speculative verify step
+        when drafts are on — it subsumes the plain tick, since
+        ``n_draft=0`` IS plain-tick semantics, so the tick itself never
+        dispatches and never needs warming), the prefill chunk ladder,
+        the session extract/restore pair, and the resize pairs the
+        scheduler's policy can actually request
+        (:func:`_reachable_resize_pairs` — grows jump rungs on demand
+        bursts, shrinks only ever step to the adjacent lower rung)."""
         for S in self.ladder:
-            exe = self._get_exe(("tick", S))
             h, c, rng = D.zero_carries(self.spec, S)
-            out = exe(self._dp, h, c, rng, *self._host_args(S))
-            out[3].block_until_ready()  # host-sync-ok: warmup sweep is pre-traffic by design
-        for src in self.ladder:
-            for dst in self.ladder:
-                if src == dst:
-                    continue
-                exe = self._get_exe(("resize", src, dst))
-                h, c, rng = D.zero_carries(self.spec, src)
-                out = exe(h, c, rng)
+            if self._spec_k:
+                exe = self._get_exe(("spec", S))
+                out = exe(self._dp, h, c, rng, *self._spec_args(S))
+                out[4].block_until_ready()  # host-sync-ok: warmup sweep is pre-traffic by design
+            else:
+                exe = self._get_exe(("tick", S))
+                out = exe(self._dp, h, c, rng, *self._host_args(S))
+                out[3].block_until_ready()  # host-sync-ok: warmup sweep is pre-traffic by design
+            for C in self.chunk_ladder:
+                exe = self._get_exe(("prefill", S, C))
+                out = exe(self._dp, h, c, rng,
+                          *self._prefill_args(S, C))
                 out[2].block_until_ready()  # host-sync-ok: warmup sweep is pre-traffic by design
+            if self.session_store is not None:
+                exe = self._get_exe(("extract", S))
+                out = exe(h, c, rng, np.int32(0))
+                out[2].block_until_ready()  # host-sync-ok: warmup sweep is pre-traffic by design
+                hr = [np.zeros(hd, np.float32)
+                      for hd in self.spec.hidden_sizes]
+                cr = [np.zeros(hd, np.float32)
+                      for hd in self.spec.hidden_sizes]
+                exe = self._get_exe(("restore", S))
+                out = exe(h, c, rng, hr, cr,
+                          np.zeros(2, np.uint32), np.int32(0))
+                out[2].block_until_ready()  # host-sync-ok: warmup sweep is pre-traffic by design
+        for src, dst in _reachable_resize_pairs(self.ladder):
+            exe = self._get_exe(("resize", src, dst))
+            h, c, rng = D.zero_carries(self.spec, src)
+            out = exe(h, c, rng)
+            out[2].block_until_ready()  # host-sync-ok: warmup sweep is pre-traffic by design
 
     # ---- public API --------------------------------------------------
 
@@ -410,15 +589,28 @@ class GenerationEngine:
                max_new_tokens: Optional[int] = None, greedy: bool = True,
                temperature: float = 1.0, top_k: int = 0, seed: int = 0,
                stop: Optional[Union[str, int]] = None,
-               deadline: Optional[Deadline] = None
+               deadline: Optional[Deadline] = None,
+               session: Optional[str] = None
                ) -> GenerationStream:
         """Queue one sequence; returns its stream immediately. Raises
         RuntimeError when the waiting queue is at ``queue_limit`` —
         FleetRouter admission turns that into a shed upstream. An
         already-expired ``deadline`` raises ``DeadlineExceeded``
-        synchronously — the sequence never queues, never decodes."""
+        synchronously — the sequence never queues, never decodes.
+
+        ``session`` names a resumable carry in the engine's
+        :class:`~deeplearning4j_tpu.generation.session.SessionStore`.
+        On a hit the sequence continues from the stored (h, c)/PRNG
+        state — the new prompt extends the old one without replaying
+        the prefix, bitwise-equal to never having retired; on a miss it
+        starts fresh. Either way the carry is re-captured when this
+        sequence retires, so the token stays resumable turn after turn
+        (and, via the write-through checkpoint, on other nodes)."""
         if self._stop.is_set():
             raise RuntimeError("generation engine is shut down")
+        if session is not None and self.session_store is None:
+            raise ValueError(
+                "session= requires an engine with a session_store")
         if deadline is not None and deadline.expired:
             self._c_deadline.inc(1.0, session=self.session_id,
                                  stage="ingress")
@@ -428,8 +620,18 @@ class GenerationEngine:
             ids = self.vocab.encode(prompt)
         else:
             ids = [int(t) for t in prompt]
-        if not ids:
+        resume = None
+        if session is not None:
+            resume = self.session_store.load(session)
+        if resume is not None:
+            # the resumed carry still owes the model its pending tokens
+            # (last emitted, or the unconsumed prompt tail) — they lead
+            # the new prompt through the normal prefill path
+            ids = [int(t) for t in resume.pending] + ids
+        elif not ids:
             ids = [self.stop_id if self.stop_id is not None else 0]
+        if not ids:
+            raise ValueError("resume produced an empty prompt")
         bad = [t for t in ids if not 0 <= t < self.spec.vocab_size]
         if bad:
             raise ValueError(f"prompt ids out of range: {bad[:5]}")
@@ -444,10 +646,24 @@ class GenerationEngine:
                "max_new_tokens": int(max_new_tokens
                                      if max_new_tokens is not None
                                      else self.max_new_tokens)}
+        if session is not None:
+            req["session"] = session
         stream = GenerationStream(req, buffer=self.stream_buffer)
+        stream.session = session
         slot = _Slot(stream, req["prompt"], req["max_new_tokens"],
                      stop_id, req["seed"], req["temperature"],
-                     req["top_k"], req["greedy"], deadline=deadline)
+                     req["top_k"], req["greedy"], deadline=deadline,
+                     session=session, resume=resume)
+        if self._prefill_chunk and len(slot.prompt) > 1:
+            slot.prefill_mode = "chunked"
+        if self._spec_k:
+            slot.draft = SP.NGramDraft()
+            if resume is not None:
+                slot.draft.observe_many(resume.history)
+                slot.draft.observe_many(
+                    slot.prompt[len(resume.pending):])
+            else:
+                slot.draft.observe_many(slot.prompt)
         with self._cv:
             if len(self._waiting) >= self.queue_limit:
                 raise RuntimeError("generation queue full")
@@ -509,9 +725,10 @@ class GenerationEngine:
         with self._cv:
             active = sum(1 for s in self._slots if s is not None)
             waiting = len(self._waiting)
-        return {
+        out = {
             "session": self.session_id,
             "precision": self.precision,
+            "sampling": self.sampling,
             "slots": {"bucket": self._bucket, "max": self.max_slots,
                       "active": active, "waiting": waiting,
                       "max_active": self._max_active,
@@ -520,17 +737,39 @@ class GenerationEngine:
                           "retired": dict(self._outcomes)},
             "tokens": {"generated": self._tokens_out,
                        "prefill_ticks": self._prefill_ticks},
+            "prefill": {"chunk": self._prefill_chunk,
+                        "ladder": list(self.chunk_ladder),
+                        "chunks": self._prefill_chunks,
+                        "chunk_tokens": self._prefill_chunk_tokens,
+                        "tick_tokens": self._prefill_ticks},
             "latency_ms": {
                 "token": {f"p{int(q * 100)}": v * 1e3
                           for q, v in tq.items()},
                 "ttft": {f"p{int(q * 100)}": v * 1e3
-                         for q, v in fq.items()}},
+                         for q, v in fq.items()},
+                "ttft_by_mode": {
+                    mode: {f"p{int(q * 100)}": v * 1e3
+                           for q, v in ring.quantiles(
+                               _QUANTILES).items()}
+                    for mode, ring in self.ttft_rings.items()}},
             "stream_errors": self._stream_errors,
             "recompiles_after_warmup": self._post_warmup_compiles,
             "warmup_s": round(self.warmup_s, 3),
             "head_agreement": (self.gate_result.top1_agreement
                                if self.gate_result else None),
         }
+        if self._spec_k:
+            prop = self._spec_proposed
+            out["speculative"] = {
+                "k": self._spec_k,
+                "proposed": prop,
+                "accepted": self._spec_accepted,
+                "dispatches": self._spec_dispatches,
+                "acceptance": (self._spec_accepted / prop
+                               if prop else None)}
+        if self.session_store is not None:
+            out["session_store"] = self.session_store.stats()
+        return out
 
     def shutdown(self, timeout: float = 5.0):
         self._stop.set()
@@ -538,11 +777,20 @@ class GenerationEngine:
             self._cv.notify_all()
         self._thread.join(timeout=timeout)
         with self._cv:
-            doomed = [s for s in self._slots if s is not None]
-            doomed += self._waiting
+            in_flight = [(i, s) for i, s in enumerate(self._slots)
+                         if s is not None]
+            waiting = list(self._waiting)
             self._slots = [None] * self.max_slots
             self._waiting = []
-        for s in doomed:
+        for i, s in in_flight:
+            # drain capture: between dispatches an in-flight slot's
+            # device state is consistent, so a SIGTERM-style shutdown
+            # checkpoints its session carry — the client resumes the
+            # token on another node sharing the artifact store
+            self._capture_session(i, s)
+            s.stream._fail("generation engine shut down")
+            self._retired(s, "error", count_metrics=False)
+        for s in waiting:
             s.stream._fail("generation engine shut down")
             self._retired(s, "error", count_metrics=False)
 
@@ -640,7 +888,162 @@ class GenerationEngine:
                             self._retired(s, "error")
                             self._slots[i] = None
 
+    def _capture_session(self, i: int, s: _Slot,
+                         overrun: bool = False):
+        """Checkpoint a retiring slot's carry into the session store.
+        Skipped when the sequence has no session token, the engine no
+        store, nothing was ever fed (``needs_reset`` still set), the
+        loaded snapshot was never restored into a device slot (the
+        store's copy is still the truth), or the device state overran
+        the committed stream (a speculative dispatch that stopped
+        before its last accepted position) — a resume must continue
+        from exactly the state the client saw."""
+        if (self.session_store is None or s.session is None
+                or s.needs_reset or s.resume is not None or overrun):
+            return
+        exe = self._get_exe(("extract", self._bucket))
+        hr, cr, rr = exe(self._h, self._c, self._rng, np.int32(i))
+        pending = [int(s.next_input)]
+        pending += [int(t) for t in s.prompt[s.ppos:]]
+        if s.draft is not None:
+            history = list(s.draft.history)
+        else:
+            history = [int(t) for t in s.prompt] + list(s.stream.ids)
+            history = history[-512:]
+        snap = CarrySnapshot(
+            [np.asarray(x) for x in hr],  # host-sync-ok: session capture at retirement, once per retired sequence — not the per-token path
+            [np.asarray(x) for x in cr],  # host-sync-ok: session capture at retirement, once per retired sequence — not the per-token path
+            np.asarray(rr, np.uint32),  # host-sync-ok: session capture at retirement, once per retired sequence — not the per-token path
+            pending, s.pos, history)
+        try:
+            self.session_store.save(s.session, snap)
+        except Exception:
+            log.exception("session capture failed for %s", s.session)
+
+    def _restore_slot(self, S: int, i: int, s: _Slot):
+        """Scatter a resumed session's carry rows into slot ``i``. Runs
+        before the slot's first dispatch (its ``needs_reset`` is False,
+        so without the scatter it would decode from stale rows)."""
+        snap = s.resume
+        s.resume = None
+        hr = [np.asarray(x, np.float32) for x in snap.h]  # host-sync-ok: snapshot rows are host numpy already
+        cr = [np.asarray(x, np.float32) for x in snap.c]  # host-sync-ok: snapshot rows are host numpy already
+        rr = np.asarray(snap.rng, np.uint32)  # host-sync-ok: snapshot rows are host numpy already
+        exe = self._get_exe(("restore", S))
+        self.watchdog.observe(f"gen_restore_s{S}", hr, cr, rr)
+        self._h, self._c, self._rng = exe(
+            self._h, self._c, self._rng, hr, cr, rr, np.int32(i))
+
+    def _retire_eligible(self, i: int, s: _Slot,
+                         retire: List[tuple]) -> bool:
+        """Cancel/deadline check between dispatches; True if the slot
+        was retired. Running this BEFORE every dispatch — including
+        between the chunked-prefill scans of one long prompt — is what
+        closes the prefill blind spot: a client that hung up (or a
+        budget that ran out) during prompt ingestion must not keep
+        burning dispatches until sampling starts. Between dispatches
+        the device state is consistent, so these retires are capture-
+        safe (overrun=False)."""
+        if s.stream._cancelled.is_set():
+            retire.append((i, s, "cancelled", False))
+            return True
+        if s.deadline is not None and s.deadline.expired:
+            self._c_deadline.inc(1.0, session=self.session_id,
+                                 stage="decode")
+            retire.append((i, s, "deadline", False))
+            return True
+        return False
+
+    def _commit_retires_locked(self, retire: List[tuple]):
+        for i, s, outcome, overrun in retire:
+            if outcome != "error":
+                # capture BEFORE the terminal stream event: a client
+                # that fires its next turn the instant it sees "done"
+                # must already find the carry resumable
+                self._capture_session(i, s, overrun=overrun)
+                s.stream._finish(outcome)
+            self._retired(s, outcome)
+            self._slots[i] = None
+
+    def _prefill_pass(self, S: int, slots: List[Optional[_Slot]],
+                      retire: List[tuple]):
+        """Consume every chunked slot's remaining prompt — all but its
+        LAST token, which the sampling dispatch feeds to emit the first
+        token — in ladder-sized jitted scans. A 512-char prompt costs
+        ~ceil(511/chunk) dispatches instead of 511 ticks; the PRNG
+        chain advances identically either way (one split per consumed
+        token), so chunked and tick prefill are bitwise-interchangeable.
+        """
+        while True:
+            for i, s in enumerate(slots):
+                if s is not None and self._retire_eligible(i, s,
+                                                           retire):
+                    slots[i] = None
+            rem = {i: len(s.prompt) - s.ppos
+                   for i, s in enumerate(slots)
+                   if s is not None and s.prefill_mode == "chunked"
+                   and len(s.prompt) - s.ppos > 0}
+            if not rem:
+                return
+            top = max(rem.values())
+            C = self.chunk_ladder[-1]
+            for c in self.chunk_ladder:
+                if c >= top:
+                    C = c
+                    break
+            chunk = np.zeros((S, C), np.int32)
+            lens = np.zeros(S, np.int32)
+            reset = np.zeros(S, bool)
+            seeds = np.zeros(S, np.uint32)
+            active = np.zeros(S, bool)
+            consumed = 0
+            for i, n in rem.items():
+                s = slots[i]
+                t = min(n, C)
+                chunk[i, :t] = s.prompt[s.ppos - 1:s.ppos - 1 + t]
+                lens[i] = t
+                reset[i] = s.needs_reset
+                seeds[i] = np.uint32(s.seed & 0xFFFFFFFF)
+                active[i] = True
+                consumed += t
+            exe = self._get_exe(("prefill", S, C))
+            self.watchdog.observe(
+                f"gen_prefill_{self.precision}_s{S}_c{C}",
+                chunk, lens, reset, seeds, active)
+            self._h, self._c, self._rng = exe(
+                self._dp, self._h, self._c, self._rng, chunk, lens,
+                reset, seeds, active)
+            for i, n in rem.items():
+                s = slots[i]
+                t = min(n, C)
+                s.ppos += t
+                s.pos += t
+                s.needs_reset = False
+                if s.ppos >= len(s.prompt):
+                    s.next_input = s.prompt[s.ppos - 1]
+            self._prefill_chunks += 1
+            self._prefill_chunk_tokens += consumed
+            self._c_prefill_chunks.inc(1.0, session=self.session_id)
+            self._c_prefill_tokens.inc(float(consumed),  # host-sync-ok: consumed is a host int accumulator
+                                       session=self.session_id,
+                                       mode="chunked")
+
     def _tick_once(self, S: int, slots: List[Optional[_Slot]]):
+        retire: List[tuple] = []      # (i, slot, outcome, overrun)
+
+        # 0) session restore: scatter resumed carries into their slots
+        #    before anything dispatches over them
+        for i, s in enumerate(slots):
+            if s is not None and s.resume is not None:
+                self._restore_slot(S, i, s)
+
+        # 1) chunked prefill (with mid-prefill retirement checks)
+        if self._prefill_chunk:
+            self._prefill_pass(S, slots, retire)
+
+        # 2) build the decode dispatch's control arrays; cancel/expired
+        #    slots retire here, BEFORE the dispatch, so their device
+        #    state stays consistent for session capture
         tokens = np.zeros(S, np.int32)
         reset = np.zeros(S, bool)
         seeds = np.zeros(S, np.uint32)
@@ -648,9 +1051,14 @@ class GenerationEngine:
         temp = np.ones(S, np.float32)
         topk = np.zeros(S, np.int32)
         greedy = np.ones(S, bool)
+        pos = np.zeros(S, np.uint64)
+        in_prefill = [False] * S
         n_active = 0
         for i, s in enumerate(slots):
             if s is None:
+                continue
+            if self._retire_eligible(i, s, retire):
+                slots[i] = None
                 continue
             n_active += 1
             tokens[i] = s.next_input
@@ -660,87 +1068,158 @@ class GenerationEngine:
             temp[i] = s.temperature
             topk[i] = s.top_k
             greedy[i] = s.greedy
+            pos[i] = s.pos
+            in_prefill[i] = s.ppos < len(s.prompt)
         self._max_active = max(self._max_active, n_active)
         self._g_active.set(float(n_active), session=self.session_id)  # host-sync-ok: python int gauge, no device value
+        if n_active == 0:
+            with self._cv:
+                self._commit_retires_locked(retire)
+                self._maybe_shrink_locked()
+            return
 
-        exe = self._get_exe(("tick", S))
-        self.watchdog.observe(f"gen_tick_{self.precision}_s{S}",
-                              tokens, reset, seeds, active, temp, topk,
-                              greedy)
-        t0 = time.time()
-        self._h, self._c, self._rng, out = exe(
-            self._dp, self._h, self._c, self._rng, tokens, reset, seeds,
-            active, temp, topk, greedy)
-        sampled = np.asarray(out)  # host-sync-ok: streaming egress — the sampled tokens ARE the response payload
+        # 3) ONE decode dispatch: the speculative verify step when
+        #    drafts are on (n_draft=0 degrades to plain-tick semantics,
+        #    so prefilling/chain-mode co-residents are unaffected),
+        #    else the plain tick
+        use_ext = np.zeros(S, bool)
+        if self._spec_k:
+            K1 = self._spec_k + 1
+            toks2 = np.zeros((S, K1), np.int32)
+            toks2[:, 0] = tokens
+            n_draft = np.zeros(S, np.int32)
+            for i, s in enumerate(slots):
+                if s is None or in_prefill[i] or s.draft is None:
+                    continue
+                if not (s.greedy or self.sampling == "counter"):
+                    # chain-mode sampling has no position-addressable
+                    # keys, so acceptance can't be verified — plain
+                    # tick semantics for this slot
+                    continue
+                cap = min(self._spec_k, s.max_new - s.gen_count - 1)
+                if cap <= 0:
+                    continue
+                d = s.draft.propose(cap)
+                if d:
+                    toks2[i, 1:1 + len(d)] = d
+                    n_draft[i] = len(d)
+                    self._spec_proposed += len(d)
+                    self._c_spec_proposed.inc(float(len(d)),  # host-sync-ok: draft is a host-side list
+                                              session=self.session_id)
+            ext_keys = np.zeros((S, K1, 2), np.uint32)
+            if self.sampling == "counter":
+                ext_keys = SP.counter_keys(seeds, pos, K1)
+                use_ext = active.copy()
+            exe = self._get_exe(("spec", S))
+            self.watchdog.observe(
+                f"gen_spec_{self.precision}_s{S}", toks2, n_draft,
+                reset, seeds, active, temp, topk, greedy, ext_keys,
+                use_ext)
+            t0 = time.time()
+            self._h, self._c, self._rng, out, ne = exe(
+                self._dp, self._h, self._c, self._rng, toks2, n_draft,
+                reset, seeds, active, temp, topk, greedy, ext_keys,
+                use_ext)
+            emitted = np.asarray(out)  # host-sync-ok: streaming egress — the sampled tokens ARE the response payload
+            n_emit = np.asarray(ne)  # host-sync-ok: streaming egress — the commit counts route the response payload
+            self._spec_dispatches += 1
+        else:
+            ext_key = np.zeros((S, 2), np.uint32)
+            if self.sampling == "counter":
+                ext_key = SP.counter_keys(seeds, pos, 1)[:, 0]
+                use_ext = active.copy()
+            exe = self._get_exe(("tick", S))
+            self.watchdog.observe(f"gen_tick_{self.precision}_s{S}",
+                                  tokens, reset, seeds, active, temp,
+                                  topk, greedy, ext_key, use_ext)
+            t0 = time.time()
+            self._h, self._c, self._rng, out = exe(
+                self._dp, self._h, self._c, self._rng, tokens, reset,
+                seeds, active, temp, topk, greedy, ext_key, use_ext)
+            emitted = np.asarray(out)[:, None]  # host-sync-ok: streaming egress — the sampled tokens ARE the response payload
+            n_emit = active.astype(np.int32)
         dt = time.time() - t0
         self.token_ring.record(dt)
         now = time.time()
 
-        retire: List[tuple] = []
+        # 4) route emitted tokens (possibly several per slot)
         for i, s in enumerate(slots):
             if s is None:
                 continue
             s.needs_reset = False
-            # cancel/deadline retire BEFORE the prefill branch: a
-            # sequence whose client hung up (or whose budget ran out)
-            # during prompt ingestion must not keep burning ticks until
-            # sampling starts — this was exactly the prefill blind spot
-            if s.stream._cancelled.is_set():
-                s.stream._finish("cancelled")
-                retire.append((i, s, "cancelled"))
-                continue
-            if s.deadline is not None and s.deadline.expired:
-                self._c_deadline.inc(1.0, session=self.session_id,
-                                     stage="decode")
-                s.stream._finish("deadline")
-                retire.append((i, s, "deadline"))
-                continue
-            if s.ppos < len(s.prompt):       # prefill: force next char
+            if in_prefill[i]:            # tick prefill: force next char
                 s.next_input = s.prompt[s.ppos]
                 s.ppos += 1
+                s.pos += 1
                 self._prefill_ticks += 1
+                self._c_prefill_tokens.inc(1.0,
+                                           session=self.session_id,
+                                           mode="tick")
                 continue
-            tok = int(sampled[i])
-            s.gen_count += 1
-            s.stream.ids.append(tok)
-            if s.t_first is None:
-                s.t_first = now
-                s.stream.ttft_ms = (now - s.t_join) * 1e3
-                self.ttft_ring.record(now - s.t_join)
-            ok = s.stream._push({"token": tok,
-                                 "text": self.vocab.itos[tok]
-                                 if tok < self.vocab.size else "�",
-                                 "i": s.gen_count - 1})
-            self._tokens_out += 1
-            self._c_tokens.inc(1.0, session=self.session_id)
-            if not ok:
-                self._stream_errors += 1
-                self._c_stream_err.inc(1.0, session=self.session_id)
-                s.stream._fail("stream buffer overflow "
-                               "(consumer too slow)")
-                retire.append((i, s, "error"))
-            elif s.stream._cancelled.is_set():
-                s.stream._finish("cancelled")
-                retire.append((i, s, "cancelled"))
-            elif s.stop_id is not None and tok == s.stop_id:
-                s.stream._finish("stop")
-                retire.append((i, s, "stop"))
-            elif s.gen_count >= s.max_new:
-                s.stream._finish("length")
-                retire.append((i, s, "length"))
-            else:
+            m = int(n_emit[i])
+            outcome = None
+            overrun = False
+            for j in range(m):
+                tok = int(emitted[i, j])
+                s.gen_count += 1
+                s.pos += 1
                 s.next_input = tok
+                s.stream.ids.append(tok)
+                if s.draft is not None:
+                    s.draft.observe(tok)
+                if s.t_first is None:
+                    s.t_first = now
+                    s.stream.ttft_ms = (now - s.t_join) * 1e3
+                    self.ttft_ring.record(now - s.t_join)
+                    ring = self.ttft_rings.get(s.prefill_mode)
+                    if ring is not None:
+                        ring.record(now - s.t_join)
+                ok = s.stream._push({"token": tok,
+                                     "text": self.vocab.itos[tok]
+                                     if tok < self.vocab.size else "�",
+                                     "i": s.gen_count - 1})
+                self._tokens_out += 1
+                self._c_tokens.inc(1.0, session=self.session_id)
+                if j >= 1:               # an accepted draft made it out
+                    self._spec_accepted += 1
+                    self._c_spec_accepted.inc(1.0,
+                                              session=self.session_id)
+                if not ok:
+                    self._stream_errors += 1
+                    self._c_stream_err.inc(1.0,
+                                           session=self.session_id)
+                    s.stream._fail("stream buffer overflow "
+                                   "(consumer too slow)")
+                    outcome = "error"
+                elif s.stream._cancelled.is_set():
+                    outcome = "cancelled"
+                elif s.stop_id is not None and tok == s.stop_id:
+                    outcome = "stop"
+                elif s.gen_count >= s.max_new:
+                    outcome = "length"
+                if outcome is not None:
+                    # retiring before the dispatch's last emitted token
+                    # leaves the device state ahead of the committed
+                    # stream — the capture path must know
+                    overrun = j < m - 1
+                    break
+            if outcome is not None:
+                retire.append((i, s, outcome, overrun))
 
-        if self._tokens_out and self._tokens_out % 64 == 0:
+        if self._tokens_out - self._flush_mark >= 64:
+            self._flush_mark = self._tokens_out
             for q, v in self.token_ring.quantiles(_QUANTILES).items():
                 self._g_token_ms.set(v * 1e3, session=self.session_id,
                                      quantile=str(q))
             for q, v in self.ttft_ring.quantiles(_QUANTILES).items():
                 self._g_ttft.set(v * 1e3, session=self.session_id,
                                  quantile=str(q))
+            for mode, ring in self.ttft_rings.items():
+                for q, v in ring.quantiles(_QUANTILES).items():
+                    self._g_prefill_ttft.set(
+                        v * 1e3, session=self.session_id, mode=mode,
+                        quantile=str(q))
 
         with self._cv:
-            for i, s, outcome in retire:
-                self._retired(s, outcome)
-                self._slots[i] = None
+            self._commit_retires_locked(retire)
             self._maybe_shrink_locked()

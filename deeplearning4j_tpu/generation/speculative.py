@@ -1,0 +1,202 @@
+"""Speculative decode: n-gram draft + one-dispatch batched verify.
+
+The CPU/TPU decode loop is dispatch-bound — one jitted tick per token
+costs far more in launch overhead than in FLOPs at decode batch sizes.
+Speculative decoding amortizes that: a host-side n-gram/suffix-table
+draft proposes up to ``k`` continuation tokens per slot from the
+sequence's own history, and ONE jitted scan feeds the slot's current
+input plus all k drafts through the stack, samples every position, and
+commits the longest accepted prefix in-graph. A dispatch emits
+``n_acc + 1`` tokens (the accepted drafts plus the model's own token at
+the first divergence — the "bonus" token), so acceptance rate converts
+directly into tokens/s.
+
+Exactness discipline (the part that makes this a serving feature and
+not a sampler): a draft token is accepted iff it equals the token plain
+decode *would* have emitted at that position. For greedy that is the
+argmax; for seeded sampling the per-position key must be reproducible
+without replaying the carried split chain, so sampling keys derive from
+**counter-based splitmix64** over (request seed, absolute position) —
+the same construction as ``nlp/pairgen.py``'s fused draw streams (PR
+13) and ``chaos/plan.py``'s schedules (PR 14). Accepted output is
+bitwise-equal to non-speculative decode in the same sampling mode, and
+same-seed replay is exact regardless of batching, drafts, or which
+node runs the sequence. Keys are keyed on (seed, position) only —
+never the physical slot index — so co-residency stays invisible.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.pairgen import GOLDEN, _mix_np
+
+# domain-separation salt so generation draws never collide with the
+# embedding pair streams sharing the splitmix64 construction
+_SALT = np.uint64(0x47454E5350454331)          # "GENSPEC1"
+_LO32 = np.uint64(0xFFFFFFFF)
+
+
+def counter_keys(seeds: np.ndarray, pos: np.ndarray,
+                 k: int) -> np.ndarray:
+    """(S,) request seeds + (S,) absolute positions -> (S, k, 2) uint32
+    sampling keys; ``key[s, j]`` covers position ``pos[s] + j``.
+
+    key64 = mix(mix(seed ^ SALT) + (pos + j + 1) * GOLDEN) — the
+    pairgen ``draws_at`` shape with a generation-domain salt. Pure
+    counter arithmetic: any position's key is computable from (seed,
+    position) alone, which is what the speculative verify step and
+    cross-node session resume both rely on.
+    """
+    s = np.asarray(seeds, np.uint64).reshape(-1, 1) ^ _SALT  # host-sync-ok: seeds are host scalars, keys are host-computed by design
+    base = _mix_np(s.copy())
+    p = (np.asarray(pos, np.uint64).reshape(-1, 1)  # host-sync-ok: positions are host counters
+         + np.arange(k, dtype=np.uint64)[None, :])
+    z = _mix_np(base + (p + np.uint64(1)) * np.uint64(GOLDEN))
+    out = np.empty(z.shape + (2,), np.uint32)
+    out[..., 0] = (z >> np.uint64(32)).astype(np.uint32)
+    out[..., 1] = (z & _LO32).astype(np.uint32)
+    return out
+
+
+class NGramDraft:
+    """Per-sequence n-gram/suffix draft table.
+
+    Observes every token the sequence consumes or emits and keeps, for
+    each context length 1..max_order, the most recent continuation seen
+    after that context. ``propose(k)`` walks the longest-match table
+    greedily to extend the current suffix — character LSTM output is
+    highly self-repetitive, so recency-biased longest-suffix matching
+    is a strong cheap draft (and a wrong draft only costs the already
+    amortized verify dispatch, never correctness)."""
+
+    __slots__ = ("max_order", "max_history", "history", "tables")
+
+    def __init__(self, max_order: int = 3, max_history: int = 512):
+        self.max_order = int(max_order)
+        self.max_history = int(max_history)
+        self.history: List[int] = []
+        self.tables = [dict() for _ in range(self.max_order)]
+
+    def observe(self, tok: int) -> None:
+        h = self.history
+        for o in range(self.max_order):
+            n = o + 1
+            if len(h) >= n:
+                self.tables[o][tuple(h[-n:])] = tok
+        h.append(tok)
+        if len(h) > self.max_history:
+            del h[:len(h) - self.max_history]
+
+    def observe_many(self, toks) -> None:
+        for t in toks:
+            self.observe(int(t))
+
+    def _lookup(self, ctx: List[int]) -> Optional[int]:
+        for o in reversed(range(self.max_order)):
+            n = o + 1
+            if len(ctx) >= n:
+                hit = self.tables[o].get(tuple(ctx[-n:]))
+                if hit is not None:
+                    return hit
+        return None
+
+    def propose(self, k: int) -> List[int]:
+        out: List[int] = []
+        ctx = list(self.history)
+        for _ in range(k):
+            tok = self._lookup(ctx)
+            if tok is None:
+                break
+            out.append(tok)
+            ctx.append(tok)
+        return out
+
+
+def build_spec_tick(model, spec, k: int):
+    """The jittable draft-verify-commit step for up to ``k`` drafts.
+
+    spec_tick(dp, h, c, rng, tokens, n_draft, reset, seeds, active,
+    temp, top_k, greedy, ext_keys, use_ext)
+        -> (h', c', rng', emitted, n_emit)
+
+    - tokens (S, k+1) i32: position 0 is the slot's current input, the
+      rest its draft continuation (padded past ``n_draft``)
+    - n_draft (S,) i32: drafts attached this dispatch (0 = plain tick
+      semantics — exactly one token emits)
+    - ext_keys (S, k+1, 2) u32 + use_ext (S,): counter-mode sampling
+      keys per position (see ``counter_keys``); chain-mode slots use
+      the carried split chain, advanced one split per emitted token —
+      bitwise the same chain plain decode would have consumed
+    - emitted (S, k+1) i32: per-position sampled tokens; the scheduler
+      streams ``emitted[i, :n_emit[i]]``
+    - n_emit (S,) i32: accepted drafts + 1 bonus token (0 for inactive
+      slots)
+
+    The commit is in-graph: acceptance compares each draft against the
+    token sampled at its position, the carries/rng roll back to the
+    state after the last *emitted* token via ``take_along_axis`` over
+    the scan's stacked states, and masked-neutral slots pass through —
+    one dispatch, no host round-trip inside.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.generation.decode import (
+        _head_logits, _lstm_cores, _sample_one, _stack_step)
+    if k < 1:
+        raise ValueError("speculative k must be >= 1")
+    cores = _lstm_cores(model, spec)
+    V = spec.vocab_size
+    K1 = k + 1
+
+    def spec_tick(dp, h, c, rng, tokens, n_draft, reset, seeds, active,
+                  temp, top_k, greedy, ext_keys, use_ext):
+        S = tokens.shape[0]
+        rmask = reset[:, None]
+        fresh = jax.vmap(jax.random.PRNGKey)(seeds)
+        rng0 = jnp.where(rmask, fresh, rng)
+        h0 = [jnp.where(rmask, 0.0, hl) for hl in h]
+        c0 = [jnp.where(rmask, 0.0, cl) for cl in c]
+
+        def step(carry, xs):
+            hs, cs, r = carry
+            tok_t, ext_t = xs
+            x = jax.nn.one_hot(tok_t, V, dtype=jnp.float32)
+            h_new, c_new, top = _stack_step(cores, dp, x, hs, cs)
+            logits = _head_logits(dp["head"], top)
+            split = jax.vmap(lambda kk: jax.random.split(kk, 2))(r)
+            key = jnp.where(use_ext[:, None], ext_t, split[:, 1])
+            sampled = jax.vmap(_sample_one)(
+                key, logits, temp, top_k, greedy)
+            r2 = split[:, 0]
+            return (h_new, c_new, r2), (h_new, c_new, r2, sampled)
+
+        xs = (jnp.transpose(tokens),
+              jnp.swapaxes(ext_keys, 0, 1))
+        _, (ys_h, ys_c, ys_rng, ys_tok) = jax.lax.scan(
+            step, (h0, c0, rng0), xs)
+        targets = jnp.transpose(ys_tok).astype(jnp.int32)   # (S, K1)
+        drafts = tokens[:, 1:]
+        dpos = jnp.arange(k, dtype=jnp.int32)[None, :]
+        ok = (targets[:, :k] == drafts) & (dpos < n_draft[:, None])
+        n_acc = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1),
+                        axis=1)                              # (S,)
+
+        def sel(y):
+            idx = jnp.broadcast_to(
+                n_acc.reshape((1, S) + (1,) * (y.ndim - 2)), (1,) + y.shape[1:])
+            return jnp.take_along_axis(y, idx, axis=0)[0]
+
+        amask = active[:, None]
+        h_out = [jnp.where(amask, sel(y), hi)
+                 for y, hi in zip(ys_h, h0)]
+        c_out = [jnp.where(amask, sel(y), ci)
+                 for y, ci in zip(ys_c, c0)]
+        rng_out = jnp.where(amask, sel(ys_rng), rng0)
+        n_emit = jnp.where(active, n_acc + 1, 0).astype(jnp.int32)
+        return h_out, c_out, rng_out, targets, n_emit
+
+    return spec_tick

@@ -518,11 +518,37 @@ class FleetRouter:
 
     def generate(self, prompt, model: Optional[str] = None,
                  deadline: Optional[Deadline] = None, **kw):
-        """Admission-controlled decode submit; returns the stream."""
+        """Admission-controlled decode submit; returns the stream.
+
+        A ``session=`` token routes with affinity when no model is
+        named: the pool already holding the carry locally (device tier
+        beats host tier) wins, so multi-turn sessions keep resuming
+        without a store round-trip; a cold token lands on any pool
+        with a session store, which resumes it from the shared
+        checkpoint — the cross-node path."""
         if self._shutdown:
             raise RuntimeError("FleetRouter is shut down")
+        if model is None and kw.get("session") is not None:
+            pool = self._session_affinity(kw["session"])
+            if pool is not None:
+                return pool.submit(prompt, deadline=deadline, **kw)
         return self.generation_pool(model).submit(
             prompt, deadline=deadline, **kw)
+
+    def _session_affinity(self, token: str
+                          ) -> Optional[GenerationPool]:
+        with self._pools_lock:
+            pools = list(self._gen_pools.values())
+        tier_rank = {"device": 3, "host": 2}
+        best, best_rank = None, 0
+        for p in pools:
+            store = getattr(p.engine, "session_store", None)
+            if store is None:
+                continue
+            rank = tier_rank.get(store.resident(token), 1)
+            if rank > best_rank:
+                best, best_rank = p, rank
+        return best
 
     # ---- version lifecycle -----------------------------------------------
     def swap(self, name: str, model, version: str) -> ModelPool:

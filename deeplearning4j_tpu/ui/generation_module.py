@@ -2,7 +2,13 @@
 
 - ``POST /api/generate``  {"prompt": str|[ids], "max_new_tokens"?,
   "greedy"?, "temperature"?, "top_k"?, "seed"?, "stop"?, "stream"?,
-  "model"?}
+  "model"?, "session"?}
+
+  ``"session"`` names a resumable carry: the engine continues the
+  session's (h, c)/PRNG state instead of replaying the prefix, and
+  re-captures it when the sequence retires — including across nodes
+  via the shared ArtifactStore checkpoint (see generation/session.py).
+  The terminal event echoes the token back.
 
   With ``"stream": true`` (the default) the response is a
   ``text/event-stream``: one ``data:`` event per sampled token
@@ -69,6 +75,12 @@ class GenerationModule(UIModule):
             kw["greedy"] = bool(body["greedy"])
         if "stop" in body:
             kw["stop"] = body["stop"]
+        if body.get("session") is not None:
+            # resumable-session token: the engine restores the carry
+            # (local tier or cross-node store checkpoint) and re-saves
+            # it at retirement; behind a router it also picks the pool
+            # already holding the carry (session affinity)
+            kw["session"] = str(body["session"])
         prompt = body.get("prompt", "")
         if self.router is not None:
             return self.router.generate(

@@ -70,7 +70,12 @@ JAX_PLATFORMS=cpu python -m benchmarks.online --smoke
 # equal to the sequential reference decode with slots reused mid-flight,
 # zero live compiles after warmup (watchdog-asserted), token p99 + TTFT
 # under the CPU bounds, and the pretrained int8 head strictly fewer
-# bytes/token than bf16 within the next-token agreement budget
+# bytes/token than bf16 within the next-token agreement budget; plus
+# the v2 serving modes: chunked prefill TTFT strictly below tick
+# prefill at 256-token prompts (bitwise-equal output), the speculative
+# stream bitwise-equal to plain decode on the pretrained artifact, and
+# a session resumed on a second in-proc node from the shared store
+# checkpoint — bitwise continuation with zero live compiles
 JAX_PLATFORMS=cpu python -m benchmarks.generation --smoke
 # native tier: build the C kernels when a toolchain exists, then gate
 # the fused pair producer — native must be >= the numpy fallback in
