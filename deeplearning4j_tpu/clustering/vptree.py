@@ -26,7 +26,7 @@ class _Node:
 class VPTree:
     def __init__(self, points: np.ndarray, distance: str = "euclidean",
                  seed: int = 0):
-        self.points = np.asarray(points, np.float64)
+        self.points = np.asarray(points, np.float64)  # host-sync-ok: legacy host tree holds host f64 rows by design
         if distance not in ("euclidean", "cosine"):
             raise ValueError(f"unsupported distance {distance!r}")
         self.distance = distance
@@ -50,9 +50,9 @@ class VPTree:
         vp = idxs.pop(vp_pos)
         node = _Node(vp)
         if idxs:
-            arr = np.asarray(idxs)
+            arr = np.asarray(idxs)  # host-sync-ok: build-time index array on host rows
             d = self._dist(vp, arr)
-            median = float(np.median(d))
+            median = float(np.median(d))  # host-sync-ok: build-time median split scalar
             node.threshold = median
             inside = [i for i, di in zip(idxs, d) if di < median]
             outside = [i for i, di in zip(idxs, d) if di >= median]
@@ -63,13 +63,13 @@ class VPTree:
     def _dist_to_query(self, q: np.ndarray, idx: int) -> float:
         if self.distance == "cosine":
             qn = q / max(np.linalg.norm(q), 1e-12)
-            return float(1.0 - self._unit[idx] @ qn)
-        return float(np.linalg.norm(self.points[idx] - q))
+            return float(1.0 - self._unit[idx] @ qn)  # host-sync-ok: host walk: distance on host rows
+        return float(np.linalg.norm(self.points[idx] - q))  # host-sync-ok: host walk: distance on host rows
 
     def search(self, query: np.ndarray, k: int
                ) -> Tuple[List[int], List[float]]:
         """k nearest (indices, distances), best-first with pruning."""
-        q = np.asarray(query, np.float64)
+        q = np.asarray(query, np.float64)  # host-sync-ok: query decode at the host-tree input boundary
         heap: List[Tuple[float, int]] = []   # max-heap via negated dist
         tau = [np.inf]
 

@@ -67,6 +67,23 @@ def quantize_weight(w: np.ndarray,
     return q, scales
 
 
+def quantize_rows(x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-ROW symmetric int8 quantization of a matrix of row vectors
+    (a retrieval corpus shard, a batch of session carries): scale[i] =
+    absmax(x[i, :]) / 127, dead rows scale 1.0. Returns ``(x_q int8
+    [N, D], scales f32 [N])``; ``x ≈ x_q * scales[:, None]``. The
+    row-major twin of :func:`quantize_weight` (which reduces all-but-
+    last); scales stay host numpy so two processes quantizing the same
+    corpus produce bitwise-identical shards."""
+    x = np.asarray(x, np.float32)  # host-sync-ok: one-time host-side corpus quantization at index build, not a query hot path
+    amax = np.max(np.abs(x), axis=1)
+    amax = np.where(amax > 0, amax, np.float32(Q_MAX))
+    scales = (amax / np.float32(Q_MAX)).astype(np.float32)
+    q = np.rint(x / scales[:, None])
+    q = np.clip(q, -Q_MAX, Q_MAX).astype(np.int8)
+    return q, scales
+
+
 def activation_scale(amax: float) -> np.float32:
     """Static per-layer activation scale from a calibrated absmax."""
     a = np.float32(amax)

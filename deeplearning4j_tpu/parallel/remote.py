@@ -347,13 +347,14 @@ class RemoteDispatcher:
         return ra
 
     def _send(self, rec: Dict[str, Any], body: bytes,
-              timeout_s: Optional[float] = None) -> _Attempt:
+              timeout_s: Optional[float] = None,
+              path: str = "/api/predict") -> _Attempt:
         nid = rec["node_id"]
         br = self._breaker(nid)
         if not br.allow():
             return _Attempt(False, None, retriable=True,
                             reason="breaker_open")
-        url = rec["url"].rstrip("/") + "/api/predict"
+        url = rec["url"].rstrip("/") + path
         with self._lock:
             self._inflight[nid] = self._inflight.get(nid, 0) + 1
         try:
@@ -525,6 +526,40 @@ class RemoteDispatcher:
         raise RemoteError(
             "predict failed on every tried node: "
             + "; ".join(f"{n}: {r}" for n, r in attempts), attempts)
+
+    def records(self) -> List[Dict[str, Any]]:
+        """The current dispatchable registry records — for callers
+        that own placement themselves (the neighbors scatter-gather
+        maps shard ownership from the gossiped stats) but still want
+        this dispatcher's breakers/inflight accounting on every send."""
+        return self._nodes()
+
+    def call(self, rec: Dict[str, Any], payload: Dict[str, Any], *,
+             path: str, timeout_s: Optional[float] = None,
+             deadline: Optional[Deadline] = None) -> Dict[str, Any]:
+        """One TARGETED dispatch: send ``payload`` to exactly the node
+        in ``rec`` at ``path`` — no re-pick, no retry-elsewhere (the
+        caller owns placement; a sharded corpus query cannot be
+        answered by an arbitrary other node). Breaker accounting,
+        deadline capping and the chaos seam are the same machinery
+        :meth:`predict` uses. Raises :class:`RemoteError` on any
+        failure (the caller decides between replica retry and partial
+        degradation) and :class:`DeadlineExceeded` on an expired
+        budget."""
+        if deadline is not None and deadline.expired:
+            self._c_deadline.inc(1.0, stage="ingress")
+            raise DeadlineExceeded(
+                f"remote call {path}: deadline expired before dispatch")
+        body = json.dumps(payload).encode()
+        t = self.timeout_s if timeout_s is None else float(timeout_s)  # host-sync-ok: config scalar
+        if deadline is not None:
+            t = max(deadline.cap_timeout(t), 1e-3)
+        att = self._send(rec, body, t, path=path)
+        if att.ok:
+            return att.value
+        raise RemoteError(
+            f"call {path} failed on node {rec['node_id']}: "
+            f"{att.reason}", [(rec["node_id"], att.reason)])
 
     def _await_first_node(self) -> Optional[Dict[str, Any]]:
         """Scale-from-zero path: signal demand, then (optionally) wait

@@ -1,0 +1,37 @@
+"""TPU-native nearest-neighbor retrieval serving.
+
+The reference framework ships retrieval as a host-side product: a
+VPTree behind a Play REST app (deeplearning4j-nearestneighbor-server —
+SURVEY §2.10), O(corpus) pointer-chasing Python/Java per query. Here
+the corpus lives on the device as a sharded matrix and one jitted
+kernel per (query-bucket, shard, k, precision) does the whole query:
+distance matmul + in-graph ``lax.top_k``, so only (k indices, k
+distances) ever cross the host boundary.
+
+- :mod:`kernels` — the fused distance+top-k kernels (f32 / int8 brute
+  force, IVF-routed variants).
+- :mod:`index` — ShardedCorpusIndex: build / quantize / IVF-cluster /
+  save / load over the ArtifactStore bucket layout.
+- :mod:`engine` — RetrievalEngine: AOT-style warmup sweep, bucket and
+  k ladders, host-side k-way merge, recompile watchdog, hot index
+  promotion.
+- :mod:`cluster` — RetrievalNode (gossiped shard ownership) and
+  NeighborsDispatcher (scatter-gather fan-out with partial-result
+  degradation).
+"""
+
+from deeplearning4j_tpu.retrieval.engine import RetrievalEngine
+from deeplearning4j_tpu.retrieval.index import ShardedCorpusIndex
+
+
+def __getattr__(name):
+    # cluster pulls in the ui/http stack; keep `import retrieval` light
+    if name in ("RetrievalNode", "NeighborsDispatcher",
+                "PartialResultError"):
+        from deeplearning4j_tpu.retrieval import cluster
+        return getattr(cluster, name)
+    raise AttributeError(name)
+
+
+__all__ = ["RetrievalEngine", "ShardedCorpusIndex", "RetrievalNode",
+           "NeighborsDispatcher", "PartialResultError"]

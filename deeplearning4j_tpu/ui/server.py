@@ -870,7 +870,9 @@ class UIServer:
         # can wait for responses to finish SERIALIZING, not just for
         # the engine queue to empty
         self._httpd.draining = False
-        self._httpd.drain_paths = {"/api/predict", "/api/generate"}
+        self._httpd.drain_paths = {"/api/predict", "/api/generate",
+                                   "/api/neighbors",
+                                   "/api/neighbors/shard"}
         self._httpd.active_requests = 0
         self._httpd.active_lock = threading.Lock()
         # fault injection on the ingress edge (chaos/plan.py site

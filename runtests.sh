@@ -55,6 +55,20 @@ JAX_PLATFORMS=cpu python -m benchmarks.serving --smoke-cluster
 # answered 504 at the front door WITHOUT device dispatch, and the
 # graftlint chaos-hygiene baseline stays empty
 JAX_PLATFORMS=cpu python -m benchmarks.serving --smoke-chaos
+# retrieval tier: interleaved A/B over the fused distance+top-k path —
+# jitted brute >= host VPTree qps on worst-case pruning-hostile
+# queries over the same corpus (>=10x in the full 1M run), int8 and
+# IVF recall@10 >= 0.95 vs the exact f32 oracle, repeated queries
+# bitwise identical (including distance ties), zero live compiles
+# after the warmup sweep, int8 bytes/query < 0.3x f32 and IVF < brute
+JAX_PLATFORMS=cpu python -m benchmarks.neighbors --smoke
+# retrieval-cluster tier: scatter-gather chaos — two serve
+# --neighbors-index subprocesses own disjoint shard slices; one is
+# SIGKILLed mid-stream (every in-flight query answers full or
+# partial:true, never an exception), rejoins under the same id warm
+# from the shared store with zero live compiles, full answers resume,
+# and the survivor SIGTERM-drains to exit 0 deregistered
+JAX_PLATFORMS=cpu python -m benchmarks.neighbors --smoke-cluster
 # elastic tier: with one straggler, bounded-staleness ASYNC_ELASTIC
 # sustains >=1.5x the SYNC round rate with divergence under the
 # hard-sync threshold, and reduces exactly to AVERAGING without one

@@ -76,6 +76,24 @@ HOT_PATHS = (
     # the ctypes loader runs host-side by definition, but sits on the
     # producer path — keep it clean of accidental device fetches
     "deeplearning4j_tpu/utils/native.py",
+    # the retrieval query path: the fused kernel's whole point is that
+    # only (k ids, k distances) cross the host boundary per query. The
+    # legitimate fetches are exactly the per-shard top-k egress into
+    # the host k-way merge, the int8 refine rescore (host f32 rows by
+    # design), warmup/build-time index preparation, and the
+    # scatter-gather JSON serde — each pragma'd in place. A stray
+    # asarray on the distance matrix would silently reintroduce the
+    # O(n_corpus) transfer the tier exists to kill.
+    "deeplearning4j_tpu/retrieval",
+    # its HTTP ingress, same contract as the predict/generate modules:
+    # request decode / response encode are the pragma'd boundaries
+    "deeplearning4j_tpu/ui/neighbors_module.py",
+    # the legacy VPTree surface is host-side math by definition, but
+    # server.py now fronts the jitted engine — police the shim so the
+    # legacy contract can't quietly pull full distance rows back, and
+    # keep the host trees (vptree/kdtree/lsh/kmeans/sptree) clean of
+    # accidental device round-trips
+    "deeplearning4j_tpu/clustering",
 )
 
 PATTERNS = (
