@@ -1,0 +1,78 @@
+"""Shared interleaved-A/B timing harness.
+
+Three benches (serving, generation, neighbors) independently grew the
+same measurement discipline: run the arms INTERLEAVED — one sample per
+arm per round, the arm order rotating each round so machine drift
+(thermal throttle, page cache, GC pauses) lands on every arm equally
+instead of biasing whichever arm runs last — discard warmup rounds,
+and headline the MEDIAN across rounds (robust to one noisy round) with
+p50/p99 client latencies from a LatencyRing. This module is that
+discipline extracted once; the three benches and the autotune sweep
+engine (benchmarks/autotune.py) all import it.
+
+The helpers are deliberately shape-agnostic: an "arm" is any callable
+of the round index returning one sample (throughput, qps, a wall
+time). What the sample means — and whether bigger is better — stays
+with the caller.
+"""
+
+from __future__ import annotations
+
+import statistics
+from typing import Any, Callable, Dict, List, Mapping, Sequence
+
+
+def interleaved(arms: Mapping[str, Callable[[int], Any]], rounds: int,
+                *, warmup: int = 0, rotate: bool = True
+                ) -> Dict[str, List[Any]]:
+    """Run every arm once per round, interleaved.
+
+    ``arms`` maps arm name -> callable(round_index) -> sample. With
+    ``rotate`` (the default) the arm order shifts by one each round —
+    the neighbors-bench rotation — so slow drift is amortized across
+    arms rather than accumulating on the last one. The first ``warmup``
+    rounds execute fully (they warm caches, allocators, branch
+    predictors) but their samples are dropped from the result.
+
+    Returns arm name -> list of ``rounds`` samples, in round order.
+    """
+    if rounds < 1:
+        raise ValueError("rounds must be >= 1")
+    samples: Dict[str, List[Any]] = {name: [] for name in arms}
+    order = list(arms)
+    for r in range(warmup + rounds):
+        if rotate:
+            cut = r % len(order)
+            rotation = order[cut:] + order[:cut]
+        else:
+            rotation = order
+        for name in rotation:
+            s = arms[name](r)
+            if r >= warmup:
+                samples[name].append(s)
+    return samples
+
+
+def median_of(samples: Mapping[str, Sequence[float]]) -> Dict[str, float]:
+    """Median per arm — the headline number of every interleaved A/B."""
+    return {name: statistics.median(vals)
+            for name, vals in samples.items()}
+
+
+def summarize(values: Sequence[float]) -> Dict[str, float]:
+    """Median / p50 / p99 / n over raw samples, for sweeps that time
+    cells directly instead of through a LatencyRing."""
+    if not values:
+        return {"n": 0.0}
+    ordered = sorted(values)
+    n = len(ordered)
+    p99 = ordered[min(n - 1, int(0.99 * n))]
+    return {"n": n * 1.0, "median": statistics.median(ordered),
+            "p50": statistics.median(ordered), "p99": p99}
+
+
+def fmt_quantiles(ring) -> str:
+    """One-line p-quantile table from a LatencyRing (seconds -> ms)."""
+    q = ring.quantiles()
+    return "  ".join(f"p{int(k * 100)}={v * 1e3:7.2f}ms"
+                     for k, v in sorted(q.items()))

@@ -59,6 +59,7 @@ import time
 import urllib.error
 import urllib.request
 
+from benchmarks import ab
 from deeplearning4j_tpu.generation import (GenerationEngine,
                                            head_bytes_per_token,
                                            reference_decode)
@@ -406,15 +407,27 @@ def run_prefill_ab(args, failures) -> None:
     plen = 256 if args.smoke else 512
     prompt = [rng.randrange(SMALL_VOCAB) for _ in range(plen)]
     ttft, outs = {}, {}
-    for mode, kw in (("tick", {}), ("chunked", {"prefill_chunk": 64})):
-        eng = GenerationEngine(model, max_slots=2,
-                               registry=MetricsRegistry(),
-                               session_id=f"gen-prefill-{mode}", **kw)
-        try:
-            for _ in range(3):
+    engines = {}
+    try:
+        # both arms alive before timing: interleaved rounds
+        # (benchmarks/ab.py) see the same machine load
+        for mode, kw in (("tick", {}),
+                         ("chunked", {"prefill_chunk": 64})):
+            engines[mode] = GenerationEngine(
+                model, max_slots=2, registry=MetricsRegistry(),
+                session_id=f"gen-prefill-{mode}", **kw)
+
+        def _arm(mode, eng):
+            def go(_r):
                 outs[mode] = eng.submit(
                     prompt, max_new_tokens=8,
                     greedy=True).result(timeout=300.0)["ids"]
+                return outs[mode]
+            return go
+
+        ab.interleaved({m: _arm(m, e) for m, e in engines.items()}, 3)
+
+        for mode, eng in engines.items():
             st = eng.stats()
             ttft[mode] = st["latency_ms"]["ttft"].get("p50", 0.0)
             if mode == "chunked" and st["prefill"]["chunks"] == 0:
@@ -424,7 +437,8 @@ def run_prefill_ab(args, failures) -> None:
                 eng.assert_warm()
             except Exception as e:
                 failures.append(f"prefill-ab: {mode} arm not warm: {e}")
-        finally:
+    finally:
+        for eng in engines.values():
             eng.shutdown()
     speedup = (ttft["tick"] / ttft["chunked"]
                if ttft.get("chunked") else float("inf"))

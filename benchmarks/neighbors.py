@@ -57,6 +57,8 @@ import urllib.request
 
 import numpy as np
 
+from benchmarks import ab
+
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -169,22 +171,28 @@ def run_ab(args, smoke: bool) -> int:
     print(f"  host VPTree built on all {n} rows in "
           f"{time.perf_counter() - t0:.1f}s")
 
-    # interleaved rounds: arm order rotates so drift (thermal, page
-    # cache) spreads across arms instead of biasing the last one
-    stats = {name: [] for name in arms}
-    stats["host-vptree"] = []
-    order = list(arms) + ["host-vptree"]
-    for r in range(rounds):
-        for name in order[r % len(order):] + order[:r % len(order)]:
+    # interleaved rounds (benchmarks/ab.py): arm order rotates so drift
+    # (thermal, page cache) spreads across arms instead of biasing the
+    # last one
+    def _engine_arm(name):
+        eng, mode, _ = arms[name]
+
+        def go(_r):
             t0 = time.perf_counter()
-            if name == "host-vptree":
-                for qv in probes:
-                    tree.search(qv, k)
-            else:
-                eng, mode, _ = arms[name]
-                eng.search(probes, k, mode=mode)
-            stats[name].append(
-                batch / (time.perf_counter() - t0))
+            eng.search(probes, k, mode=mode)
+            return batch / (time.perf_counter() - t0)
+        return go
+
+    def _host_arm(_r):
+        t0 = time.perf_counter()
+        for qv in probes:
+            tree.search(qv, k)
+        return batch / (time.perf_counter() - t0)
+
+    ab_arms = {name: _engine_arm(name) for name in arms}
+    ab_arms["host-vptree"] = _host_arm
+    order = list(ab_arms)
+    stats = ab.interleaved(ab_arms, rounds)
 
     # the gated worst-case pair: same pruning-hostile queries through
     # both arms. The fused scan's cost is query-invariant (same matmul

@@ -18,8 +18,9 @@ Two load shapes:
   waiting — latency-bound; the p50/p95/p99 table is the story (a
   closed loop can't see coordinated omission).
 
-Arms alternate per round (A/B interleaved, like input_pipeline.py) so
-machine-load drift hits both equally.
+Arms alternate per round (A/B interleaved via benchmarks/ab.py, the
+shared harness the autotuner reuses) so machine-load drift hits both
+equally.
 
 PR 6 adds two multi-process modes:
 
@@ -65,6 +66,7 @@ import time
 
 import numpy as np
 
+from benchmarks import ab
 from deeplearning4j_tpu.observe.latency import LatencyRing
 from deeplearning4j_tpu.observe.registry import MetricsRegistry
 from deeplearning4j_tpu.parallel.serving import ServingEngine
@@ -159,12 +161,6 @@ def open_loop(engine: ServingEngine, rate_hz: float, duration_s: float,
     return len(pending) / wall, ring
 
 
-def _fmt_quantiles(ring: LatencyRing) -> str:
-    q = ring.quantiles()
-    return "  ".join(f"p{int(k * 100)}={v * 1e3:7.2f}ms"
-                     for k, v in sorted(q.items()))
-
-
 def run_timed(args) -> int:
     model = build_model(width=args.width)
     arms = {}
@@ -174,22 +170,26 @@ def run_timed(args) -> int:
             batch_limit=args.batch_limit, timeout_ms=args.timeout_ms,
             replicas=args.replicas)
     try:
-        tput = {name: [] for name in arms}
         rings = {name: LatencyRing(capacity=1 << 16) for name in arms}
-        for r in range(args.rounds):
-            for name, eng in arms.items():
+
+        def _arm(name, eng):
+            def go(r):
                 t, ring = closed_loop(eng, args.clients, args.requests,
                                       args.req_size, seed=r)
-                tput[name].append(t)
                 for v in ring.snapshot():
                     rings[name].record(v)
-        med = {n: statistics.median(ts) for n, ts in tput.items()}
+                return t
+            return go
+
+        tput = ab.interleaved({n: _arm(n, e) for n, e in arms.items()},
+                              args.rounds)
+        med = ab.median_of(tput)
         print(f"closed-loop: {args.clients} clients x {args.requests} "
               f"requests x{args.req_size}, median of {args.rounds} "
               "rounds:")
         for name in arms:
             print(f"  {name:9s} {med[name]:9.1f} req/s   "
-                  f"{_fmt_quantiles(rings[name])}")
+                  f"{ab.fmt_quantiles(rings[name])}")
         speedup = med["pipelined"] / med["blocking"]
         print(f"pipelined speedup: {speedup:.2f}x")
 
@@ -197,7 +197,7 @@ def run_timed(args) -> int:
             t, ring = open_loop(arms["pipelined"], args.rate,
                                 args.open_duration, args.req_size)
             print(f"open-loop (Poisson {args.rate:.0f} req/s target): "
-                  f"{t:9.1f} req/s achieved   {_fmt_quantiles(ring)}")
+                  f"{t:9.1f} req/s achieved   {ab.fmt_quantiles(ring)}")
         for name, eng in arms.items():
             eng.assert_warm()
         if args.assert_speedup and speedup < args.assert_speedup:
@@ -247,19 +247,23 @@ def run_smoke(args) -> int:
         arms[name] = make_engine(model, pipelined=pipelined,
                                  session=f"smoke-{name}", batch_limit=16)
     try:
-        tput = {name: [] for name in arms}
         rings = {name: LatencyRing(capacity=1 << 14) for name in arms}
-        for r in range(3):
-            for name, e in arms.items():
+
+        def _arm(name, e):
+            def go(r):
                 tp, ring = closed_loop(e, 4, 30, 1, seed=r)
-                tput[name].append(tp)
                 for v in ring.snapshot():
                     rings[name].record(v)
-        med = {n: statistics.median(ts) for n, ts in tput.items()}
+                return tp
+            return go
+
+        tput = ab.interleaved({n: _arm(n, e) for n, e in arms.items()},
+                              3)
+        med = ab.median_of(tput)
         speedup = med["pipelined"] / med["blocking"]
         for name in arms:
             print(f"  {name:9s} {med[name]:9.1f} req/s   "
-                  f"{_fmt_quantiles(rings[name])}")
+                  f"{ab.fmt_quantiles(rings[name])}")
         arms["pipelined"].assert_warm()
     finally:
         for e in arms.values():
@@ -314,42 +318,57 @@ def run_precision_ab(args, smoke: bool = False) -> int:
     rows = {}
     outputs = {}
     failures = []
-    for name, policy in policies.items():
-        eng = make_engine(model, pipelined=True,
-                          session=f"prec-{name}",
-                          batch_limit=batch_limit,
-                          timeout_ms=args.timeout_ms,
-                          precision=policy)
-        try:
+    engines = {}
+    base = {}
+    rings = {}
+    try:
+        # every arm alive before timing starts: the interleaved rounds
+        # see identical machine load (benchmarks/ab.py methodology)
+        for name, policy in policies.items():
+            eng = make_engine(model, pipelined=True,
+                              session=f"prec-{name}",
+                              batch_limit=batch_limit,
+                              timeout_ms=args.timeout_ms,
+                              precision=policy)
+            engines[name] = eng
             outputs[name] = np.asarray(eng.output(eval_x))
-            d0, ms0 = eng.dispatch_count, eng.device_ms_total
-            ring = LatencyRing(capacity=1 << 16)
-            tputs = []
-            for r in range(rounds):
+            base[name] = (eng.dispatch_count, eng.device_ms_total)
+            rings[name] = LatencyRing(capacity=1 << 16)
+
+        def _arm(name, eng):
+            def go(r):
                 tp, rg = closed_loop(eng, clients, requests,
                                      args.req_size, seed=r)
-                tputs.append(tp)
                 for v in rg.snapshot():
-                    ring.record(v)
+                    rings[name].record(v)
+                return tp
+            return go
+
+        meds = ab.median_of(ab.interleaved(
+            {n: _arm(n, e) for n, e in engines.items()}, rounds))
+
+        for name, eng in engines.items():
+            d0, ms0 = base[name]
             n_req = clients * requests * rounds
             batches = eng.dispatch_count - d0
             dev_ms = eng.device_ms_total - ms0
             pbytes = eng.params_resident_bytes
             io_bytes = (args.req_size * FEATURES * 4
                         + args.req_size * outputs[name].shape[-1] * 4)
-            q = ring.quantiles((0.5, 0.99))
+            q = rings[name].quantiles((0.5, 0.99))
             try:
                 eng.assert_warm()
             except Exception as e:
                 failures.append(f"{name} arm not warm: {e}")
             rows[name] = {
-                "tput": statistics.median(tputs),
+                "tput": meds[name],
                 "p50_ms": q[0.5] * 1e3, "p99_ms": q[0.99] * 1e3,
                 "params_bytes": pbytes,
                 "bytes_per_req": pbytes * (batches / n_req) + io_bytes,
                 "devms_per_req": dev_ms / n_req,
             }
-        finally:
+    finally:
+        for eng in engines.values():
             eng.shutdown()
 
     print(f"precision A/B: width={width}, {clients} clients x "

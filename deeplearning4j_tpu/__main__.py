@@ -37,8 +37,9 @@ def _build_parser() -> argparse.ArgumentParser:
     s.add_argument("--replicas", default="1",
                    help="device replicas to serve on; an int or 'auto' "
                    "for every visible device (reference: --workers)")
-    s.add_argument("--batch-limit", type=int, default=32,
-                   help="max examples per device batch")
+    s.add_argument("--batch-limit", type=int, default=None,
+                   help="max examples per device batch (default: the "
+                   "tuned-config value when one loads, else 32)")
     s.add_argument("--queue-limit", type=int, default=128,
                    help="bound on queued request chunks")
     s.add_argument("--timeout-ms", type=float, default=5.0,
@@ -99,6 +100,19 @@ def _build_parser() -> argparse.ArgumentParser:
     n.add_argument("--model-key", default=None,
                    help="artifact-store key for this model (default: "
                    "the model file's basename)")
+    n.add_argument("--tuned-config", default=None, metavar="KEY",
+                   nargs="?", const="tuned_config",
+                   help="load a measured TunedConfig artifact from "
+                   "--artifact-store under KEY (bare flag: the default "
+                   "key) and let it size every engine this process "
+                   "starts; with --artifact-store set, the default key "
+                   "is auto-discovered even without this flag. A "
+                   "fingerprint mismatch falls through to the "
+                   "committed defaults, never a crash")
+    n.add_argument("--no-tuned", action="store_true",
+                   help="skip TunedConfig auto-discovery from "
+                   "--artifact-store; every knob keeps its explicit "
+                   "or committed-default value")
     n.add_argument("--drain-timeout", type=float, default=30.0,
                    metavar="S",
                    help="SIGTERM grace: max seconds to finish in-flight "
@@ -117,9 +131,11 @@ def _build_parser() -> argparse.ArgumentParser:
     r.add_argument("--neighbors-shards", default=None, metavar="IDS",
                    help="comma-separated shard ids this node loads and "
                    "owns (default: every shard in the manifest)")
-    r.add_argument("--neighbors-k-ladder", default="1,10,100",
+    r.add_argument("--neighbors-k-ladder", default=None,
                    metavar="KS", help="warmed k values; a request's k "
-                   "is served by the next rung up and sliced")
+                   "is served by the next rung up and sliced "
+                   "(default: the tuned-config ladder when one loads, "
+                   "else 1,10,100)")
     r.add_argument("--neighbors-batch", type=int, default=64,
                    metavar="N", help="max query batch per dispatch "
                    "(pow2 bucket ladder below it is warmed too)")
@@ -178,10 +194,12 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="enable decode serving (the model must be a "
                    "stacked-LSTM + dense-head network, e.g. the "
                    "committed TextGenerationLSTM artifact)")
-    g.add_argument("--gen-slots", type=int, default=8, metavar="N",
+    g.add_argument("--gen-slots", type=int, default=None, metavar="N",
                    help="continuous-batching slot count: concurrent "
                    "sequences decoding in one device batch; the AOT "
-                   "warmup sweeps the pow2 bucket ladder up to this")
+                   "warmup sweeps the pow2 bucket ladder up to this "
+                   "(default: the tuned-config value when one loads, "
+                   "else 8)")
     g.add_argument("--gen-max-new", type=int, default=256, metavar="N",
                    help="default per-request max generated tokens")
     g.add_argument("--gen-precision", default="f32",
@@ -196,11 +214,12 @@ def _build_parser() -> argparse.ArgumentParser:
                    "on shed)")
     g.add_argument("--gen-queue-limit", type=int, default=128,
                    help="bound on sequences waiting for a slot")
-    g.add_argument("--gen-prefill-chunk", type=int, default=0,
+    g.add_argument("--gen-prefill-chunk", type=int, default=None,
                    metavar="C",
                    help="chunked prefill: consume prompts in jitted "
                    "scans of up to C tokens (pow2 ladder, AOT-warmed) "
-                   "instead of one tick per char; 0 disables")
+                   "instead of one tick per char; 0 disables (default: "
+                   "the tuned-config value when one loads, else 0)")
     g.add_argument("--gen-speculative", type=int, default=0,
                    metavar="K",
                    help="speculative decode: n-gram draft proposes up "
@@ -230,6 +249,40 @@ def _build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _load_tuned_for_serve(args):
+    """Resolve the machine-measured TunedConfig for this serve process.
+
+    With ``--artifact-store`` the tuned artifact is auto-discovered
+    under the default key; ``--tuned-config [KEY]`` names another key.
+    The loaded (or fallen-through) config installs process-wide, so
+    every engine built below — serving pools, generation, retrieval,
+    the device feeder — resolves its un-flagged knobs from it. The
+    expectation is machine-level (no weights binding): whatever model
+    this node serves, a config measured on this backend + jax pair
+    applies; any fingerprint-field mismatch means committed defaults.
+    """
+    key = getattr(args, "tuned_config", None)
+    store_dir = getattr(args, "artifact_store", None)
+    if store_dir is None or getattr(args, "no_tuned", False):
+        return None
+    from deeplearning4j_tpu.observe.flight_recorder import (
+        default_flight_recorder)
+    from deeplearning4j_tpu.optimize import autotune
+    from deeplearning4j_tpu.parallel.aot_cache import ArtifactStore
+    cfg = autotune.load_tuned(
+        ArtifactStore(store_dir), expect=autotune.fingerprint(),
+        key=key or autotune.TUNED_KEY,
+        recorder=default_flight_recorder())
+    autotune.set_process_tuned(cfg)
+    if cfg.load_outcome == "loaded":
+        print(f"tuned config: loaded {sorted(cfg.values)} from "
+              f"{store_dir}")
+    else:
+        print(f"tuned config: {cfg.load_outcome} "
+              f"({cfg.load_reason}) — committed defaults in effect")
+    return cfg
+
+
 def _cmd_serve_neighbors(args, block: bool):
     """Retrieval mode of ``serve``: load a saved ShardedCorpusIndex
     from the artifact store and serve POST /api/neighbors through a
@@ -245,12 +298,15 @@ def _cmd_serve_neighbors(args, block: bool):
     if not args.artifact_store:
         raise SystemExit("--neighbors-index requires --artifact-store")
     store = ArtifactStore(args.artifact_store)
+    _load_tuned_for_serve(args)
     shard_ids = None
     if args.neighbors_shards:
         shard_ids = [int(s) for s in
                      args.neighbors_shards.split(",") if s != ""]
-    ladder = tuple(int(k) for k in
-                   args.neighbors_k_ladder.split(",") if k != "")
+    # an explicit --neighbors-k-ladder wins; None lets the engine pick
+    # the tuned ladder (process config installed above), else (1,10,100)
+    ladder = None if args.neighbors_k_ladder is None else tuple(
+        int(k) for k in args.neighbors_k_ladder.split(",") if k != "")
     index = ShardedCorpusIndex.load(store, args.neighbors_index,
                                     shard_ids=shard_ids)
     engine = RetrievalEngine(index, k_ladder=ladder,
@@ -346,6 +402,17 @@ def cmd_serve(args, block: bool = True):
     if not args.model:
         raise SystemExit("--model is required (or --neighbors-index "
                          "to serve a retrieval index)")
+    # measured tuned config (auto-discovered from --artifact-store)
+    # installs process-wide, then the un-flagged knobs resolve through
+    # it HERE so every construction and banner below sees real values
+    from deeplearning4j_tpu.optimize.autotune import resolve_tuned
+    tuned = _load_tuned_for_serve(args)
+    args.batch_limit = int(resolve_tuned(
+        args.batch_limit, tuned, "serving.batch_limit"))
+    args.gen_slots = int(resolve_tuned(
+        args.gen_slots, tuned, "generation.max_slots"))
+    args.gen_prefill_chunk = int(resolve_tuned(
+        args.gen_prefill_chunk, tuned, "generation.prefill_chunk"))
     model = restore_model(args.model)
     replicas = args.replicas if args.replicas == "auto" \
         else int(args.replicas)

@@ -205,7 +205,7 @@ class DeviceFeeder:
         auto: on for non-CPU backends, where ``device_put`` copies)
     """
 
-    def __init__(self, source: Iterable, *, depth: int = DEFAULT_DEPTH,
+    def __init__(self, source: Iterable, *, depth: Optional[int] = None,
                  byte_budget: Optional[int] = None, k_steps: int = 1,
                  pad_ragged: Optional[bool] = None,
                  prepare: Optional[Callable[[DataSet], DataSet]] = None,
@@ -215,6 +215,13 @@ class DeviceFeeder:
                  put: Optional[Callable] = None,
                  tracer=None, registry=None, session_id: str = "train",
                  reuse_staging: Optional[bool] = None):
+        if depth is None:
+            # direct constructions (fit() resolves its own): measured
+            # tuned depth when a process TunedConfig is installed, else
+            # the committed double buffer
+            from deeplearning4j_tpu.optimize.autotune import tuned_value
+            tuned = tuned_value("feeder.depth")
+            depth = DEFAULT_DEPTH if tuned is None else int(tuned)
         if depth < 1:
             raise ValueError("feeder depth must be >= 1")
         if k_steps < 1:

@@ -238,7 +238,7 @@ class GenerationEngine:
     and pushes sampled tokens into each stream as they decode.
     """
 
-    def __init__(self, model, *, max_slots: int = 8,
+    def __init__(self, model, *, max_slots: Optional[int] = None,
                  precision: Union[str, Any] = "f32",
                  vocab: Optional[D.Vocab] = None,
                  max_new_tokens: int = 256,
@@ -250,10 +250,21 @@ class GenerationEngine:
                                          "over the lazy dog\n",
                  registry=None, watchdog=None,
                  session_id: str = "generate",
-                 prefill_chunk: int = 0,
+                 prefill_chunk: Optional[int] = None,
                  speculative: int = 0,
                  sampling: Optional[str] = None,
-                 session_store=None):
+                 session_store=None,
+                 tuned_config=None):
+        # explicit kwargs > TunedConfig (engine-local, else process) >
+        # committed defaults — the measured slot geometry and prefill
+        # chunk tune BOTH the runtime shape and the AOT warm set (slot
+        # ladder, resize pairs, chunk ladder all derive from them)
+        from deeplearning4j_tpu.optimize.autotune import resolve_tuned
+        max_slots = int(resolve_tuned(max_slots, tuned_config,
+                                      "generation.max_slots"))
+        prefill_chunk = int(resolve_tuned(prefill_chunk, tuned_config,
+                                          "generation.prefill_chunk"))
+        self.tuned_config = tuned_config
         if max_slots < 1:
             raise ValueError("max_slots must be >= 1")
         self.model = model

@@ -292,6 +292,15 @@ class BaseModel:
             self._fit_batch(data)
             return self
         iterator = data
+        # k_steps/prefetch left at None pick up the machine-measured
+        # TunedConfig when one is installed (serve/train started with
+        # --tuned-config), else the committed defaults — explicit
+        # arguments always win
+        from deeplearning4j_tpu.optimize.autotune import tuned_value
+        k_tuned = False
+        if k_steps is None:
+            k_steps = tuned_value("fit.k_steps")
+            k_tuned = k_steps is not None
         k = 1 if k_steps is None else int(k_steps)
         if k < 1:
             raise ValueError("k_steps must be >= 1")
@@ -299,14 +308,23 @@ class BaseModel:
             DEFAULT_DEPTH, DeviceFeeder)
         from deeplearning4j_tpu.datasets.iterators import (
             AsyncDataSetIterator)
+        if prefetch is None:
+            prefetch = tuned_value("feeder.depth")
         depth = DEFAULT_DEPTH if prefetch is None else int(prefetch)
         feed = (depth > 0 and self._feed_supported()
                 and getattr(iterator, "async_supported", True))
         if k > 1 and not feed:
-            raise ValueError(
-                "k_steps > 1 needs the device feeder: prefetch must be "
-                ">= 1, the iterator async-capable (no AsyncShield), and "
-                "the model not configured for TBPTT")
+            if k_tuned:
+                # a machine-tuned k must never break a fit the feeder
+                # can't serve (shielded iterator, TBPTT, prefetch=0) —
+                # implicit tuning degrades, only explicit asks raise
+                k = 1
+            else:
+                raise ValueError(
+                    "k_steps > 1 needs the device feeder: prefetch must "
+                    "be >= 1, the iterator async-capable (no "
+                    "AsyncShield), and the model not configured for "
+                    "TBPTT")
         source = iterator
         if (feed and isinstance(iterator, DataSetIterator)
                 and not isinstance(iterator, AsyncDataSetIterator)):

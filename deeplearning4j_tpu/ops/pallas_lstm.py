@@ -58,15 +58,40 @@ _IMPL_ENV = "DL4J_LSTM_IMPL"  # "fused" | "scan" | "auto" (default)
 # crossover discipline). Opt in explicitly with DL4J_LSTM_IMPL=fused.
 _MEASURED_FUSED_WINS: Tuple[Tuple[int, int, int], ...] = ()
 
+# Runtime override installed by the autotune engine (the
+# `ops.lstm_dispatch` tunable): a TunedConfig measured on THIS machine
+# may carry crossover rules, and set_process_tuned() routes them here.
+# None means "no tuned table installed — use the committed constant".
+_runtime_rules: Optional[Tuple[Tuple[int, int, int], ...]] = None
+
 _DEF_BLOCK_T = 1  # ticks per grid step; >1 amortizes per-step overhead
                   # at the price of VMEM (zx slab is N*4H*dtype per tick)
+
+
+def dispatch_rules() -> Tuple[Tuple[int, int, int], ...]:
+    """The crossover table in effect: the tuned runtime table when one
+    was installed, else the committed measured constant."""
+    return (_MEASURED_FUSED_WINS if _runtime_rules is None
+            else _runtime_rules)
+
+
+def set_dispatch_rules(rules) -> None:
+    """Install (or with None, clear) a measured crossover table at
+    runtime. Rules arrive from a persisted TunedConfig as lists of
+    [min_batch, min_hidden, min_seq]; normalized to int tuples here."""
+    global _runtime_rules
+    if rules is None:
+        _runtime_rules = None
+        return
+    _runtime_rules = tuple(
+        (int(b), int(h), int(t)) for (b, h, t) in rules)
 
 
 def fused_wins(batch: int, hidden: int, seq: int) -> bool:
     """True where the measured crossover table says the fused kernel
     beats the XLA scan on this (batch, hidden, seq) geometry."""
     return any(batch >= b and hidden >= h and seq >= t
-               for (b, h, t) in _MEASURED_FUSED_WINS)
+               for (b, h, t) in dispatch_rules())
 
 
 def choose_impl(batch: int, hidden: int, seq: int,

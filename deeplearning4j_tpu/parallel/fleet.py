@@ -382,10 +382,14 @@ class FleetRouter:
                  max_pending: Optional[int] = None,
                  window_s: Optional[float] = None,
                  aot_cache_dir: Optional[str] = None,
+                 tuned_config=None,
                  registry=None, session_id: str = "fleet"):
         self.slo_ms = slo_ms
         self.session_id = session_id
         self.aot_cache_dir = aot_cache_dir
+        # threaded into every pool's engines (unless the pool's own
+        # engine_kwargs override): one tuned artifact sizes the fleet
+        self.tuned_config = tuned_config
         self.registry = registry if registry is not None \
             else default_registry()
         self.window_s = window_s if window_s is not None \
@@ -467,6 +471,8 @@ class FleetRouter:
         if self.aot_cache_dir is not None:
             kw.setdefault("aot_cache_dir",
                           os.path.join(self.aot_cache_dir, name))
+        if self.tuned_config is not None:
+            kw.setdefault("tuned_config", self.tuned_config)
         kw.setdefault("registry", self.registry)
         for i in range(pool_size):
             engines.append(ServingEngine(

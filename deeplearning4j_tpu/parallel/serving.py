@@ -134,7 +134,7 @@ class ServingEngine:
         fingerprint (the fleet router's swap path sets it)
     """
 
-    def __init__(self, model, *, batch_limit: int = 32,
+    def __init__(self, model, *, batch_limit: Optional[int] = None,
                  queue_limit: int = 128, timeout_ms: float = 5.0,
                  depth: int = 1, pipelined: bool = True,
                  replicas: Union[int, str] = 1,
@@ -145,9 +145,18 @@ class ServingEngine:
                  warmup: Optional[bool] = None,
                  aot_cache_dir: Optional[str] = None,
                  model_version: Optional[str] = None,
+                 tuned_config=None,
                  tracer=None, registry=None, watchdog=None,
                  session_id: str = "serve"):
         import jax
+        # explicit batch_limit > TunedConfig (this engine's, else the
+        # process-wide one) > the committed default of 32 — the autotune
+        # resolution ladder; an engine that never sees a tuned config
+        # behaves exactly as before
+        from deeplearning4j_tpu.optimize.autotune import resolve_tuned
+        batch_limit = int(resolve_tuned(batch_limit, tuned_config,
+                                        "serving.batch_limit"))
+        self.tuned_config = tuned_config
         if batch_limit < 1:
             raise ValueError("batch_limit must be >= 1")
         if not 1 <= min_bucket <= batch_limit:

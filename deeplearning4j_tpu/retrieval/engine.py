@@ -94,22 +94,34 @@ class RetrievalEngine:
     """Fused distance+top-k serving over one node's index shards."""
 
     def __init__(self, index: ShardedCorpusIndex, *,
-                 k_ladder: Tuple[int, ...] = (1, 10, 100),
+                 k_ladder: Optional[Tuple[int, ...]] = None,
                  max_batch: int = 64,
                  nprobe: Optional[int] = None,
-                 registry=None, session_id: str = "neighbors"):
+                 registry=None, session_id: str = "neighbors",
+                 tuned_config=None):
+        from deeplearning4j_tpu.optimize.autotune import (
+            resolve_tuned, tuned_value)
         self.registry = registry if registry is not None \
             else default_registry()
         self.session_id = session_id
+        self.tuned_config = tuned_config
         self._lock = threading.Lock()
         self._inflight = 0
         self.max_batch = int(max_batch)
         self.buckets = _pow2_ladder(self.max_batch)
+        k_ladder = resolve_tuned(k_ladder, tuned_config,
+                                 "retrieval.k_ladder")
         self.k_ladder = tuple(sorted(int(k) for k in k_ladder))
         if not self.k_ladder or self.k_ladder[0] < 1:
             raise ValueError(f"bad k ladder {k_ladder!r}")
         self.modes = ["brute"] + (["ivf"] if index.ivf else [])
         if index.ivf:
+            # explicit nprobe > machine-measured tuned value > the
+            # index build's own geometry hint. The registry default (a
+            # scalar) deliberately does NOT apply here: absent any
+            # measurement, the per-index hint knows the geometry better
+            if nprobe is None:
+                nprobe = tuned_value("retrieval.nprobe", tuned_config)
             hint = index.ivf.get("nprobe_hint", 8)
             self.nprobe = min(int(nprobe or hint),
                               index.ivf["clusters"])

@@ -69,6 +69,14 @@ JAX_PLATFORMS=cpu python -m benchmarks.neighbors --smoke
 # from the shared store with zero live compiles, full answers resume,
 # and the survivor SIGTERM-drains to exit 0 deregistered
 JAX_PLATFORMS=cpu python -m benchmarks.neighbors --smoke-cluster
+# autotune tier: one measured sweep (interleaved A/B per tunable)
+# persists a fingerprinted TunedConfig artifact; it must reload
+# bit-for-bit, size a consumer engine whose outputs stay bitwise-equal
+# to direct model.output, and warm a SECOND process from the shared
+# store with zero live compiles; the nprobe recall floor must actually
+# exclude a candidate (constraint, not preference) and the measured
+# winner must be >= the hand-tuned default on the serving tunable
+JAX_PLATFORMS=cpu python -m benchmarks.autotune --smoke
 # elastic tier: with one straggler, bounded-staleness ASYNC_ELASTIC
 # sustains >=1.5x the SYNC round rate with divergence under the
 # hard-sync threshold, and reduces exactly to AVERAGING without one

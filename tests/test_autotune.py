@@ -1,0 +1,514 @@
+"""TunedConfig persistence + resolution contracts (ISSUE 20).
+
+The contracts under test (optimize/autotune.py):
+
+- **roundtrip**: a measured TunedConfig saved into an ArtifactStore
+  reloads value-for-value (JSON-normalized) with outcome ``loaded``.
+- **fingerprint discipline**: EVERY fingerprint field diverging —
+  registry version, jax/jaxlib, backend platform/device kind, model
+  weights, model version, format version — falls through to the
+  committed defaults (empty value map) with outcome ``mismatch``, a
+  reason naming the field, and a flight-recorder breadcrumb; never a
+  crash. None-valued optional expectation fields (weights, model
+  version) are wildcards.
+- **corruption**: a blob mangled through the existing ``store.save``
+  chaos seam fails its checksum at load, is quarantined
+  (``.quarantine`` rename) and falls through to defaults; same for an
+  unreadable manifest. The quarantine means the failure is paid once.
+- **resolution ladder**: explicit argument > engine TunedConfig >
+  process TunedConfig > committed default, in every consumer
+  (ServingEngine geometry, RetrievalEngine nprobe/k-ladder where the
+  index hint stays the fallback, fit's k_steps degrade-not-raise).
+- **nprobe floor**: the sweep's ``choose`` can never pick a candidate
+  excluded by the recall constraint, however fast — the measured
+  0.941@32 spill case as a decision-level regression fixture.
+- **lstm dispatch**: set_dispatch_rules overrides fused_wins at
+  runtime and clears back to the committed (empty) table; the CPU
+  sweep records an explicit scan-fallback decision.
+- **cross-node**: node B (a subprocess) serves from node A's artifact
+  via the shared store: loaded outcome, tuned geometry, node A's AOT
+  table (zero live compiles), bitwise-identical outputs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.chaos import plan as chaosplan
+from deeplearning4j_tpu.chaos.plan import parse_plan
+from deeplearning4j_tpu.observe.flight_recorder import FlightRecorder
+from deeplearning4j_tpu.observe.registry import MetricsRegistry
+from deeplearning4j_tpu.optimize import autotune
+from deeplearning4j_tpu.optimize.autotune import (
+    REGISTRY,
+    TunedConfig,
+    choose,
+    load_tuned,
+    resolve_tuned,
+    save_tuned,
+    set_process_tuned,
+    tuned_value,
+)
+from deeplearning4j_tpu.parallel.aot_cache import ArtifactStore
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_IN = 5
+
+
+def _tiny_model(seed: int = 1):
+    from deeplearning4j_tpu.models.multi_layer_network import (
+        MultiLayerNetwork)
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+    from deeplearning4j_tpu.ops.losses import LossFunction
+    from deeplearning4j_tpu.optimize.updaters import Adam
+    conf = (NeuralNetConfiguration.Builder().seed(seed)
+            .updater(Adam(1e-2)).list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=3, loss=LossFunction.MCXENT))
+            .set_input_type(InputType.feed_forward(N_IN)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+@pytest.fixture(autouse=True)
+def _clean_process_state():
+    """No test may leak an armed chaos plan or an installed process
+    tuned config into the rest of the suite."""
+    yield
+    chaosplan.disarm()
+    set_process_tuned(None)
+
+
+def _fp(**over):
+    fp = autotune.fingerprint()
+    fp.update(over)
+    return fp
+
+
+def _measured(store_dir, values=None, **fp_over):
+    cfg = TunedConfig(values or {"serving.batch_limit": 8},
+                      fingerprint=_fp(**fp_over), source="measured")
+    save_tuned(ArtifactStore(store_dir), cfg)
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# roundtrip + fingerprint discipline
+# ---------------------------------------------------------------------------
+
+
+class TestRoundtrip:
+    def test_save_load_roundtrip(self, tmp_path):
+        values = {"serving.batch_limit": 16, "fit.k_steps": 4,
+                  "retrieval.k_ladder": [10, 100]}
+        cfg = TunedConfig(
+            values,
+            decisions={"fit.k_steps": {"tunable": "fit.k_steps",
+                                       "value": 4, "reason": "r"}},
+            fingerprint=_fp(), source="measured")
+        save_tuned(ArtifactStore(str(tmp_path)), cfg)
+        got = load_tuned(ArtifactStore(str(tmp_path)), expect=_fp(),
+                         registry=MetricsRegistry())
+        assert got.load_outcome == "loaded"
+        assert json.dumps(got.values, sort_keys=True) == \
+            json.dumps(values, sort_keys=True)
+        assert got.decisions["fit.k_steps"]["value"] == 4
+
+    def test_absent_artifact_falls_through(self, tmp_path):
+        got = load_tuned(ArtifactStore(str(tmp_path)), expect=_fp(),
+                         registry=MetricsRegistry())
+        assert got.load_outcome == "absent"
+        assert got.values == {}
+
+    def test_manifest_written_atomically_last(self, tmp_path):
+        """The blob exists before the manifest does — a reader racing
+        the save either sees the complete pair or a clean miss."""
+        _measured(str(tmp_path))
+        d = tmp_path / "objects" / autotune.TUNED_KEY
+        assert (d / autotune.TUNED_BLOB).exists()
+        assert (d / autotune.TUNED_MANIFEST).exists()
+        assert not (d / (autotune.TUNED_MANIFEST + ".tmp")).exists()
+
+    def test_unknown_tunables_in_blob_are_dropped(self, tmp_path):
+        """A future registry's extra keys don't poison an old reader."""
+        _measured(str(tmp_path), values={"serving.batch_limit": 8,
+                                         "not.a.tunable": 99})
+        got = load_tuned(ArtifactStore(str(tmp_path)), expect=_fp(),
+                         registry=MetricsRegistry())
+        assert got.load_outcome == "loaded"
+        assert got.get("serving.batch_limit") == 8
+        assert "not.a.tunable" not in got.values
+
+    def test_save_without_fingerprint_raises(self, tmp_path):
+        with pytest.raises(ValueError):
+            save_tuned(ArtifactStore(str(tmp_path)), TunedConfig())
+
+    def test_expect_none_pins_nothing(self, tmp_path):
+        """``expect=None`` accepts any artifact — an inspection tool
+        reading a foreign store must not need the producing machine's
+        fingerprint (and 'never raises' covers this path too)."""
+        _measured(str(tmp_path), jax="0.0.0-other",
+                  weights_sha256="0" * 64)
+        got = load_tuned(ArtifactStore(str(tmp_path)), expect=None,
+                         registry=MetricsRegistry())
+        assert got.load_outcome == "loaded"
+        assert got.get("serving.batch_limit") == 8
+
+
+class TestFingerprintMismatch:
+    # every field the manifest pins, each diverged one at a time
+    FIELDS = [
+        ("format_version", -1),
+        ("registry_version", -1),
+        ("jax", "0.0.0-other"),
+        ("jaxlib", "0.0.0-other"),
+        ("backend", {"platform": "tpu", "device_kind": "v5e"}),
+        ("weights_sha256", "0" * 64),
+        ("model_version", "other-model"),
+    ]
+
+    @pytest.mark.parametrize("field,bad", FIELDS,
+                             ids=[f for f, _ in FIELDS])
+    def test_each_field_mismatch_falls_through(self, tmp_path, field,
+                                               bad):
+        _measured(str(tmp_path), weights_sha256="a" * 64,
+                  model_version="m1")
+        rec = FlightRecorder(dump_dir=str(tmp_path / "fr"))
+        expect = _fp(weights_sha256="a" * 64, model_version="m1")
+        expect[field] = bad
+        got = load_tuned(ArtifactStore(str(tmp_path)), expect=expect,
+                         registry=MetricsRegistry(), recorder=rec)
+        assert got.load_outcome == "mismatch"
+        assert got.values == {}, \
+            "a mismatched artifact must never apply values"
+        assert field.split(".")[0] in got.load_reason
+        crumb = rec._notes["autotune.tuned_config"]
+        assert crumb["outcome"] == "mismatch"
+        assert field in crumb["reason"]
+
+    def test_none_expectation_fields_are_wildcards(self, tmp_path):
+        """A machine-level consumer (expect carries no weights/model
+        binding) accepts a model-bound artifact from the same machine."""
+        _measured(str(tmp_path), weights_sha256="a" * 64,
+                  model_version="m1")
+        got = load_tuned(ArtifactStore(str(tmp_path)), expect=_fp(),
+                         registry=MetricsRegistry())
+        assert got.load_outcome == "loaded"
+
+    def test_mismatch_counts_by_outcome(self, tmp_path):
+        _measured(str(tmp_path))
+        reg = MetricsRegistry()
+        load_tuned(ArtifactStore(str(tmp_path)),
+                   expect=_fp(jax="0.0.0-other"), registry=reg)
+        text = reg.render()
+        assert 'dl4j_autotune_artifact_loads_total{outcome="mismatch"}' \
+            in text.replace("'", '"')
+
+
+# ---------------------------------------------------------------------------
+# corruption through the store.save chaos seam
+# ---------------------------------------------------------------------------
+
+
+def _arm(text: str):
+    return chaosplan.arm(parse_plan(text, registry=MetricsRegistry()))
+
+
+class TestCorruption:
+    def test_corrupt_blob_quarantined(self, tmp_path):
+        _arm("seed=3;store.save:corrupt(count=1,arg=blob)")
+        _measured(str(tmp_path))
+        chaosplan.disarm()
+        rec = FlightRecorder(dump_dir=str(tmp_path / "fr"))
+        got = load_tuned(ArtifactStore(str(tmp_path)), expect=_fp(),
+                         registry=MetricsRegistry(), recorder=rec)
+        assert got.load_outcome == "corrupt"
+        assert got.values == {}
+        d = tmp_path / "objects" / autotune.TUNED_KEY
+        assert (d / (autotune.TUNED_BLOB + ".quarantine")).exists()
+        assert not (d / autotune.TUNED_BLOB).exists()
+        assert rec._notes["autotune.tuned_config"]["outcome"] == \
+            "corrupt"
+
+    def test_corrupt_manifest_quarantined(self, tmp_path):
+        _arm("seed=3;store.save:corrupt(count=1,arg=manifest)")
+        _measured(str(tmp_path))
+        chaosplan.disarm()
+        got = load_tuned(ArtifactStore(str(tmp_path)), expect=_fp(),
+                         registry=MetricsRegistry())
+        # a mangled manifest either fails JSON parse (quarantined,
+        # corrupt) or parses to a diverged fingerprint (mismatch);
+        # both are fall-throughs, never a crash
+        assert got.load_outcome in ("corrupt", "mismatch")
+        assert got.values == {}
+
+    def test_quarantine_means_paid_once(self, tmp_path):
+        _arm("seed=3;store.save:corrupt(count=1,arg=blob)")
+        _measured(str(tmp_path))
+        chaosplan.disarm()
+        store = ArtifactStore(str(tmp_path))
+        assert load_tuned(store, expect=_fp(),
+                          registry=MetricsRegistry()
+                          ).load_outcome == "corrupt"
+        # second load: the quarantined blob is gone -> clean corrupt
+        # fall-through again (blob unreadable), still no crash
+        again = load_tuned(store, expect=_fp(),
+                           registry=MetricsRegistry())
+        assert again.load_outcome == "corrupt"
+        assert again.values == {}
+
+    def test_resave_after_quarantine_recovers(self, tmp_path):
+        _arm("seed=3;store.save:corrupt(count=1,arg=blob)")
+        _measured(str(tmp_path))
+        chaosplan.disarm()
+        store = ArtifactStore(str(tmp_path))
+        load_tuned(store, expect=_fp(), registry=MetricsRegistry())
+        _measured(str(tmp_path))           # a clean re-tune overwrites
+        got = load_tuned(store, expect=_fp(),
+                         registry=MetricsRegistry())
+        assert got.load_outcome == "loaded"
+        assert got.get("serving.batch_limit") == 8
+
+
+# ---------------------------------------------------------------------------
+# resolution ladder + consumers
+# ---------------------------------------------------------------------------
+
+
+class TestResolution:
+    def test_ladder_explicit_beats_tuned_beats_default(self):
+        cfg = TunedConfig({"serving.batch_limit": 16})
+        assert resolve_tuned(64, cfg, "serving.batch_limit") == 64
+        assert resolve_tuned(None, cfg, "serving.batch_limit") == 16
+        assert resolve_tuned(None, None, "serving.batch_limit") == \
+            REGISTRY["serving.batch_limit"].default
+
+    def test_process_config_is_the_second_fallback(self):
+        set_process_tuned(TunedConfig({"serving.batch_limit": 8}))
+        engine_cfg = TunedConfig({"serving.batch_limit": 16})
+        assert resolve_tuned(None, engine_cfg,
+                             "serving.batch_limit") == 16
+        assert resolve_tuned(None, None, "serving.batch_limit") == 8
+        set_process_tuned(None)
+        assert resolve_tuned(None, None, "serving.batch_limit") == 32
+
+    def test_defaults_config_resolves_to_committed(self):
+        cfg = TunedConfig.defaults()
+        assert cfg.values == {}
+        assert tuned_value("fit.k_steps", cfg) is None
+        assert cfg.effective("fit.k_steps") == 1
+
+    def test_serving_engine_sizes_from_tuned(self):
+        from deeplearning4j_tpu.parallel.serving import ServingEngine
+        model = _tiny_model()
+        cfg = TunedConfig({"serving.batch_limit": 4})
+        eng = ServingEngine(model, tuned_config=cfg,
+                            feature_shape=(N_IN,),
+                            registry=MetricsRegistry(),
+                            session_id="t-tuned")
+        try:
+            assert eng.batch_limit == 4
+            assert eng.ladder[-1] == 4
+        finally:
+            eng.shutdown()
+        eng = ServingEngine(model, batch_limit=2, tuned_config=cfg,
+                            feature_shape=(N_IN,),
+                            registry=MetricsRegistry(),
+                            session_id="t-explicit")
+        try:
+            assert eng.batch_limit == 2    # explicit beats tuned
+        finally:
+            eng.shutdown()
+
+    def test_retrieval_engine_nprobe_ladder(self):
+        from benchmarks.neighbors import blob_corpus
+        from deeplearning4j_tpu.retrieval.engine import RetrievalEngine
+        from deeplearning4j_tpu.retrieval.index import ShardedCorpusIndex
+        corpus = blob_corpus(512, 8, k_blobs=8, seed=0)
+
+        def _idx():
+            # engines take ownership of an index's shard arrays, so
+            # each gets its own (seeded-identical) build
+            return ShardedCorpusIndex.build(corpus, shard_rows=512,
+                                            ivf_clusters=8,
+                                            nprobe_hint=3, seed=0)
+
+        cfg = TunedConfig({"retrieval.nprobe": 5,
+                           "retrieval.k_ladder": [10, 100]})
+        eng = RetrievalEngine(_idx(), max_batch=4, tuned_config=cfg,
+                              registry=MetricsRegistry(),
+                              session_id="t-np")
+        assert eng.nprobe == 5              # tuned beats the hint
+        assert eng.k_ladder == (10, 100)    # tuned ladder applies
+        eng2 = RetrievalEngine(_idx(), max_batch=4, nprobe=2,
+                               tuned_config=cfg,
+                               registry=MetricsRegistry(),
+                               session_id="t-np2")
+        assert eng2.nprobe == 2             # explicit beats tuned
+        eng3 = RetrievalEngine(_idx(), max_batch=4,
+                               registry=MetricsRegistry(),
+                               session_id="t-np3")
+        assert eng3.nprobe == 3             # no tuning -> index hint
+        assert eng3.k_ladder == (1, 10, 100)
+
+    def test_tuned_k_steps_degrades_without_feeder(self):
+        """A machine-tuned fit.k_steps > 1 must not break a fit the
+        feeder can't serve — only an EXPLICIT k_steps raises."""
+        from benchmarks.input_pipeline import (SleepyIterator,
+                                               build_model,
+                                               make_batches)
+        set_process_tuned(TunedConfig({"fit.k_steps": 4}))
+        model = build_model(width=16)
+        batches = make_batches(2, batch=4)
+        # prefetch=0 disables the feeder; tuned k silently degrades
+        model.fit(SleepyIterator(batches, 0.0), epochs=1, prefetch=0)
+        with pytest.raises(ValueError):
+            model.fit(SleepyIterator(batches, 0.0), epochs=1,
+                      k_steps=4, prefetch=0)
+
+
+# ---------------------------------------------------------------------------
+# choose(): the decision rule + the nprobe floor fixture
+# ---------------------------------------------------------------------------
+
+
+class TestChoose:
+    def test_higher_is_better_picks_max(self):
+        d = choose(REGISTRY["serving.batch_limit"],
+                   [(8, 100.0), (16, 150.0), (32, 120.0)])
+        assert d["value"] == 16 and d["score"] == 150.0
+
+    def test_lower_is_better_picks_min(self):
+        d = choose(REGISTRY["generation.prefill_chunk"],
+                   [(0, 40.0), (16, 25.0), (64, 30.0)])
+        assert d["value"] == 16
+
+    def test_tie_prefers_committed_default(self):
+        d = choose(REGISTRY["serving.batch_limit"],
+                   [(8, 100.0), (32, 100.0)])
+        assert d["value"] == 32
+
+    def test_excluded_candidate_never_wins(self):
+        """The measured 0.941@32 spill case as a decision fixture:
+        nprobe=32 is the fastest cell but sits below the recall floor
+        — it must lose to the slower in-floor candidate."""
+        d = choose(REGISTRY["retrieval.nprobe"],
+                   [(32, 900.0), (64, 610.0)],
+                   excluded={32: "recall@10 0.941 below the 0.95 "
+                                 "floor"})
+        assert d["value"] == 64
+        assert d["excluded"] == [[32, "recall@10 0.941 below the 0.95 "
+                                      "floor"]]
+
+    def test_all_excluded_keeps_default(self):
+        d = choose(REGISTRY["retrieval.nprobe"],
+                   [(4, 900.0), (8, 800.0)],
+                   excluded={4: "floor", 8: "floor"})
+        assert d["value"] == REGISTRY["retrieval.nprobe"].default
+        assert d["score"] is None
+        assert "kept default" in d["reason"]
+
+
+# ---------------------------------------------------------------------------
+# lstm dispatch table
+# ---------------------------------------------------------------------------
+
+
+class TestLstmDispatch:
+    def test_runtime_rules_override_and_clear(self):
+        from deeplearning4j_tpu.ops import pallas_lstm
+        assert not pallas_lstm.fused_wins(64, 256, 128)  # committed ()
+        try:
+            pallas_lstm.set_dispatch_rules([[32, 128, 64]])
+            assert pallas_lstm.fused_wins(64, 256, 128)
+            assert not pallas_lstm.fused_wins(8, 256, 128)
+            assert pallas_lstm.dispatch_rules() == ((32, 128, 64),)
+        finally:
+            pallas_lstm.set_dispatch_rules(None)
+        assert pallas_lstm.dispatch_rules() == ()
+
+    def test_process_tuned_installs_rules(self):
+        from deeplearning4j_tpu.ops import pallas_lstm
+        set_process_tuned(TunedConfig(
+            {"ops.lstm_dispatch": [[16, 64, 32]]}))
+        assert pallas_lstm.fused_wins(16, 64, 32)
+        set_process_tuned(None)
+        assert not pallas_lstm.fused_wins(16, 64, 32)
+
+    def test_cpu_sweep_records_explicit_fallback(self):
+        """On a non-TPU backend the tuner must say WHY the table is
+        empty, not leave it silently unpopulated."""
+        import jax
+        if jax.default_backend() == "tpu":
+            pytest.skip("chip attached: the fallback branch is moot")
+        from benchmarks.autotune import sweep_lstm_dispatch
+        d = sweep_lstm_dispatch(rounds=1,
+                                cells=MetricsRegistry().counter(
+                                    "dl4j_autotune_cells_total", "t"))
+        assert d["value"] == []
+        assert d["impl"] == "scan"
+        assert "scan fallback" in d["reason"]
+
+
+# ---------------------------------------------------------------------------
+# two-process cross-node load (node B serves node A's artifact)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestCrossNode:
+    def test_node_b_serves_node_a_artifact(self, tmp_path):
+        from benchmarks.autotune import AOT_KEY
+        from benchmarks.serving import build_model
+        from deeplearning4j_tpu.observe.registry import MetricsRegistry
+        from deeplearning4j_tpu.parallel.serving import ServingEngine
+
+        store = ArtifactStore(str(tmp_path))
+        # node A: a (hand-rolled) measured artifact bound to the bench
+        # model's weights, plus its published AOT executable table
+        model = build_model(width=64)
+        fp = autotune.fingerprint(model.train_state.params,
+                                  model_version="bench")
+        cfg = TunedConfig({"serving.batch_limit": 8},
+                          fingerprint=fp, source="measured")
+        save_tuned(store, cfg)
+        eng = ServingEngine(model, tuned_config=cfg,
+                            feature_shape=(128,),
+                            registry=MetricsRegistry(),
+                            session_id="tune-consumer",
+                            aot_cache_dir=store.cache_dir(AOT_KEY),
+                            model_version="bench")
+        try:
+            x = np.random.default_rng(0).normal(
+                size=(5, 128)).astype(np.float32)
+            want = np.asarray(eng.output(x))
+        finally:
+            eng.shutdown()
+
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        out = subprocess.run(
+            [sys.executable, "-m", "benchmarks.autotune",
+             "--verify-node", "--store", str(tmp_path),
+             "--width", "64", "--seed", "0"],
+            cwd=_ROOT, env=env, capture_output=True, text=True,
+            timeout=600)
+        assert out.returncode == 0, out.stdout + out.stderr
+        report = json.loads(out.stdout.strip().splitlines()[-1])
+        assert report["outcome"] == "loaded"
+        assert report["batch_limit"] == 8
+        assert report["recompiles"] == 0
+        assert report["aot_hits"] >= 1, \
+            "node B compiled instead of loading node A's AOT table"
+        import hashlib
+        assert report["digest"] == hashlib.sha256(
+            want.tobytes()).hexdigest()

@@ -94,6 +94,13 @@ HOT_PATHS = (
     # keep the host trees (vptree/kdtree/lsh/kmeans/sptree) clean of
     # accidental device round-trips
     "deeplearning4j_tpu/clustering",
+    # the tuned-config resolution path runs inside every consumer's
+    # constructor AND fit's per-call setup: a stray device fetch here
+    # would tax every engine start and every fit entry. The module is
+    # json/hashlib bookkeeping by design — the only legitimate host
+    # reads are the fingerprint's one-time weights digest (delegated to
+    # aot_cache's pragma'd site) and save/load file IO.
+    "deeplearning4j_tpu/optimize/autotune.py",
 )
 
 PATTERNS = (
